@@ -1,0 +1,15 @@
+from analytics_zoo_tpu.common.nncontext import (
+    init_nncontext,
+    get_nncontext,
+    NNContext,
+    ZooTpuConf,
+)
+from analytics_zoo_tpu.common.config import ZooBuildInfo
+
+__all__ = [
+    "init_nncontext",
+    "get_nncontext",
+    "NNContext",
+    "ZooTpuConf",
+    "ZooBuildInfo",
+]

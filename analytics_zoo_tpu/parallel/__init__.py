@@ -1,0 +1,19 @@
+from analytics_zoo_tpu.parallel.mesh import (
+    ShardingRules,
+    logical_sharding,
+    shard_params,
+    shard_batch,
+    DP_RULES,
+    FSDP_RULES,
+    TP_RULES,
+)
+
+__all__ = [
+    "ShardingRules",
+    "logical_sharding",
+    "shard_params",
+    "shard_batch",
+    "DP_RULES",
+    "FSDP_RULES",
+    "TP_RULES",
+]

@@ -1,0 +1,116 @@
+"""Sharding rules & helpers — the GSPMD replacement for the reference's
+parameter-manager all-reduce (SURVEY.md §2.10).
+
+The reference's only parallelism is synchronous data parallel, implemented as
+Spark-shuffle gradient aggregation + block-manager weight broadcast
+(reference `docs/docs/wp-bigdl.md:146-160`, subclassed at
+`Topology.scala:952`). On TPU that whole mechanism is replaced by compiler-
+inserted collectives: we annotate array shardings over a `Mesh` and XLA emits
+the all-reduces over ICI. This module holds the annotation vocabulary.
+
+Design (scaling-book recipe): parameters carry *logical axis names*
+("embed", "mlp", "heads", "kv", "vocab", ...); a `ShardingRules` table maps
+logical names to mesh axes. Swapping DP → FSDP → TP is a table swap, not a
+model change.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class ShardingRules:
+    """Maps logical array-axis names to mesh axis names (or None)."""
+
+    def __init__(self, rules: Mapping[str, "str | tuple | None"]):
+        self.rules = dict(rules)
+
+    def spec(self, logical_axes: Sequence["str | None"]) -> P:
+        return P(*[self.rules.get(a) if a is not None else None
+                   for a in logical_axes])
+
+    def with_overrides(self, **over) -> "ShardingRules":
+        merged = dict(self.rules)
+        merged.update(over)
+        return ShardingRules(merged)
+
+
+# Pure data parallel: params replicated, batch over "data".
+DP_RULES = ShardingRules({
+    "batch": "data",
+})
+
+# ZeRO-3 style: params and optimizer state sharded over the fsdp axis on
+# their largest dim; batch over (data, fsdp).
+FSDP_RULES = ShardingRules({
+    "batch": ("data", "fsdp"),
+    "embed": "fsdp",
+    "vocab": "fsdp",
+})
+
+# Megatron-style tensor parallel on the "model" axis.
+TP_RULES = ShardingRules({
+    "batch": "data",
+    "mlp": "model",
+    "heads": "model",
+    "vocab": "model",
+})
+
+
+def _filter_spec_for_mesh(spec: P, mesh: Mesh) -> P:
+    """Drop mesh axes that don't exist in `mesh` from a PartitionSpec, so
+    rules written for a big mesh degrade gracefully on a small one."""
+    out = []
+    for entry in spec:
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry if a in mesh.axis_names)
+            out.append(kept if kept else None)
+        else:
+            out.append(entry if entry in mesh.axis_names else None)
+    return P(*out)
+
+
+def logical_sharding(mesh: Mesh, rules: ShardingRules,
+                     logical_axes: Sequence["str | None"]) -> NamedSharding:
+    spec = _filter_spec_for_mesh(rules.spec(logical_axes), mesh)
+    return NamedSharding(mesh, spec)
+
+
+def shard_batch(batch: Any, mesh: Mesh,
+                data_axes: "tuple[str, ...]" = ("data", "fsdp")) -> Any:
+    """Device-put a host batch pytree with dim0 sharded over the data axes."""
+    axes = tuple(a for a in data_axes if a in mesh.axis_names)
+
+    def _put(x):
+        x = np.asarray(x)
+        spec = [None] * x.ndim
+        if x.ndim > 0:
+            spec[0] = axes or None
+        return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+
+    return jax.tree_util.tree_map(_put, batch)
+
+
+def shard_params(params: Any, mesh: Mesh,
+                 rules: Optional[ShardingRules] = None,
+                 logical_axes: Any = None) -> Any:
+    """Device-put a parameter pytree.
+
+    If `logical_axes` (a matching pytree of axis-name tuples) is given, each
+    leaf is placed per the rules table; otherwise params are replicated
+    (plain DP — the reference's broadcast-weights semantics).
+    """
+    if logical_axes is None or rules is None:
+        repl = NamedSharding(mesh, P())
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, repl), params)
+    return jax.tree_util.tree_map(
+        lambda x, ax: jax.device_put(
+            x, logical_sharding(mesh, rules, ax)),
+        params, logical_axes)

@@ -1,0 +1,38 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh.
+
+Mirrors the reference's philosophy of testing distributed semantics on
+`local[N]` Spark without a real cluster (SURVEY.md §4.3): N virtual CPU
+devices stand in for N TPU chips; the pjit/GSPMD code paths are identical.
+"""
+
+import os
+
+if not os.environ.get("ZOO_TPU_TEST_REAL_DEVICE"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+if not os.environ.get("ZOO_TPU_TEST_REAL_DEVICE"):
+    # The axon TPU plugin registers itself regardless of JAX_PLATFORMS;
+    # the config update is authoritative.
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_context():
+    """Reset the process-wide NNContext between tests."""
+    yield
+    from analytics_zoo_tpu.common import nncontext
+    nncontext.reset_nncontext()
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(42)

@@ -1,0 +1,77 @@
+import jax
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu import init_nncontext, get_nncontext
+from analytics_zoo_tpu.common.config import MeshConf, ZooTpuConf, parse_axes
+
+
+def test_default_mesh_uses_all_devices():
+    ctx = init_nncontext()
+    assert ctx.num_devices == len(jax.devices())
+    assert ctx.mesh.axis_names == ("data",)
+    assert ctx.data_parallel_size == len(jax.devices())
+
+
+def test_mesh_spec_string():
+    ctx = init_nncontext(tpu_mesh="data=4,model=2")
+    assert dict(ctx.mesh.shape) == {"data": 4, "model": 2}
+
+
+def test_mesh_wildcard():
+    ctx = init_nncontext(tpu_mesh={"data": -1, "model": 2})
+    assert ctx.mesh.shape["model"] == 2
+    assert ctx.mesh.shape["data"] == len(jax.devices()) // 2
+
+
+def test_parse_axes():
+    assert parse_axes("data=8") == {"data": 8}
+    assert parse_axes(None) == {"data": -1}
+    assert parse_axes({"fsdp": 4}) == {"fsdp": 4}
+
+
+def test_batch_divisibility_check():
+    ctx = init_nncontext()
+    ctx.check_batch_size(len(jax.devices()) * 2)
+    with pytest.raises(ValueError):
+        ctx.check_batch_size(len(jax.devices()) + 1)
+
+
+def test_get_or_create():
+    ctx = init_nncontext(app_name="x")
+    assert get_nncontext() is ctx
+
+
+def test_rng_keys_unique():
+    ctx = init_nncontext(seed=3)
+    k1 = ctx.next_rng_key()
+    k2 = ctx.next_rng_key()
+    assert not np.array_equal(jax.random.key_data(k1),
+                              jax.random.key_data(k2))
+    ks = ctx.next_rng_key(4)
+    assert len(ks) == 4
+
+
+def test_mesh_conf_errors():
+    with pytest.raises(ValueError):
+        MeshConf(axes={"a": -1, "b": -1}).resolved_axes(8)
+    with pytest.raises(ValueError):
+        MeshConf(axes={"a": 3}).resolved_axes(8)
+    assert MeshConf(axes={"a": 3}, allow_partial=True).resolved_axes(8) == \
+        {"a": 3}
+
+
+def test_batch_sharding_shapes():
+    ctx = init_nncontext()
+    sh = ctx.batch_sharding(ndim=3)
+    x = np.zeros((len(jax.devices()) * 2, 4, 4), np.float32)
+    y = jax.device_put(x, sh)
+    assert y.sharding.is_equivalent_to(sh, 3)
+
+
+def test_conf_env_overlay(monkeypatch):
+    monkeypatch.setenv("ZOO_TPU_SEED", "99")
+    monkeypatch.setenv("ZOO_TPU_COMPUTE_DTYPE", "float32")
+    conf = ZooTpuConf.from_env()
+    assert conf.seed == 99
+    assert conf.compute_dtype == "float32"
