@@ -1,0 +1,1 @@
+from analytics_zoo_tpu.ops import activations, initializers, regularizers

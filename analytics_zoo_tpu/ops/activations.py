@@ -1,0 +1,64 @@
+"""Activation functions by Keras name.
+
+(reference: activation strings accepted across
+`Z/pipeline/api/keras/layers/*.scala`, e.g. `Dense.scala` `activation` arg;
+standalone layers in `layers/Activation*.scala`.)
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+Activation = Callable[[jnp.ndarray], jnp.ndarray]
+
+
+def linear(x):
+    return x
+
+
+def hard_sigmoid(x):
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+def softmax(x):
+    return jax.nn.softmax(x, axis=-1)
+
+
+def log_softmax(x):
+    return jax.nn.log_softmax(x, axis=-1)
+
+
+_REGISTRY: "dict[str, Activation]" = {
+    "linear": linear,
+    "relu": jax.nn.relu,
+    "relu6": jax.nn.relu6,
+    "tanh": jnp.tanh,
+    "sigmoid": jax.nn.sigmoid,
+    "hard_sigmoid": hard_sigmoid,
+    "softmax": softmax,
+    "log_softmax": log_softmax,
+    "softplus": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+    "elu": jax.nn.elu,
+    "selu": jax.nn.selu,
+    "gelu": jax.nn.gelu,
+    "silu": jax.nn.silu,
+    "swish": jax.nn.silu,
+    "exp": jnp.exp,
+}
+
+
+def get(name: "str | Activation | None") -> Optional[Activation]:
+    """Resolve an activation by name; None and 'linear' → identity-ish None."""
+    if name is None:
+        return None
+    if callable(name):
+        return name
+    key = name.lower()
+    if key not in _REGISTRY:
+        raise ValueError(
+            f"unknown activation '{name}'; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[key]

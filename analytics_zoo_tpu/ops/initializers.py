@@ -1,0 +1,69 @@
+"""Weight initializers (Keras-1 names).
+
+The reference's layers take `init = "glorot_uniform"`-style strings that
+BigDL resolves to init methods; here they resolve to `jax.nn.initializers`
+functions. (reference: `Z/pipeline/api/keras/layers/Dense.scala` `init` arg.)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.nn import initializers as jinit
+
+Initializer = Callable[..., jnp.ndarray]
+
+
+def _uniform_scale(scale=0.05):
+    def init(key, shape, dtype=jnp.float32):
+        return jax.random.uniform(key, shape, dtype, -scale, scale)
+    return init
+
+
+def _normal_scale(stddev=0.05):
+    def init(key, shape, dtype=jnp.float32):
+        return jax.random.normal(key, shape, dtype) * stddev
+    return init
+
+
+def _identity():
+    def init(key, shape, dtype=jnp.float32):
+        if len(shape) != 2 or shape[0] != shape[1]:
+            raise ValueError("identity init requires a square 2D shape, "
+                             f"got {shape}")
+        return jnp.eye(shape[0], dtype=dtype)
+    return init
+
+
+_REGISTRY: "dict[str, Callable[[], Initializer]]" = {
+    "glorot_uniform": lambda: jinit.glorot_uniform(),
+    "glorot_normal": lambda: jinit.glorot_normal(),
+    "xavier": lambda: jinit.glorot_uniform(),
+    "he_uniform": lambda: jinit.he_uniform(),
+    "he_normal": lambda: jinit.he_normal(),
+    "lecun_uniform": lambda: jinit.lecun_uniform(),
+    "lecun_normal": lambda: jinit.lecun_normal(),
+    "orthogonal": lambda: jinit.orthogonal(),
+    "uniform": lambda: _uniform_scale(),
+    "normal": lambda: _normal_scale(),
+    "zero": lambda: jinit.zeros,
+    "zeros": lambda: jinit.zeros,
+    "one": lambda: jinit.ones,
+    "ones": lambda: jinit.ones,
+    "identity": lambda: _identity(),
+}
+
+
+def get(name: "str | Initializer | None") -> Initializer:
+    """Resolve an initializer by Keras name (or pass a callable through)."""
+    if name is None:
+        return jinit.glorot_uniform()
+    if callable(name):
+        return name
+    key = name.lower()
+    if key not in _REGISTRY:
+        raise ValueError(
+            f"unknown initializer '{name}'; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[key]()

@@ -1,0 +1,58 @@
+"""Weight regularizers (L1/L2), Keras-1 style.
+
+(reference: `wRegularizer`/`bRegularizer` args on layers, BigDL
+`L1L2Regularizer`; loss contribution added during training.)
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+Regularizer = Callable[[jnp.ndarray], jnp.ndarray]
+
+
+class L1L2:
+    def __init__(self, l1: float = 0.0, l2: float = 0.0):
+        self.l1 = float(l1)
+        self.l2 = float(l2)
+
+    def __call__(self, w: jnp.ndarray) -> jnp.ndarray:
+        loss = jnp.zeros((), dtype=jnp.float32)
+        if self.l1:
+            loss = loss + self.l1 * jnp.sum(jnp.abs(w)).astype(jnp.float32)
+        if self.l2:
+            loss = loss + self.l2 * jnp.sum(jnp.square(w)).astype(jnp.float32)
+        return loss
+
+    def __repr__(self):
+        return f"L1L2(l1={self.l1}, l2={self.l2})"
+
+
+def l1(v: float = 0.01) -> L1L2:
+    return L1L2(l1=v)
+
+
+def l2(v: float = 0.01) -> L1L2:
+    return L1L2(l2=v)
+
+
+def l1l2(v1: float = 0.01, v2: float = 0.01) -> L1L2:
+    return L1L2(l1=v1, l2=v2)
+
+
+def get(spec) -> Optional[Regularizer]:
+    if spec is None:
+        return None
+    if callable(spec):
+        return spec
+    if isinstance(spec, str):
+        name = spec.lower()
+        if name == "l1":
+            return l1()
+        if name == "l2":
+            return l2()
+        if name in ("l1l2", "l1_l2"):
+            return l1l2()
+    raise ValueError(f"unknown regularizer {spec!r}")

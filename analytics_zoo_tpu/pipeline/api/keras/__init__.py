@@ -1,0 +1,10 @@
+from analytics_zoo_tpu.pipeline.api.keras import layers
+from analytics_zoo_tpu.pipeline.api.keras.engine import (
+    KerasLayer,
+    Input,
+    Variable,
+)
+from analytics_zoo_tpu.pipeline.api.keras.models import Sequential, Model
+
+__all__ = ["KerasLayer", "Input", "Variable", "Sequential", "Model",
+           "layers"]

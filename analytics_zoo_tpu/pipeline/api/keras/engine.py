@@ -1,0 +1,295 @@
+"""Layer/graph engine underneath the Keras-style API (L4 core).
+
+The reference's layers wrap BigDL modules whose kernels bottom out in
+MKL-DNN (SURVEY.md §2.4, §2.11.4). Here a layer is a *pure-functional
+module*: ``build(rng, input_shape) -> params`` produces a pytree and
+``apply(params, x) -> (y, state_updates)`` is a traceable JAX function.
+There is no mutable forward state, so whole models jit/pjit cleanly and XLA
+owns fusion and MXU tiling. Flax is deliberately not used: the Keras-1
+semantics the reference exposes (shape-inference chaining, layer name
+registry, `trainable` freezing, containers-as-layers) are small enough to
+implement directly, and owning the engine keeps every downstream design
+choice (sharding annotations, dtype policy, state threading) explicit.
+
+Conventions:
+- Shapes exclude the batch dimension (Keras-1 style, like the reference's
+  `inputShape` args, e.g. `Z/pipeline/api/keras/layers/Dense.scala`).
+- ``params[layer.name]`` is that layer's own pytree; non-trainable state
+  (e.g. BatchNorm moving stats) lives under the reserved ``"_state"`` key
+  and is updated through the second element of ``apply``'s result.
+- ``Variable`` is the functional-graph handle; the autograd surface
+  (`pipeline.api.autograd`) builds on the same node type (SURVEY.md §2.3
+  maps the reference's symbolic `Variable` to exactly this).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Callable, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Shape = Tuple[int, ...]
+ShapeLike = Union[Shape, List[Shape]]
+
+_name_lock = threading.Lock()
+_name_counters: "dict[str, itertools.count]" = {}
+
+
+def unique_name(prefix: str) -> str:
+    with _name_lock:
+        counter = _name_counters.setdefault(prefix, itertools.count(1))
+        return f"{prefix}_{next(counter)}"
+
+
+def reset_name_registry() -> None:
+    with _name_lock:
+        _name_counters.clear()
+
+
+def as_shape(s) -> Shape:
+    if isinstance(s, int):
+        return (s,)
+    return tuple(int(d) for d in s)
+
+
+def is_multi_shape(s) -> bool:
+    return isinstance(s, list) or (
+        isinstance(s, tuple) and len(s) > 0 and
+        isinstance(s[0], (tuple, list)))
+
+
+class KerasLayer:
+    """Base class for all layers.
+
+    Subclasses implement :meth:`build` (params creation, optional),
+    :meth:`call` (forward), and :meth:`compute_output_shape`.
+    """
+
+    def __init__(self, input_shape: Optional[ShapeLike] = None,
+                 name: Optional[str] = None, trainable: bool = True,
+                 **kwargs):
+        if kwargs:
+            raise TypeError(
+                f"{type(self).__name__}: unexpected kwargs {list(kwargs)}")
+        self.name = name or unique_name(type(self).__name__.lower())
+        self.trainable = trainable
+        self._given_input_shape = (
+            None if input_shape is None else
+            (list(map(as_shape, input_shape))
+             if is_multi_shape(input_shape) else as_shape(input_shape)))
+        self._build_input_shape: Optional[ShapeLike] = None
+        self._output_shape: Optional[ShapeLike] = None
+
+    # -- framework ----------------------------------------------------------
+    def build(self, rng, input_shape: ShapeLike) -> dict:
+        """Create parameters for ``input_shape``; default: no params."""
+        del rng, input_shape
+        return {}
+
+    def call(self, params: dict, inputs, *, training: bool = False,
+             rng=None):
+        raise NotImplementedError(type(self).__name__)
+
+    def apply(self, params: dict, inputs, *, training: bool = False,
+              rng=None):
+        """Forward returning ``(outputs, state_updates)``.
+
+        Only stateful layers (BatchNorm) override this; everything else
+        routes through :meth:`call` with no updates.
+        """
+        return self.call(params, inputs, training=training, rng=rng), {}
+
+    def compute_output_shape(self, input_shape: ShapeLike) -> ShapeLike:
+        return input_shape
+
+    # -- build bookkeeping --------------------------------------------------
+    def init(self, rng, input_shape: Optional[ShapeLike] = None) -> dict:
+        """Build with shape bookkeeping; returns this layer's params."""
+        if input_shape is None:
+            input_shape = self._given_input_shape
+        if input_shape is None:
+            raise ValueError(
+                f"layer {self.name}: input_shape required (pass it to the "
+                "constructor or to init)")
+        self._build_input_shape = input_shape
+        params = self.build(rng, input_shape)
+        self._output_shape = self.compute_output_shape(input_shape)
+        return params
+
+    @property
+    def input_shape(self) -> Optional[ShapeLike]:
+        return self._build_input_shape or self._given_input_shape
+
+    @property
+    def output_shape(self) -> Optional[ShapeLike]:
+        return self._output_shape
+
+    def param_count(self, params: dict) -> int:
+        return sum(int(np.prod(x.shape)) for x in
+                   jax.tree_util.tree_leaves(params))
+
+    def regularizers(self) -> "list[tuple[str, Callable]]":
+        """(param_key, regularizer) pairs contributing to the train loss."""
+        return []
+
+    def regularization_loss(self, params: dict):
+        loss = jnp.zeros((), jnp.float32)
+        for key, reg in self.regularizers():
+            if key in params:
+                loss = loss + reg(params[key])
+        return loss
+
+    # -- functional API -----------------------------------------------------
+    def __call__(self, x: "Variable | Sequence[Variable]") -> "Variable":
+        """Apply this layer to graph variables, creating a new node."""
+        parents = list(x) if isinstance(x, (list, tuple)) else [x]
+        if not all(isinstance(p, Variable) for p in parents):
+            raise TypeError(
+                f"layer {self.name} called on non-Variable input; use "
+                "Input(shape=...) to start a functional graph")
+        in_shape: ShapeLike = (
+            [p.shape for p in parents] if len(parents) > 1
+            else parents[0].shape)
+        out_shape = self.compute_output_shape(in_shape)
+        return Variable(shape=as_shape(out_shape), layer=self,
+                        parents=parents)
+
+    def __repr__(self):
+        return f"{type(self).__name__}(name={self.name})"
+
+
+class _InputLayer(KerasLayer):
+    """Placeholder node for functional graphs (Keras `Input`)."""
+
+    def __init__(self, shape: Shape, name: Optional[str] = None):
+        super().__init__(input_shape=shape, name=name or unique_name("input"))
+        self._output_shape = as_shape(shape)
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        return inputs
+
+    def compute_output_shape(self, input_shape):
+        return input_shape
+
+
+class Variable:
+    """A node in the functional graph.
+
+    Holds the symbolic shape (batch dim excluded) plus the producing layer
+    and parent variables. Arithmetic operator overloads are installed by
+    `pipeline.api.autograd` (mirrors reference `autograd/math.scala:354-594`
+    where `Variable` ops lazily build graph nodes).
+    """
+
+    __slots__ = ("shape", "layer", "parents", "name")
+
+    def __init__(self, shape: Shape, layer: Optional[KerasLayer] = None,
+                 parents: Optional[List["Variable"]] = None,
+                 name: Optional[str] = None):
+        self.shape = as_shape(shape)
+        self.layer = layer
+        self.parents = parents or []
+        self.name = name or (layer.name if layer is not None
+                             else unique_name("var"))
+
+    @property
+    def is_input(self) -> bool:
+        return isinstance(self.layer, _InputLayer) or self.layer is None
+
+    def __repr__(self):
+        return f"Variable(name={self.name}, shape={self.shape})"
+
+    # operator overloads — implementations provided by autograd (lazy import
+    # avoids an engine<->autograd cycle)
+    def _ag(self):
+        from analytics_zoo_tpu.pipeline.api import autograd
+        return autograd
+
+    def __add__(self, other):
+        return self._ag().add(self, other)
+
+    def __radd__(self, other):
+        return self._ag().add(self, other)
+
+    def __sub__(self, other):
+        return self._ag().sub(self, other)
+
+    def __rsub__(self, other):
+        return self._ag().rsub(self, other)
+
+    def __mul__(self, other):
+        return self._ag().mul(self, other)
+
+    def __rmul__(self, other):
+        return self._ag().mul(self, other)
+
+    def __truediv__(self, other):
+        return self._ag().div(self, other)
+
+    def __rtruediv__(self, other):
+        return self._ag().rdiv(self, other)
+
+    def __neg__(self):
+        return self._ag().neg(self)
+
+    def __pow__(self, p):
+        return self._ag().pow(self, p)
+
+    def __getitem__(self, idx):
+        return self._ag().slice_var(self, idx)
+
+    def squeeze(self, dim=None):
+        return self._ag().squeeze(self, dim)
+
+    def expand_dims(self, axis):
+        return self._ag().expand_dims(self, axis)
+
+
+def Input(shape: ShapeLike, name: Optional[str] = None) -> Variable:
+    """Create a functional-graph input placeholder.
+
+    `shape` excludes the batch dimension, matching the reference's
+    `Input(inputShape=...)` (`Z/pipeline/api/keras/models/Topology.scala`).
+    """
+    layer = _InputLayer(as_shape(shape), name=name)
+    return Variable(shape=as_shape(shape), layer=layer, parents=[])
+
+
+def topological_order(outputs: Sequence[Variable]) -> List[Variable]:
+    """Topo-sort the graph feeding ``outputs`` (inputs first)."""
+    order: List[Variable] = []
+    seen: set = set()
+
+    def visit(v: Variable, stack: set):
+        if id(v) in seen:
+            return
+        if id(v) in stack:
+            raise ValueError("cycle detected in layer graph")
+        stack.add(id(v))
+        for p in v.parents:
+            visit(p, stack)
+        stack.discard(id(v))
+        seen.add(id(v))
+        order.append(v)
+
+    for out in outputs:
+        visit(out, set())
+    return order
+
+
+def collect_layers(order: Sequence[Variable]) -> List[KerasLayer]:
+    """Unique non-input layers in topo order (shared layers appear once)."""
+    seen: set = set()
+    layers: List[KerasLayer] = []
+    for v in order:
+        lyr = v.layer
+        if lyr is None or isinstance(lyr, _InputLayer):
+            continue
+        if id(lyr) not in seen:
+            seen.add(id(lyr))
+            layers.append(lyr)
+    return layers

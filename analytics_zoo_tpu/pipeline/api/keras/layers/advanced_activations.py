@@ -1,0 +1,87 @@
+"""Advanced activation layers.
+
+Reference surface: `Z/pipeline/api/keras/layers/{LeakyReLU,ELU,PReLU,SReLU,
+ThresholdedReLU}.scala` + Softmax.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.pipeline.api.keras.engine import KerasLayer, Shape
+
+
+class LeakyReLU(KerasLayer):
+    def __init__(self, alpha: float = 0.3, input_shape=None, name=None,
+                 **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.alpha = float(alpha)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.where(x >= 0, x, self.alpha * x)
+
+
+class ELU(KerasLayer):
+    def __init__(self, alpha: float = 1.0, input_shape=None, name=None,
+                 **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.alpha = float(alpha)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jax.nn.elu(x, alpha=self.alpha)
+
+
+class ThresholdedReLU(KerasLayer):
+    def __init__(self, theta: float = 1.0, input_shape=None, name=None,
+                 **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.theta = float(theta)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.where(x > self.theta, x, jnp.zeros_like(x))
+
+
+class PReLU(KerasLayer):
+    """Learnable leak, one alpha per feature (trailing axis)."""
+
+    def __init__(self, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+
+    def build(self, rng, input_shape: Shape) -> dict:
+        return {"alpha": jnp.full((input_shape[-1],), 0.25, jnp.float32)}
+
+    def call(self, params, x, *, training=False, rng=None):
+        a = params["alpha"].astype(x.dtype)
+        return jnp.where(x >= 0, x, a * x)
+
+
+class SReLU(KerasLayer):
+    """S-shaped ReLU with learnable thresholds/slopes
+    (reference `layers/SReLU.scala`)."""
+
+    def __init__(self, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+
+    def build(self, rng, input_shape: Shape) -> dict:
+        n = input_shape[-1]
+        return {
+            "t_right": jnp.ones((n,), jnp.float32),
+            "a_right": jnp.ones((n,), jnp.float32),
+            "t_left": jnp.zeros((n,), jnp.float32),
+            "a_left": jnp.zeros((n,), jnp.float32),
+        }
+
+    def call(self, params, x, *, training=False, rng=None):
+        tr = params["t_right"].astype(x.dtype)
+        ar = params["a_right"].astype(x.dtype)
+        tl = params["t_left"].astype(x.dtype)
+        al = params["a_left"].astype(x.dtype)
+        y_right = tr + ar * (x - tr)
+        y_left = tl + al * (x - tl)
+        return jnp.where(x >= tr, y_right, jnp.where(x <= tl, y_left, x))
+
+
+class Softmax(KerasLayer):
+    def call(self, params, x, *, training=False, rng=None):
+        return jax.nn.softmax(x, axis=-1)

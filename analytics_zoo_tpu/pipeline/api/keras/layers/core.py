@@ -1,0 +1,256 @@
+"""Core layers: Dense, Activation, Dropout, reshape family.
+
+Reference surface: `Z/pipeline/api/keras/layers/{Dense,Activation,Dropout,
+Flatten,Reshape,Permute,RepeatVector,Masking,Squeeze,ExpandDim,Narrow,
+Select}.scala`. Kernels are jnp/XLA ops — matmuls hit the MXU; elementwise
+ops fuse into neighbors.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.ops import activations, initializers, regularizers
+from analytics_zoo_tpu.pipeline.api.keras.engine import (
+    KerasLayer, Shape, ShapeLike, as_shape)
+
+
+class Dense(KerasLayer):
+    """Fully-connected layer, applied over the last axis.
+
+    (reference `layers/Dense.scala`; golden-tested like `DenseSpec.scala`.)
+    """
+
+    def __init__(self, output_dim: int, init="glorot_uniform",
+                 activation=None, w_regularizer=None, b_regularizer=None,
+                 bias: bool = True, input_shape=None, name=None,
+                 **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.output_dim = int(output_dim)
+        self.kernel_init = initializers.get(init)
+        self.activation = activations.get(activation)
+        self.w_regularizer = regularizers.get(w_regularizer)
+        self.b_regularizer = regularizers.get(b_regularizer)
+        self.bias = bias
+
+    def build(self, rng, input_shape: Shape) -> dict:
+        in_dim = input_shape[-1]
+        k_key, _ = jax.random.split(rng)
+        params = {"kernel": self.kernel_init(k_key, (in_dim, self.output_dim))}
+        if self.bias:
+            params["bias"] = jnp.zeros((self.output_dim,), jnp.float32)
+        return params
+
+    def call(self, params, x, *, training=False, rng=None):
+        y = x @ params["kernel"].astype(x.dtype)
+        if self.bias:
+            y = y + params["bias"].astype(y.dtype)
+        if self.activation is not None:
+            y = self.activation(y)
+        return y
+
+    def compute_output_shape(self, input_shape: Shape) -> Shape:
+        return tuple(input_shape[:-1]) + (self.output_dim,)
+
+    def regularizers(self):
+        out = []
+        if self.w_regularizer is not None:
+            out.append(("kernel", self.w_regularizer))
+        if self.b_regularizer is not None:
+            out.append(("bias", self.b_regularizer))
+        return out
+
+
+class Activation(KerasLayer):
+    """Standalone activation layer (reference `layers/Activation.scala`)."""
+
+    def __init__(self, activation, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.activation = activations.get(activation) or (lambda x: x)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return self.activation(x)
+
+
+class Dropout(KerasLayer):
+    """Inverted dropout (reference `layers/Dropout.scala`)."""
+
+    def __init__(self, p: float, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.p = float(p)
+
+    def call(self, params, x, *, training=False, rng=None):
+        if not training or self.p <= 0.0:
+            return x
+        if rng is None:
+            raise ValueError(f"{self.name}: dropout needs an rng in "
+                             "training mode")
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, jnp.zeros_like(x))
+
+
+class Flatten(KerasLayer):
+    """Flatten all non-batch dims (reference `layers/Flatten.scala`)."""
+
+    def call(self, params, x, *, training=False, rng=None):
+        return x.reshape((x.shape[0], -1))
+
+    def compute_output_shape(self, input_shape: Shape) -> Shape:
+        return (int(np.prod(input_shape)),)
+
+
+class Reshape(KerasLayer):
+    """Reshape non-batch dims; one dim may be -1
+    (reference `layers/Reshape.scala`)."""
+
+    def __init__(self, target_shape, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.target_shape = tuple(int(d) for d in target_shape)
+
+    def _resolve(self, input_shape: Shape) -> Shape:
+        total = int(np.prod(input_shape))
+        tgt = list(self.target_shape)
+        if -1 in tgt:
+            i = tgt.index(-1)
+            known = int(np.prod([d for d in tgt if d != -1]))
+            if known == 0 or total % known != 0:
+                raise ValueError(
+                    f"{self.name}: cannot reshape {input_shape} to "
+                    f"{self.target_shape}")
+            tgt[i] = total // known
+        return tuple(tgt)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return x.reshape((x.shape[0],) + self._resolve(tuple(x.shape[1:])))
+
+    def compute_output_shape(self, input_shape: Shape) -> Shape:
+        return self._resolve(input_shape)
+
+
+class Permute(KerasLayer):
+    """Permute non-batch dims; dims are 1-indexed like Keras
+    (reference `layers/Permute.scala`)."""
+
+    def __init__(self, dims, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.dims = tuple(int(d) for d in dims)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.transpose(x, (0,) + self.dims)
+
+    def compute_output_shape(self, input_shape: Shape) -> Shape:
+        return tuple(input_shape[d - 1] for d in self.dims)
+
+
+class RepeatVector(KerasLayer):
+    """(F,) -> (n, F) (reference `layers/RepeatVector.scala`)."""
+
+    def __init__(self, n: int, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.n = int(n)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.repeat(x[:, None, :], self.n, axis=1)
+
+    def compute_output_shape(self, input_shape: Shape) -> Shape:
+        return (self.n, input_shape[0])
+
+
+class Squeeze(KerasLayer):
+    """Remove a size-1 non-batch dim; 1-indexed over non-batch dims
+    (reference `layers/Squeeze.scala`)."""
+
+    def __init__(self, dim: int, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.dim = int(dim)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.squeeze(x, axis=self.dim)
+
+    def compute_output_shape(self, input_shape: Shape) -> Shape:
+        shape = list(input_shape)
+        if shape[self.dim - 1] != 1:
+            raise ValueError(f"{self.name}: dim {self.dim} of {input_shape} "
+                             "is not 1")
+        del shape[self.dim - 1]
+        return tuple(shape)
+
+
+class ExpandDim(KerasLayer):
+    """Insert a size-1 dim at a non-batch position
+    (reference `layers/ExpandDim.scala`)."""
+
+    def __init__(self, dim: int, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.dim = int(dim)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.expand_dims(x, axis=self.dim)
+
+    def compute_output_shape(self, input_shape: Shape) -> Shape:
+        shape = list(input_shape)
+        shape.insert(self.dim - 1, 1)
+        return tuple(shape)
+
+
+class Narrow(KerasLayer):
+    """Slice `length` elements from `offset` along a dim (1-indexed
+    non-batch dims; reference `layers/Narrow.scala`)."""
+
+    def __init__(self, dim: int, offset: int, length: int = 1,
+                 input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.dim = int(dim)
+        self.offset = int(offset)
+        self.length = int(length)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jax.lax.slice_in_dim(x, self.offset,
+                                    self.offset + self.length,
+                                    axis=self.dim)
+
+    def compute_output_shape(self, input_shape: Shape) -> Shape:
+        shape = list(input_shape)
+        shape[self.dim - 1] = self.length
+        return tuple(shape)
+
+
+class Select(KerasLayer):
+    """Select index along a dim, removing it (reference
+    `layers/Select.scala`)."""
+
+    def __init__(self, dim: int, index: int, input_shape=None, name=None,
+                 **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.dim = int(dim)
+        self.index = int(index)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jax.lax.index_in_dim(x, self.index, axis=self.dim,
+                                    keepdims=False)
+
+    def compute_output_shape(self, input_shape: Shape) -> Shape:
+        shape = list(input_shape)
+        del shape[self.dim - 1]
+        return tuple(shape)
+
+
+class Masking(KerasLayer):
+    """Zero timesteps equal to mask_value (reference
+    `layers/Masking.scala`). Downstream layers see zeros (no mask
+    propagation — JAX models handle masking explicitly)."""
+
+    def __init__(self, mask_value: float = 0.0, input_shape=None, name=None,
+                 **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.mask_value = float(mask_value)
+
+    def call(self, params, x, *, training=False, rng=None):
+        keep = jnp.any(x != self.mask_value, axis=-1, keepdims=True)
+        return jnp.where(keep, x, jnp.zeros_like(x))

@@ -1,0 +1,110 @@
+"""Embedding layers.
+
+Reference surface: `Z/pipeline/api/keras/layers/{Embedding,WordEmbedding,
+SparseEmbedding}.scala`. `WordEmbedding` loads pretrained GloVe-style
+vectors and is frozen by default (`WordEmbedding.scala:49-134`).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.ops import initializers, regularizers
+from analytics_zoo_tpu.pipeline.api.keras.engine import KerasLayer, Shape
+
+
+class Embedding(KerasLayer):
+    """Trainable index→vector lookup (reference `layers/Embedding.scala`).
+
+    Input: int ids of shape (seq,) → output (seq, output_dim). The gather
+    is a `jnp.take` which XLA lowers to an efficient dynamic-gather; on
+    TPU big embedding tables stay in HBM and can be sharded over the
+    "vocab" logical axis (see parallel.mesh.FSDP_RULES).
+    """
+
+    def __init__(self, input_dim: int, output_dim: int, init="uniform",
+                 w_regularizer=None, input_shape=None, name=None,
+                 pad_zero: bool = False, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.input_dim = int(input_dim)
+        self.output_dim = int(output_dim)
+        self.kernel_init = initializers.get(init)
+        self.w_regularizer = regularizers.get(w_regularizer)
+        self.pad_zero = pad_zero  # reserve row 0 as all-zero padding
+
+    def build(self, rng, input_shape: Shape) -> dict:
+        table = self.kernel_init(rng, (self.input_dim, self.output_dim))
+        if self.pad_zero:
+            table = table.at[0].set(0.0)
+        return {"embeddings": table}
+
+    def call(self, params, x, *, training=False, rng=None):
+        ids = x.astype(jnp.int32)
+        return jnp.take(params["embeddings"], ids, axis=0)
+
+    def compute_output_shape(self, input_shape: Shape) -> Shape:
+        return tuple(input_shape) + (self.output_dim,)
+
+    def regularizers(self):
+        if self.w_regularizer is not None:
+            return [("embeddings", self.w_regularizer)]
+        return []
+
+
+class WordEmbedding(KerasLayer):
+    """Pretrained word embeddings, frozen by default
+    (reference `layers/WordEmbedding.scala:49-134`).
+
+    Construct with a numpy weight table, or via
+    :meth:`from_glove` with a GloVe text file + word index.
+    """
+
+    def __init__(self, weights: np.ndarray, trainable: bool = False,
+                 input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name,
+                         trainable=trainable, **kwargs)
+        self.weights = np.asarray(weights, np.float32)
+        self.input_dim, self.output_dim = self.weights.shape
+
+    @staticmethod
+    def from_glove(glove_path: str, word_index: "dict[str, int]",
+                   embedding_dim: Optional[int] = None,
+                   trainable: bool = False, input_shape=None,
+                   name=None) -> "WordEmbedding":
+        """Build a table from a GloVe `word v1 v2 ...` text file; row 0 is
+        the all-zero padding/OOV vector (mirrors `WordEmbedding.scala`'s
+        GloVe loading)."""
+        vectors: "dict[str, np.ndarray]" = {}
+        dim = embedding_dim
+        with open(glove_path, "r", encoding="utf-8") as f:
+            for line in f:
+                parts = line.rstrip().split(" ")
+                word = parts[0]
+                if word not in word_index:
+                    continue
+                vec = np.asarray(parts[1:], np.float32)
+                if dim is None:
+                    dim = vec.shape[0]
+                vectors[word] = vec
+        if dim is None:
+            raise ValueError(f"no usable vectors found in {glove_path}")
+        max_idx = max(word_index.values())
+        table = np.zeros((max_idx + 1, dim), np.float32)
+        for word, idx in word_index.items():
+            if word in vectors:
+                table[idx] = vectors[word]
+        return WordEmbedding(table, trainable=trainable,
+                             input_shape=input_shape, name=name)
+
+    def build(self, rng, input_shape: Shape) -> dict:
+        return {"embeddings": jnp.asarray(self.weights)}
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.take(params["embeddings"], x.astype(jnp.int32), axis=0)
+
+    def compute_output_shape(self, input_shape: Shape) -> Shape:
+        return tuple(input_shape) + (self.output_dim,)

@@ -1,0 +1,131 @@
+"""Merge layers (multi-input combination).
+
+Reference surface: `Z/pipeline/api/keras/layers/Merge.scala` (modes sum,
+mul, concat, ave, cos, dot, max, min) plus the keras2-style Add/Multiply/
+Average/Maximum/Minimum/Concatenate aliases.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.pipeline.api.keras.engine import (
+    KerasLayer, Shape, ShapeLike)
+
+_MODES = ("sum", "mul", "concat", "ave", "cos", "dot", "max", "min")
+
+
+class Merge(KerasLayer):
+    def __init__(self, mode: str = "sum", concat_axis: int = -1,
+                 input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        if mode not in _MODES:
+            raise ValueError(f"merge mode must be one of {_MODES}")
+        self.mode = mode
+        self.concat_axis = int(concat_axis)
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        xs: "list" = list(inputs)
+        if len(xs) < 2:
+            raise ValueError(f"{self.name}: merge needs >= 2 inputs")
+        m = self.mode
+        if m == "sum":
+            out = xs[0]
+            for x in xs[1:]:
+                out = out + x
+            return out
+        if m == "mul":
+            out = xs[0]
+            for x in xs[1:]:
+                out = out * x
+            return out
+        if m == "ave":
+            out = xs[0]
+            for x in xs[1:]:
+                out = out + x
+            return out / float(len(xs))
+        if m == "max":
+            out = xs[0]
+            for x in xs[1:]:
+                out = jnp.maximum(out, x)
+            return out
+        if m == "min":
+            out = xs[0]
+            for x in xs[1:]:
+                out = jnp.minimum(out, x)
+            return out
+        if m == "concat":
+            return jnp.concatenate(xs, axis=self.concat_axis)
+        if m == "dot":
+            # batched dot of flattened vectors → (B, 1)
+            a = xs[0].reshape(xs[0].shape[0], -1)
+            b = xs[1].reshape(xs[1].shape[0], -1)
+            return jnp.sum(a * b, axis=-1, keepdims=True)
+        # cos
+        a = xs[0].reshape(xs[0].shape[0], -1)
+        b = xs[1].reshape(xs[1].shape[0], -1)
+        na = jnp.linalg.norm(a, axis=-1, keepdims=True)
+        nb = jnp.linalg.norm(b, axis=-1, keepdims=True)
+        return jnp.sum(a * b, axis=-1, keepdims=True) / \
+            jnp.maximum(na * nb, 1e-12)
+
+    def compute_output_shape(self, input_shape: ShapeLike) -> Shape:
+        shapes: "list[Shape]" = [tuple(s) for s in input_shape]
+        if self.mode in ("sum", "mul", "ave", "max", "min"):
+            return shapes[0]
+        if self.mode == "concat":
+            axis = self.concat_axis
+            # axis counts the batch dim (Keras convention): -1 or 1-indexed
+            out = list(shapes[0])
+            idx = axis - 1 if axis > 0 else len(out) + axis \
+                if axis < 0 else 0
+            out[idx] = sum(s[idx] for s in shapes)
+            return tuple(out)
+        return (1,)  # dot / cos
+
+
+def merge(inputs, mode="sum", concat_axis=-1, name=None):
+    """Functional helper: ``merge([a, b], mode="concat")``."""
+    return Merge(mode=mode, concat_axis=concat_axis, name=name)(inputs)
+
+
+class _MergeAlias(Merge):
+    _mode = "sum"
+
+    def __init__(self, input_shape=None, name=None, **kwargs):
+        super().__init__(mode=self._mode, input_shape=input_shape,
+                         name=name, **kwargs)
+
+
+class Add(_MergeAlias):
+    _mode = "sum"
+
+
+class Multiply(_MergeAlias):
+    _mode = "mul"
+
+
+class Average(_MergeAlias):
+    _mode = "ave"
+
+
+class Maximum(_MergeAlias):
+    _mode = "max"
+
+
+class Minimum(_MergeAlias):
+    _mode = "min"
+
+
+class Concatenate(Merge):
+    def __init__(self, axis=-1, input_shape=None, name=None, **kwargs):
+        super().__init__(mode="concat", concat_axis=axis,
+                         input_shape=input_shape, name=name, **kwargs)
+
+
+class Dot(Merge):
+    def __init__(self, input_shape=None, name=None, **kwargs):
+        super().__init__(mode="dot", input_shape=input_shape, name=name,
+                         **kwargs)

@@ -1,0 +1,75 @@
+"""Noise layers (reference `Z/pipeline/api/keras/layers/{GaussianNoise,
+GaussianDropout,SpatialDropout1D,SpatialDropout2D,SpatialDropout3D}.scala`).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.pipeline.api.keras.engine import KerasLayer
+
+
+class GaussianNoise(KerasLayer):
+    def __init__(self, sigma: float, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.sigma = float(sigma)
+
+    def call(self, params, x, *, training=False, rng=None):
+        if not training:
+            return x
+        if rng is None:
+            raise ValueError(f"{self.name}: needs rng in training mode")
+        return x + self.sigma * jax.random.normal(rng, x.shape, x.dtype)
+
+
+class GaussianDropout(KerasLayer):
+    def __init__(self, p: float, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.p = float(p)
+
+    def call(self, params, x, *, training=False, rng=None):
+        if not training or self.p <= 0:
+            return x
+        if rng is None:
+            raise ValueError(f"{self.name}: needs rng in training mode")
+        stddev = (self.p / (1.0 - self.p)) ** 0.5
+        return x * (1.0 + stddev *
+                    jax.random.normal(rng, x.shape, x.dtype))
+
+
+class _SpatialDropoutND(KerasLayer):
+    """Drop whole feature maps (channels-last)."""
+
+    ndim = 1
+
+    def __init__(self, p: float = 0.5, dim_ordering="tf", input_shape=None,
+                 name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.p = float(p)
+        self.dim_ordering = dim_ordering
+
+    def call(self, params, x, *, training=False, rng=None):
+        if not training or self.p <= 0:
+            return x
+        if rng is None:
+            raise ValueError(f"{self.name}: needs rng in training mode")
+        keep = 1.0 - self.p
+        if self.dim_ordering == "tf":
+            mask_shape = (x.shape[0],) + (1,) * self.ndim + (x.shape[-1],)
+        else:
+            mask_shape = (x.shape[0], x.shape[1]) + (1,) * self.ndim
+        mask = jax.random.bernoulli(rng, keep, mask_shape)
+        return jnp.where(mask, x / keep, jnp.zeros_like(x))
+
+
+class SpatialDropout1D(_SpatialDropoutND):
+    ndim = 1
+
+
+class SpatialDropout2D(_SpatialDropoutND):
+    ndim = 2
+
+
+class SpatialDropout3D(_SpatialDropoutND):
+    ndim = 3

@@ -1,0 +1,156 @@
+"""Pooling layers (max/avg × 1/2/3D, plus global variants).
+
+Reference surface: `Z/pipeline/api/keras/layers/{MaxPooling1D,MaxPooling2D,
+MaxPooling3D,AveragePooling1D,AveragePooling2D,AveragePooling3D,
+GlobalMaxPooling1D,...}.scala`. All lower to `lax.reduce_window`, which XLA
+fuses with adjacent convs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.pipeline.api.keras.engine import KerasLayer, Shape
+from analytics_zoo_tpu.pipeline.api.keras.layers.conv import (
+    _conv_out_len, _norm_tuple)
+
+
+class _PoolND(KerasLayer):
+    ndim = 2
+    mode = "max"  # or "avg"
+
+    def __init__(self, pool_size=2, strides=None, border_mode="valid",
+                 dim_ordering="tf", input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        n = self.ndim
+        self.pool_size = _norm_tuple(pool_size, n, "pool_size")
+        self.strides = (self.pool_size if strides is None
+                        else _norm_tuple(strides, n, "strides"))
+        if border_mode not in ("valid", "same"):
+            raise ValueError(f"border_mode must be valid|same, "
+                             f"got {border_mode}")
+        self.border_mode = border_mode
+        self.dim_ordering = dim_ordering
+
+    def _window(self):
+        if self.dim_ordering == "tf":
+            return (1,) + self.pool_size + (1,), (1,) + self.strides + (1,)
+        return (1, 1) + self.pool_size, (1, 1) + self.strides
+
+    def call(self, params, x, *, training=False, rng=None):
+        window, strides = self._window()
+        if self.mode == "max":
+            init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+                else jnp.iinfo(x.dtype).min
+            return jax.lax.reduce_window(
+                x, init, jax.lax.max, window, strides,
+                self.border_mode.upper())
+        summed = jax.lax.reduce_window(
+            x, 0.0, jax.lax.add, window, strides, self.border_mode.upper())
+        if self.border_mode == "valid":
+            return summed / float(np.prod(self.pool_size))
+        # "same": divide by actual window size at the edges
+        counts = jax.lax.reduce_window(
+            jnp.ones_like(x), 0.0, jax.lax.add, window, strides, "SAME")
+        return summed / counts
+
+    def compute_output_shape(self, input_shape: Shape) -> Shape:
+        n = self.ndim
+        if self.dim_ordering == "tf":
+            spatial = input_shape[:n]
+            ch = input_shape[n:]
+            out_sp = tuple(_conv_out_len(s, k, st, self.border_mode)
+                           for s, k, st in zip(spatial, self.pool_size,
+                                               self.strides))
+            return out_sp + ch
+        ch = input_shape[:1]
+        spatial = input_shape[1:1 + n]
+        out_sp = tuple(_conv_out_len(s, k, st, self.border_mode)
+                       for s, k, st in zip(spatial, self.pool_size,
+                                           self.strides))
+        return ch + out_sp
+
+
+class MaxPooling1D(_PoolND):
+    ndim, mode = 1, "max"
+
+    def __init__(self, pool_length=2, stride=None, **kwargs):
+        kwargs.setdefault("strides", stride)
+        super().__init__(pool_size=pool_length, **kwargs)
+
+
+class AveragePooling1D(_PoolND):
+    ndim, mode = 1, "avg"
+
+    def __init__(self, pool_length=2, stride=None, **kwargs):
+        kwargs.setdefault("strides", stride)
+        super().__init__(pool_size=pool_length, **kwargs)
+
+
+class MaxPooling2D(_PoolND):
+    ndim, mode = 2, "max"
+
+
+class AveragePooling2D(_PoolND):
+    ndim, mode = 2, "avg"
+
+
+class MaxPooling3D(_PoolND):
+    ndim, mode = 3, "max"
+
+
+class AveragePooling3D(_PoolND):
+    ndim, mode = 3, "avg"
+
+
+class _GlobalPoolND(KerasLayer):
+    ndim = 2
+    mode = "max"
+
+    def __init__(self, dim_ordering="tf", input_shape=None, name=None,
+                 **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.dim_ordering = dim_ordering
+
+    def _axes(self):
+        if self.dim_ordering == "tf":
+            return tuple(range(1, 1 + self.ndim))
+        return tuple(range(2, 2 + self.ndim))
+
+    def call(self, params, x, *, training=False, rng=None):
+        if self.mode == "max":
+            return jnp.max(x, axis=self._axes())
+        return jnp.mean(x, axis=self._axes())
+
+    def compute_output_shape(self, input_shape: Shape) -> Shape:
+        if self.dim_ordering == "tf":
+            return (input_shape[-1],)
+        return (input_shape[0],)
+
+
+class GlobalMaxPooling1D(_GlobalPoolND):
+    ndim, mode = 1, "max"
+
+
+class GlobalAveragePooling1D(_GlobalPoolND):
+    ndim, mode = 1, "avg"
+
+
+class GlobalMaxPooling2D(_GlobalPoolND):
+    ndim, mode = 2, "max"
+
+
+class GlobalAveragePooling2D(_GlobalPoolND):
+    ndim, mode = 2, "avg"
+
+
+class GlobalMaxPooling3D(_GlobalPoolND):
+    ndim, mode = 3, "max"
+
+
+class GlobalAveragePooling3D(_GlobalPoolND):
+    ndim, mode = 3, "avg"

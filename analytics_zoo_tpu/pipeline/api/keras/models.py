@@ -1,0 +1,326 @@
+"""Containers: `Sequential` and functional `Model` (+ shared `KerasNet`).
+
+Analog of reference `Z/pipeline/api/keras/models/Topology.scala:572-889`
+(`Model` graph / `Sequential`). Training methods (`compile/fit/...`) are
+attached in `topology.py`; this module is the structural half: parameter
+init with Keras-style shape-inference chaining, pure forward, summary.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.pipeline.api.keras.engine import (
+    KerasLayer,
+    Shape,
+    ShapeLike,
+    Variable,
+    _InputLayer,
+    as_shape,
+    collect_layers,
+    is_multi_shape,
+    topological_order,
+    unique_name,
+)
+
+
+class KerasNet(KerasLayer):
+    """Shared container behavior. Containers are layers, so they nest."""
+
+    def __init__(self, name: Optional[str] = None):
+        super().__init__(name=name)
+
+    # -- to be provided by subclasses ---------------------------------------
+    @property
+    def layers(self) -> "list[KerasLayer]":
+        raise NotImplementedError
+
+    # -- params -------------------------------------------------------------
+    def init_params(self, rng=None,
+                    input_shape: Optional[ShapeLike] = None) -> dict:
+        """Build the whole parameter pytree.
+
+        ``rng`` defaults to a key from the process NNContext so plain
+        ``model.init_params()`` "just works" after ``init_nncontext()``.
+        """
+        if rng is None:
+            from analytics_zoo_tpu.common.nncontext import get_nncontext
+            rng = get_nncontext().next_rng_key()
+        return self.init(rng, input_shape)
+
+    def forward(self, params: dict, inputs, *, training: bool = False,
+                rng=None):
+        out, _ = self.apply(params, inputs, training=training, rng=rng)
+        return out
+
+    def regularization_loss(self, params: dict):
+        loss = jnp.zeros((), jnp.float32)
+        for lyr in self.layers:
+            sub = params.get(lyr.name, {})
+            loss = loss + lyr.regularization_loss(sub)
+        return loss
+
+    def trainable_mask(self, params: dict) -> dict:
+        """Bool pytree: True where the optimizer should update.
+
+        ``_state`` subtrees (BatchNorm stats) and layers frozen via
+        ``trainable=False`` are masked out (reference analog: `freezeUpTo`,
+        `NetUtils.scala:47-140`).
+        """
+        def mask_layer(lyr: KerasLayer, sub: dict) -> Any:
+            if isinstance(lyr, KerasNet):
+                return {inner.name: mask_layer(inner,
+                                               sub.get(inner.name, {}))
+                        for inner in lyr.layers if inner.name in sub}
+            def leaf_mask(path_leaf):
+                return lyr.trainable
+            out = {}
+            for k, v in sub.items():
+                if k == "_state":
+                    out[k] = jax.tree_util.tree_map(lambda _: False, v)
+                else:
+                    out[k] = jax.tree_util.tree_map(
+                        lambda _: bool(lyr.trainable), v)
+            return out
+        return {lyr.name: mask_layer(lyr, params.get(lyr.name, {}))
+                for lyr in self.layers if lyr.name in params}
+
+    def freeze(self, *layer_names: str) -> "KerasNet":
+        """Freeze named layers (all layers if no names given)."""
+        targets = set(layer_names)
+        for lyr in self.layers:
+            if not targets or lyr.name in targets:
+                lyr.trainable = False
+        return self
+
+    def unfreeze(self, *layer_names: str) -> "KerasNet":
+        targets = set(layer_names)
+        for lyr in self.layers:
+            if not targets or lyr.name in targets:
+                lyr.trainable = True
+        return self
+
+    # -- introspection ------------------------------------------------------
+    def summary(self, params: Optional[dict] = None,
+                line_length: int = 76) -> str:
+        """Printable per-layer summary (reference `Topology.scala:567`)."""
+        rows = [("Layer (type)", "Output Shape", "Param #")]
+        total = 0
+        for lyr in self.layers:
+            n = (lyr.param_count(params.get(lyr.name, {}))
+                 if params else 0)
+            total += n
+            rows.append((f"{lyr.name} ({type(lyr).__name__})",
+                         str(lyr.output_shape), str(n) if params else "?"))
+        widths = [max(len(r[i]) for r in rows) + 2 for i in range(3)]
+        lines = ["=" * line_length]
+        for i, r in enumerate(rows):
+            lines.append("".join(c.ljust(w) for c, w in zip(r, widths)))
+            if i == 0:
+                lines.append("-" * line_length)
+        lines.append("=" * line_length)
+        if params:
+            lines.append(f"Total params: {total}")
+        text = "\n".join(lines)
+        print(text)
+        return text
+
+
+class Sequential(KerasNet):
+    """Linear stack of layers (reference `Topology.scala:779-889`)."""
+
+    def __init__(self, layers: Optional[Sequence[KerasLayer]] = None,
+                 name: Optional[str] = None):
+        super().__init__(name=name or unique_name("sequential"))
+        self._layers: "list[KerasLayer]" = []
+        for lyr in layers or []:
+            self.add(lyr)
+
+    @property
+    def layers(self) -> "list[KerasLayer]":
+        return self._layers
+
+    def add(self, layer: KerasLayer) -> "Sequential":
+        if not isinstance(layer, KerasLayer):
+            raise TypeError(f"expected a KerasLayer, got {type(layer)}")
+        if not self._layers and layer._given_input_shape is None and not \
+                isinstance(layer, KerasNet):
+            raise ValueError(
+                "first layer of a Sequential needs input_shape=...")
+        self._layers.append(layer)
+        return self
+
+    def build(self, rng, input_shape: ShapeLike) -> dict:
+        params = {}
+        shape = input_shape
+        keys = jax.random.split(rng, max(len(self._layers), 1))
+        for key, lyr in zip(keys, self._layers):
+            params[lyr.name] = lyr.init(key, shape)
+            shape = lyr.output_shape
+        return params
+
+    def init(self, rng, input_shape: Optional[ShapeLike] = None) -> dict:
+        if input_shape is None:
+            if not self._layers:
+                raise ValueError("empty Sequential")
+            first = self._layers[0]
+            input_shape = first._given_input_shape
+            if input_shape is None and isinstance(first, KerasNet):
+                # nested container knows its own input shape
+                inner = first
+                while isinstance(inner, Sequential) and inner._layers:
+                    inner = inner._layers[0]
+                input_shape = inner._given_input_shape
+            if input_shape is None:
+                raise ValueError(
+                    "cannot infer input shape; give the first layer "
+                    "input_shape=...")
+        return super().init(rng, input_shape)
+
+    def compute_output_shape(self, input_shape: ShapeLike) -> ShapeLike:
+        shape = input_shape
+        for lyr in self._layers:
+            shape = lyr.compute_output_shape(shape)
+        return shape
+
+    def apply(self, params: dict, inputs, *, training: bool = False,
+              rng=None):
+        x = inputs
+        updates: dict = {}
+        for i, lyr in enumerate(self._layers):
+            sub_rng = jax.random.fold_in(rng, i) if rng is not None else None
+            x, upd = lyr.apply(params[lyr.name], x, training=training,
+                               rng=sub_rng)
+            if upd:
+                updates[lyr.name] = upd
+        return x, updates
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        out, _ = self.apply(params, inputs, training=training, rng=rng)
+        return out
+
+
+class Model(KerasNet):
+    """Functional graph model (reference `Topology.scala:572-658`).
+
+    Built from `Input(...)` variables through layer calls; supports
+    multi-input/multi-output and shared layers (a layer instance used at
+    several nodes contributes one set of params).
+    """
+
+    def __init__(self, inputs: "Variable | Sequence[Variable]",
+                 outputs: "Variable | Sequence[Variable]",
+                 name: Optional[str] = None):
+        super().__init__(name=name or unique_name("model"))
+        self.inputs: "list[Variable]" = (
+            list(inputs) if isinstance(inputs, (list, tuple)) else [inputs])
+        self.outputs: "list[Variable]" = (
+            list(outputs) if isinstance(outputs, (list, tuple))
+            else [outputs])
+        self._order = topological_order(self.outputs)
+        for v in self.inputs:
+            if v not in self._order:
+                raise ValueError(f"input {v} is not connected to outputs")
+        self._graph_layers = collect_layers(self._order)
+        self._multi_out = isinstance(outputs, (list, tuple))
+
+    @property
+    def layers(self) -> "list[KerasLayer]":
+        return self._graph_layers
+
+    def build(self, rng, input_shape: ShapeLike) -> dict:
+        del input_shape  # graph shapes come from the Input variables
+        params = {}
+        keys = jax.random.split(rng, max(len(self._graph_layers), 1))
+        built = {}
+        # walk nodes in order so every layer sees its node input shape
+        for v in self._order:
+            lyr = v.layer
+            if lyr is None or isinstance(lyr, _InputLayer):
+                continue
+            if id(lyr) in built:
+                continue
+            in_shape: ShapeLike = (
+                [p.shape for p in v.parents] if len(v.parents) > 1
+                else v.parents[0].shape)
+            idx = len(built)
+            params[lyr.name] = lyr.init(keys[idx], in_shape)
+            built[id(lyr)] = True
+        return params
+
+    def init(self, rng, input_shape: Optional[ShapeLike] = None) -> dict:
+        shape: ShapeLike = ([v.shape for v in self.inputs]
+                            if len(self.inputs) > 1
+                            else self.inputs[0].shape)
+        return super().init(rng, input_shape or shape)
+
+    def compute_output_shape(self, input_shape: ShapeLike) -> ShapeLike:
+        shapes = [v.shape for v in self.outputs]
+        return shapes if self._multi_out else shapes[0]
+
+    def apply(self, params: dict, inputs, *, training: bool = False,
+              rng=None):
+        xs = list(inputs) if isinstance(inputs, (list, tuple)) else [inputs]
+        if len(xs) != len(self.inputs):
+            raise ValueError(
+                f"model {self.name} expects {len(self.inputs)} inputs, "
+                f"got {len(xs)}")
+        values: "dict[int, Any]" = {id(v): x
+                                    for v, x in zip(self.inputs, xs)}
+        updates: dict = {}
+        for i, v in enumerate(self._order):
+            if id(v) in values:
+                continue
+            lyr = v.layer
+            if lyr is None or isinstance(lyr, _InputLayer):
+                raise ValueError(
+                    f"graph input {v.name} was not fed; it must be listed "
+                    "in Model(inputs=...)")
+            args = [values[id(p)] for p in v.parents]
+            arg = args if len(args) > 1 else args[0]
+            sub_rng = jax.random.fold_in(rng, i) if rng is not None else None
+            out, upd = lyr.apply(params[lyr.name], arg, training=training,
+                                 rng=sub_rng)
+            if upd:
+                # shared layers may emit updates at several nodes; last wins
+                updates[lyr.name] = upd
+            values[id(v)] = out
+        outs = [values[id(v)] for v in self.outputs]
+        return (outs if self._multi_out else outs[0]), updates
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        out, _ = self.apply(params, inputs, training=training, rng=rng)
+        return out
+
+    def new_graph(self, output_names: "list[str]") -> "Model":
+        """Sub-graph ending at the named variables (reference `GraphNet.
+        newGraph`, `NetUtils.scala:47-140` — transfer-learning surgery)."""
+        by_name = {v.name: v for v in self._order}
+        missing = [n for n in output_names if n not in by_name]
+        if missing:
+            raise ValueError(f"no graph nodes named {missing}")
+        outs = [by_name[n] for n in output_names]
+        return Model(self.inputs, outs if len(outs) > 1 else outs[0])
+
+    def freeze_up_to(self, *node_names: str) -> "Model":
+        """Freeze every layer at or before the named nodes (reference
+        `freezeUpTo`)."""
+        by_name = {v.name: v for v in self._order}
+        missing = [n for n in node_names if n not in by_name]
+        if missing:
+            raise ValueError(f"no graph nodes named {missing}")
+        frontier = [by_name[n] for n in node_names]
+        seen = set()
+        while frontier:
+            v = frontier.pop()
+            if id(v) in seen:
+                continue
+            seen.add(id(v))
+            if v.layer is not None and not isinstance(v.layer, _InputLayer):
+                v.layer.trainable = False
+            frontier.extend(v.parents)
+        return self

@@ -1,0 +1,112 @@
+"""Shape-inference battery: compute_output_shape must match actual forward
+shapes for every layer (the contract Sequential chaining relies on)."""
+
+import jax
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.pipeline.api.keras import Sequential, layers as L
+
+CASES = [
+    (lambda: L.Dense(7), (5,)),
+    (lambda: L.Dense(7), (4, 5)),
+    (lambda: L.Activation("relu"), (5,)),
+    (lambda: L.Dropout(0.3), (5,)),
+    (lambda: L.Flatten(), (3, 4, 5)),
+    (lambda: L.Reshape((6, 2)), (3, 4)),
+    (lambda: L.Reshape((-1, 2)), (3, 4)),
+    (lambda: L.Permute((2, 1)), (3, 4)),
+    (lambda: L.RepeatVector(6), (5,)),
+    (lambda: L.Squeeze(2), (3, 1, 4)),
+    (lambda: L.ExpandDim(2), (3, 4)),
+    (lambda: L.Narrow(1, 1, 2), (5, 4)),
+    (lambda: L.Select(1, 2), (5, 4)),
+    (lambda: L.Masking(0.0), (3, 4)),
+    (lambda: L.Convolution1D(6, 3), (10, 4)),
+    (lambda: L.Convolution2D(6, 3, 3), (9, 9, 2)),
+    (lambda: L.Convolution2D(6, 3, 3, border_mode="same", subsample=2),
+     (9, 9, 2)),
+    (lambda: L.Convolution2D(6, 3, 3, dim_ordering="th"), (2, 9, 9)),
+    (lambda: L.Convolution3D(4, 3, 3, 3), (8, 8, 8, 2)),
+    (lambda: L.AtrousConvolution2D(4, 3, 3, atrous_rate=(2, 2)),
+     (9, 9, 2)),
+    (lambda: L.SeparableConvolution2D(5, 3), (8, 8, 2)),
+    (lambda: L.Deconvolution2D(3, 3, subsample=(2, 2)), (5, 5, 2)),
+    (lambda: L.ZeroPadding1D(2), (5, 3)),
+    (lambda: L.ZeroPadding2D((1, 2)), (5, 5, 2)),
+    (lambda: L.Cropping1D((1, 1)), (6, 3)),
+    (lambda: L.Cropping2D(((1, 1), (2, 2))), (8, 8, 2)),
+    (lambda: L.UpSampling1D(2), (5, 3)),
+    (lambda: L.UpSampling2D((2, 3)), (4, 4, 2)),
+    (lambda: L.UpSampling3D((2, 2, 2)), (3, 3, 3, 2)),
+    (lambda: L.MaxPooling1D(2), (6, 3)),
+    (lambda: L.MaxPooling2D(), (8, 8, 3)),
+    (lambda: L.MaxPooling2D(pool_size=3, strides=2, border_mode="same"),
+     (9, 9, 3)),
+    (lambda: L.MaxPooling3D(), (6, 6, 6, 2)),
+    (lambda: L.AveragePooling1D(2), (6, 3)),
+    (lambda: L.AveragePooling2D(), (8, 8, 3)),
+    (lambda: L.AveragePooling2D(border_mode="same", pool_size=3),
+     (8, 8, 3)),
+    (lambda: L.AveragePooling3D(), (6, 6, 6, 2)),
+    (lambda: L.GlobalMaxPooling1D(), (6, 3)),
+    (lambda: L.GlobalMaxPooling2D(), (6, 6, 3)),
+    (lambda: L.GlobalMaxPooling3D(), (4, 4, 4, 2)),
+    (lambda: L.GlobalAveragePooling1D(), (6, 3)),
+    (lambda: L.GlobalAveragePooling2D(), (6, 6, 3)),
+    (lambda: L.GlobalAveragePooling3D(), (4, 4, 4, 2)),
+    (lambda: L.BatchNormalization(), (6,)),
+    (lambda: L.BatchNormalization(), (6, 6, 3)),
+    (lambda: L.LayerNormalization(), (4, 6)),
+    (lambda: L.WithinChannelLRN2D(), (6, 6, 3)),
+    (lambda: L.Embedding(10, 4), (3,)),
+    (lambda: L.SimpleRNN(5), (4, 3)),
+    (lambda: L.SimpleRNN(5, return_sequences=True), (4, 3)),
+    (lambda: L.LSTM(5), (4, 3)),
+    (lambda: L.LSTM(5, return_sequences=True, go_backwards=True), (4, 3)),
+    (lambda: L.GRU(5), (4, 3)),
+    (lambda: L.Bidirectional(L.LSTM(5, return_sequences=True)), (4, 3)),
+    (lambda: L.Bidirectional(L.GRU(5), merge_mode="sum"), (4, 3)),
+    (lambda: L.TimeDistributed(L.Dense(7)), (4, 3)),
+    (lambda: L.LeakyReLU(), (5,)),
+    (lambda: L.ELU(), (5,)),
+    (lambda: L.ThresholdedReLU(), (5,)),
+    (lambda: L.PReLU(), (5,)),
+    (lambda: L.SReLU(), (5,)),
+    (lambda: L.Softmax(), (5,)),
+    (lambda: L.GaussianNoise(0.1), (5,)),
+    (lambda: L.GaussianDropout(0.1), (5,)),
+    (lambda: L.SpatialDropout1D(0.3), (6, 3)),
+    (lambda: L.SpatialDropout2D(0.3), (6, 6, 3)),
+    (lambda: L.SpatialDropout3D(0.3), (4, 4, 4, 2)),
+]
+
+
+@pytest.mark.parametrize("make,in_shape", CASES,
+                         ids=[f"{i}" for i in range(len(CASES))])
+def test_output_shape_matches_forward(make, in_shape):
+    lyr = make()
+    params = lyr.init(jax.random.key(0), in_shape)
+    declared = lyr.compute_output_shape(in_shape)
+    batch = 2
+    if isinstance(lyr, L.Embedding):
+        x = np.zeros((batch,) + in_shape, np.int32)
+    else:
+        x = np.random.RandomState(0).randn(batch, *in_shape) \
+            .astype(np.float32)
+    y, _ = lyr.apply(params, x, training=True, rng=jax.random.key(1))
+    assert tuple(y.shape) == (batch,) + tuple(declared), \
+        f"{type(lyr).__name__}: declared {declared}, actual {y.shape[1:]}"
+
+
+def test_sequential_shape_chaining():
+    m = Sequential()
+    m.add(L.Convolution2D(4, 3, 3, input_shape=(16, 16, 1)))
+    m.add(L.BatchNormalization())
+    m.add(L.MaxPooling2D())
+    m.add(L.Flatten())
+    m.add(L.Dense(10))
+    params = m.init(jax.random.key(0))
+    assert m.output_shape == (10,)
+    x = np.zeros((2, 16, 16, 1), np.float32)
+    assert m.forward(params, x).shape == (2, 10)
