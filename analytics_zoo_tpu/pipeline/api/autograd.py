@@ -1,0 +1,405 @@
+"""Autograd surface (L3): `Variable` ops, `Parameter`, `CustomLoss`,
+`Lambda`.
+
+The reference implements symbolic autograd by lazily wrapping every op in a
+BigDL layer node (`Z/pipeline/api/autograd/math.scala:32-594`,
+`KerasParameter.scala`, `CustomLoss.scala`, `Lambda.scala`). On TPU, JAX
+*is* the autograd — so this module only keeps the reference's authoring
+API: the same op vocabulary building nodes on the functional graph from
+`keras.engine`, differentiated for free by `jax.grad` inside the training
+step.
+
+Axis convention (matches the reference): `axis` counts the batch dimension
+as 0; graph shapes exclude batch, so `axis >= 1` addresses the symbolic
+dims. Reducing over the batch axis inside a graph is not supported.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.pipeline.api.keras.engine import (
+    KerasLayer, Shape, Variable, as_shape, unique_name)
+
+EPSILON = 1e-7
+
+VarOrScalar = Union[Variable, float, int]
+
+
+class _OpLayer(KerasLayer):
+    """A layer wrapping an arbitrary array function, used to lower autograd
+    ops onto the functional graph (the analog of the reference wrapping
+    each op in a BigDL module)."""
+
+    def __init__(self, fn: Callable, shape_fn: Callable, name=None):
+        super().__init__(name=name or unique_name("op"))
+        self.fn = fn
+        self.shape_fn = shape_fn
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        return self.fn(inputs)
+
+    def compute_output_shape(self, input_shape):
+        return self.shape_fn(input_shape)
+
+
+class Lambda(_OpLayer):
+    """User function → layer (reference `autograd/Lambda.scala`).
+
+    Divergence from the reference: the function operates on jnp arrays
+    (it runs under jit and is differentiated by JAX), not on Variables —
+    strictly more expressive since any traceable JAX code is allowed.
+    """
+
+    def __init__(self, function: Callable, output_shape=None,
+                 input_shape=None, name=None):
+        shape_fn = ((lambda s: as_shape(output_shape))
+                    if output_shape is not None else (lambda s: s))
+        super().__init__(function, shape_fn,
+                         name=name or unique_name("lambda"))
+        self._given_input_shape = (None if input_shape is None
+                                   else as_shape(input_shape))
+
+
+class _ParameterLayer(KerasLayer):
+    """Standalone trainable weight (reference `KerasParameter.scala:31-104`).
+    A zero-input graph node whose output is the weight itself."""
+
+    def __init__(self, shape: Shape, init_weight=None, name=None):
+        super().__init__(name=name or unique_name("parameter"))
+        self.shape = as_shape(shape)
+        self.init_weight = (None if init_weight is None
+                            else np.asarray(init_weight, np.float32))
+
+    def build(self, rng, input_shape):
+        if self.init_weight is not None:
+            if tuple(self.init_weight.shape) != self.shape:
+                raise ValueError(
+                    f"init_weight shape {self.init_weight.shape} != "
+                    f"declared {self.shape}")
+            return {"weight": jnp.asarray(self.init_weight)}
+        scale = 0.05
+        return {"weight": jax.random.uniform(
+            rng, self.shape, jnp.float32, -scale, scale)}
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        return params["weight"]
+
+    def compute_output_shape(self, input_shape):
+        return self.shape
+
+
+class _ConstantLayer(KerasLayer):
+    """Literal value node (reference `KerasConstant`,
+    `KerasParameter.scala:181`)."""
+
+    def __init__(self, value, name=None):
+        super().__init__(name=name or unique_name("constant"))
+        self.value = np.asarray(value, np.float32)
+        self.trainable = False
+
+    def build(self, rng, input_shape):
+        return {}
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        return jnp.asarray(self.value)
+
+    def compute_output_shape(self, input_shape):
+        return tuple(self.value.shape)
+
+
+def Parameter(shape, init_weight=None, name=None) -> Variable:
+    """Create a trainable standalone weight variable."""
+    layer = _ParameterLayer(as_shape(shape), init_weight, name=name)
+    return Variable(shape=layer.shape, layer=layer, parents=[])
+
+
+def Constant(value, name=None) -> Variable:
+    layer = _ConstantLayer(value, name=name)
+    return Variable(shape=tuple(layer.value.shape), layer=layer,
+                    parents=[])
+
+
+# ---------------------------------------------------------------------------
+# op builders
+# ---------------------------------------------------------------------------
+
+def _norm_axis(axis: int, var: Variable) -> int:
+    """Reference axis (0 = batch) → runtime array axis; rejects batch."""
+    ndim = len(var.shape) + 1
+    if axis < 0:
+        axis = ndim + axis
+    if axis == 0:
+        raise ValueError("reducing/indexing over the batch axis inside the "
+                         "graph is not supported")
+    return axis
+
+
+def _reduce_shape(shape: Shape, axis: int, keepdims: bool) -> Shape:
+    # axis already normalized (>=1); shape excludes batch
+    idx = axis - 1
+    s = list(shape)
+    if keepdims:
+        s[idx] = 1
+    else:
+        del s[idx]
+    return tuple(s)
+
+
+def _unary(var: Variable, fn: Callable, name: str,
+           shape_fn: Optional[Callable] = None) -> Variable:
+    return _OpLayer(fn, shape_fn or (lambda s: s),
+                    name=unique_name(name))(var)
+
+
+def _binary(a: Variable, b: VarOrScalar, fn: Callable, name: str,
+            shape_fn: Optional[Callable] = None) -> Variable:
+    if isinstance(b, Variable):
+        sf = shape_fn or (lambda shapes: _broadcast_shape(*shapes))
+        return _OpLayer(lambda xs: fn(xs[0], xs[1]), sf,
+                        name=unique_name(name))([a, b])
+    const = b
+    return _OpLayer(lambda x: fn(x, const), shape_fn or (lambda s: s),
+                    name=unique_name(name))(a)
+
+
+def _broadcast_shape(sa: Shape, sb: Shape) -> Shape:
+    out = list(np.broadcast_shapes(tuple(sa), tuple(sb)))
+    return tuple(out)
+
+
+def add(a, b) -> Variable:
+    return _binary(a, b, lambda x, y: x + y, "add")
+
+
+def sub(a, b) -> Variable:
+    return _binary(a, b, lambda x, y: x - y, "sub")
+
+
+def rsub(a, b) -> Variable:
+    return _binary(a, b, lambda x, y: y - x, "rsub")
+
+
+def mul(a, b) -> Variable:
+    return _binary(a, b, lambda x, y: x * y, "mul")
+
+
+def div(a, b) -> Variable:
+    return _binary(a, b, lambda x, y: x / y, "div")
+
+
+def rdiv(a, b) -> Variable:
+    return _binary(a, b, lambda x, y: y / x, "rdiv")
+
+
+def neg(a) -> Variable:
+    return _unary(a, lambda x: -x, "neg")
+
+
+def abs(a) -> Variable:  # noqa: A001 — matches reference AutoGrad.abs
+    return _unary(a, jnp.abs, "abs")
+
+
+def square(a) -> Variable:
+    return _unary(a, jnp.square, "square")
+
+
+def sqrt(a) -> Variable:
+    return _unary(a, jnp.sqrt, "sqrt")
+
+
+def log(a) -> Variable:
+    return _unary(a, jnp.log, "log")
+
+
+def exp(a) -> Variable:
+    return _unary(a, jnp.exp, "exp")
+
+
+def pow(a, p) -> Variable:  # noqa: A001
+    return _unary(a, lambda x: jnp.power(x, p), "pow")
+
+
+def softsign(a) -> Variable:
+    return _unary(a, jax.nn.soft_sign, "softsign")
+
+
+def softplus(a) -> Variable:
+    return _unary(a, jax.nn.softplus, "softplus")
+
+
+def clip(a, min_value: float, max_value: float) -> Variable:
+    return _unary(a, lambda x: jnp.clip(x, min_value, max_value), "clip")
+
+
+def epsilon() -> float:
+    return EPSILON
+
+
+def maximum(a, b) -> Variable:
+    return _binary(a, b, jnp.maximum, "maximum")
+
+
+def minimum(a, b) -> Variable:
+    return _binary(a, b, jnp.minimum, "minimum")
+
+
+def sum(a: Variable, axis: int = 1, keepdims: bool = False) -> Variable:  # noqa: A001
+    ax = _norm_axis(axis, a)
+    return _unary(a, lambda x: jnp.sum(x, axis=ax, keepdims=keepdims),
+                  "sum", lambda s: _reduce_shape(s, ax, keepdims))
+
+
+def mean(a: Variable, axis: int = 1, keepdims: bool = False) -> Variable:
+    ax = _norm_axis(axis, a)
+    return _unary(a, lambda x: jnp.mean(x, axis=ax, keepdims=keepdims),
+                  "mean", lambda s: _reduce_shape(s, ax, keepdims))
+
+
+def max(a: Variable, axis: int = 1, keepdims: bool = False) -> Variable:  # noqa: A001
+    ax = _norm_axis(axis, a)
+    return _unary(a, lambda x: jnp.max(x, axis=ax, keepdims=keepdims),
+                  "max", lambda s: _reduce_shape(s, ax, keepdims))
+
+
+def stack(inputs: Sequence[Variable], axis: int = 1) -> Variable:
+    ax = _norm_axis(axis, inputs[0])
+
+    def shape_fn(shapes):
+        s = list(shapes[0])
+        s.insert(ax - 1, len(inputs))
+        return tuple(s)
+
+    return _OpLayer(lambda xs: jnp.stack(xs, axis=ax), shape_fn,
+                    name=unique_name("stack"))(list(inputs))
+
+
+def expand_dims(a: Variable, axis: int) -> Variable:
+    ax = _norm_axis(axis, a)
+
+    def shape_fn(s):
+        out = list(s)
+        out.insert(ax - 1, 1)
+        return tuple(out)
+
+    return _unary(a, lambda x: jnp.expand_dims(x, ax), "expanddims",
+                  shape_fn)
+
+
+def squeeze(a: Variable, dim: Optional[int] = None) -> Variable:
+    if dim is None:
+        def shape_fn(s):
+            return tuple(d for d in s if d != 1)
+        return _unary(a, lambda x: jnp.squeeze(
+            x, axis=tuple(i for i in range(1, x.ndim)
+                          if x.shape[i] == 1)), "squeeze", shape_fn)
+    ax = _norm_axis(dim, a)
+
+    def shape_fn(s):
+        out = list(s)
+        del out[ax - 1]
+        return tuple(out)
+
+    return _unary(a, lambda x: jnp.squeeze(x, axis=ax), "squeeze",
+                  shape_fn)
+
+
+def contiguous(a: Variable) -> Variable:
+    return _unary(a, lambda x: x, "contiguous")
+
+
+def slice_var(a: Variable, idx) -> Variable:
+    """`v[...]` — numpy basic indexing on non-batch dims (reference
+    Variable.slice/indexSelect)."""
+    full_idx = (slice(None),) + (idx if isinstance(idx, tuple) else (idx,))
+
+    def shape_fn(s):
+        probe = np.zeros((1,) + tuple(s), np.int8)[full_idx]
+        return tuple(probe.shape[1:])
+
+    return _unary(a, lambda x: x[full_idx], "slice", shape_fn)
+
+
+def mm(a: Variable, b: Variable, axes: Optional[Sequence[int]] = None
+       ) -> Variable:
+    """Matrix multiply (reference `AutoGrad.mm`, math.scala)."""
+    def fn(x, y):
+        return jnp.matmul(x, y)
+
+    def shape_fn(shapes):
+        sa, sb = shapes
+        return tuple(sa[:-1]) + (sb[-1],)
+
+    if axes is not None:
+        return batch_dot(a, b, axes)
+    return _OpLayer(lambda xs: fn(xs[0], xs[1]), shape_fn,
+                    name=unique_name("mm"))([a, b])
+
+
+def batch_dot(a: Variable, b: Variable, axes: Sequence[int] = (2, 1)
+              ) -> Variable:
+    """Keras-style batch_dot: contract `axes` (batch-inclusive indices)
+    per-sample."""
+    ax_a, ax_b = axes
+
+    def fn(xs):
+        x, y = xs
+        return jax.vmap(
+            lambda u, v: jnp.tensordot(u, v,
+                                       axes=((ax_a - 1,), (ax_b - 1,))))(
+            x, y)
+
+    def shape_fn(shapes):
+        sa = list(shapes[0])
+        sb = list(shapes[1])
+        del sa[ax_a - 1]
+        del sb[ax_b - 1]
+        return tuple(sa + sb)
+
+    return _OpLayer(fn, shape_fn, name=unique_name("batchdot"))([a, b])
+
+
+def l2_normalize(a: Variable, axis: int = 1) -> Variable:
+    ax = _norm_axis(axis, a)
+    return _unary(
+        a, lambda x: x / jnp.maximum(
+            jnp.linalg.norm(x, axis=ax, keepdims=True), EPSILON),
+        "l2normalize")
+
+
+# ---------------------------------------------------------------------------
+# CustomLoss
+# ---------------------------------------------------------------------------
+
+class CustomLoss:
+    """Build a loss function from a Variable lambda (reference
+    `autograd/CustomLoss.scala:34`).
+
+    ``loss_func(y_true, y_pred)`` receives Variables and returns a
+    Variable (any shape — the result is mean-reduced). The instance is a
+    plain ``(y_true, y_pred) -> scalar`` callable usable anywhere an
+    objective is accepted (see `keras.objectives`).
+    """
+
+    def __init__(self, loss_func: Callable[[Variable, Variable], Variable],
+                 y_pred_shape: Shape, y_true_shape: Optional[Shape] = None):
+        from analytics_zoo_tpu.pipeline.api.keras.engine import Input
+        from analytics_zoo_tpu.pipeline.api.keras.models import Model
+        y_pred_shape = as_shape(y_pred_shape)
+        y_true_shape = (as_shape(y_true_shape) if y_true_shape is not None
+                        else y_pred_shape)
+        y_true_v = Input(y_true_shape, name=unique_name("y_true"))
+        y_pred_v = Input(y_pred_shape, name=unique_name("y_pred"))
+        out = loss_func(y_true_v, y_pred_v)
+        if not isinstance(out, Variable):
+            raise TypeError("loss_func must return a Variable")
+        self._model = Model([y_true_v, y_pred_v], out)
+        self._params = self._model.init(jax.random.key(0))
+
+    def __call__(self, y_true, y_pred):
+        val = self._model.forward(self._params, [y_true, y_pred])
+        return jnp.mean(val)

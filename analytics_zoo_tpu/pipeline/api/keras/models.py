@@ -244,9 +244,11 @@ class Model(KerasNet):
                 continue
             if id(lyr) in built:
                 continue
-            in_shape: ShapeLike = (
-                [p.shape for p in v.parents] if len(v.parents) > 1
-                else v.parents[0].shape)
+            if not v.parents:  # zero-input node (Parameter / Constant)
+                in_shape: ShapeLike = v.shape
+            else:
+                in_shape = ([p.shape for p in v.parents]
+                            if len(v.parents) > 1 else v.parents[0].shape)
             idx = len(built)
             params[lyr.name] = lyr.init(keys[idx], in_shape)
             built[id(lyr)] = True
@@ -281,7 +283,8 @@ class Model(KerasNet):
                     f"graph input {v.name} was not fed; it must be listed "
                     "in Model(inputs=...)")
             args = [values[id(p)] for p in v.parents]
-            arg = args if len(args) > 1 else args[0]
+            arg = (None if not args
+                   else args if len(args) > 1 else args[0])
             sub_rng = jax.random.fold_in(rng, i) if rng is not None else None
             out, upd = lyr.apply(params[lyr.name], arg, training=training,
                                  rng=sub_rng)

@@ -1,0 +1,158 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.pipeline.api import autograd as A
+from analytics_zoo_tpu.pipeline.api.keras import Input, Model, layers as L
+
+
+def _model(inputs, outputs):
+    m = Model(inputs, outputs)
+    return m, m.init(jax.random.key(0))
+
+
+def test_operator_overloads():
+    x = Input((3,))
+    y = Input((3,))
+    out = (x + y) * 2.0 - x / 2.0 + (-y)
+    m, p = _model([x, y], out)
+    a = np.array([[1.0, 2.0, 3.0]], np.float32)
+    b = np.array([[4.0, 5.0, 6.0]], np.float32)
+    expect = (a + b) * 2 - a / 2 - b
+    np.testing.assert_allclose(m.forward(p, [a, b]), expect, rtol=1e-6)
+
+
+def test_unary_ops():
+    x = Input((4,))
+    m, p = _model(x, A.sqrt(A.abs(x * x) + 1e-9))
+    a = np.array([[1.0, -2.0, 3.0, -4.0]], np.float32)
+    np.testing.assert_allclose(m.forward(p, a), np.abs(a), rtol=1e-4)
+
+    m2, p2 = _model(x, A.clip(x, -1.0, 1.0))
+    np.testing.assert_allclose(m2.forward(p2, a),
+                               np.clip(a, -1, 1), rtol=1e-6)
+
+
+def test_reduce_ops_shapes_and_values():
+    x = Input((4, 5))
+    s = A.sum(x, axis=2)
+    assert s.shape == (4,)
+    mn = A.mean(x, axis=1, keepdims=True)
+    assert mn.shape == (1, 5)
+    m, p = _model(x, s)
+    a = np.random.RandomState(0).randn(2, 4, 5).astype(np.float32)
+    np.testing.assert_allclose(m.forward(p, a), a.sum(2), rtol=1e-5)
+
+
+def test_reduce_over_batch_rejected():
+    x = Input((4,))
+    with pytest.raises(ValueError):
+        A.sum(x, axis=0)
+
+
+def test_mm_and_batch_dot():
+    a = Input((3, 4))
+    b = Input((4, 5))
+    out = A.mm(a, b)
+    assert out.shape == (3, 5)
+    m, p = _model([a, b], out)
+    xa = np.random.RandomState(0).randn(2, 3, 4).astype(np.float32)
+    xb = np.random.RandomState(1).randn(2, 4, 5).astype(np.float32)
+    np.testing.assert_allclose(m.forward(p, [xa, xb]), xa @ xb, rtol=1e-4,
+                               atol=1e-5)
+
+    d = A.batch_dot(a, b, axes=(2, 1))
+    assert d.shape == (3, 5)
+
+
+def test_parameter_and_constant():
+    x = Input((3,))
+    w = A.Parameter((3,), init_weight=np.array([1.0, 2.0, 3.0]))
+    c = A.Constant(np.array([10.0, 10.0, 10.0]))
+    out = x * w + c
+    m, p = _model(x, out)
+    a = np.ones((2, 3), np.float32)
+    np.testing.assert_allclose(
+        m.forward(p, a), np.array([[11.0, 12.0, 13.0]] * 2), rtol=1e-6)
+    # parameter is trainable, constant is not
+    mask = m.trainable_mask(p)
+    flat = jax.tree_util.tree_leaves(mask)
+    assert any(flat)
+
+
+def test_parameter_gradient_flows():
+    x = Input((2,))
+    w = A.Parameter((2,), init_weight=np.array([1.0, 1.0]))
+    m, p = _model(x, A.sum(x * w, axis=1, keepdims=True))
+
+    def loss(params, a):
+        return jnp.mean(m.forward(params, a))
+
+    g = jax.grad(loss)(p, np.array([[3.0, 4.0]], np.float32))
+    w_name = w.layer.name
+    np.testing.assert_allclose(g[w_name]["weight"],
+                               np.array([3.0, 4.0]), rtol=1e-6)
+
+
+def test_slice_and_squeeze():
+    x = Input((4, 5))
+    sl = x[1:3]
+    assert sl.shape == (2, 5)
+    m, p = _model(x, sl)
+    a = np.random.RandomState(0).randn(2, 4, 5).astype(np.float32)
+    np.testing.assert_allclose(m.forward(p, a), a[:, 1:3], rtol=1e-6)
+
+    y = Input((1, 5))
+    sq = y.squeeze(1)
+    assert sq.shape == (5,)
+
+
+def test_stack_and_expand_dims():
+    x = Input((4,))
+    y = Input((4,))
+    st = A.stack([x, y], axis=1)
+    assert st.shape == (2, 4)
+    m, p = _model([x, y], st)
+    a = np.ones((3, 4), np.float32)
+    b = np.zeros((3, 4), np.float32)
+    assert m.forward(p, [a, b]).shape == (3, 2, 4)
+
+    e = A.expand_dims(x, 1)
+    assert e.shape == (1, 4)
+
+
+def test_l2_normalize():
+    x = Input((3,))
+    m, p = _model(x, A.l2_normalize(x, axis=1))
+    a = np.array([[3.0, 0.0, 4.0]], np.float32)
+    np.testing.assert_allclose(m.forward(p, a),
+                               np.array([[0.6, 0.0, 0.8]]), rtol=1e-5)
+
+
+def test_lambda_layer():
+    x = Input((4,))
+    out = A.Lambda(lambda v: jnp.tanh(v) * 2.0)(x)
+    m, p = _model(x, out)
+    a = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+    np.testing.assert_allclose(m.forward(p, a), np.tanh(a) * 2, rtol=1e-5)
+
+
+def test_custom_loss():
+    # reference pattern: CustomLoss from (yTrue, yPred) => Variable
+    loss = A.CustomLoss(
+        lambda y_true, y_pred: A.mean(A.square(y_true - y_pred), axis=1),
+        y_pred_shape=(3,))
+    yt = np.array([[1.0, 2.0, 3.0]], np.float32)
+    yp = np.array([[1.5, 2.0, 2.0]], np.float32)
+    expect = np.mean((yt - yp) ** 2)
+    np.testing.assert_allclose(float(loss(yt, yp)), expect, rtol=1e-5)
+
+
+def test_custom_loss_is_differentiable():
+    loss = A.CustomLoss(
+        lambda y_true, y_pred: A.square(y_true - y_pred),
+        y_pred_shape=(2,))
+    g = jax.grad(lambda yp: loss(np.zeros((1, 2), np.float32), yp))(
+        jnp.ones((1, 2)))
+    np.testing.assert_allclose(g, np.full((1, 2), 1.0), rtol=1e-5)
