@@ -1,0 +1,143 @@
+"""Objectives (losses), Keras-1 names and semantics.
+
+Reference surface: `Z/pipeline/api/keras/objectives/` — 15 losses
+(SURVEY.md §2.4): BCE, CCE, SparseCCE, ClassNLL, MSE/MAE/MAPE/MSLE,
+Hinge/SquaredHinge/RankHinge, KLD, Poisson, CosineProximity.
+
+Every loss is a pure ``fn(y_true, y_pred) -> scalar`` (mean over the
+batch), traceable and differentiable; under pjit the mean over a sharded
+batch compiles to a cross-device all-reduce automatically.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Union
+
+import jax
+import jax.numpy as jnp
+
+EPSILON = 1e-7
+
+LossFn = Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray]
+
+
+def mean_squared_error(y_true, y_pred):
+    return jnp.mean(jnp.square(y_pred - y_true))
+
+
+def mean_absolute_error(y_true, y_pred):
+    return jnp.mean(jnp.abs(y_pred - y_true))
+
+
+def mean_absolute_percentage_error(y_true, y_pred):
+    diff = jnp.abs((y_true - y_pred) /
+                   jnp.clip(jnp.abs(y_true), EPSILON, None))
+    return 100.0 * jnp.mean(diff)
+
+
+def mean_squared_logarithmic_error(y_true, y_pred):
+    a = jnp.log(jnp.clip(y_pred, EPSILON, None) + 1.0)
+    b = jnp.log(jnp.clip(y_true, EPSILON, None) + 1.0)
+    return jnp.mean(jnp.square(a - b))
+
+
+def binary_crossentropy(y_true, y_pred):
+    p = jnp.clip(y_pred, EPSILON, 1.0 - EPSILON)
+    return jnp.mean(-(y_true * jnp.log(p) +
+                      (1.0 - y_true) * jnp.log(1.0 - p)))
+
+
+def categorical_crossentropy(y_true, y_pred):
+    p = jnp.clip(y_pred, EPSILON, 1.0)
+    per_sample = -jnp.sum(y_true * jnp.log(p), axis=-1)
+    return jnp.mean(per_sample)
+
+
+def sparse_categorical_crossentropy(y_true, y_pred):
+    labels = y_true.astype(jnp.int32)
+    if labels.ndim == y_pred.ndim:
+        labels = labels[..., 0]
+    p = jnp.clip(y_pred, EPSILON, 1.0)
+    logp = jnp.log(p)
+    picked = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return -jnp.mean(picked)
+
+
+def class_nll(y_true, y_pred):
+    """Negative log-likelihood over log-probabilities (BigDL
+    `ClassNLLCriterion` semantics with 0-based labels; pair with a
+    log_softmax output)."""
+    labels = y_true.astype(jnp.int32)
+    if labels.ndim == y_pred.ndim:
+        labels = labels[..., 0]
+    picked = jnp.take_along_axis(y_pred, labels[..., None], axis=-1)[..., 0]
+    return -jnp.mean(picked)
+
+
+def hinge(y_true, y_pred):
+    return jnp.mean(jnp.maximum(1.0 - y_true * y_pred, 0.0))
+
+
+def squared_hinge(y_true, y_pred):
+    return jnp.mean(jnp.square(jnp.maximum(1.0 - y_true * y_pred, 0.0)))
+
+
+def rank_hinge(y_true, y_pred, margin: float = 1.0):
+    """Pairwise ranking hinge (reference `objectives/RankHinge.scala`,
+    used by KNRM text matching): batch rows alternate
+    positive, negative, positive, negative, ...; y_true is ignored."""
+    scores = y_pred.reshape(-1)
+    pos = scores[0::2]
+    neg = scores[1::2]
+    return jnp.mean(jnp.maximum(margin - pos + neg, 0.0))
+
+
+def kullback_leibler_divergence(y_true, y_pred):
+    t = jnp.clip(y_true, EPSILON, 1.0)
+    p = jnp.clip(y_pred, EPSILON, 1.0)
+    return jnp.mean(jnp.sum(t * jnp.log(t / p), axis=-1))
+
+
+def poisson(y_true, y_pred):
+    return jnp.mean(y_pred - y_true * jnp.log(y_pred + EPSILON))
+
+
+def cosine_proximity(y_true, y_pred):
+    t = y_true / jnp.maximum(
+        jnp.linalg.norm(y_true, axis=-1, keepdims=True), EPSILON)
+    p = y_pred / jnp.maximum(
+        jnp.linalg.norm(y_pred, axis=-1, keepdims=True), EPSILON)
+    return -jnp.mean(jnp.sum(t * p, axis=-1))
+
+
+_REGISTRY: "dict[str, LossFn]" = {
+    "mean_squared_error": mean_squared_error,
+    "mse": mean_squared_error,
+    "mean_absolute_error": mean_absolute_error,
+    "mae": mean_absolute_error,
+    "mean_absolute_percentage_error": mean_absolute_percentage_error,
+    "mape": mean_absolute_percentage_error,
+    "mean_squared_logarithmic_error": mean_squared_logarithmic_error,
+    "msle": mean_squared_logarithmic_error,
+    "binary_crossentropy": binary_crossentropy,
+    "categorical_crossentropy": categorical_crossentropy,
+    "sparse_categorical_crossentropy": sparse_categorical_crossentropy,
+    "class_nll": class_nll,
+    "hinge": hinge,
+    "squared_hinge": squared_hinge,
+    "rank_hinge": rank_hinge,
+    "kullback_leibler_divergence": kullback_leibler_divergence,
+    "kld": kullback_leibler_divergence,
+    "poisson": poisson,
+    "cosine_proximity": cosine_proximity,
+}
+
+
+def get(name: "str | LossFn") -> LossFn:
+    if callable(name):
+        return name
+    key = name.lower()
+    if key not in _REGISTRY:
+        raise ValueError(f"unknown loss '{name}'; known: "
+                         f"{sorted(_REGISTRY)}")
+    return _REGISTRY[key]

@@ -1,0 +1,174 @@
+"""Validation metrics.
+
+Reference surface: `Z/pipeline/api/keras/metrics/{Accuracy,AUC,MAE}.scala`
++ BigDL Top1/Top5/Loss (SURVEY.md §2.4, §5 "Metrics").
+
+Design for jit: each metric exposes ``batch_stats(y_true, y_pred) ->
+dict[str, array]`` (pure, traceable — runs inside the pjit'd eval step,
+so partial sums are all-reduced by XLA across the sharded batch) and
+``aggregate(stats) -> float`` (host-side, after summing stats over
+batches). This splits cleanly across the device/host boundary the way
+BigDL's ValidationMethod accumulates `ValidationResult`s.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Metric:
+    name = "metric"
+
+    def batch_stats(self, y_true, y_pred) -> "dict[str, jnp.ndarray]":
+        raise NotImplementedError
+
+    def aggregate(self, stats: "dict[str, np.ndarray]") -> float:
+        raise NotImplementedError
+
+
+class Accuracy(Metric):
+    """Auto-dispatching accuracy like the reference zoo `Accuracy`
+    (`keras/metrics/Accuracy.scala:36`): softmax outputs → argmax vs
+    (sparse or one-hot) labels; single-unit sigmoid outputs → 0.5
+    threshold."""
+
+    name = "accuracy"
+
+    def batch_stats(self, y_true, y_pred):
+        if y_pred.ndim >= 2 and y_pred.shape[-1] > 1:
+            pred = jnp.argmax(y_pred, axis=-1)
+            if y_true.ndim == y_pred.ndim and y_true.shape[-1] > 1:
+                true = jnp.argmax(y_true, axis=-1)  # one-hot
+            else:
+                true = y_true.reshape(pred.shape).astype(jnp.int32)
+        else:
+            pred = (y_pred.reshape(y_pred.shape[0], -1)[:, 0] >
+                    0.5).astype(jnp.int32)
+            true = y_true.reshape(y_true.shape[0], -1)[:, 0] \
+                .astype(jnp.int32)
+        correct = jnp.sum((pred == true).astype(jnp.float32))
+        count = jnp.asarray(pred.size, jnp.float32)
+        return {"correct": correct, "count": count}
+
+    def aggregate(self, stats):
+        return float(stats["correct"] / np.maximum(stats["count"], 1.0))
+
+
+SparseCategoricalAccuracy = Accuracy
+CategoricalAccuracy = Accuracy
+BinaryAccuracy = Accuracy
+
+
+class Top5Accuracy(Metric):
+    """(BigDL `Top5Accuracy`, used by the ImageNet recipes.)"""
+
+    name = "top5accuracy"
+
+    def batch_stats(self, y_true, y_pred):
+        true = (jnp.argmax(y_true, axis=-1)
+                if y_true.ndim == y_pred.ndim and y_true.shape[-1] > 1
+                else y_true.reshape(y_pred.shape[0]).astype(jnp.int32))
+        _, top5 = jax.lax.top_k(y_pred, 5)
+        correct = jnp.sum(jnp.any(top5 == true[:, None], axis=-1)
+                          .astype(jnp.float32))
+        return {"correct": correct,
+                "count": jnp.asarray(true.size, jnp.float32)}
+
+    def aggregate(self, stats):
+        return float(stats["correct"] / np.maximum(stats["count"], 1.0))
+
+
+class MAE(Metric):
+    """(reference `keras/metrics/MAE.scala:27`.)"""
+
+    name = "mae"
+
+    def batch_stats(self, y_true, y_pred):
+        return {"abs_sum": jnp.sum(jnp.abs(y_pred - y_true))
+                .astype(jnp.float32),
+                "count": jnp.asarray(y_pred.size, jnp.float32)}
+
+    def aggregate(self, stats):
+        return float(stats["abs_sum"] / np.maximum(stats["count"], 1.0))
+
+
+class MSE(Metric):
+    name = "mse"
+
+    def batch_stats(self, y_true, y_pred):
+        return {"sq_sum": jnp.sum(jnp.square(y_pred - y_true))
+                .astype(jnp.float32),
+                "count": jnp.asarray(y_pred.size, jnp.float32)}
+
+    def aggregate(self, stats):
+        return float(stats["sq_sum"] / np.maximum(stats["count"], 1.0))
+
+
+class Loss(Metric):
+    """Wraps a loss fn as a metric (BigDL `Loss` validation method)."""
+
+    name = "loss"
+
+    def __init__(self, loss_fn: Callable):
+        self.loss_fn = loss_fn
+
+    def batch_stats(self, y_true, y_pred):
+        n = jnp.asarray(y_pred.shape[0], jnp.float32)
+        return {"loss_sum": self.loss_fn(y_true, y_pred) * n, "count": n}
+
+    def aggregate(self, stats):
+        return float(stats["loss_sum"] / np.maximum(stats["count"], 1.0))
+
+
+class AUC(Metric):
+    """Streaming ROC-AUC via thresholded confusion counts (reference
+    `keras/metrics/AUC.scala:128`; same approach as tf.metrics.auc)."""
+
+    name = "auc"
+
+    def __init__(self, thresholds: int = 200):
+        self.n_thresholds = int(thresholds)
+
+    def batch_stats(self, y_true, y_pred):
+        scores = y_pred.reshape(-1).astype(jnp.float32)
+        labels = y_true.reshape(-1).astype(jnp.float32)
+        ts = jnp.linspace(0.0, 1.0, self.n_thresholds)
+        pred_pos = scores[None, :] >= ts[:, None]  # (T, N)
+        is_pos = labels[None, :] > 0.5
+        tp = jnp.sum(pred_pos & is_pos, axis=1).astype(jnp.float32)
+        fp = jnp.sum(pred_pos & ~is_pos, axis=1).astype(jnp.float32)
+        pos = jnp.sum(is_pos.astype(jnp.float32))
+        neg = labels.size - pos
+        return {"tp": tp, "fp": fp,
+                "pos": pos, "neg": jnp.asarray(neg, jnp.float32)}
+
+    def aggregate(self, stats):
+        tpr = stats["tp"] / np.maximum(stats["pos"], 1.0)
+        fpr = stats["fp"] / np.maximum(stats["neg"], 1.0)
+        # thresholds ascend → fpr/tpr descend; integrate |trapezoid|
+        return float(np.abs(np.trapezoid(tpr, fpr)))
+
+
+_REGISTRY: "dict[str, Callable[[], Metric]]" = {
+    "accuracy": Accuracy,
+    "acc": Accuracy,
+    "top5accuracy": Top5Accuracy,
+    "top5": Top5Accuracy,
+    "mae": MAE,
+    "mse": MSE,
+    "auc": AUC,
+}
+
+
+def get(spec: "str | Metric") -> Metric:
+    if isinstance(spec, Metric):
+        return spec
+    key = spec.lower()
+    if key not in _REGISTRY:
+        raise ValueError(f"unknown metric '{spec}'; known: "
+                         f"{sorted(_REGISTRY)}")
+    return _REGISTRY[key]()

@@ -1,0 +1,178 @@
+"""Optimizers + learning-rate schedules.
+
+Reference surface: zoo `Adam` with schedules
+(`Z/pipeline/api/keras/optimizers/Adam.scala:124`) and BigDL optim methods
+(SGD + Poly/Warmup used by the Inception recipe,
+`examples/inception/Train.scala:78-89`), plus the TF→BigDL translation
+table (`P/pipeline/api/net.py:592-688`).
+
+Here every optim method is an optax `GradientTransformation` factory with
+a Keras-style class facade. The gradient all-reduce the reference did via
+Spark shuffle is implicit: grads of a pjit'd loss over a sharded batch
+come out already averaged across devices.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import optax
+
+ScheduleLike = Union[float, Callable[[int], float]]
+
+
+# -- LR schedules -----------------------------------------------------------
+
+def poly(lr: float, power: float = 0.5, max_iteration: int = 100000,
+         end_lr: float = 0.0):
+    """BigDL `SGD.Poly` (Inception recipe, Train.scala:83)."""
+    return optax.polynomial_schedule(
+        init_value=lr, end_value=end_lr, power=power,
+        transition_steps=max_iteration)
+
+
+def warmup(base_lr: float, warmup_iterations: int, delta: float = 0.0,
+           after: Optional[Callable[[int], float]] = None):
+    """BigDL `SGD.Warmup`: linear ramp from base_lr by `delta` per
+    iteration for `warmup_iterations`, then `after` (Train.scala:78-89)."""
+    peak = base_lr + delta * warmup_iterations
+    ramp = optax.linear_schedule(base_lr, peak, warmup_iterations)
+    if after is None:
+        return ramp
+    return optax.join_schedules([ramp, after], [warmup_iterations])
+
+
+def exponential_decay(lr: float, decay_rate: float, decay_steps: int,
+                      staircase: bool = False):
+    return optax.exponential_decay(lr, decay_steps, decay_rate,
+                                   staircase=staircase)
+
+
+def step_decay(lr: float, step_size: int, gamma: float = 0.1):
+    return optax.exponential_decay(lr, step_size, gamma, staircase=True)
+
+
+def plateau(lr: float, *args, **kwargs):
+    raise NotImplementedError(
+        "metric-reactive Plateau schedules are host-driven; use "
+        "Estimator's reduce_lr_on_plateau hook (planned) or a step "
+        "schedule")
+
+
+# -- optim methods ----------------------------------------------------------
+
+class ZooOptimizer:
+    """Base facade: `to_optax()` yields the GradientTransformation."""
+
+    def __init__(self, lr: ScheduleLike = 1e-3):
+        self.lr = lr
+
+    def _lr(self):
+        return self.lr
+
+    def to_optax(self) -> optax.GradientTransformation:
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}(lr={self.lr})"
+
+
+class SGD(ZooOptimizer):
+    def __init__(self, lr: ScheduleLike = 0.01, momentum: float = 0.0,
+                 dampening: float = 0.0, nesterov: bool = False,
+                 weight_decay: float = 0.0):
+        super().__init__(lr)
+        self.momentum = momentum
+        self.nesterov = nesterov
+        self.weight_decay = weight_decay
+
+    def to_optax(self):
+        parts = []
+        if self.weight_decay:
+            parts.append(optax.add_decayed_weights(self.weight_decay))
+        parts.append(optax.sgd(self._lr(),
+                               momentum=self.momentum or None,
+                               nesterov=self.nesterov))
+        return optax.chain(*parts)
+
+
+class Adam(ZooOptimizer):
+    """(reference zoo `keras/optimizers/Adam.scala:124` — Adam with an
+    attachable schedule.)"""
+
+    def __init__(self, lr: ScheduleLike = 1e-3, beta_1: float = 0.9,
+                 beta_2: float = 0.999, epsilon: float = 1e-8,
+                 weight_decay: float = 0.0):
+        super().__init__(lr)
+        self.beta_1, self.beta_2, self.epsilon = beta_1, beta_2, epsilon
+        self.weight_decay = weight_decay
+
+    def to_optax(self):
+        if self.weight_decay:
+            return optax.adamw(self._lr(), b1=self.beta_1, b2=self.beta_2,
+                               eps=self.epsilon,
+                               weight_decay=self.weight_decay)
+        return optax.adam(self._lr(), b1=self.beta_1, b2=self.beta_2,
+                          eps=self.epsilon)
+
+
+class AdamW(Adam):
+    def __init__(self, lr=1e-3, weight_decay=0.01, **kw):
+        super().__init__(lr, weight_decay=weight_decay, **kw)
+
+
+class RMSprop(ZooOptimizer):
+    def __init__(self, lr: ScheduleLike = 1e-3, decay_rate: float = 0.9,
+                 epsilon: float = 1e-8):
+        super().__init__(lr)
+        self.decay_rate = decay_rate
+        self.epsilon = epsilon
+
+    def to_optax(self):
+        return optax.rmsprop(self._lr(), decay=self.decay_rate,
+                             eps=self.epsilon)
+
+
+class Adagrad(ZooOptimizer):
+    def to_optax(self):
+        return optax.adagrad(self._lr())
+
+
+class Adadelta(ZooOptimizer):
+    def __init__(self, lr: ScheduleLike = 1.0, rho: float = 0.95,
+                 epsilon: float = 1e-8):
+        super().__init__(lr)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def to_optax(self):
+        return optax.adadelta(self._lr(), rho=self.rho, eps=self.epsilon)
+
+
+class Adamax(ZooOptimizer):
+    def to_optax(self):
+        return optax.adamax(self._lr())
+
+
+_REGISTRY = {
+    "sgd": SGD,
+    "adam": Adam,
+    "adamw": AdamW,
+    "rmsprop": RMSprop,
+    "adagrad": Adagrad,
+    "adadelta": Adadelta,
+    "adamax": Adamax,
+}
+
+
+def get(spec: "str | ZooOptimizer | optax.GradientTransformation"):
+    """Resolve to an optax GradientTransformation."""
+    if isinstance(spec, ZooOptimizer):
+        return spec.to_optax()
+    if isinstance(spec, optax.GradientTransformation):
+        return spec
+    key = spec.lower()
+    if key not in _REGISTRY:
+        raise ValueError(f"unknown optimizer '{spec}'; known: "
+                         f"{sorted(_REGISTRY)}")
+    return _REGISTRY[key]().to_optax()

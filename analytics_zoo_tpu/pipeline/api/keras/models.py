@@ -104,6 +104,111 @@ class KerasNet(KerasLayer):
                 lyr.trainable = True
         return self
 
+    # -- training surface (reference `Topology.scala:128-540`:
+    #    compile/fit/evaluate/predict + tensorboard/checkpoint/clipping) ----
+    def compile(self, optimizer="adam", loss="mse", metrics=None):
+        """Configure training (reference `KerasNet.compile`,
+        `Topology.scala:128-184`; accepts string names, optimizer objects,
+        loss callables incl. `autograd.CustomLoss`)."""
+        from analytics_zoo_tpu.pipeline.estimator import Estimator
+        self._estimator = Estimator(self, optimizer=optimizer, loss=loss,
+                                    metrics=metrics)
+        return self
+
+    @property
+    def estimator(self):
+        est = getattr(self, "_estimator", None)
+        if est is None:
+            raise RuntimeError("call compile(...) first")
+        return est
+
+    def set_tensorboard(self, log_dir: str, app_name: str = "zoo_tpu"):
+        """(reference `Topology.scala:197`)"""
+        self.estimator.set_tensorboard(log_dir, app_name)
+        return self
+
+    def set_checkpoint(self, path: str, trigger=None):
+        """(reference `Topology.scala:238-248`)"""
+        self.estimator.set_checkpoint(path, trigger)
+        return self
+
+    def set_gradient_clipping_by_l2_norm(self, clip_norm: float):
+        """(reference `Topology.scala:254-284`)"""
+        self.estimator.set_gradient_clipping_by_l2_norm(clip_norm)
+        return self
+
+    def set_constant_gradient_clipping(self, min_value, max_value):
+        self.estimator.set_constant_gradient_clipping(min_value, max_value)
+        return self
+
+    def fit(self, x, y=None, batch_size: int = 32, nb_epoch: int = 10,
+            validation_data=None, **kwargs):
+        """Train (reference `KerasNet.fit`, `Topology.scala:336-481`).
+
+        `x` may be numpy array(s) (+ `y`), an `ArrayDataset`, or any
+        object with the FeatureSet protocol (`num_samples` +
+        `iter_batches`)."""
+        return self.estimator.train(
+            x, y, batch_size=batch_size, nb_epoch=nb_epoch,
+            validation_data=validation_data, **kwargs)
+
+    def evaluate(self, x, y=None, batch_size: int = 32):
+        """(reference `Topology.scala:489-540`)"""
+        return self.estimator.evaluate(x, y, batch_size=batch_size)
+
+    def predict(self, x, batch_size: int = 32, distributed: bool = True):
+        """(reference `Predictable`, `pipeline/api/Predictor.scala:203`;
+        `distributed` kept for API parity — execution is always sharded
+        over the mesh)."""
+        del distributed
+        return self.estimator.predict(x, batch_size=batch_size)
+
+    def predict_classes(self, x, batch_size: int = 32,
+                        zero_based_label: bool = True):
+        probs = self.predict(x, batch_size=batch_size)
+        classes = np.argmax(probs, axis=-1)
+        return classes if zero_based_label else classes + 1
+
+    # -- persistence (reference `Topology.scala:754-775` saveModel /
+    #    Net.load; weights-only analog of BigDL checkpoint files) ----------
+    def save_weights(self, path: str):
+        params = self.estimator.params if getattr(
+            self, "_estimator", None) is not None and \
+            self.estimator.params is not None else None
+        if params is None:
+            raise RuntimeError("no parameters to save; fit or init first")
+        flat = {}
+        for kp, leaf in jax.tree_util.tree_leaves_with_path(params):
+            key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                           for p in kp)
+            flat[key] = np.asarray(leaf)
+        np.savez(path, **flat)
+
+    def load_weights(self, path: str):
+        import jax.tree_util as jtu
+        data = np.load(path)
+        est = self.estimator
+        if est.params is None:
+            est._ensure_initialized()
+        leaves_with_path = jtu.tree_leaves_with_path(est.params)
+        new_leaves = []
+        for kp, leaf in leaves_with_path:
+            key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                           for p in kp)
+            if key not in data:
+                raise KeyError(f"weight {key} missing from {path}")
+            saved = data[key]
+            if tuple(saved.shape) != tuple(leaf.shape):
+                raise ValueError(
+                    f"shape mismatch for {key}: saved {saved.shape} vs "
+                    f"model {leaf.shape}")
+            new_leaves.append(saved)
+        treedef = jtu.tree_structure(est.params)
+        est.params = jax.device_put(
+            jtu.tree_unflatten(treedef, new_leaves))
+        est._train_step = None
+        return self
+
     # -- introspection ------------------------------------------------------
     def summary(self, params: Optional[dict] = None,
                 line_length: int = 76) -> str:

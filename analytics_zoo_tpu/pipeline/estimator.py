@@ -1,0 +1,531 @@
+"""Training runtime (L7): `Estimator` — the TPU-native replacement for the
+reference's `InternalDistriOptimizer` → BigDL `DistriOptimizer` stack
+(reference `Topology.scala:902-1145`, `pipeline/estimator/Estimator.scala`).
+
+Where the reference runs two Spark jobs per iteration (replica
+forward/backward, then shuffle-based gradient aggregation + block-manager
+weight broadcast — `docs/docs/wp-bigdl.md:146-160`), here one jit'd
+train-step runs SPMD over the device mesh: the batch is sharded on the
+data axes, parameters are replicated (or FSDP-sharded), and XLA inserts
+the gradient all-reduce over ICI. There is no parameter server and no
+host round-trip in the hot loop; the host only feeds the next sharded
+batch and reads back scalar metrics.
+
+Checkpointing, TensorBoard scalars (Throughput/Loss/LearningRate — the
+same scalars BigDL's TrainSummary records), trigger-based validation, and
+gradient clipping mirror the reference's training features (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple, \
+    Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from analytics_zoo_tpu.common.nncontext import NNContext, get_nncontext, \
+    logger
+from analytics_zoo_tpu.ops import losses as losses_lib
+from analytics_zoo_tpu.ops import metrics as metrics_lib
+from analytics_zoo_tpu.ops import optimizers as optim_lib
+from analytics_zoo_tpu.parallel.mesh import shard_batch, shard_params
+
+
+# ---------------------------------------------------------------------------
+# Triggers (BigDL Trigger analog: EveryEpoch / SeveralIteration / MaxEpoch /
+# MaxIteration — used for validation, checkpoint and stop conditions)
+# ---------------------------------------------------------------------------
+
+class Trigger:
+    def __call__(self, epoch: int, iteration: int,
+                 epoch_end: bool) -> bool:
+        raise NotImplementedError
+
+    @staticmethod
+    def every_epoch() -> "Trigger":
+        return EveryEpoch()
+
+    @staticmethod
+    def several_iteration(n: int) -> "Trigger":
+        return SeveralIteration(n)
+
+    @staticmethod
+    def max_epoch(n: int) -> "Trigger":
+        return MaxEpoch(n)
+
+    @staticmethod
+    def max_iteration(n: int) -> "Trigger":
+        return MaxIteration(n)
+
+
+class EveryEpoch(Trigger):
+    def __call__(self, epoch, iteration, epoch_end):
+        return epoch_end
+
+
+class SeveralIteration(Trigger):
+    def __init__(self, n: int):
+        self.n = int(n)
+
+    def __call__(self, epoch, iteration, epoch_end):
+        return iteration > 0 and iteration % self.n == 0
+
+
+class MaxEpoch(Trigger):
+    def __init__(self, n: int):
+        self.n = int(n)
+
+    def __call__(self, epoch, iteration, epoch_end):
+        return epoch >= self.n
+
+
+class MaxIteration(Trigger):
+    def __init__(self, n: int):
+        self.n = int(n)
+
+    def __call__(self, epoch, iteration, epoch_end):
+        return iteration >= self.n
+
+
+# ---------------------------------------------------------------------------
+# In-memory dataset (the FeatureSet protocol's simplest implementation;
+# feature.FeatureSet provides the cached/sharded/tiered version)
+# ---------------------------------------------------------------------------
+
+class ArrayDataset:
+    """Numpy (x, y) pairs with per-epoch shuffling and fixed-size batches.
+
+    Implements the data protocol the Estimator consumes:
+    ``num_samples`` and ``iter_batches(batch_size, shuffle, seed)``.
+    Incomplete trailing batches are dropped during training (static shapes
+    keep XLA from recompiling; the reference similarly requires
+    batch % cores == 0, `P/pipeline/api/net.py:741-749`).
+    """
+
+    def __init__(self, x, y=None):
+        self.x = x if isinstance(x, (list, tuple)) else [x]
+        self.x = [np.asarray(a) for a in self.x]
+        self.y = None if y is None else np.asarray(y)
+        n = self.x[0].shape[0]
+        for a in self.x:
+            if a.shape[0] != n:
+                raise ValueError("inconsistent sample counts in x")
+        if self.y is not None and self.y.shape[0] != n:
+            raise ValueError("x and y sample counts differ")
+        self._n = n
+
+    @property
+    def num_samples(self) -> int:
+        return self._n
+
+    def iter_batches(self, batch_size: int, shuffle: bool = True,
+                     seed: int = 0, drop_last: bool = True):
+        idx = np.arange(self._n)
+        if shuffle:
+            np.random.RandomState(seed).shuffle(idx)
+        end = (self._n - self._n % batch_size) if drop_last else self._n
+        for start in range(0, end, batch_size):
+            sel = idx[start:start + batch_size]
+            xb = [a[sel] for a in self.x]
+            xb = xb[0] if len(xb) == 1 else xb
+            yb = None if self.y is None else self.y[sel]
+            yield xb, yb
+
+
+def to_dataset(data, y=None):
+    if hasattr(data, "iter_batches"):
+        return data
+    return ArrayDataset(data, y)
+
+
+# ---------------------------------------------------------------------------
+# Estimator
+# ---------------------------------------------------------------------------
+
+@dataclass
+class TrainResult:
+    history: "list[dict]"
+    params: Any
+    opt_state: Any
+    step: int
+
+
+class Estimator:
+    """`Estimator.train/evaluate` (reference
+    `pipeline/estimator/Estimator.scala:31-56`) over a pjit'd step."""
+
+    def __init__(self, model, optimizer="adam", loss="mse",
+                 metrics: Optional[List] = None,
+                 ctx: Optional[NNContext] = None):
+        self.model = model
+        self.ctx = ctx or get_nncontext()
+        self.loss_fn = losses_lib.get(loss)
+        self.metrics = [metrics_lib.get(m) for m in (metrics or [])]
+        self._base_tx = optim_lib.get(optimizer)
+        self._clip: Optional[optax.GradientTransformation] = None
+        self._lr_fn = self._extract_lr_fn(optimizer)
+
+        self.params = None
+        self.opt_state = None
+        self.step = 0
+        self._train_step = None
+        self._eval_step = None
+        self._predict_fn = None
+
+        # training features
+        self.checkpoint_path: Optional[str] = None
+        self.checkpoint_trigger: Trigger = EveryEpoch()
+        self.tensorboard_dir: Optional[str] = None
+        self.tensorboard_app: str = "zoo_tpu"
+        self._tb_writer = None
+
+    # -- knobs (reference `Topology.scala:197-284`) -------------------------
+    @staticmethod
+    def _extract_lr_fn(optimizer):
+        if isinstance(optimizer, optim_lib.ZooOptimizer):
+            lr = optimizer.lr
+            return lr if callable(lr) else (lambda step: lr)
+        return lambda step: float("nan")
+
+    def set_gradient_clipping_by_l2_norm(self, clip_norm: float):
+        self._clip = optax.clip_by_global_norm(clip_norm)
+        self._train_step = None
+        return self
+
+    def set_constant_gradient_clipping(self, min_value: float,
+                                       max_value: float):
+        # optax.clip is symmetric; emulate [min, max] clamping
+        lo, hi = float(min_value), float(max_value)
+
+        def clamp(updates):
+            return jax.tree_util.tree_map(
+                lambda g: jnp.clip(g, lo, hi), updates)
+        self._clip = optax.stateless(lambda u, p=None: clamp(u))
+        self._train_step = None
+        return self
+
+    def set_checkpoint(self, path: str,
+                       trigger: Optional[Trigger] = None):
+        self.checkpoint_path = path
+        if trigger is not None:
+            self.checkpoint_trigger = trigger
+        return self
+
+    def set_tensorboard(self, log_dir: str, app_name: str = "zoo_tpu"):
+        self.tensorboard_dir = log_dir
+        self.tensorboard_app = app_name
+        return self
+
+    def _tb(self):
+        if self.tensorboard_dir is None:
+            return None
+        if self._tb_writer is None:
+            from torch.utils.tensorboard import SummaryWriter
+            self._tb_writer = SummaryWriter(
+                os.path.join(self.tensorboard_dir, self.tensorboard_app))
+        return self._tb_writer
+
+    # -- compiled steps -----------------------------------------------------
+    def _tx(self) -> optax.GradientTransformation:
+        mask = self.model.trainable_mask(self.params)
+        labels = jax.tree_util.tree_map(
+            lambda t: "train" if t else "freeze", mask)
+        parts = []
+        if self._clip is not None:
+            parts.append(self._clip)
+        parts.append(self._base_tx)
+        return optax.multi_transform(
+            {"train": optax.chain(*parts), "freeze": optax.set_to_zero()},
+            labels)
+
+    @staticmethod
+    def _merge_updates(params, updates):
+        """Recursively fold BatchNorm-style state updates into params."""
+        if not isinstance(updates, dict) or not isinstance(params, dict):
+            return updates
+        out = dict(params)
+        for k, v in updates.items():
+            out[k] = Estimator._merge_updates(params.get(k), v)
+        return out
+
+    def _build_train_step(self, tx):
+        model = self.model
+        loss_fn = self.loss_fn
+
+        def train_step(params, opt_state, rng, x, y):
+            def compute_loss(p):
+                out, state_upd = model.apply(p, x, training=True, rng=rng)
+                loss = loss_fn(y, out)
+                loss = loss + model.regularization_loss(p)
+                return loss, state_upd
+
+            (loss, state_upd), grads = jax.value_and_grad(
+                compute_loss, has_aux=True)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            if state_upd:
+                params = Estimator._merge_updates(params, state_upd)
+            return params, opt_state, loss
+
+        return jax.jit(train_step, donate_argnums=(0, 1))
+
+    def _build_eval_step(self):
+        model = self.model
+        metrics = self.metrics
+        loss_fn = self.loss_fn
+
+        def eval_step(params, x, y):
+            out = model.forward(params, x, training=False)
+            stats = {"loss": {
+                "loss_sum": loss_fn(y, out) *
+                jnp.asarray(_batch_dim(x), jnp.float32),
+                "count": jnp.asarray(_batch_dim(x), jnp.float32)}}
+            for m in metrics:
+                stats[m.name] = m.batch_stats(y, out)
+            return stats
+
+        return jax.jit(eval_step)
+
+    def _build_predict_fn(self):
+        model = self.model
+
+        def predict_fn(params, x):
+            return model.forward(params, x, training=False)
+
+        return jax.jit(predict_fn)
+
+    def _ensure_initialized(self, sample_batch=None):
+        if self.params is None:
+            self.params = self.model.init_params(
+                self.ctx.next_rng_key())
+            self.params = shard_params(self.params, self.ctx.mesh)
+        if self.opt_state is None:
+            tx = self._tx()
+            self.opt_state = tx.init(self.params)
+            self._train_step = self._build_train_step(tx)
+        elif self._train_step is None:
+            self._train_step = self._build_train_step(self._tx())
+
+    # -- API ---------------------------------------------------------------
+    def train(self, data, y=None, batch_size: int = 32,
+              nb_epoch: int = 1,
+              validation_data=None,
+              validation_trigger: Optional[Trigger] = None,
+              end_trigger: Optional[Trigger] = None) -> TrainResult:
+        ds = to_dataset(data, y)
+        self.ctx.check_batch_size(batch_size)
+        self._ensure_initialized()
+        tb = self._tb()
+        validation_trigger = validation_trigger or EveryEpoch()
+        base_rng = self.ctx.next_rng_key()
+        history: "list[dict]" = []
+        stop = False
+
+        for epoch in range(1, nb_epoch + 1):
+            epoch_loss, epoch_batches = 0.0, 0
+            t0 = time.time()
+            n_records = 0
+            for xb, yb in ds.iter_batches(batch_size, shuffle=True,
+                                          seed=epoch):
+                xb = shard_batch(xb, self.ctx.mesh)
+                yb = shard_batch(yb, self.ctx.mesh)
+                rng = jax.random.fold_in(base_rng, self.step)
+                self.params, self.opt_state, loss = self._train_step(
+                    self.params, self.opt_state, rng, xb, yb)
+                self.step += 1
+                epoch_batches += 1
+                n_records += batch_size
+                loss_f = float(loss)
+                epoch_loss += loss_f
+                if tb is not None:
+                    tb.add_scalar("Loss", loss_f, self.step)
+                    lr = self._lr_fn(self.step)
+                    if lr == lr:  # not NaN
+                        tb.add_scalar("LearningRate", lr, self.step)
+                if self.checkpoint_path and self.checkpoint_trigger(
+                        epoch, self.step, False):
+                    self.save_checkpoint()
+                if end_trigger is not None and end_trigger(
+                        epoch - 1, self.step, False):
+                    stop = True
+                    break
+
+            dt = max(time.time() - t0, 1e-9)
+            throughput = n_records / dt
+            entry = {"epoch": epoch,
+                     "loss": epoch_loss / max(epoch_batches, 1),
+                     "throughput": throughput, "step": self.step}
+            if tb is not None:
+                tb.add_scalar("Throughput", throughput, self.step)
+            if validation_data is not None and validation_trigger(
+                    epoch, self.step, True):
+                val = self.evaluate(validation_data, batch_size=batch_size)
+                entry.update({f"val_{k}": v for k, v in val.items()})
+                if tb is not None:
+                    for k, v in val.items():
+                        tb.add_scalar(f"Validation/{k}", v, self.step)
+            if self.checkpoint_path and self.checkpoint_trigger(
+                    epoch, self.step, True):
+                self.save_checkpoint()
+            history.append(entry)
+            logger.info("epoch %d: %s", epoch, entry)
+            if stop or (end_trigger is not None and
+                        end_trigger(epoch, self.step, True)):
+                break
+        if tb is not None:
+            tb.flush()
+        return TrainResult(history, self.params, self.opt_state, self.step)
+
+    def evaluate(self, data, y=None, batch_size: int = 32
+                 ) -> "dict[str, float]":
+        ds = to_dataset(data, y)
+        self._ensure_initialized()
+        if self._eval_step is None:
+            self._eval_step = self._build_eval_step()
+        totals: "dict[str, dict[str, np.ndarray]]" = {}
+        for xb, yb in ds.iter_batches(batch_size, shuffle=False,
+                                      drop_last=True):
+            xb = shard_batch(xb, self.ctx.mesh)
+            yb = shard_batch(yb, self.ctx.mesh)
+            stats = jax.device_get(self._eval_step(self.params, xb, yb))
+            for mname, mstats in stats.items():
+                acc = totals.setdefault(mname, {})
+                for k, v in mstats.items():
+                    acc[k] = acc.get(k, 0) + np.asarray(v)
+        out = {}
+        if "loss" in totals:
+            out["loss"] = float(totals["loss"]["loss_sum"] /
+                                np.maximum(totals["loss"]["count"], 1.0))
+        for m in self.metrics:
+            if m.name in totals:
+                out[m.name] = m.aggregate(totals[m.name])
+        return out
+
+    def predict(self, data, batch_size: int = 32) -> np.ndarray:
+        ds = to_dataset(data)
+        self._ensure_initialized()
+        if self._predict_fn is None:
+            self._predict_fn = self._build_predict_fn()
+        outs = []
+        n = ds.num_samples
+        for xb, _ in ds.iter_batches(batch_size, shuffle=False,
+                                     drop_last=False):
+            bsize = _batch_dim(xb)
+            if bsize < batch_size:  # pad to keep the compiled shape
+                xb = _pad_batch(xb, batch_size)
+            xb = shard_batch(xb, self.ctx.mesh)
+            y = jax.device_get(self._predict_fn(self.params, xb))
+            outs.append(_trim_batch(y, bsize))
+        if not outs:
+            return np.empty((0,))
+        return _concat_pytree(outs)[:n] if not isinstance(outs[0], (list,
+            tuple)) else _concat_pytree(outs)
+
+    # -- checkpoint / resume (reference `Topology.scala:238-248,996-1004`,
+    #    resume via Module.load, SURVEY.md §5 "Checkpoint / resume") -------
+    def save_checkpoint(self, path: Optional[str] = None):
+        path = path or self.checkpoint_path
+        if path is None:
+            raise ValueError("no checkpoint path set")
+        os.makedirs(path, exist_ok=True)
+        state = {
+            "params": jax.device_get(self.params),
+            "opt_state": jax.device_get(self.opt_state),
+            "step": self.step,
+        }
+        tmp = os.path.join(path, f".tmp_ckpt_{self.step}")
+        with open(tmp, "wb") as f:
+            pickle.dump(state, f)
+        final = os.path.join(path, f"ckpt_{self.step}.pkl")
+        os.replace(tmp, final)
+        latest = os.path.join(path, "LATEST")
+        with open(latest, "w") as f:
+            f.write(os.path.basename(final))
+        return final
+
+    def load_checkpoint(self, path: Optional[str] = None,
+                        step: Optional[int] = None):
+        path = path or self.checkpoint_path
+        if step is not None:
+            fname = os.path.join(path, f"ckpt_{step}.pkl")
+        else:
+            with open(os.path.join(path, "LATEST")) as f:
+                fname = os.path.join(path, f.read().strip())
+        with open(fname, "rb") as f:
+            state = pickle.load(f)
+        params = _remap_layer_names(self.model, state["params"])
+        self.params = shard_params(params, self.ctx.mesh)
+        # opt_state leaves are keyed by the saving process's layer names;
+        # rebuild the state tree for THIS model and pour the leaves in
+        tx = self._tx()
+        template = tx.init(self.params)
+        saved_leaves = jax.tree_util.tree_leaves(state["opt_state"])
+        template_def = jax.tree_util.tree_structure(template)
+        if len(saved_leaves) != template_def.num_leaves:
+            raise ValueError(
+                "optimizer state in checkpoint does not match this "
+                f"model/optimizer ({len(saved_leaves)} vs "
+                f"{template_def.num_leaves} leaves)")
+        self.opt_state = jax.device_put(
+            jax.tree_util.tree_unflatten(template_def, saved_leaves))
+        self.step = state["step"]
+        self._train_step = self._build_train_step(tx)
+        return self
+
+
+def _remap_layer_names(model, saved: dict) -> dict:
+    """Re-key a saved params dict onto this model instance's layer names.
+
+    Auto-generated layer names (`dense_7`, ...) differ between processes;
+    structure (layer order + shapes) is the stable identity — the same
+    positional contract BigDL uses when loading module snapshots.
+    """
+    from analytics_zoo_tpu.pipeline.api.keras.models import KerasNet
+    if not isinstance(model, KerasNet):
+        return saved
+    layers = model.layers
+    if len(layers) != len(saved):
+        raise ValueError(
+            f"checkpoint has {len(saved)} layer entries but model "
+            f"{model.name} has {len(layers)} layers")
+    out = {}
+    for lyr, (_, sub) in zip(layers, saved.items()):
+        out[lyr.name] = (_remap_layer_names(lyr, sub)
+                         if isinstance(lyr, KerasNet) else sub)
+    return out
+
+
+def _batch_dim(x) -> int:
+    leaf = x[0] if isinstance(x, (list, tuple)) else x
+    return int(leaf.shape[0])
+
+
+def _pad_batch(x, target: int):
+    def pad(a):
+        missing = target - a.shape[0]
+        return np.concatenate(
+            [a, np.repeat(a[-1:], missing, axis=0)], axis=0)
+    if isinstance(x, (list, tuple)):
+        return [pad(np.asarray(a)) for a in x]
+    return pad(np.asarray(x))
+
+
+def _trim_batch(y, n: int):
+    if isinstance(y, (list, tuple)):
+        return [np.asarray(a)[:n] for a in y]
+    return np.asarray(y)[:n]
+
+
+def _concat_pytree(chunks):
+    if isinstance(chunks[0], (list, tuple)):
+        n_out = len(chunks[0])
+        return [np.concatenate([c[i] for c in chunks], axis=0)
+                for i in range(n_out)]
+    return np.concatenate(chunks, axis=0)
