@@ -1,0 +1,7 @@
+from analytics_zoo_tpu.models.image.imageclassification.image_classifier \
+    import ImageClassifier
+from analytics_zoo_tpu.models.image.imageclassification.resnet import (
+    resnet50, ResNet)
+from analytics_zoo_tpu.models.image.imageclassification.lenet import lenet5
+
+__all__ = ["ImageClassifier", "resnet50", "ResNet", "lenet5"]

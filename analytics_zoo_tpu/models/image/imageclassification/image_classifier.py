@@ -1,0 +1,44 @@
+"""ImageClassifier (reference
+`Z/models/image/imageclassification/ImageClassifier.scala:55` + config
+registry): a ZooModel dispatching to named architectures."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from analytics_zoo_tpu.models.common import ZooModel
+
+
+class ImageClassifier(ZooModel):
+    """``ImageClassifier(model_name="resnet-50")`` — named-architecture
+    image classification (the pretrained-weight registry of the reference
+    maps to `load_model` files here)."""
+
+    ARCHS = ("lenet-5", "resnet-50", "resnet-101", "resnet-152")
+
+    def __init__(self, model_name: str = "resnet-50",
+                 input_shape: Tuple[int, int, int] = (224, 224, 3),
+                 classes: int = 1000):
+        super().__init__()
+        name = model_name.lower()
+        if name not in self.ARCHS:
+            raise ValueError(f"unknown architecture '{model_name}'; "
+                             f"known: {self.ARCHS}")
+        self.model_name = name
+        self.input_shape = tuple(input_shape)
+        self.classes = int(classes)
+
+    def hyper_parameters(self):
+        return {"model_name": self.model_name,
+                "input_shape": self.input_shape,
+                "classes": self.classes}
+
+    def build_model(self):
+        if self.model_name == "lenet-5":
+            from analytics_zoo_tpu.models.image.imageclassification \
+                .lenet import lenet5
+            return lenet5(self.input_shape, self.classes)
+        from analytics_zoo_tpu.models.image.imageclassification.resnet \
+            import ResNet
+        depth = int(self.model_name.split("-")[1])
+        return ResNet(depth).build(self.input_shape, self.classes)

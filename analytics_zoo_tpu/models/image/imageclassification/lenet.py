@@ -1,0 +1,24 @@
+"""LeNet-5 — the minimum end-to-end config (BASELINE.json config #1:
+"LeNet-5 MNIST via zoo.pipeline.api.keras"; reference
+`pyzoo/zoo/examples/tensorflow/distributed_training/train_lenet.py`)."""
+
+from __future__ import annotations
+
+from analytics_zoo_tpu.pipeline.api.keras.models import Sequential
+from analytics_zoo_tpu.pipeline.api.keras.layers import (
+    Convolution2D, Dense, Dropout, Flatten, MaxPooling2D)
+
+
+def lenet5(input_shape=(28, 28, 1), classes: int = 10,
+           dropout: float = 0.5) -> Sequential:
+    m = Sequential(name="lenet5")
+    m.add(Convolution2D(32, 5, 5, activation="relu", border_mode="same",
+                        input_shape=input_shape))
+    m.add(MaxPooling2D())
+    m.add(Convolution2D(64, 5, 5, activation="relu", border_mode="same"))
+    m.add(MaxPooling2D())
+    m.add(Flatten())
+    m.add(Dense(512, activation="relu"))
+    m.add(Dropout(dropout))
+    m.add(Dense(classes, activation="softmax"))
+    return m

@@ -1,0 +1,82 @@
+"""ResNet v1.5 for image classification — the nnframes ResNet-50/ImageNet
+headline workload (BASELINE.json: ≥45% MFU on v5e; reference recipe
+`examples/inception/Train.scala` is the equivalent CNN training recipe).
+
+TPU-first choices:
+- NHWC layout end-to-end (native TPU conv layout).
+- Channel counts are multiples of 64/128 → clean MXU tiling.
+- BatchNorm statistics are global-batch under pjit (syncBN for free).
+- Feed bf16 inputs for MXU throughput; params stay f32 (layers cast).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from analytics_zoo_tpu.pipeline.api.keras.engine import Input
+from analytics_zoo_tpu.pipeline.api.keras.models import Model
+from analytics_zoo_tpu.pipeline.api.keras.layers import (
+    Activation, AveragePooling2D, BatchNormalization, Convolution2D, Dense,
+    Flatten, GlobalAveragePooling2D, Add, MaxPooling2D, ZeroPadding2D)
+
+
+def _conv_bn(x, filters, kernel, stride=1, activation="relu",
+             name=None):
+    x = Convolution2D(filters, kernel, kernel, subsample=stride,
+                      border_mode="same", bias=False, name=name)(x)
+    x = BatchNormalization(name=None if name is None else name + "_bn")(x)
+    if activation:
+        x = Activation(activation)(x)
+    return x
+
+
+def _bottleneck(x, filters, stride=1, downsample=False, name=""):
+    """v1.5 bottleneck: stride lives on the 3x3 conv."""
+    shortcut = x
+    y = _conv_bn(x, filters, 1, 1, name=name + "_c1")
+    y = _conv_bn(y, filters, 3, stride, name=name + "_c2")
+    y = Convolution2D(filters * 4, 1, 1, border_mode="same", bias=False,
+                      name=name + "_c3")(y)
+    y = BatchNormalization(name=name + "_c3_bn")(y)
+    if downsample:
+        shortcut = Convolution2D(filters * 4, 1, 1, subsample=stride,
+                                 border_mode="same", bias=False,
+                                 name=name + "_down")(x)
+        shortcut = BatchNormalization(name=name + "_down_bn")(shortcut)
+    out = Add()([y, shortcut])
+    return Activation("relu")(out)
+
+
+class ResNet:
+    """Builder; `ResNet(depth).build(input_shape, classes)` → keras Model."""
+
+    DEPTH_BLOCKS = {50: (3, 4, 6, 3), 101: (3, 4, 23, 3),
+                    152: (3, 8, 36, 3)}
+
+    def __init__(self, depth: int = 50):
+        if depth not in self.DEPTH_BLOCKS:
+            raise ValueError(f"depth must be one of "
+                             f"{sorted(self.DEPTH_BLOCKS)}")
+        self.depth = depth
+
+    def build(self, input_shape=(224, 224, 3), classes: int = 1000
+              ) -> Model:
+        blocks = self.DEPTH_BLOCKS[self.depth]
+        inp = Input(input_shape, name="image")
+        x = _conv_bn(inp, 64, 7, stride=2, name="stem")
+        x = MaxPooling2D(pool_size=3, strides=2, border_mode="same")(x)
+        filters = 64
+        for stage, n_blocks in enumerate(blocks):
+            for b in range(n_blocks):
+                stride = 2 if (b == 0 and stage > 0) else 1
+                x = _bottleneck(x, filters, stride=stride,
+                                downsample=(b == 0),
+                                name=f"s{stage}b{b}")
+            filters *= 2
+        x = GlobalAveragePooling2D()(x)
+        out = Dense(classes, name="fc")(x)
+        return Model(inp, out, name=f"resnet{self.depth}")
+
+
+def resnet50(input_shape=(224, 224, 3), classes: int = 1000) -> Model:
+    return ResNet(50).build(input_shape, classes)

@@ -74,6 +74,26 @@ def class_nll(y_true, y_pred):
     return -jnp.mean(picked)
 
 
+def softmax_cross_entropy(y_true, y_pred):
+    """Stable fused log-softmax CE over *logits* with sparse int labels
+    (TPU-preferred: avoids materializing probabilities; the BigDL analog
+    is CrossEntropyCriterion = LogSoftMax + ClassNLL)."""
+    labels = y_true.astype(jnp.int32)
+    if labels.ndim == y_pred.ndim:
+        labels = labels[..., 0]
+    logp = jax.nn.log_softmax(y_pred.astype(jnp.float32), axis=-1)
+    picked = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return -jnp.mean(picked)
+
+
+def sigmoid_cross_entropy(y_true, y_pred):
+    """Stable BCE over logits."""
+    z = y_pred.astype(jnp.float32)
+    t = y_true.astype(jnp.float32)
+    return jnp.mean(jnp.maximum(z, 0) - z * t +
+                    jnp.log1p(jnp.exp(-jnp.abs(z))))
+
+
 def hinge(y_true, y_pred):
     return jnp.mean(jnp.maximum(1.0 - y_true * y_pred, 0.0))
 
@@ -123,6 +143,9 @@ _REGISTRY: "dict[str, LossFn]" = {
     "categorical_crossentropy": categorical_crossentropy,
     "sparse_categorical_crossentropy": sparse_categorical_crossentropy,
     "class_nll": class_nll,
+    "softmax_cross_entropy": softmax_cross_entropy,
+    "sparse_categorical_crossentropy_from_logits": softmax_cross_entropy,
+    "sigmoid_cross_entropy": sigmoid_cross_entropy,
     "hinge": hinge,
     "squared_hinge": squared_hinge,
     "rank_hinge": rank_hinge,

@@ -329,9 +329,12 @@ class Estimator:
         stop = False
 
         for epoch in range(1, nb_epoch + 1):
-            epoch_loss, epoch_batches = 0.0, 0
             t0 = time.time()
             n_records = 0
+            # keep losses on-device during the epoch: fetching per step
+            # would stall the dispatch pipeline (expensive over remote
+            # device transports)
+            pending: "list[tuple[int, Any]]" = []
             for xb, yb in ds.iter_batches(batch_size, shuffle=True,
                                           seed=epoch):
                 xb = shard_batch(xb, self.ctx.mesh)
@@ -340,15 +343,8 @@ class Estimator:
                 self.params, self.opt_state, loss = self._train_step(
                     self.params, self.opt_state, rng, xb, yb)
                 self.step += 1
-                epoch_batches += 1
                 n_records += batch_size
-                loss_f = float(loss)
-                epoch_loss += loss_f
-                if tb is not None:
-                    tb.add_scalar("Loss", loss_f, self.step)
-                    lr = self._lr_fn(self.step)
-                    if lr == lr:  # not NaN
-                        tb.add_scalar("LearningRate", lr, self.step)
+                pending.append((self.step, loss))
                 if self.checkpoint_path and self.checkpoint_trigger(
                         epoch, self.step, False):
                     self.save_checkpoint()
@@ -357,7 +353,18 @@ class Estimator:
                     stop = True
                     break
 
+            losses_np = ([float(v) for v in
+                          jax.device_get([v for _, v in pending])]
+                         if pending else [])
             dt = max(time.time() - t0, 1e-9)
+            if tb is not None:
+                for (s, _), lf in zip(pending, losses_np):
+                    tb.add_scalar("Loss", lf, s)
+                    lr = self._lr_fn(s)
+                    if lr == lr:  # not NaN
+                        tb.add_scalar("LearningRate", lr, s)
+            epoch_batches = len(pending)
+            epoch_loss = float(np.sum(losses_np))
             throughput = n_records / dt
             entry = {"epoch": epoch,
                      "loss": epoch_loss / max(epoch_batches, 1),

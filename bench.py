@@ -1,0 +1,122 @@
+"""Headline benchmark: ResNet-50 training throughput on the local chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The BASELINE.json target is the nnframes ResNet-50 ImageNet recipe at
+>=45% MFU (v5e). vs_baseline here = achieved MFU / 0.45, with FLOPs taken
+from XLA's own cost analysis of the compiled train step and peak chip
+FLOPs from ZOO_TPU_PEAK_TFLOPS (default 197, TPU v5e bf16).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    from analytics_zoo_tpu import init_nncontext
+    from analytics_zoo_tpu.models.image.imageclassification import resnet50
+    from analytics_zoo_tpu.ops import losses, optimizers
+    import optax
+
+    batch = int(os.environ.get("ZOO_TPU_BENCH_BATCH", "128"))
+    image = int(os.environ.get("ZOO_TPU_BENCH_IMAGE", "224"))
+    steps = int(os.environ.get("ZOO_TPU_BENCH_STEPS", "10"))
+    peak_tflops = float(os.environ.get("ZOO_TPU_PEAK_TFLOPS", "197"))
+
+    ctx = init_nncontext(tpu_mesh={"data": 1},
+                         devices=jax.devices()[:1],
+                         log_level="WARNING")
+    model = resnet50(input_shape=(image, image, 3), classes=1000)
+    params = model.init_params()
+    loss_fn = losses.softmax_cross_entropy
+    tx = optimizers.SGD(lr=0.1, momentum=0.9).to_optax()
+    opt_state = tx.init(params)
+
+    def train_step(params, opt_state, x, y):
+        def compute_loss(p):
+            out, upd = model.apply(p, x, training=True)
+            return loss_fn(y, out), upd
+
+        (loss, upd), grads = jax.value_and_grad(
+            compute_loss, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        from analytics_zoo_tpu.pipeline.estimator import Estimator
+        params = Estimator._merge_updates(params, upd)
+        return params, opt_state, loss
+
+    rs = np.random.RandomState(0)
+    # bf16 inputs: layers compute in input dtype, params stay f32
+    x = jax.numpy.asarray(
+        rs.randn(batch, image, image, 3), jax.numpy.bfloat16)
+    y = jax.numpy.asarray(rs.randint(0, 1000, size=(batch, 1)),
+                          jax.numpy.int32)
+
+    # Remote-device transports make per-call host syncs expensive and
+    # async dispatch unreliable for timing: chain K steps inside ONE jit
+    # via lax.scan, force a scalar to host to sync, and difference two
+    # chain lengths to cancel the constant round-trip/dispatch overhead.
+    def chain(k):
+        def run(params, opt_state, x, y):
+            def body(carry, _):
+                p, o = carry
+                p, o, loss = train_step(p, o, x, y)
+                return (p, o), loss
+            (p, o), losses_seq = jax.lax.scan(
+                body, (params, opt_state), None, length=k)
+            return p, o, losses_seq[-1]
+        return jax.jit(run)
+
+    single = jax.jit(train_step)
+    try:
+        cost = single.lower(params, opt_state, x, y).compile() \
+            .cost_analysis()
+        cost = cost[0] if isinstance(cost, (list, tuple)) else cost
+        flops_per_step = float(cost.get("flops", 0.0))
+    except Exception:
+        flops_per_step = 0.0
+    if not flops_per_step or flops_per_step != flops_per_step:
+        # analytic fallback: fwd ~4.09 GFLOPs/img @224, train ~3x fwd
+        flops_per_step = 3 * 4.09e9 * batch * (image / 224.0) ** 2
+
+    k_short, k_long = 2, 2 + steps
+    run_short = chain(k_short)
+    run_long = chain(k_long)
+
+    def timed(fn):
+        t0 = time.perf_counter()
+        p, o, loss = fn(params, opt_state, x, y)
+        loss_val = float(np.asarray(loss))  # host fetch = real sync
+        return time.perf_counter() - t0, loss_val
+
+    timed(run_short)  # warmup (compile)
+    timed(run_long)
+    t_short, _ = timed(run_short)
+    t_long, loss = timed(run_long)
+    dt = max(t_long - t_short, 1e-9)
+
+    images_per_sec = batch * steps / dt
+    steps_per_sec = steps / dt
+    mfu = (flops_per_step * steps_per_sec) / (peak_tflops * 1e12)
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(images_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(mfu / 0.45, 4),
+    }))
+    print(f"# batch={batch} image={image} steps={steps} "
+          f"step_time={dt / steps * 1000:.1f}ms mfu={mfu:.3f} "
+          f"loss={float(loss):.3f} flops/step={flops_per_step:.3e}",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
