@@ -1,0 +1,4 @@
+from analytics_zoo_tpu.feature.common import (
+    Preprocessing, ChainedPreprocessing, ArrayToTensor, SeqToTensor,
+    ScalarToTensor, TensorToSample, FeatureLabelPreprocessing, Sample)
+from analytics_zoo_tpu.feature.feature_set import FeatureSet, MemoryType

@@ -1,0 +1,20 @@
+from analytics_zoo_tpu.feature.image.imageset import (
+    ImageFeature, ImageSet, LocalImageSet)
+from analytics_zoo_tpu.feature.image.transforms import (
+    ImageBrightness, ImageCenterCrop, ImageChannelNormalize,
+    ImageContrast, ImageExpand, ImageFiller, ImageHFlip, ImageHue,
+    ImageMatToTensor, ImagePixelNormalizer, ImageRandomCrop,
+    ImageRandomPreprocessing, ImageResize, ImageSaturation,
+    ImageSetToSample, ImageAspectScale, ImageChannelScaledNormalizer,
+    ImageRandomAspectScale, ImageColorJitter)
+
+__all__ = [
+    "ImageFeature", "ImageSet", "LocalImageSet",
+    "ImageResize", "ImageCenterCrop", "ImageRandomCrop", "ImageHFlip",
+    "ImageBrightness", "ImageContrast", "ImageSaturation", "ImageHue",
+    "ImageChannelNormalize", "ImagePixelNormalizer", "ImageMatToTensor",
+    "ImageSetToSample", "ImageExpand", "ImageFiller",
+    "ImageRandomPreprocessing", "ImageAspectScale",
+    "ImageRandomAspectScale", "ImageChannelScaledNormalizer",
+    "ImageColorJitter",
+]

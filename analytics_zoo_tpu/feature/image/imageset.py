@@ -1,0 +1,143 @@
+"""ImageSet / ImageFeature (reference `Z/feature/image/ImageSet.scala:34-
+229`: local/distributed collections of `ImageFeature` read from
+disk/HDFS, convertible to DataSet[Sample]).
+
+Decoding uses PIL (the OpenCV role); pixel data is numpy HWC uint8 until
+`ImageMatToTensor` converts to float HWC — NHWC being the TPU conv
+layout (divergence from BigDL's CHW float means no transpose on device).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Any, Callable, Iterable, Iterator, List, Optional
+
+import numpy as np
+
+from analytics_zoo_tpu.feature.common import Preprocessing, Sample
+from analytics_zoo_tpu.feature.feature_set import FeatureSet
+
+
+class ImageFeature(dict):
+    """Mutable record for one image (reference BigDL `ImageFeature` keys:
+    bytes/mat/floats/label/uri/...)."""
+
+    IMAGE = "image"       # np.ndarray HWC (uint8 until MatToTensor)
+    LABEL = "label"
+    URI = "uri"
+    SAMPLE = "sample"
+    ORIGINAL_SIZE = "original_size"
+
+    def __init__(self, image: Optional[np.ndarray] = None, label=None,
+                 uri: Optional[str] = None):
+        super().__init__()
+        if image is not None:
+            self[self.IMAGE] = image
+            self[self.ORIGINAL_SIZE] = image.shape
+        if label is not None:
+            self[self.LABEL] = label
+        if uri is not None:
+            self[self.URI] = uri
+
+    @property
+    def image(self) -> np.ndarray:
+        return self[self.IMAGE]
+
+    @image.setter
+    def image(self, v):
+        self[self.IMAGE] = v
+
+    @property
+    def label(self):
+        return self.get(self.LABEL)
+
+
+def _decode(path: str) -> np.ndarray:
+    from PIL import Image
+    with Image.open(path) as im:
+        return np.asarray(im.convert("RGB"), np.uint8)
+
+
+class ImageSet:
+    """Collection of ImageFeatures with a lazy transform pipeline.
+
+    `ImageSet.read(dir)` mirrors `ImageSet.read`
+    (`ImageSet.scala:196`): reads every image under a path (glob or dir);
+    `with_label_from_dirs` reads a `class_name/xxx.jpg` layout.
+    """
+
+    def __init__(self, features: "list[ImageFeature]"):
+        self.features = features
+
+    # -- readers ------------------------------------------------------------
+    @staticmethod
+    def read(path: str, with_label_from_dirs: bool = False,
+             max_images: Optional[int] = None) -> "ImageSet":
+        if os.path.isdir(path):
+            if with_label_from_dirs:
+                classes = sorted(
+                    d for d in os.listdir(path)
+                    if os.path.isdir(os.path.join(path, d)))
+                label_map = {c: i for i, c in enumerate(classes)}
+                feats = []
+                for c in classes:
+                    for f in sorted(glob.glob(
+                            os.path.join(path, c, "*"))):
+                        feats.append(ImageFeature(
+                            _decode(f),
+                            label=np.asarray([label_map[c]], np.int32),
+                            uri=f))
+                        if max_images and len(feats) >= max_images:
+                            return ImageSet(feats)
+                return ImageSet(feats)
+            files = sorted(
+                f for f in glob.glob(os.path.join(path, "*"))
+                if os.path.isfile(f))
+        else:
+            files = sorted(glob.glob(path))
+        if max_images:
+            files = files[:max_images]
+        return ImageSet([ImageFeature(_decode(f), uri=f) for f in files])
+
+    @staticmethod
+    def from_arrays(images: np.ndarray,
+                    labels: Optional[np.ndarray] = None) -> "ImageSet":
+        feats = []
+        for i in range(len(images)):
+            feats.append(ImageFeature(
+                np.asarray(images[i]),
+                label=None if labels is None else labels[i]))
+        return ImageSet(feats)
+
+    # -- pipeline -----------------------------------------------------------
+    def transform(self, *transformers: Preprocessing) -> "ImageSet":
+        feats = self.features
+        for t in transformers:
+            feats = [t.apply(f) for f in feats]
+            feats = [f for f in feats if f is not None]
+        return ImageSet(feats)
+
+    def to_feature_set(self, memory_type="dram") -> FeatureSet:
+        """→ FeatureSet of Samples (requires ImageSetToSample in the
+        pipeline, or images already tensorized)."""
+        samples = []
+        for f in self.features:
+            s = f.get(ImageFeature.SAMPLE)
+            if s is None:
+                s = Sample(feature=np.asarray(f.image, np.float32),
+                           label=f.label)
+            samples.append(s)
+        return FeatureSet.sample_rdd(samples, memory_type=memory_type)
+
+    def get_image(self) -> "list[np.ndarray]":
+        return [f.image for f in self.features]
+
+    def get_label(self) -> "list":
+        return [f.label for f in self.features]
+
+    def __len__(self):
+        return len(self.features)
+
+
+LocalImageSet = ImageSet  # single-process variant name parity

@@ -1,0 +1,11 @@
+from analytics_zoo_tpu.feature.text.text_feature import TextFeature
+from analytics_zoo_tpu.feature.text.text_set import TextSet
+from analytics_zoo_tpu.feature.text.transforms import (
+    Tokenizer, Normalizer, WordIndexer, SequenceShaper,
+    TextFeatureToSample)
+from analytics_zoo_tpu.feature.text.relations import (
+    Relation, Relations)
+
+__all__ = ["TextFeature", "TextSet", "Tokenizer", "Normalizer",
+           "WordIndexer", "SequenceShaper", "TextFeatureToSample",
+           "Relation", "Relations"]

@@ -1,0 +1,246 @@
+"""Data-layer tests: FeatureSet tiers, Preprocessing algebra, image and
+text pipelines (reference test analogs: FeatureSet/pmem specs, TextSet
+pipeline specs, image transformer specs — SURVEY.md §4)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.feature import (
+    ArrayToTensor, FeatureSet, MemoryType, Sample, ScalarToTensor,
+    SeqToTensor, TensorToSample, FeatureLabelPreprocessing)
+from analytics_zoo_tpu.feature.image import (
+    ImageCenterCrop, ImageChannelNormalize, ImageFeature, ImageHFlip,
+    ImageMatToTensor, ImageRandomCrop, ImageResize, ImageSet,
+    ImageSetToSample, ImageBrightness, ImageExpand)
+from analytics_zoo_tpu.feature.text import (
+    Relation, Relations, TextSet)
+
+
+# -- FeatureSet -------------------------------------------------------------
+
+def test_featureset_dram_batches():
+    x = np.arange(40, dtype=np.float32).reshape(20, 2)
+    y = np.arange(20, dtype=np.float32)[:, None]
+    fs = FeatureSet.array(x, y)
+    assert fs.num_samples == 20
+    batches = list(fs.iter_batches(8, shuffle=True, seed=1))
+    assert len(batches) == 2  # drop_last
+    xb, yb = batches[0]
+    assert xb.shape == (8, 2) and yb.shape == (8, 1)
+    # shuffle must keep x/y aligned
+    np.testing.assert_allclose(xb[:, 0] // 2, yb[:, 0])
+
+
+def test_featureset_epoch_shuffle_differs():
+    x = np.arange(64, dtype=np.float32)[:, None]
+    fs = FeatureSet.array(x)
+    b1 = next(iter(fs.iter_batches(32, seed=1)))[0]
+    b2 = next(iter(fs.iter_batches(32, seed=2)))[0]
+    assert not np.array_equal(b1, b2)
+
+
+def test_featureset_pmem_tier(tmp_path):
+    x = np.random.RandomState(0).randn(16, 3).astype(np.float32)
+    y = np.arange(16, dtype=np.int32)[:, None]
+    fs = FeatureSet.array(x, y, memory_type="pmem",
+                          pmem_path=str(tmp_path / "arena"))
+    assert fs.memory_type == MemoryType.PMEM
+    assert (tmp_path / "arena").exists()
+    xb, yb = next(iter(fs.iter_batches(8, shuffle=True, seed=0)))
+    assert xb.shape == (8, 3)
+    # rows stay aligned after sorted-index gather
+    for i in range(8):
+        np.testing.assert_allclose(xb[i], x[int(yb[i, 0])])
+
+
+def test_featureset_sharding():
+    x = np.arange(100, dtype=np.float32)[:, None]
+    fs0 = FeatureSet.array(x, shard_index=0, num_shards=4)
+    fs3 = FeatureSet.array(x, shard_index=3, num_shards=4)
+    assert fs0.num_samples == 25 and fs3.num_samples == 25
+    assert float(fs3._x[0][0, 0]) == 75.0
+
+
+def test_featureset_multi_input():
+    xa = np.zeros((10, 2), np.float32)
+    xb = np.ones((10, 3), np.float32)
+    fs = FeatureSet.array([xa, xb], np.zeros((10, 1)))
+    xb_, yb = next(iter(fs.iter_batches(5)))
+    assert isinstance(xb_, list) and len(xb_) == 2
+    assert xb_[0].shape == (5, 2) and xb_[1].shape == (5, 3)
+
+
+def test_featureset_trains_with_estimator():
+    from analytics_zoo_tpu import init_nncontext
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential, layers as L
+    init_nncontext(seed=0)
+    rs = np.random.RandomState(0)
+    x = rs.randn(64, 4).astype(np.float32)
+    y = (x.sum(1, keepdims=True) > 0).astype(np.float32)
+    fs = FeatureSet.array(x, y)
+    m = Sequential()
+    m.add(L.Dense(1, activation="sigmoid", input_shape=(4,)))
+    m.compile(optimizer="adam", loss="binary_crossentropy")
+    res = m.fit(fs, batch_size=16, nb_epoch=3)
+    assert len(res.history) == 3
+
+
+# -- Preprocessing algebra --------------------------------------------------
+
+def test_preprocessing_chaining():
+    pre = SeqToTensor((3,)) >> TensorToSample()
+    out = pre.apply([1, 2, 3])
+    assert isinstance(out, Sample)
+    np.testing.assert_allclose(out.feature, [1, 2, 3])
+
+
+def test_feature_label_preprocessing():
+    pre = FeatureLabelPreprocessing(SeqToTensor((2,)), ScalarToTensor())
+    s = pre.apply(([1.0, 2.0], 5))
+    np.testing.assert_allclose(s.feature, [1, 2])
+    np.testing.assert_allclose(s.label, [5])
+
+
+def test_from_iterable_with_preprocessing():
+    pre = FeatureLabelPreprocessing(SeqToTensor((2,)), ScalarToTensor())
+    records = [([i, i + 1], i) for i in range(10)]
+    fs = FeatureSet.from_iterable(records, pre)
+    assert fs.num_samples == 10
+    xb, yb = next(iter(fs.iter_batches(5, shuffle=False)))
+    np.testing.assert_allclose(xb[0], [0, 1])
+
+
+# -- Image pipeline ---------------------------------------------------------
+
+def _fake_image(h=32, w=48):
+    rs = np.random.RandomState(0)
+    return rs.randint(0, 255, size=(h, w, 3)).astype(np.uint8)
+
+
+def test_image_transforms_chain():
+    imgs = np.stack([_fake_image() for _ in range(4)])
+    labels = np.arange(4, dtype=np.int32)[:, None]
+    iset = ImageSet.from_arrays(imgs, labels)
+    out = iset.transform(
+        ImageResize(40, 40),
+        ImageRandomCrop(32, 32, seed=0),
+        ImageHFlip(p=1.0),
+        ImageChannelNormalize(123.0, 117.0, 104.0, 58.4, 57.1, 57.4),
+        ImageMatToTensor(),
+        ImageSetToSample())
+    fs = out.to_feature_set()
+    assert fs.num_samples == 4
+    xb, yb = next(iter(fs.iter_batches(2, shuffle=False)))
+    assert xb.shape == (2, 32, 32, 3)
+    assert xb.dtype == np.float32
+    assert yb.shape == (2, 1)
+
+
+def test_image_center_crop_and_resize_shapes():
+    f = ImageFeature(_fake_image(50, 60))
+    f = ImageResize(40, 40).apply(f)
+    assert f.image.shape == (40, 40, 3)
+    f = ImageCenterCrop(20, 24).apply(f)
+    assert f.image.shape == (20, 24, 3)
+
+
+def test_image_read_from_disk(tmp_path):
+    from PIL import Image
+    for cls in ("cat", "dog"):
+        os.makedirs(tmp_path / cls)
+        for i in range(2):
+            Image.fromarray(_fake_image()).save(
+                tmp_path / cls / f"{i}.png")
+    iset = ImageSet.read(str(tmp_path), with_label_from_dirs=True)
+    assert len(iset) == 4
+    labels = sorted(int(l[0]) for l in iset.get_label())
+    assert labels == [0, 0, 1, 1]
+
+
+def test_image_expand_and_brightness():
+    f = ImageFeature(_fake_image(20, 20))
+    f2 = ImageExpand(max_expand_ratio=2.0, seed=0).apply(f)
+    h, w, _ = f2.image.shape
+    assert h >= 20 and w >= 20
+    f3 = ImageBrightness(10, 10, seed=0).apply(
+        ImageFeature(_fake_image(8, 8)))
+    assert f3.image.shape == (8, 8, 3)
+
+
+# -- Text pipeline ----------------------------------------------------------
+
+TEXTS = ["The quick brown fox jumps over the lazy dog",
+         "the dog sleeps", "a fox! A FOX?", "dog dog dog"]
+
+
+def test_text_pipeline_end_to_end():
+    ts = TextSet.from_texts(TEXTS, labels=[0, 1, 0, 1])
+    ts.tokenize().normalize().word2idx().shape_sequence(6) \
+        .generate_sample()
+    x, y = ts.to_arrays()
+    assert x.shape == (4, 6)
+    assert y.shape == (4, 1)
+    wi = ts.get_word_index()
+    assert wi is not None and wi["dog"] >= 1
+    # "dog" appears most → rank 1 (index starts at 1)
+    assert wi["dog"] == 1
+
+
+def test_text_word2idx_filters():
+    ts = TextSet.from_texts(TEXTS)
+    ts.tokenize().normalize().word2idx(remove_topn=1, max_words_num=3)
+    wi = ts.get_word_index()
+    assert "dog" not in wi  # most frequent removed
+    assert len(wi) == 3
+
+
+def test_text_vocab_save_load(tmp_path):
+    ts = TextSet.from_texts(TEXTS)
+    ts.tokenize().normalize().word2idx()
+    p = str(tmp_path / "vocab.txt")
+    ts.save_word_index(p)
+    ts2 = TextSet.from_texts(["a new dog"]).load_word_index(p)
+    assert ts2.get_word_index() == ts.get_word_index()
+
+
+def test_text_read_dir(tmp_path):
+    for cls, docs in (("pos", ["good good", "great stuff"]),
+                      ("neg", ["bad thing"])):
+        os.makedirs(tmp_path / cls)
+        for i, d in enumerate(docs):
+            (tmp_path / cls / f"{i}.txt").write_text(d)
+    ts = TextSet.read(str(tmp_path))
+    assert len(ts) == 3
+    assert ts.n_classes == 2
+
+
+def test_relations_pairs_and_lists(tmp_path):
+    rels = [Relation("q1", "d1", 1), Relation("q1", "d2", 0),
+            Relation("q1", "d3", 0), Relation("q2", "d1", 0),
+            Relation("q2", "d4", 1)]
+    csv_path = tmp_path / "rel.csv"
+    csv_path.write_text("id1,id2,label\n" + "\n".join(
+        f"{r.id1},{r.id2},{r.label}" for r in rels))
+    loaded = Relations.read(str(csv_path))
+    assert loaded == rels
+
+    q_corpus = TextSet.from_texts(["query one", "query two"])
+    for f, uri in zip(q_corpus.features, ["q1", "q2"]):
+        f[f.URI] = uri
+    d_corpus = TextSet.from_texts(["doc a", "doc b", "doc c", "doc d"])
+    for f, uri in zip(d_corpus.features, ["d1", "d2", "d3", "d4"]):
+        f[f.URI] = uri
+    for c in (q_corpus, d_corpus):
+        c.tokenize().normalize().word2idx().shape_sequence(3)
+
+    x1, x2 = TextSet.from_relation_pairs(loaded, q_corpus, d_corpus,
+                                         seed=0)
+    assert x1.shape[0] % 2 == 0  # alternating pos/neg rows
+    assert x1.shape == x2.shape
+
+    l1, l2, labels, gids = TextSet.from_relation_lists(
+        loaded, q_corpus, d_corpus)
+    assert l1.shape[0] == 5
+    assert set(gids.tolist()) == {0, 1}
