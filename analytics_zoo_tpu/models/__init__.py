@@ -1,1 +1,3 @@
-from analytics_zoo_tpu.models.common import ZooModel
+from analytics_zoo_tpu.models.common import Ranker, ZooModel
+
+__all__ = ["ZooModel", "Ranker"]

@@ -1,0 +1,4 @@
+from analytics_zoo_tpu.models.anomalydetection.anomaly_detector import (
+    AnomalyDetector, FeatureLabelIndex)
+
+__all__ = ["AnomalyDetector", "FeatureLabelIndex"]

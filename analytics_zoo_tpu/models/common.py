@@ -90,7 +90,8 @@ class ZooModel:
 
         from analytics_zoo_tpu.parallel.mesh import shard_params
         from analytics_zoo_tpu.common.nncontext import get_nncontext
-        from analytics_zoo_tpu.pipeline.estimator import _remap_layer_names
+        from analytics_zoo_tpu.pipeline.estimator import \
+            _check_params_compatible
         with open(path, "rb") as f:
             state = pickle.load(f)
         mod = importlib.import_module(state["module"])
@@ -98,8 +99,8 @@ class ZooModel:
         inst = klass(**state["hyper_parameters"])
         inst.compile()  # default compile; caller may re-compile
         est = inst.model.estimator
-        params = _remap_layer_names(inst.model, state["params"])
-        est.params = shard_params(params, get_nncontext().mesh)
+        _check_params_compatible(inst.model, state["params"])
+        est.params = shard_params(state["params"], get_nncontext().mesh)
         return inst
 
 

@@ -1,0 +1,8 @@
+from analytics_zoo_tpu.models.recommendation.recommender import (
+    Recommender, UserItemFeature, UserItemPrediction)
+from analytics_zoo_tpu.models.recommendation.neuralcf import NeuralCF
+from analytics_zoo_tpu.models.recommendation.wide_and_deep import (
+    WideAndDeep, ColumnFeatureInfo)
+
+__all__ = ["Recommender", "UserItemFeature", "UserItemPrediction",
+           "NeuralCF", "WideAndDeep", "ColumnFeatureInfo"]

@@ -1,0 +1,77 @@
+"""NeuralCF — neural collaborative filtering, GMF + MLP
+(reference `Z/models/recommendation/NeuralCF.scala:43-130`).
+
+Input: (batch, 2) int [user_id, item_id], ids 0-based (divergence: the
+reference's BigDL LookupTable is 1-based). Output: log-probabilities over
+`num_classes` (the reference ends in LogSoftMax).
+
+TPU note: both towers are embedding gathers + small dense stack — the
+whole model compiles to a handful of fused gathers/GEMMs; the NCF
+samples/sec headline number in BASELINE.json benches this model.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from analytics_zoo_tpu.models.recommendation.recommender import Recommender
+from analytics_zoo_tpu.pipeline.api.keras.engine import Input
+from analytics_zoo_tpu.pipeline.api.keras.models import Model
+from analytics_zoo_tpu.pipeline.api.keras.layers import (
+    Concatenate, Dense, Embedding, Multiply, Select)
+from analytics_zoo_tpu.pipeline.api.keras.layers.core import Activation
+
+
+class NeuralCF(Recommender):
+    def __init__(self, user_count: int, item_count: int, num_classes: int,
+                 user_embed: int = 20, item_embed: int = 20,
+                 hidden_layers: Sequence[int] = (40, 20, 10),
+                 include_mf: bool = True, mf_embed: int = 20):
+        super().__init__()
+        self.user_count = int(user_count)
+        self.item_count = int(item_count)
+        self.num_classes = int(num_classes)
+        self.user_embed = int(user_embed)
+        self.item_embed = int(item_embed)
+        self.hidden_layers = tuple(int(h) for h in hidden_layers)
+        self.include_mf = bool(include_mf)
+        self.mf_embed = int(mf_embed)
+
+    def hyper_parameters(self):
+        return {
+            "user_count": self.user_count,
+            "item_count": self.item_count,
+            "num_classes": self.num_classes,
+            "user_embed": self.user_embed,
+            "item_embed": self.item_embed,
+            "hidden_layers": self.hidden_layers,
+            "include_mf": self.include_mf,
+            "mf_embed": self.mf_embed,
+        }
+
+    def build_model(self) -> Model:
+        inp = Input((2,), name="user_item")
+        user = Select(1, 0, name="user_id")(inp)
+        item = Select(1, 1, name="item_id")(inp)
+
+        # MLP tower (init normal(0, 0.1) like the reference's randn(0,0.1))
+        mlp_u = Embedding(self.user_count, self.user_embed,
+                          init="normal", name="mlp_user_table")(user)
+        mlp_i = Embedding(self.item_count, self.item_embed,
+                          init="normal", name="mlp_item_table")(item)
+        x = Concatenate(axis=-1)([mlp_u, mlp_i])
+        for h in self.hidden_layers:
+            x = Dense(h, activation="relu")(x)
+
+        if self.include_mf:
+            if self.mf_embed <= 0:
+                raise ValueError("mf_embed must be positive")
+            mf_u = Embedding(self.user_count, self.mf_embed,
+                             init="normal", name="mf_user_table")(user)
+            mf_i = Embedding(self.item_count, self.mf_embed,
+                             init="normal", name="mf_item_table")(item)
+            gmf = Multiply()([mf_u, mf_i])
+            x = Concatenate(axis=-1)([gmf, x])
+        out = Dense(self.num_classes)(x)
+        out = Activation("log_softmax")(out)
+        return Model(inp, out, name="neuralcf")

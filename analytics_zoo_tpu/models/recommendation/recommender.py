@@ -1,0 +1,79 @@
+"""Recommender base (reference
+`Z/models/recommendation/Recommender.scala:27-105`): recommend_for_user /
+recommend_for_item / predict_user_item_pair over user-item pair
+features)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Iterable, List, Optional
+
+import numpy as np
+
+from analytics_zoo_tpu.models.common import ZooModel
+
+
+@dataclass
+class UserItemFeature:
+    """(reference case class `UserItemFeature`)"""
+
+    user_id: int
+    item_id: int
+    feature: Any  # model input row (ndarray or list of ndarrays)
+
+
+@dataclass
+class UserItemPrediction:
+    """(reference case class `UserItemPrediction`)"""
+
+    user_id: int
+    item_id: int
+    prediction: int
+    probability: float
+
+
+class Recommender(ZooModel):
+    """Shared ranking helpers. Models output log-probabilities over
+    classes (reference models end in LogSoftMax)."""
+
+    def predict_user_item_pair(
+            self, pairs: "list[UserItemFeature]",
+            batch_size: int = 128) -> "list[UserItemPrediction]":
+        """(reference `predictUserItemPair`)"""
+        feats = [p.feature for p in pairs]
+        first = feats[0]
+        if isinstance(first, (list, tuple)):
+            x = [np.stack([f[i] for f in feats])
+                 for i in range(len(first))]
+        else:
+            x = np.stack(feats)
+        logp = self.predict(x, batch_size=batch_size)
+        classes = np.argmax(logp, axis=-1)
+        probs = np.exp(np.max(logp, axis=-1))
+        return [UserItemPrediction(p.user_id, p.item_id,
+                                   int(c), float(pr))
+                for p, c, pr in zip(pairs, classes, probs)]
+
+    @staticmethod
+    def _top_k(preds: "list[UserItemPrediction]", key_fn, k: int
+               ) -> "list[UserItemPrediction]":
+        groups: "dict[int, list[UserItemPrediction]]" = {}
+        for p in preds:
+            groups.setdefault(key_fn(p), []).append(p)
+        out: "list[UserItemPrediction]" = []
+        for _, items in sorted(groups.items()):
+            items.sort(key=lambda p: (-p.prediction, -p.probability))
+            out.extend(items[:k])
+        return out
+
+    def recommend_for_user(self, pairs: "list[UserItemFeature]",
+                           max_items: int) -> "list[UserItemPrediction]":
+        """(reference `recommendForUser`)"""
+        preds = self.predict_user_item_pair(pairs)
+        return self._top_k(preds, lambda p: p.user_id, max_items)
+
+    def recommend_for_item(self, pairs: "list[UserItemFeature]",
+                           max_users: int) -> "list[UserItemPrediction]":
+        """(reference `recommendForItem`)"""
+        preds = self.predict_user_item_pair(pairs)
+        return self._top_k(preds, lambda p: p.item_id, max_users)

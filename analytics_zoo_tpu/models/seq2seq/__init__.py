@@ -1,0 +1,4 @@
+from analytics_zoo_tpu.models.seq2seq.seq2seq import (
+    Seq2seq, RNNEncoder, RNNDecoder, Bridge)
+
+__all__ = ["Seq2seq", "RNNEncoder", "RNNDecoder", "Bridge"]

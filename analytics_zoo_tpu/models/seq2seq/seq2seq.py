@@ -1,0 +1,242 @@
+"""Seq2seq (reference `Z/models/seq2seq/Seq2seq.scala:50-302`,
+`RNNEncoder`/`RNNDecoder`, `Bridge`): generic RNN encoder-decoder with a
+state bridge, teacher-forcing training on `[encoder_input,
+decoder_input]`, and a greedy `infer` loop feeding back the last
+timestep (same contract as the reference's `infer:114-150`).
+
+The encoder/decoder stacks reuse the framework's `lax.scan` RNN layers;
+state handoff uses `call_with_state` rather than BigDL's SelectTable
+node plumbing.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.models.common import ZooModel
+from analytics_zoo_tpu.pipeline.api.keras.engine import KerasLayer, Shape
+from analytics_zoo_tpu.pipeline.api.keras.models import KerasNet
+from analytics_zoo_tpu.pipeline.api.keras.layers import Dense, GRU, LSTM
+
+
+def _make_rnn(rnn_type: str, hidden: int, name: str):
+    t = rnn_type.lower()
+    if t == "lstm":
+        return LSTM(hidden, return_sequences=True, name=name)
+    if t == "gru":
+        return GRU(hidden, return_sequences=True, name=name)
+    raise ValueError(f"unsupported rnn type {rnn_type}")
+
+
+class RNNEncoder:
+    """(reference `RNNEncoder.scala`) — a stack of recurrent layers whose
+    final carries are exposed to the decoder."""
+
+    def __init__(self, rnn_type: str = "lstm", num_layers: int = 1,
+                 hidden_size: int = 128):
+        self.rnn_type = rnn_type
+        self.num_layers = int(num_layers)
+        self.hidden_size = int(hidden_size)
+        self.rnns = [_make_rnn(rnn_type, hidden_size, f"enc_rnn_{i}")
+                     for i in range(self.num_layers)]
+
+
+class RNNDecoder:
+    """(reference `RNNDecoder.scala`)"""
+
+    def __init__(self, rnn_type: str = "lstm", num_layers: int = 1,
+                 hidden_size: int = 128):
+        self.rnn_type = rnn_type
+        self.num_layers = int(num_layers)
+        self.hidden_size = int(hidden_size)
+        self.rnns = [_make_rnn(rnn_type, hidden_size, f"dec_rnn_{i}")
+                     for i in range(self.num_layers)]
+
+
+class Bridge:
+    """(reference `Bridge.scala`): adapts encoder final states into
+    decoder initial states — "dense" (linear), "densenonlinear" (tanh),
+    or "passthrough"."""
+
+    def __init__(self, bridge_type: str = "passthrough"):
+        if bridge_type not in ("passthrough", "dense", "densenonlinear"):
+            raise ValueError(f"unsupported bridge type {bridge_type}")
+        self.bridge_type = bridge_type
+        self.denses: "list[Dense]" = []
+
+    def make_layers(self, num_states: int, hidden: int) -> "list[Dense]":
+        if self.bridge_type == "passthrough":
+            self.denses = []
+        else:
+            act = None if self.bridge_type == "dense" else "tanh"
+            self.denses = [Dense(hidden, activation=act,
+                                 name=f"bridge_{i}")
+                           for i in range(num_states)]
+        return self.denses
+
+
+class _Seq2seqNet(KerasNet):
+    """The compiled container: inputs [enc_seq, dec_seq]."""
+
+    def __init__(self, encoder: RNNEncoder, decoder: RNNDecoder,
+                 bridge: Bridge, generator: Optional[KerasLayer],
+                 input_shape: Shape, output_shape: Shape):
+        super().__init__(name="seq2seq")
+        self.encoder = encoder
+        self.decoder = decoder
+        self.bridge = bridge
+        self.generator = generator
+        self._enc_shape = tuple(input_shape)
+        self._dec_shape = tuple(output_shape)
+        self._given_input_shape = [self._enc_shape, self._dec_shape]
+        states_per_layer = 2 if encoder.rnn_type.lower() == "lstm" else 1
+        self._n_states = decoder.num_layers * states_per_layer
+        self.bridge.make_layers(self._n_states, decoder.hidden_size)
+
+    @property
+    def layers(self):
+        out = list(self.encoder.rnns) + list(self.decoder.rnns) + \
+            list(self.bridge.denses)
+        if self.generator is not None:
+            out.append(self.generator)
+        return out
+
+    def build(self, rng, input_shape) -> dict:
+        params = {}
+        keys = jax.random.split(rng, len(self.layers))
+        ki = 0
+        shape = self._enc_shape
+        for r in self.encoder.rnns:
+            params[r.name] = r.init(keys[ki], shape)
+            ki += 1
+            shape = (shape[0], r.output_dim)
+        shape = self._dec_shape
+        for r in self.decoder.rnns:
+            params[r.name] = r.init(keys[ki], shape)
+            ki += 1
+            shape = (shape[0], r.output_dim)
+        for d in self.bridge.denses:
+            params[d.name] = d.init(
+                keys[ki], (self.encoder.hidden_size,))
+            ki += 1
+        if self.generator is not None:
+            params[self.generator.name] = self.generator.init(
+                keys[ki], shape)
+        return params
+
+    def _flatten_states(self, carries):
+        flat = []
+        for c in carries:
+            if isinstance(c, tuple):
+                flat.extend(c)
+            else:
+                flat.append(c)
+        return flat
+
+    def _unflatten_states(self, flat):
+        lstm = self.decoder.rnn_type.lower() == "lstm"
+        out = []
+        i = 0
+        for _ in range(self.decoder.num_layers):
+            if lstm:
+                out.append((flat[i], flat[i + 1]))
+                i += 2
+            else:
+                out.append(flat[i])
+                i += 1
+        return out
+
+    def apply(self, params, inputs, *, training=False, rng=None):
+        enc_in, dec_in = inputs
+        x = enc_in
+        carries = []
+        for r in self.encoder.rnns:
+            x, carry = r.call_with_state(params[r.name], x,
+                                         training=training, rng=rng)
+            carries.append(carry)
+        flat = self._flatten_states(carries)
+        if self.bridge.denses:
+            flat = [d.call(params[d.name], s)
+                    for d, s in zip(self.bridge.denses, flat)]
+        init_states = self._unflatten_states(flat)
+        y = dec_in
+        for r, state in zip(self.decoder.rnns, init_states):
+            y, _ = r.call_with_state(params[r.name], y,
+                                     initial_carry=state,
+                                     training=training, rng=rng)
+        if self.generator is not None:
+            y = self.generator.call(params[self.generator.name], y,
+                                    training=training, rng=rng)
+        return y, {}
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        out, _ = self.apply(params, inputs, training=training, rng=rng)
+        return out
+
+    def compute_output_shape(self, input_shape):
+        shape = (self._dec_shape[0], self.decoder.hidden_size)
+        if self.generator is not None:
+            shape = tuple(self.generator.compute_output_shape(shape))
+        return shape
+
+
+class Seq2seq(ZooModel):
+    def __init__(self, encoder: "RNNEncoder | None" = None,
+                 decoder: "RNNDecoder | None" = None,
+                 input_shape: Sequence[int] = (10, 32),
+                 output_shape: Sequence[int] = (10, 32),
+                 bridge: "Bridge | str | None" = None,
+                 generator: Optional[KerasLayer] = None):
+        super().__init__()
+        self.encoder = encoder or RNNEncoder()
+        self.decoder = decoder or RNNDecoder(
+            rnn_type=self.encoder.rnn_type,
+            num_layers=self.encoder.num_layers,
+            hidden_size=self.encoder.hidden_size)
+        if self.encoder.rnn_type.lower() != \
+                self.decoder.rnn_type.lower():
+            raise ValueError("encoder/decoder rnn types must match")
+        if isinstance(bridge, str):
+            bridge = Bridge(bridge)
+        self.bridge = bridge or Bridge("passthrough")
+        self.generator = generator
+        self.input_shape = tuple(input_shape)
+        self.output_shape = tuple(output_shape)
+
+    def hyper_parameters(self):
+        # encoder/decoder/bridge/generator are rebuilt from these
+        return {"encoder": None, "decoder": None,
+                "input_shape": self.input_shape,
+                "output_shape": self.output_shape}
+
+    def build_model(self) -> _Seq2seqNet:
+        return _Seq2seqNet(self.encoder, self.decoder, self.bridge,
+                           self.generator, self.input_shape,
+                           self.output_shape)
+
+    def infer(self, input_seq: np.ndarray, start_sign: np.ndarray,
+              max_seq_len: int = 30,
+              stop_sign: Optional[np.ndarray] = None) -> np.ndarray:
+        """Greedy generation (reference `infer:114-150`): start from
+        `start_sign`, repeatedly feed the growing sequence, append the
+        last-timestep output; stop at `stop_sign` or `max_seq_len`."""
+        est = self.model.estimator
+        est._ensure_initialized()
+        params = est.params
+        if input_seq.ndim == 2:
+            input_seq = input_seq[None]
+        cur = np.asarray(start_sign, np.float32).reshape(
+            (1, 1) + np.asarray(start_sign).shape[-1:])
+        for _ in range(max_seq_len):
+            out = np.asarray(self.model.forward(
+                params, [jnp.asarray(input_seq), jnp.asarray(cur)]))
+            nxt = out[:, -1:, :]
+            if stop_sign is not None and np.allclose(
+                    nxt[0, 0], stop_sign, atol=1e-8):
+                break
+            cur = np.concatenate([cur, nxt], axis=1)
+        return cur

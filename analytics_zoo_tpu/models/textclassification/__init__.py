@@ -1,0 +1,4 @@
+from analytics_zoo_tpu.models.textclassification.text_classifier import (
+    TextClassifier)
+
+__all__ = ["TextClassifier"]

@@ -1,0 +1,70 @@
+"""TextClassifier (reference
+`Z/models/textclassification/TextClassifier.scala:34-70`): CNN/LSTM/GRU
+encoder → Dense(128) → Dropout(0.2) → ReLU → Dense(class_num, softmax).
+
+Two input modes, like the reference:
+- with an `embedding` layer (e.g. `WordEmbedding.from_glove`): input is
+  (sequence_length,) token ids;
+- without: input is pre-embedded (sequence_length, token_length).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from analytics_zoo_tpu.models.common import ZooModel
+from analytics_zoo_tpu.pipeline.api.keras.models import Sequential
+from analytics_zoo_tpu.pipeline.api.keras.layers import (
+    Activation, Convolution1D, Dense, Dropout, GlobalMaxPooling1D, GRU,
+    LSTM)
+from analytics_zoo_tpu.pipeline.api.keras.engine import KerasLayer
+
+
+class TextClassifier(ZooModel):
+    def __init__(self, class_num: int, token_length: int = 200,
+                 sequence_length: int = 500, encoder: str = "cnn",
+                 encoder_output_dim: int = 256,
+                 embedding: Optional[KerasLayer] = None):
+        super().__init__()
+        if encoder.lower() not in ("cnn", "lstm", "gru"):
+            raise ValueError(f"unsupported encoder {encoder}")
+        self.class_num = int(class_num)
+        self.token_length = int(token_length)
+        self.sequence_length = int(sequence_length)
+        self.encoder = encoder.lower()
+        self.encoder_output_dim = int(encoder_output_dim)
+        self.embedding = embedding
+
+    def hyper_parameters(self):
+        return {"class_num": self.class_num,
+                "token_length": self.token_length,
+                "sequence_length": self.sequence_length,
+                "encoder": self.encoder,
+                "encoder_output_dim": self.encoder_output_dim}
+
+    def build_model(self) -> Sequential:
+        m = Sequential(name="text_classifier")
+        if self.embedding is not None:
+            if self.embedding._given_input_shape is None:
+                self.embedding._given_input_shape = \
+                    (self.sequence_length,)
+            m.add(self.embedding)
+            first_shape = None
+        else:
+            first_shape = (self.sequence_length, self.token_length)
+        if self.encoder == "cnn":
+            m.add(Convolution1D(self.encoder_output_dim, 5,
+                                activation="relu",
+                                input_shape=first_shape))
+            m.add(GlobalMaxPooling1D())
+        elif self.encoder == "lstm":
+            m.add(LSTM(self.encoder_output_dim,
+                       input_shape=first_shape))
+        else:
+            m.add(GRU(self.encoder_output_dim,
+                      input_shape=first_shape))
+        m.add(Dense(128))
+        m.add(Dropout(0.2))
+        m.add(Activation("relu"))
+        m.add(Dense(self.class_num, activation="softmax"))
+        return m

@@ -75,6 +75,7 @@ class KerasLayer:
         if kwargs:
             raise TypeError(
                 f"{type(self).__name__}: unexpected kwargs {list(kwargs)}")
+        self._auto_named = name is None
         self.name = name or unique_name(type(self).__name__.lower())
         self.trainable = trainable
         self._given_input_shape = (

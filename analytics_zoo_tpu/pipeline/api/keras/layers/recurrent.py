@@ -67,7 +67,11 @@ class _RNNBase(KerasLayer):
         output."""
         raise NotImplementedError
 
-    def call(self, params, x, *, training=False, rng=None):
+    def call_with_state(self, params, x, initial_carry=None, *,
+                        training=False, rng=None):
+        """Run the RNN returning (sequence outputs (B, T, H), final
+        carry). `initial_carry` enables encoder→decoder state handoff
+        (the reference Seq2seq `Bridge` contract)."""
         if self.go_backwards:
             x = jnp.flip(x, axis=1)
         b = x.shape[0]
@@ -75,16 +79,22 @@ class _RNNBase(KerasLayer):
         zx = x @ params["kernel"].astype(x.dtype) + \
             params["bias"].astype(x.dtype)
         zx_t = jnp.swapaxes(zx, 0, 1)  # (T, B, G·H)
-        carry0 = self.carry_init(b, x.dtype)
+        carry0 = (initial_carry if initial_carry is not None
+                  else self.carry_init(b, x.dtype))
 
         def scan_fn(carry, z):
             new_carry, out = self.step(params, carry, z)
             return new_carry, out
 
-        _, outs = jax.lax.scan(scan_fn, carry0, zx_t)
+        final_carry, outs = jax.lax.scan(scan_fn, carry0, zx_t)
+        return jnp.swapaxes(outs, 0, 1), final_carry
+
+    def call(self, params, x, *, training=False, rng=None):
+        outs, _ = self.call_with_state(params, x, training=training,
+                                       rng=rng)
         if self.return_sequences:
-            return jnp.swapaxes(outs, 0, 1)  # (B, T, H)
-        return outs[-1]
+            return outs  # (B, T, H)
+        return outs[:, -1]
 
     def carry_init(self, batch, dtype):
         return self.initial_state(batch, dtype)

@@ -34,6 +34,25 @@ class KerasNet(KerasLayer):
     def __init__(self, name: Optional[str] = None):
         super().__init__(name=name)
 
+    def _canonicalize_names(self, layers: "list[KerasLayer]") -> None:
+        """Rename auto-named layers to container-scoped deterministic
+        names (`dense_1`, `dense_2`, ... in container order).
+
+        Auto-generated names are process-global counters, so two builds
+        of the same architecture get different names; params dicts are
+        keyed by name, so checkpoints/save_model would not transfer.
+        Scoping the numbering to the container makes names a pure
+        function of the architecture. User-provided names are kept.
+        Note: a shared layer re-used across two separately-built models
+        is renamed by whichever container canonicalizes it last.
+        """
+        counters: "dict[str, int]" = {}
+        for lyr in layers:
+            prefix = type(lyr).__name__.lower()
+            counters[prefix] = counters.get(prefix, 0) + 1
+            if getattr(lyr, "_auto_named", False):
+                lyr.name = f"{prefix}_{counters[prefix]}"
+
     # -- to be provided by subclasses ---------------------------------------
     @property
     def layers(self) -> "list[KerasLayer]":
@@ -257,6 +276,7 @@ class Sequential(KerasNet):
             raise ValueError(
                 "first layer of a Sequential needs input_shape=...")
         self._layers.append(layer)
+        self._canonicalize_names(self._layers)
         return self
 
     def build(self, rng, input_shape: ShapeLike) -> dict:
@@ -332,6 +352,14 @@ class Model(KerasNet):
                 raise ValueError(f"input {v} is not connected to outputs")
         self._graph_layers = collect_layers(self._order)
         self._multi_out = isinstance(outputs, (list, tuple))
+        # deterministic names: rename auto-named layers in graph order,
+        # keeping node names in sync for new_graph/freeze_up_to lookups
+        old_names = {id(lyr): lyr.name for lyr in self._graph_layers}
+        self._canonicalize_names(self._graph_layers)
+        for v in self._order:
+            if v.layer is not None and \
+                    v.name == old_names.get(id(v.layer)):
+                v.name = v.layer.name
 
     @property
     def layers(self) -> "list[KerasLayer]":

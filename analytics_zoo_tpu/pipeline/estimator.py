@@ -467,7 +467,8 @@ class Estimator:
                 fname = os.path.join(path, f.read().strip())
         with open(fname, "rb") as f:
             state = pickle.load(f)
-        params = _remap_layer_names(self.model, state["params"])
+        params = state["params"]
+        _check_params_compatible(self.model, params)
         self.params = shard_params(params, self.ctx.mesh)
         # opt_state leaves are keyed by the saving process's layer names;
         # rebuild the state tree for THIS model and pour the leaves in
@@ -487,26 +488,18 @@ class Estimator:
         return self
 
 
-def _remap_layer_names(model, saved: dict) -> dict:
-    """Re-key a saved params dict onto this model instance's layer names.
-
-    Auto-generated layer names (`dense_7`, ...) differ between processes;
-    structure (layer order + shapes) is the stable identity — the same
-    positional contract BigDL uses when loading module snapshots.
-    """
-    from analytics_zoo_tpu.pipeline.api.keras.models import KerasNet
-    if not isinstance(model, KerasNet):
-        return saved
-    layers = model.layers
-    if len(layers) != len(saved):
+def _check_params_compatible(model, saved: dict) -> None:
+    """Layer names are deterministic per architecture
+    (`KerasNet._canonicalize_names`), so a checkpoint's keys must match
+    this model's layer names exactly; mismatch means a different
+    architecture (or user-renamed layers)."""
+    expected = {lyr.name for lyr in model.layers}
+    got = set(saved)
+    if expected != got:
         raise ValueError(
-            f"checkpoint has {len(saved)} layer entries but model "
-            f"{model.name} has {len(layers)} layers")
-    out = {}
-    for lyr, (_, sub) in zip(layers, saved.items()):
-        out[lyr.name] = (_remap_layer_names(lyr, sub)
-                         if isinstance(lyr, KerasNet) else sub)
-    return out
+            "checkpoint does not match model architecture; missing "
+            f"layers {sorted(expected - got)}, unexpected "
+            f"{sorted(got - expected)}")
 
 
 def _batch_dim(x) -> int:

@@ -1,0 +1,211 @@
+"""Model-zoo specs (reference pattern §4.5: each model gets a
+train-few-steps + save/load + predict spec, e.g. `NeuralCFSpec.scala`,
+`TextClassifierSpec.scala`)."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu import init_nncontext
+from analytics_zoo_tpu.models.anomalydetection import AnomalyDetector
+from analytics_zoo_tpu.models.common import Ranker, ZooModel
+from analytics_zoo_tpu.models.image.imageclassification import (
+    ImageClassifier, lenet5)
+from analytics_zoo_tpu.models.recommendation import (
+    ColumnFeatureInfo, NeuralCF, UserItemFeature, WideAndDeep)
+from analytics_zoo_tpu.models.seq2seq import (
+    Bridge, RNNDecoder, RNNEncoder, Seq2seq)
+from analytics_zoo_tpu.models.textclassification import TextClassifier
+from analytics_zoo_tpu.models.textmatching import KNRM
+from analytics_zoo_tpu.ops.optimizers import Adam
+
+
+@pytest.fixture(autouse=True)
+def _ctx():
+    init_nncontext(seed=0)
+    yield
+
+
+def _pairs_data(n=64, users=20, items=30, classes=5, seed=0):
+    rs = np.random.RandomState(seed)
+    x = np.stack([rs.randint(0, users, n),
+                  rs.randint(0, items, n)], axis=1).astype(np.float32)
+    y = rs.randint(0, classes, (n, 1)).astype(np.int32)
+    return x, y
+
+
+def test_neuralcf_train_predict_recommend(tmp_path):
+    x, y = _pairs_data()
+    ncf = NeuralCF(user_count=20, item_count=30, num_classes=5)
+    ncf.compile(optimizer=Adam(lr=0.01), loss="class_nll",
+                metrics=["accuracy"])
+    res = ncf.fit(x, y, batch_size=16, nb_epoch=2)
+    assert len(res.history) == 2
+    logp = ncf.predict(x, batch_size=16)
+    assert logp.shape == (64, 5)
+    assert np.all(logp <= 0)  # log-probabilities
+
+    pairs = [UserItemFeature(int(u), int(i), np.asarray([u, i],
+                                                        np.float32))
+             for u, i in x[:10]]
+    recs = ncf.recommend_for_user(pairs, max_items=2)
+    assert all(r.probability <= 1.0 + 1e-6 for r in recs)
+    by_user = {}
+    for r in recs:
+        by_user.setdefault(r.user_id, []).append(r)
+    assert all(len(v) <= 2 for v in by_user.values())
+
+    # save / load round trip
+    path = str(tmp_path / "ncf.model")
+    ncf.save_model(path)
+    loaded = ZooModel.load_model(path)
+    np.testing.assert_allclose(loaded.predict(x[:8], batch_size=8),
+                               logp[:8], rtol=1e-5, atol=1e-6)
+
+
+def test_wide_and_deep_variants():
+    info = ColumnFeatureInfo(
+        wide_base_dims=[5, 5], wide_cross_dims=[10],
+        indicator_dims=[3], embed_in_dims=[20], embed_out_dims=[8],
+        continuous_cols=["age"])
+    rs = np.random.RandomState(0)
+    n = 32
+    x_wide = (rs.rand(n, info.wide_dim) > 0.8).astype(np.float32)
+    ind = np.eye(3, dtype=np.float32)[rs.randint(0, 3, n)]
+    embed_ids = rs.randint(0, 20, (n, 1)).astype(np.float32)
+    cont = rs.randn(n, 1).astype(np.float32)
+    x_deep = np.concatenate([ind, embed_ids, cont], axis=1)
+    y = rs.randint(0, 2, (n, 1)).astype(np.int32)
+
+    wnd = WideAndDeep("wide_n_deep", num_classes=2, column_info=info)
+    wnd.compile(optimizer=Adam(lr=0.01), loss="class_nll")
+    wnd.fit([x_wide, x_deep], y, batch_size=16, nb_epoch=2)
+    out = wnd.predict([x_wide, x_deep], batch_size=16)
+    assert out.shape == (n, 2)
+
+    wide = WideAndDeep("wide", num_classes=2, column_info=info)
+    wide.compile(optimizer=Adam(lr=0.01), loss="class_nll")
+    assert wide.predict(x_wide, batch_size=16).shape == (n, 2)
+
+    deep = WideAndDeep("deep", num_classes=2, column_info=info)
+    deep.compile(optimizer=Adam(lr=0.01), loss="class_nll")
+    assert deep.predict(x_deep, batch_size=16).shape == (n, 2)
+
+
+def test_text_classifier_cnn_and_gru():
+    rs = np.random.RandomState(0)
+    n, seq, tok = 32, 20, 16
+    x = rs.randn(n, seq, tok).astype(np.float32)
+    y = rs.randint(0, 3, (n, 1)).astype(np.int32)
+    for encoder in ("cnn", "gru"):
+        tc = TextClassifier(class_num=3, token_length=tok,
+                            sequence_length=seq, encoder=encoder,
+                            encoder_output_dim=16)
+        tc.compile(optimizer=Adam(lr=0.01),
+                   loss="sparse_categorical_crossentropy",
+                   metrics=["accuracy"])
+        res = tc.fit(x, y, batch_size=16, nb_epoch=1)
+        probs = tc.predict(x, batch_size=16)
+        assert probs.shape == (n, 3)
+        np.testing.assert_allclose(probs.sum(-1), 1.0, rtol=1e-4)
+
+
+def test_text_classifier_with_embedding():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Embedding
+    rs = np.random.RandomState(0)
+    n, seq = 16, 10
+    x = rs.randint(0, 50, (n, seq)).astype(np.float32)
+    y = rs.randint(0, 2, (n, 1)).astype(np.int32)
+    tc = TextClassifier(class_num=2, sequence_length=seq, encoder="cnn",
+                        encoder_output_dim=8,
+                        embedding=Embedding(50, 12, input_shape=(seq,)))
+    tc.compile(optimizer=Adam(lr=0.01),
+               loss="sparse_categorical_crossentropy")
+    tc.fit(x, y, batch_size=8, nb_epoch=1)
+    assert tc.predict(x, batch_size=8).shape == (n, 2)
+
+
+def test_knrm_ranking_train_and_metrics():
+    rs = np.random.RandomState(0)
+    t1, t2, vocab = 5, 8, 40
+    n_pairs = 16  # rows = 32, alternating pos/neg
+    x = rs.randint(1, vocab, (2 * n_pairs, t1 + t2)).astype(np.float32)
+    y = np.zeros((2 * n_pairs, 1), np.float32)  # ignored by rank_hinge
+    knrm = KNRM(t1, t2, vocab, embed_size=16, kernel_num=5)
+    knrm.compile(optimizer=Adam(lr=0.01), loss="rank_hinge")
+    res = knrm.fit(x, y, batch_size=16, nb_epoch=2)
+    assert np.isfinite(res.history[-1]["loss"])
+    scores = knrm.predict(x, batch_size=16)
+    assert scores.shape == (2 * n_pairs, 1)
+
+    # ranking metrics via the Ranker mixin
+    labels = np.tile([1, 0], n_pairs)
+    gids = np.repeat(np.arange(n_pairs), 2)
+    ndcg = knrm.evaluate_ndcg(scores.reshape(-1), labels, gids, k=1)
+    mapv = knrm.evaluate_map(scores.reshape(-1), labels, gids)
+    assert 0.0 <= ndcg <= 1.0
+    assert 0.0 <= mapv <= 1.0
+
+
+def test_ranker_metrics_known_values():
+    r = Ranker()
+    # two queries; perfect ranking in q0, inverted in q1
+    scores = np.array([0.9, 0.1, 0.2, 0.8])
+    labels = np.array([1, 0, 1, 0])
+    gids = np.array([0, 0, 1, 1])
+    assert r.evaluate_ndcg(scores, labels, gids, k=1) == \
+        pytest.approx(0.5)
+    assert r.evaluate_map(scores, labels, gids) == pytest.approx(0.75)
+
+
+def test_anomaly_detector_unroll_train_detect():
+    ts = np.sin(np.linspace(0, 20, 200)).astype(np.float32)
+    ts[150] += 5.0  # planted anomaly
+    indexed = AnomalyDetector.unroll(ts, unroll_length=10)
+    x, y = AnomalyDetector.to_arrays(indexed)
+    assert x.shape == (190, 10, 1)
+    ad = AnomalyDetector(feature_shape=(10, 1), hidden_layers=(8, 8),
+                         dropouts=(0.1, 0.1))
+    ad.compile(optimizer=Adam(lr=0.01), loss="mse")
+    ad.fit(x, y, batch_size=32, nb_epoch=1)
+    preds = ad.predict(x, batch_size=32)
+    idx, threshold = AnomalyDetector.detect_anomalies(y, preds,
+                                                      anomaly_size=5)
+    assert len(idx) >= 5
+    # the planted spike (label index 150-10=140) should be flagged
+    assert any(135 <= i <= 145 for i in idx)
+
+
+def test_seq2seq_train_and_infer():
+    rs = np.random.RandomState(0)
+    n, t_in, t_out, f = 32, 6, 5, 8
+    enc = rs.randn(n, t_in, f).astype(np.float32)
+    dec = rs.randn(n, t_out, f).astype(np.float32)
+    target = np.cumsum(dec, axis=1).astype(np.float32)
+
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    s2s = Seq2seq(encoder=RNNEncoder("lstm", 2, 16),
+                  decoder=RNNDecoder("lstm", 2, 16),
+                  input_shape=(t_in, f), output_shape=(t_out, f),
+                  bridge=Bridge("dense"),
+                  generator=Dense(f, name="generator"))
+    s2s.compile(optimizer=Adam(lr=0.01), loss="mse")
+    res = s2s.fit([enc, dec], target, batch_size=16, nb_epoch=2)
+    assert res.history[-1]["loss"] < res.history[0]["loss"] * 2
+
+    out = s2s.model.predict([enc, dec], batch_size=16)
+    assert out.shape == (n, t_out, f)
+
+    gen = s2s.infer(enc[0], start_sign=np.ones(f), max_seq_len=4)
+    assert gen.shape[1] == 5  # start + 4 generated
+    assert gen.shape[2] == f
+
+
+def test_image_classifier_named_archs():
+    ic = ImageClassifier("lenet-5", input_shape=(28, 28, 1), classes=10)
+    ic.compile(optimizer=Adam(lr=0.01),
+               loss="sparse_categorical_crossentropy")
+    rs = np.random.RandomState(0)
+    x = rs.randn(16, 28, 28, 1).astype(np.float32)
+    y = rs.randint(0, 10, (16, 1)).astype(np.int32)
+    ic.fit(x, y, batch_size=8, nb_epoch=1)
+    assert ic.predict(x, batch_size=8).shape == (16, 10)
