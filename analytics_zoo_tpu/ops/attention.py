@@ -1,0 +1,63 @@
+"""Attention ops.
+
+The reference's longest context is BERT-512 with dense attention inside
+`TransformerLayer.scala`/`BERT.scala` (SURVEY.md §5 "Long-context:
+absent"). Here attention is a first-class op with two interchangeable
+implementations:
+
+- :func:`dot_product_attention` — plain XLA (fused by the compiler);
+- `parallel.ring_attention` — sequence-parallel ring attention over a
+  mesh axis for long contexts (K/V blocks rotate over ICI while each
+  device accumulates flash-style softmax statistics).
+
+Both share the same blockwise-softmax accumulation math, so ring == dense
+numerically (tested to 1e-5).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def dot_product_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                          mask: Optional[jnp.ndarray] = None,
+                          causal: bool = False,
+                          scale: Optional[float] = None) -> jnp.ndarray:
+    """Standard attention. q,k,v: (B, T, H, D) → (B, T, H, D).
+
+    `mask`: broadcastable to (B, H, Tq, Tk), 1 = attend. Softmax in f32
+    regardless of input dtype (bf16-safe).
+    """
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    # (B, H, Tq, Tk)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    logits = logits * scale
+    if causal:
+        tq, tk = logits.shape[-2], logits.shape[-1]
+        causal_mask = jnp.tril(jnp.ones((tq, tk), jnp.bool_),
+                               k=tk - tq)
+        logits = jnp.where(causal_mask, logits, -1e30)
+    if mask is not None:
+        logits = jnp.where(mask.astype(jnp.bool_), logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _flash_block_update(carry, s, v_blk):
+    """One blockwise-softmax accumulation step (shared by ring
+    attention). carry = (o_acc, m, l); s: (B, H, Tq, Tk_blk) f32 logits;
+    v_blk: (B, Tk_blk, H, D)."""
+    o_acc, m, l = carry
+    m_blk = jnp.max(s, axis=-1)               # (B, H, Tq)
+    m_new = jnp.maximum(m, m_blk)
+    alpha = jnp.exp(m - m_new)                # rescale old accumulator
+    p = jnp.exp(s - m_new[..., None])         # (B, H, Tq, Tk)
+    l_new = l * alpha + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_blk.dtype), v_blk)
+    o_new = o_acc * alpha.transpose(0, 2, 1)[..., None] + \
+        pv.astype(jnp.float32)
+    return o_new, m_new, l_new

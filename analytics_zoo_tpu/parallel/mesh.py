@@ -97,6 +97,32 @@ def shard_batch(batch: Any, mesh: Mesh,
     return jax.tree_util.tree_map(_put, batch)
 
 
+def auto_fsdp_sharding(mesh: Mesh, x, axis: str = "fsdp",
+                       min_elems: int = 2 ** 12) -> NamedSharding:
+    """Pick a ZeRO-style sharding for one param leaf: shard the largest
+    dim divisible by the axis size; replicate small/indivisible leaves.
+    XLA all-gathers shards just-in-time inside the jit'd step (GSPMD),
+    which is the compiler-native form of ZeRO-3."""
+    if axis not in mesh.axis_names:
+        return NamedSharding(mesh, P())
+    n = mesh.shape[axis]
+    if n == 1 or x.size < min_elems:
+        return NamedSharding(mesh, P())
+    dims = sorted(range(x.ndim), key=lambda d: -x.shape[d])
+    for d in dims:
+        if x.shape[d] % n == 0:
+            spec = [None] * x.ndim
+            spec[d] = axis
+            return NamedSharding(mesh, P(*spec))
+    return NamedSharding(mesh, P())
+
+
+def shard_params_fsdp(params: Any, mesh: Mesh, axis: str = "fsdp") -> Any:
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, auto_fsdp_sharding(mesh, x, axis)),
+        params)
+
+
 def shard_params(params: Any, mesh: Mesh,
                  rules: Optional[ShardingRules] = None,
                  logical_axes: Any = None) -> Any:

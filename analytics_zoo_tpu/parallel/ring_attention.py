@@ -1,0 +1,78 @@
+"""Ring attention — sequence/context parallelism over a mesh axis.
+
+Absent from the reference (max context 512, SURVEY.md §5); first-class
+here because it shapes the core design for long-context training. The
+sequence axis of Q/K/V is sharded over the mesh's ``seq`` axis; each
+device holds one Q block and rotates K/V blocks around the ring with
+`lax.ppermute` (ICI neighbor exchange), accumulating flash-style
+blockwise softmax statistics — attention over sequence length S costs
+O(S/n) memory per device and overlaps compute with the K/V rotation.
+
+Causal masking uses global block indices: ring step t on device i
+processes the K/V block originally resident on device (i - t) mod n.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from analytics_zoo_tpu.ops.attention import _flash_block_update
+
+
+def _ring_attention_local(q, k, v, axis_name: str, causal: bool,
+                          scale: Optional[float]):
+    """Inside-shard_map body. q,k,v: (B, T_loc, H, D) local blocks."""
+    n = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, t_loc, h, d = q.shape
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+
+    q_pos = my_idx * t_loc + jnp.arange(t_loc)          # global q rows
+    local_pos = jnp.arange(t_loc)
+
+    def step(t, carry):
+        o_acc, m, l, k_blk, v_blk = carry
+        src = (my_idx - t) % n                           # block origin
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk) \
+            .astype(jnp.float32) * scale
+        if causal:
+            k_pos = src * t_loc + local_pos
+            mask = q_pos[:, None] >= k_pos[None, :]      # (Tq, Tk)
+            s = jnp.where(mask[None, None], s, -1e30)
+        o_acc, m, l = _flash_block_update((o_acc, m, l), s, v_blk)
+        # rotate K/V to the next device on the ring (skip after last)
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return o_acc, m, l, k_blk, v_blk
+
+    o0 = jnp.zeros((b, t_loc, h, d), jnp.float32)
+    m0 = jnp.full((b, h, t_loc), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, t_loc), jnp.float32)
+    o, m, l, _, _ = jax.lax.fori_loop(0, n, step, (o0, m0, l0, k, v))
+    denom = l.transpose(0, 2, 1)[..., None]              # (B, Tq, H, 1)
+    return (o / jnp.maximum(denom, 1e-30)).astype(q.dtype)
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   mesh: Mesh, axis: str = "seq",
+                   causal: bool = False,
+                   scale: Optional[float] = None) -> jnp.ndarray:
+    """Sequence-parallel attention. q,k,v: (B, T, H, D) with T sharded
+    over `axis`; returns (B, T, H, D) sharded the same way. Falls back
+    to a single-block computation when the axis is absent or size 1."""
+    if axis not in mesh.axis_names or mesh.shape[axis] == 1:
+        from analytics_zoo_tpu.ops.attention import dot_product_attention
+        return dot_product_attention(q, k, v, causal=causal, scale=scale)
+    spec = P(None, axis, None, None)
+    fn = jax.shard_map(
+        functools.partial(_ring_attention_local, axis_name=axis,
+                          causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)

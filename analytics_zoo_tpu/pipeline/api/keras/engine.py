@@ -156,11 +156,32 @@ class KerasLayer:
             [p.shape for p in parents] if len(parents) > 1
             else parents[0].shape)
         out_shape = self.compute_output_shape(in_shape)
+        if is_multi_shape(out_shape):
+            # multi-output layer (e.g. BERT): one base node evaluating
+            # to the list, plus one selector Variable per output
+            base = Variable(shape=(), layer=self, parents=parents)
+            return [_TupleSelect(i)(base, shape=as_shape(s))
+                    for i, s in enumerate(out_shape)]
         return Variable(shape=as_shape(out_shape), layer=self,
                         parents=parents)
 
     def __repr__(self):
         return f"{type(self).__name__}(name={self.name})"
+
+
+class _TupleSelect(KerasLayer):
+    """Selects the i-th element of a multi-output layer's result."""
+
+    def __init__(self, index: int, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.index = int(index)
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        return inputs[self.index]
+
+    def __call__(self, base: "Variable", shape: Optional[Shape] = None
+                 ) -> "Variable":
+        return Variable(shape=shape or (), layer=self, parents=[base])
 
 
 class _InputLayer(KerasLayer):

@@ -28,6 +28,8 @@ from analytics_zoo_tpu.pipeline.api.keras.layers.advanced_activations \
 from analytics_zoo_tpu.pipeline.api.keras.layers.noise import (
     GaussianNoise, GaussianDropout, SpatialDropout1D, SpatialDropout2D,
     SpatialDropout3D)
+from analytics_zoo_tpu.pipeline.api.keras.layers.transformer import (
+    MultiHeadAttention, TransformerLayer, BERT)
 
 __all__ = [
     # core
@@ -59,4 +61,6 @@ __all__ = [
     # noise
     "GaussianNoise", "GaussianDropout", "SpatialDropout1D",
     "SpatialDropout2D", "SpatialDropout3D",
+    # transformer
+    "MultiHeadAttention", "TransformerLayer", "BERT",
 ]

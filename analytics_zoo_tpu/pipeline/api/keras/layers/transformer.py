@@ -1,0 +1,354 @@
+"""Transformer layers: MultiHeadAttention, TransformerLayer (GPT-style),
+BERT.
+
+Reference surface: `Z/pipeline/api/keras/layers/TransformerLayer.scala:50`
+(input [batch, seqLen, 2] = token+position ids, post-LN blocks,
+`bidirectional` flag) and `BERT.scala:53-110` (4 inputs: ids, segment
+ids, position ids, attention mask; pooled first-token output;
+`output_all_block`).
+
+TPU-first redesign:
+- all N blocks share ONE traced program: per-block params are stacked on
+  a leading axis and the depth loop is a `lax.scan` — compile time and
+  HLO size are O(1) in depth (the reference unrolls per block);
+- attention runs in f32 softmax over bf16 QK^T on the MXU
+  (`ops.attention`), or sequence-parallel ring attention over a mesh
+  axis when `sequence_parallel_axis` is set (long-context path the
+  reference lacks);
+- weights init normal(0, initializer_range) like the reference.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.ops.attention import dot_product_attention
+from analytics_zoo_tpu.pipeline.api.keras.engine import (
+    KerasLayer, Shape, ShapeLike)
+
+
+def _normal(rng, shape, stddev):
+    return jax.random.normal(rng, shape, jnp.float32) * stddev
+
+
+def _layer_norm(x, g, b, eps=1e-5):
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    return y * g.astype(y.dtype) + b.astype(y.dtype)
+
+
+def _dropout(x, p, rng, training):
+    if not training or p <= 0.0 or rng is None:
+        return x
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, jnp.zeros_like(x))
+
+
+class MultiHeadAttention(KerasLayer):
+    """Self-attention layer (the per-block attention of the reference's
+    TransformerLayer, exposed standalone)."""
+
+    def __init__(self, hidden_size: int, n_head: int,
+                 attn_p_drop: float = 0.1, resid_p_drop: float = 0.1,
+                 causal: bool = False, initializer_range: float = 0.02,
+                 sequence_parallel_axis: Optional[str] = None,
+                 input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        if hidden_size % n_head:
+            raise ValueError("hidden_size must divide by n_head")
+        self.hidden_size = int(hidden_size)
+        self.n_head = int(n_head)
+        self.attn_p_drop = float(attn_p_drop)
+        self.resid_p_drop = float(resid_p_drop)
+        self.causal = causal
+        self.initializer_range = float(initializer_range)
+        self.sequence_parallel_axis = sequence_parallel_axis
+
+    def build(self, rng, input_shape: Shape) -> dict:
+        h = self.hidden_size
+        k1, k2 = jax.random.split(rng)
+        return {
+            "qkv_kernel": _normal(k1, (h, 3 * h), self.initializer_range),
+            "qkv_bias": jnp.zeros((3 * h,), jnp.float32),
+            "out_kernel": _normal(k2, (h, h), self.initializer_range),
+            "out_bias": jnp.zeros((h,), jnp.float32),
+        }
+
+    def _attend(self, q, k, v, mask):
+        if self.sequence_parallel_axis:
+            from analytics_zoo_tpu.common.nncontext import get_nncontext
+            from analytics_zoo_tpu.parallel.ring_attention import \
+                ring_attention
+            mesh = get_nncontext().mesh
+            return ring_attention(q, k, v, mesh,
+                                  axis=self.sequence_parallel_axis,
+                                  causal=self.causal)
+        return dot_product_attention(q, k, v, mask=mask,
+                                     causal=self.causal)
+
+    def call(self, params, x, *, training=False, rng=None, mask=None):
+        b, t, h = x.shape
+        nh, hd = self.n_head, h // self.n_head
+        qkv = x @ params["qkv_kernel"].astype(x.dtype) + \
+            params["qkv_bias"].astype(x.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, t, nh, hd)
+        k = k.reshape(b, t, nh, hd)
+        v = v.reshape(b, t, nh, hd)
+        out = self._attend(q, k, v, mask).reshape(b, t, h)
+        out = out @ params["out_kernel"].astype(out.dtype) + \
+            params["out_bias"].astype(out.dtype)
+        if rng is not None:
+            out = _dropout(out, self.resid_p_drop, rng, training)
+        return out
+
+    def compute_output_shape(self, input_shape: Shape) -> Shape:
+        return input_shape
+
+
+class TransformerLayer(KerasLayer):
+    """GPT-style decoder stack (reference `TransformerLayer.scala:50`).
+
+    Input: (seq_len,) int token ids (positions are implicit 0..T-1 —
+    covers the reference's [seqLen, 2] token+position input, which is
+    also accepted). Output: (seq_len, hidden_size), or a list of every
+    block's output when `output_all_block`.
+    """
+
+    def __init__(self, n_block: int = 12, hidden_size: int = 768,
+                 n_head: int = 12, seq_len: int = 512,
+                 vocab: int = 40990, intermediate_size: int = 0,
+                 hidden_p_drop: float = 0.1, attn_p_drop: float = 0.1,
+                 initializer_range: float = 0.02,
+                 bidirectional: bool = False,
+                 output_all_block: bool = False,
+                 embed_p_drop: float = 0.1,
+                 sequence_parallel_axis: Optional[str] = None,
+                 input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape or (seq_len,),
+                         name=name, **kwargs)
+        if hidden_size % n_head:
+            raise ValueError("hidden_size must divide by n_head")
+        self.n_block = int(n_block)
+        self.hidden_size = int(hidden_size)
+        self.n_head = int(n_head)
+        self.seq_len = int(seq_len)
+        self.vocab = int(vocab)
+        self.intermediate_size = int(intermediate_size) or \
+            4 * self.hidden_size
+        self.hidden_p_drop = float(hidden_p_drop)
+        self.attn_p_drop = float(attn_p_drop)
+        self.initializer_range = float(initializer_range)
+        self.bidirectional = bidirectional
+        self.output_all_block = output_all_block
+        self.embed_p_drop = float(embed_p_drop)
+        self.sequence_parallel_axis = sequence_parallel_axis
+
+    # -- params -------------------------------------------------------------
+    def _build_blocks(self, rng) -> dict:
+        """Per-block params stacked on a leading n_block axis."""
+        h, m, n = self.hidden_size, self.intermediate_size, self.n_block
+        ks = jax.random.split(rng, 4)
+        r = self.initializer_range
+        return {
+            "qkv_kernel": _normal(ks[0], (n, h, 3 * h), r),
+            "qkv_bias": jnp.zeros((n, 3 * h), jnp.float32),
+            "attn_out_kernel": _normal(ks[1], (n, h, h), r),
+            "attn_out_bias": jnp.zeros((n, h), jnp.float32),
+            "ln1_g": jnp.ones((n, h), jnp.float32),
+            "ln1_b": jnp.zeros((n, h), jnp.float32),
+            "mlp_in_kernel": _normal(ks[2], (n, h, m), r),
+            "mlp_in_bias": jnp.zeros((n, m), jnp.float32),
+            "mlp_out_kernel": _normal(ks[3], (n, m, h), r),
+            "mlp_out_bias": jnp.zeros((n, h), jnp.float32),
+            "ln2_g": jnp.ones((n, h), jnp.float32),
+            "ln2_b": jnp.zeros((n, h), jnp.float32),
+        }
+
+    def build(self, rng, input_shape: ShapeLike) -> dict:
+        k_embed, k_pos, k_blocks = jax.random.split(rng, 3)
+        r = self.initializer_range
+        return {
+            "tok_embed": _normal(k_embed, (self.vocab, self.hidden_size),
+                                 r),
+            "pos_embed": _normal(k_pos, (self.seq_len, self.hidden_size),
+                                 r),
+            "blocks": self._build_blocks(k_blocks),
+        }
+
+    # -- forward ------------------------------------------------------------
+    def _embed(self, params, x):
+        if x.ndim == 3:  # reference layout (B, T, 2): token + position
+            tok_ids = x[..., 0].astype(jnp.int32)
+            pos_ids = x[..., 1].astype(jnp.int32)
+            pos = jnp.take(params["pos_embed"], pos_ids, axis=0)
+        else:
+            tok_ids = x.astype(jnp.int32)
+            pos = params["pos_embed"][None, :tok_ids.shape[1]]
+        return jnp.take(params["tok_embed"], tok_ids, axis=0) + pos
+
+    def _run_blocks(self, params, h0, mask, training, rng):
+        nh, hd = self.n_head, self.hidden_size // self.n_head
+        causal = not self.bidirectional
+        sp_axis = self.sequence_parallel_axis
+        n = self.n_block
+        rngs = (jax.random.split(rng, n) if rng is not None
+                else jnp.zeros((n, 2), jnp.uint32))
+
+        def block(x, inputs):
+            p, blk_rng = inputs
+            b, t, hsz = x.shape
+            r1 = r2 = r3 = None
+            if rng is not None:
+                key = jax.random.wrap_key_data(blk_rng) if \
+                    blk_rng.dtype == jnp.uint32 else blk_rng
+                r1, r2, r3 = jax.random.split(key, 3)
+            qkv = x @ p["qkv_kernel"].astype(x.dtype) + \
+                p["qkv_bias"].astype(x.dtype)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(b, t, nh, hd)
+            k = k.reshape(b, t, nh, hd)
+            v = v.reshape(b, t, nh, hd)
+            if sp_axis:
+                from analytics_zoo_tpu.common.nncontext import \
+                    get_nncontext
+                from analytics_zoo_tpu.parallel.ring_attention import \
+                    ring_attention
+                attn = ring_attention(q, k, v, get_nncontext().mesh,
+                                      axis=sp_axis, causal=causal)
+            else:
+                attn = dot_product_attention(q, k, v, mask=mask,
+                                             causal=causal)
+            attn = attn.reshape(b, t, hsz)
+            attn = attn @ p["attn_out_kernel"].astype(x.dtype) + \
+                p["attn_out_bias"].astype(x.dtype)
+            attn = _dropout(attn, self.hidden_p_drop, r1, training)
+            x = _layer_norm(x + attn, p["ln1_g"], p["ln1_b"])
+            mlp = jax.nn.gelu(x @ p["mlp_in_kernel"].astype(x.dtype) +
+                              p["mlp_in_bias"].astype(x.dtype))
+            mlp = mlp @ p["mlp_out_kernel"].astype(x.dtype) + \
+                p["mlp_out_bias"].astype(x.dtype)
+            mlp = _dropout(mlp, self.hidden_p_drop, r2, training)
+            x = _layer_norm(x + mlp, p["ln2_g"], p["ln2_b"])
+            return x, x
+
+        if rng is not None:
+            rngs_data = jax.vmap(jax.random.key_data)(rngs)
+        else:
+            rngs_data = rngs
+        final, all_blocks = jax.lax.scan(
+            block, h0, (params["blocks"], rngs_data))
+        return final, all_blocks
+
+    def call(self, params, x, *, training=False, rng=None, mask=None):
+        r_embed = None
+        if rng is not None:
+            rng, r_embed = jax.random.split(rng)
+        h0 = self._embed(params, x)
+        h0 = _dropout(h0, self.embed_p_drop, r_embed, training)
+        final, all_blocks = self._run_blocks(params, h0, mask, training,
+                                             rng)
+        if self.output_all_block:
+            return [all_blocks[i] for i in range(self.n_block)]
+        return final
+
+    def compute_output_shape(self, input_shape: ShapeLike):
+        t = (input_shape[0] if not is_multi(input_shape)
+             else input_shape[0][0])
+        shape = (t, self.hidden_size)
+        if self.output_all_block:
+            return [shape] * self.n_block
+        return shape
+
+
+def is_multi(s):
+    return isinstance(s, list) or (isinstance(s, tuple) and s and
+                                   isinstance(s[0], (tuple, list)))
+
+
+class BERT(TransformerLayer):
+    """BERT encoder (reference `BERT.scala:53-110`).
+
+    Inputs: a list of 4 arrays — `[token_ids (B, T), token_type_ids
+    (B, T), position_ids (B, T), attention_mask (B, T)]` (reference
+    input contract). Output: `[sequence_output(s), pooled_output]` —
+    per-block sequence outputs when `output_all_block`, else the last
+    block's, plus the tanh-Dense pooled first token.
+    """
+
+    def __init__(self, vocab: int = 40990, hidden_size: int = 768,
+                 n_block: int = 12, n_head: int = 12, seq_len: int = 512,
+                 intermediate_size: int = 3072,
+                 hidden_p_drop: float = 0.1, attn_p_drop: float = 0.1,
+                 initializer_range: float = 0.02,
+                 output_all_block: bool = True,
+                 n_token_types: int = 2,
+                 sequence_parallel_axis: Optional[str] = None,
+                 input_shape=None, name=None, **kwargs):
+        super().__init__(
+            n_block=n_block, hidden_size=hidden_size, n_head=n_head,
+            seq_len=seq_len, vocab=vocab,
+            intermediate_size=intermediate_size,
+            hidden_p_drop=hidden_p_drop, attn_p_drop=attn_p_drop,
+            initializer_range=initializer_range, bidirectional=True,
+            output_all_block=output_all_block,
+            sequence_parallel_axis=sequence_parallel_axis,
+            input_shape=input_shape or [(seq_len,)] * 4,
+            name=name, **kwargs)
+        self.n_token_types = int(n_token_types)
+
+    def build(self, rng, input_shape: ShapeLike) -> dict:
+        k1, k2 = jax.random.split(rng)
+        params = super().build(k1, input_shape)
+        r = self.initializer_range
+        k_type, k_pool = jax.random.split(k2)
+        params["type_embed"] = _normal(
+            k_type, (self.n_token_types, self.hidden_size), r)
+        params["embed_ln_g"] = jnp.ones((self.hidden_size,), jnp.float32)
+        params["embed_ln_b"] = jnp.zeros((self.hidden_size,), jnp.float32)
+        params["pooler_kernel"] = _normal(
+            k_pool, (self.hidden_size, self.hidden_size), r)
+        params["pooler_bias"] = jnp.zeros((self.hidden_size,),
+                                          jnp.float32)
+        return params
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        token_ids, token_type_ids, position_ids, attn_mask = inputs
+        tok = jnp.take(params["tok_embed"],
+                       token_ids.astype(jnp.int32), axis=0)
+        pos = jnp.take(params["pos_embed"],
+                       position_ids.astype(jnp.int32), axis=0)
+        typ = jnp.take(params["type_embed"],
+                       token_type_ids.astype(jnp.int32), axis=0)
+        h0 = _layer_norm(tok + pos + typ, params["embed_ln_g"],
+                         params["embed_ln_b"])
+        r_embed = None
+        if rng is not None:
+            rng, r_embed = jax.random.split(rng)
+        h0 = _dropout(h0, self.embed_p_drop, r_embed, training)
+        # (B, 1, 1, T) multiplicative mask → attention bias semantics of
+        # the reference's `(-mask + 1) * -10000`
+        mask = attn_mask[:, None, None, :]
+        final, all_blocks = self._run_blocks(params, h0, mask, training,
+                                             rng)
+        pooled = jnp.tanh(
+            final[:, 0] @ params["pooler_kernel"].astype(final.dtype) +
+            params["pooler_bias"].astype(final.dtype))
+        if self.output_all_block:
+            outs = [all_blocks[i] for i in range(self.n_block)]
+        else:
+            outs = [final]
+        return outs + [pooled]
+
+    def compute_output_shape(self, input_shape: ShapeLike):
+        t = input_shape[0][0]
+        seq_shape = (t, self.hidden_size)
+        n = self.n_block if self.output_all_block else 1
+        return [seq_shape] * n + [(self.hidden_size,)]

@@ -163,9 +163,13 @@ class Estimator:
 
     def __init__(self, model, optimizer="adam", loss="mse",
                  metrics: Optional[List] = None,
-                 ctx: Optional[NNContext] = None):
+                 ctx: Optional[NNContext] = None,
+                 parallel_mode: str = "dp"):
+        if parallel_mode not in ("dp", "fsdp"):
+            raise ValueError("parallel_mode must be dp|fsdp")
         self.model = model
         self.ctx = ctx or get_nncontext()
+        self.parallel_mode = parallel_mode
         self.loss_fn = losses_lib.get(loss)
         self.metrics = [metrics_lib.get(m) for m in (metrics or [])]
         self._base_tx = optim_lib.get(optimizer)
@@ -231,6 +235,14 @@ class Estimator:
             self._tb_writer = SummaryWriter(
                 os.path.join(self.tensorboard_dir, self.tensorboard_app))
         return self._tb_writer
+
+    def _place_params(self, params):
+        """DP: replicate (the reference's broadcast-weights semantics);
+        FSDP: ZeRO-shard over the 'fsdp' mesh axis."""
+        if self.parallel_mode == "fsdp":
+            from analytics_zoo_tpu.parallel.mesh import shard_params_fsdp
+            return shard_params_fsdp(params, self.ctx.mesh)
+        return shard_params(params, self.ctx.mesh)
 
     # -- compiled steps -----------------------------------------------------
     def _tx(self) -> optax.GradientTransformation:
@@ -305,7 +317,7 @@ class Estimator:
         if self.params is None:
             self.params = self.model.init_params(
                 self.ctx.next_rng_key())
-            self.params = shard_params(self.params, self.ctx.mesh)
+            self.params = self._place_params(self.params)
         if self.opt_state is None:
             tx = self._tx()
             self.opt_state = tx.init(self.params)
@@ -469,7 +481,7 @@ class Estimator:
             state = pickle.load(f)
         params = state["params"]
         _check_params_compatible(self.model, params)
-        self.params = shard_params(params, self.ctx.mesh)
+        self.params = self._place_params(params)
         # opt_state leaves are keyed by the saving process's layer names;
         # rebuild the state tree for THIS model and pour the leaves in
         tx = self._tx()
