@@ -51,14 +51,28 @@ _REGISTRY: "dict[str, Activation]" = {
 }
 
 
+class NamedActivation:
+    """Picklable by-name activation (several jnp/jax.nn functions are
+    re-exports that pickle can't resolve by qualified name)."""
+
+    def __init__(self, name: str):
+        if name not in _REGISTRY:
+            raise ValueError(
+                f"unknown activation '{name}'; known: "
+                f"{sorted(_REGISTRY)}")
+        self.name = name
+
+    def __call__(self, x):
+        return _REGISTRY[self.name](x)
+
+    def __repr__(self):
+        return f"NamedActivation({self.name})"
+
+
 def get(name: "str | Activation | None") -> Optional[Activation]:
-    """Resolve an activation by name; None and 'linear' → identity-ish None."""
+    """Resolve an activation by name; None → None (identity)."""
     if name is None:
         return None
     if callable(name):
         return name
-    key = name.lower()
-    if key not in _REGISTRY:
-        raise ValueError(
-            f"unknown activation '{name}'; known: {sorted(_REGISTRY)}")
-    return _REGISTRY[key]
+    return NamedActivation(name.lower())

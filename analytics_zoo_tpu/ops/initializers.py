@@ -56,14 +56,28 @@ _REGISTRY: "dict[str, Callable[[], Initializer]]" = {
 }
 
 
+class NamedInitializer:
+    """Picklable by-name initializer (jax initializer factories return
+    closures, which would make every layer object unpicklable)."""
+
+    def __init__(self, name: str):
+        if name not in _REGISTRY:
+            raise ValueError(
+                f"unknown initializer '{name}'; known: "
+                f"{sorted(_REGISTRY)}")
+        self.name = name
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        return _REGISTRY[self.name]()(key, shape, dtype)
+
+    def __repr__(self):
+        return f"NamedInitializer({self.name})"
+
+
 def get(name: "str | Initializer | None") -> Initializer:
     """Resolve an initializer by Keras name (or pass a callable through)."""
     if name is None:
-        return jinit.glorot_uniform()
+        return NamedInitializer("glorot_uniform")
     if callable(name):
         return name
-    key = name.lower()
-    if key not in _REGISTRY:
-        raise ValueError(
-            f"unknown initializer '{name}'; known: {sorted(_REGISTRY)}")
-    return _REGISTRY[key]()
+    return NamedInitializer(name.lower())

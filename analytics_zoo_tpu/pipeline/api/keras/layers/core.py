@@ -71,7 +71,7 @@ class Activation(KerasLayer):
 
     def __init__(self, activation, input_shape=None, name=None, **kwargs):
         super().__init__(input_shape=input_shape, name=name, **kwargs)
-        self.activation = activations.get(activation) or (lambda x: x)
+        self.activation = activations.get(activation) or activations.linear
 
     def call(self, params, x, *, training=False, rng=None):
         return self.activation(x)

@@ -33,9 +33,9 @@ class _RNNBase(KerasLayer):
                  input_shape=None, name=None, **kwargs):
         super().__init__(input_shape=input_shape, name=name, **kwargs)
         self.output_dim = int(output_dim)
-        self.activation = activations.get(activation) or (lambda x: x)
+        self.activation = activations.get(activation) or activations.linear
         self.inner_activation = (activations.get(inner_activation)
-                                 or (lambda x: x))
+                                 or activations.linear)
         self.kernel_init = initializers.get(init)
         self.inner_init = initializers.get(inner_init)
         self.return_sequences = return_sequences

@@ -1,0 +1,7 @@
+from analytics_zoo_tpu.pipeline.nnframes.nn_estimator import (
+    NNEstimator, NNModel, NNClassifier, NNClassifierModel)
+from analytics_zoo_tpu.pipeline.nnframes.nn_image_reader import (
+    NNImageReader, NNImageSchema)
+
+__all__ = ["NNEstimator", "NNModel", "NNClassifier", "NNClassifierModel",
+           "NNImageReader", "NNImageSchema"]

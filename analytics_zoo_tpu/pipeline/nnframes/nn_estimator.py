@@ -1,0 +1,303 @@
+"""nnframes (L8): DataFrame-native ML pipeline.
+
+Reference: `Z/pipeline/nnframes/NNEstimator.scala:183-816` — a Spark
+`ml.Estimator` that maps DataFrame rows through a `Preprocessing` chain
+into Samples/MiniBatches, drives the distributed optimizer, and returns
+an `NNModel` transformer that appends a prediction column
+(`NNClassifier.scala:42,140` adds classification sugar).
+
+The DataFrame engine here is pandas (Spark isn't part of the TPU-native
+core; SURVEY.md §2.10 keeps "RDD/DataFrame" as an ingest role only). The
+API surface — estimator params, `fit(df) -> NNModel`,
+`transform(df) -> df + prediction`, ML-style setters — is kept, so
+nnframes user code ports by changing the DataFrame import.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+import pandas as pd
+
+from analytics_zoo_tpu.common.nncontext import get_nncontext
+from analytics_zoo_tpu.feature.common import (
+    ChainedPreprocessing, FeatureLabelPreprocessing, Preprocessing,
+    Sample, SeqToTensor)
+from analytics_zoo_tpu.feature.feature_set import FeatureSet
+from analytics_zoo_tpu.pipeline.estimator import Estimator, Trigger
+
+
+class _Params:
+    """Spark-ML-style param plumbing: `set_x(v)`/`setX(v)` both work."""
+
+    def __getattr__(self, name):
+        # camelCase aliases for API parity (setFeaturesCol, ...)
+        if name.startswith("set") and len(name) > 3 and \
+                name[3].isupper():
+            snake = "set_" + "".join(
+                ("_" + c.lower()) if c.isupper() else c
+                for c in name[3:]).lstrip("_")
+            return object.__getattribute__(self, snake)
+        raise AttributeError(name)
+
+
+class NNEstimator(_Params):
+    def __init__(self, model, criterion="mse",
+                 feature_preprocessing: Optional[Preprocessing] = None,
+                 label_preprocessing: Optional[Preprocessing] = None):
+        self.model = model
+        self.criterion = criterion
+        self.feature_preprocessing = feature_preprocessing
+        self.label_preprocessing = label_preprocessing
+        self.features_col = "features"
+        self.label_col = "label"
+        self.prediction_col = "prediction"
+        self.batch_size = 32
+        self.max_epoch = 10
+        self.optim_method = "adam"
+        self.learning_rate: Optional[float] = None
+        self.validation_df: Optional[pd.DataFrame] = None
+        self.validation_trigger: Optional[Trigger] = None
+        self.checkpoint_path: Optional[str] = None
+        self.checkpoint_trigger: Optional[Trigger] = None
+        self.tensorboard: Optional[tuple] = None
+        self.clip_l2: Optional[float] = None
+        self.clip_const: Optional[tuple] = None
+        self.metrics: "list" = []
+
+    # -- param setters (reference `NNEstimator` params) --------------------
+    def set_features_col(self, v):
+        self.features_col = v
+        return self
+
+    def set_label_col(self, v):
+        self.label_col = v
+        return self
+
+    def set_prediction_col(self, v):
+        self.prediction_col = v
+        return self
+
+    def set_batch_size(self, v):
+        self.batch_size = int(v)
+        return self
+
+    def set_max_epoch(self, v):
+        self.max_epoch = int(v)
+        return self
+
+    def set_optim_method(self, v):
+        self.optim_method = v
+        return self
+
+    def set_learning_rate(self, v):
+        self.learning_rate = float(v)
+        return self
+
+    def set_validation(self, df, trigger: Optional[Trigger] = None,
+                       metrics: Optional[list] = None):
+        """(reference `setValidation`)"""
+        self.validation_df = df
+        self.validation_trigger = trigger
+        if metrics:
+            self.metrics = metrics
+        return self
+
+    def set_checkpoint(self, path, trigger: Optional[Trigger] = None):
+        self.checkpoint_path = path
+        self.checkpoint_trigger = trigger
+        return self
+
+    def set_tensorboard(self, log_dir, app_name="nnframes"):
+        self.tensorboard = (log_dir, app_name)
+        return self
+
+    def set_gradient_clipping_by_l2_norm(self, v):
+        self.clip_l2 = float(v)
+        return self
+
+    def set_constant_gradient_clipping(self, lo, hi):
+        self.clip_const = (float(lo), float(hi))
+        return self
+
+    # -- data plumbing (reference `getDataSet`, NNEstimator.scala:361) -----
+    def _row_to_feature(self, value):
+        if self.feature_preprocessing is not None:
+            return self.feature_preprocessing.apply(value)
+        return np.asarray(value, np.float32)
+
+    def _df_to_feature_set(self, df: pd.DataFrame,
+                           with_label: bool = True) -> FeatureSet:
+        samples = []
+        has_label = with_label and self.label_col in df.columns
+        for _, row in df.iterrows():
+            feat = self._row_to_feature(row[self.features_col])
+            if isinstance(feat, Sample):
+                samples.append(feat)
+                continue
+            label = None
+            if has_label:
+                label = row[self.label_col]
+                if self.label_preprocessing is not None:
+                    label = self.label_preprocessing.apply(label)
+                else:
+                    label = np.atleast_1d(
+                        np.asarray(label, np.float32))
+            samples.append(Sample(feature=feat, label=label))
+        return FeatureSet.sample_rdd(samples)
+
+    # -- fit ----------------------------------------------------------------
+    def _build_optimizer(self):
+        from analytics_zoo_tpu.ops import optimizers as optim_lib
+        opt = self.optim_method
+        if isinstance(opt, str) and self.learning_rate is not None:
+            opt = optim_lib._REGISTRY[opt.lower()](lr=self.learning_rate)
+        return opt
+
+    def fit(self, df: pd.DataFrame) -> "NNModel":
+        """(reference `NNEstimator.fit → internalFit`,
+        NNEstimator.scala:392-450)"""
+        fs = self._df_to_feature_set(df)
+        est = Estimator(self.model, optimizer=self._build_optimizer(),
+                        loss=self.criterion, metrics=self.metrics)
+        if self.clip_l2 is not None:
+            est.set_gradient_clipping_by_l2_norm(self.clip_l2)
+        if self.clip_const is not None:
+            est.set_constant_gradient_clipping(*self.clip_const)
+        if self.checkpoint_path:
+            est.set_checkpoint(self.checkpoint_path,
+                               self.checkpoint_trigger)
+        if self.tensorboard:
+            est.set_tensorboard(*self.tensorboard)
+        val = None
+        if self.validation_df is not None:
+            val = self._df_to_feature_set(self.validation_df)
+        est.train(fs, batch_size=self.batch_size,
+                  nb_epoch=self.max_epoch, validation_data=val,
+                  validation_trigger=self.validation_trigger)
+        return self._wrap_model(est)
+
+    def _wrap_model(self, est: Estimator) -> "NNModel":
+        m = NNModel(self.model, self.feature_preprocessing,
+                    estimator=est)
+        m.features_col = self.features_col
+        m.prediction_col = self.prediction_col
+        m.batch_size = self.batch_size
+        return m
+
+
+class NNModel(_Params):
+    """`ml.Transformer` analog: batched inference appending a prediction
+    column (reference NNEstimator.scala:571-816, incl. persistence)."""
+
+    def __init__(self, model,
+                 feature_preprocessing: Optional[Preprocessing] = None,
+                 estimator: Optional[Estimator] = None):
+        self.model = model
+        self.feature_preprocessing = feature_preprocessing
+        self.features_col = "features"
+        self.prediction_col = "prediction"
+        self.batch_size = 32
+        if estimator is None:
+            estimator = Estimator(model, optimizer="adam", loss="mse")
+            estimator._ensure_initialized()
+        self.estimator = estimator
+
+    def set_features_col(self, v):
+        self.features_col = v
+        return self
+
+    def set_prediction_col(self, v):
+        self.prediction_col = v
+        return self
+
+    def set_batch_size(self, v):
+        self.batch_size = int(v)
+        return self
+
+    def _features_array(self, df: pd.DataFrame) -> np.ndarray:
+        rows = []
+        for v in df[self.features_col]:
+            f = (self.feature_preprocessing.apply(v)
+                 if self.feature_preprocessing is not None
+                 else np.asarray(v, np.float32))
+            if isinstance(f, Sample):
+                f = f.feature
+            rows.append(np.asarray(f, np.float32))
+        return np.stack(rows)
+
+    def _raw_predict(self, df: pd.DataFrame) -> np.ndarray:
+        x = self._features_array(df)
+        return self.estimator.predict(x, batch_size=self.batch_size)
+
+    def transform(self, df: pd.DataFrame) -> pd.DataFrame:
+        preds = self._raw_predict(df)
+        out = df.copy()
+        out[self.prediction_col] = [np.asarray(p).reshape(-1)
+                                    for p in preds]
+        return out
+
+    # -- persistence (MLWritable/MLReadable analog) -------------------------
+    def save(self, path: str, over_write: bool = False):
+        if os.path.exists(path) and not over_write:
+            raise FileExistsError(path)
+        import jax
+        state = {
+            "model": self.model,
+            "params": jax.device_get(self.estimator.params),
+            "features_col": self.features_col,
+            "prediction_col": self.prediction_col,
+            "batch_size": self.batch_size,
+            "feature_preprocessing": self.feature_preprocessing,
+            "class": type(self).__name__,
+        }
+        with open(path, "wb") as f:
+            pickle.dump(state, f)
+
+    @classmethod
+    def load(cls, path: str) -> "NNModel":
+        from analytics_zoo_tpu.parallel.mesh import shard_params
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+        klass = (NNClassifierModel
+                 if state.get("class") == "NNClassifierModel" else cls)
+        m = klass(state["model"], state["feature_preprocessing"])
+        m.features_col = state["features_col"]
+        m.prediction_col = state["prediction_col"]
+        m.batch_size = state["batch_size"]
+        m.estimator.params = shard_params(state["params"],
+                                          get_nncontext().mesh)
+        return m
+
+
+class NNClassifier(NNEstimator):
+    """Classification sugar (reference `NNClassifier.scala:42`): float
+    labels, argmax prediction."""
+
+    def fit(self, df: pd.DataFrame) -> "NNClassifierModel":
+        nn_model = super().fit(df)
+        m = NNClassifierModel(self.model, self.feature_preprocessing,
+                              estimator=nn_model.estimator)
+        m.features_col = self.features_col
+        m.prediction_col = self.prediction_col
+        m.batch_size = self.batch_size
+        return m
+
+
+class NNClassifierModel(NNModel):
+    """(reference `NNClassifierModel`, NNClassifier.scala:140): appends
+    the argmax class as a scalar prediction."""
+
+    def transform(self, df: pd.DataFrame) -> pd.DataFrame:
+        preds = self._raw_predict(df)
+        out = df.copy()
+        if preds.ndim > 1 and preds.shape[-1] > 1:
+            out[self.prediction_col] = np.argmax(preds, axis=-1) \
+                .astype(np.float64)
+        else:
+            out[self.prediction_col] = (preds.reshape(-1) > 0.5) \
+                .astype(np.float64)
+        return out

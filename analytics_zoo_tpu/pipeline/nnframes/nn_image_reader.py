@@ -1,0 +1,72 @@
+"""NNImageReader / NNImageSchema (reference
+`Z/pipeline/nnframes/NNImageReader.scala:144-182`): read images into a
+DataFrame with the image-schema struct columns
+(origin, height, width, nChannels, mode, data)."""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import List, Optional
+
+import numpy as np
+import pandas as pd
+
+
+class NNImageSchema:
+    """Column names of the image struct (reference `NNImageSchema`)."""
+
+    ORIGIN = "origin"
+    HEIGHT = "height"
+    WIDTH = "width"
+    N_CHANNELS = "nChannels"
+    MODE = "mode"
+    DATA = "data"
+
+    COLUMNS = [ORIGIN, HEIGHT, WIDTH, N_CHANNELS, MODE, DATA]
+
+    @staticmethod
+    def to_ndarray(row) -> np.ndarray:
+        """image struct row → HWC uint8 ndarray."""
+        return np.asarray(row[NNImageSchema.DATA], np.uint8).reshape(
+            int(row[NNImageSchema.HEIGHT]),
+            int(row[NNImageSchema.WIDTH]),
+            int(row[NNImageSchema.N_CHANNELS]))
+
+
+class NNImageReader:
+    @staticmethod
+    def read_images(path: str, min_partitions: int = 1,
+                    resize_h: int = -1, resize_w: int = -1,
+                    image_codec: int = -1) -> pd.DataFrame:
+        """(reference `NNImageReader.readImages`; `min_partitions` and
+        `image_codec` kept for signature parity.)"""
+        from PIL import Image
+        del min_partitions, image_codec
+        if os.path.isdir(path):
+            files = sorted(
+                f for f in glob.glob(os.path.join(path, "**", "*"),
+                                     recursive=True)
+                if os.path.isfile(f))
+        else:
+            files = sorted(glob.glob(path))
+        rows = []
+        for f in files:
+            try:
+                with Image.open(f) as im:
+                    rgb = im.convert("RGB")
+                    if resize_h > 0 and resize_w > 0:
+                        rgb = rgb.resize((resize_w, resize_h),
+                                         Image.BILINEAR)
+                    arr = np.asarray(rgb, np.uint8)
+            except Exception:
+                continue  # non-image files are skipped
+            rows.append({
+                NNImageSchema.ORIGIN: f,
+                NNImageSchema.HEIGHT: arr.shape[0],
+                NNImageSchema.WIDTH: arr.shape[1],
+                NNImageSchema.N_CHANNELS: arr.shape[2],
+                NNImageSchema.MODE: 16,  # CV_8UC3 parity
+                NNImageSchema.DATA: arr.reshape(-1),
+            })
+        return pd.DataFrame(rows, columns=NNImageSchema.COLUMNS)

@@ -1,0 +1,152 @@
+"""nnframes tests (reference analog:
+`pyzoo/test/zoo/pipeline/nnframes/`)."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from analytics_zoo_tpu import init_nncontext
+from analytics_zoo_tpu.feature.common import SeqToTensor
+from analytics_zoo_tpu.pipeline.api.keras import Sequential, layers as L
+from analytics_zoo_tpu.pipeline.nnframes import (
+    NNClassifier, NNEstimator, NNImageReader, NNImageSchema, NNModel)
+
+
+@pytest.fixture(autouse=True)
+def _ctx():
+    init_nncontext(seed=0)
+    yield
+
+
+def _df(n=64, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, 4).astype(np.float32)
+    w = np.array([1.0, -2.0, 0.5, 3.0], np.float32)
+    y = x @ w + 0.1
+    return pd.DataFrame({"features": [row for row in x],
+                         "label": y.astype(np.float64)})
+
+
+def _cls_df(n=64, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, 4).astype(np.float32)
+    y = (x.sum(1) > 0).astype(np.int64)
+    return pd.DataFrame({"features": [row for row in x], "label": y})
+
+
+def _reg_model():
+    m = Sequential()
+    m.add(L.Dense(8, activation="tanh", input_shape=(4,)))
+    m.add(L.Dense(1))
+    return m
+
+
+def test_nnestimator_fit_transform():
+    df = _df()
+    est = (NNEstimator(_reg_model(), "mse", SeqToTensor((4,)))
+           .set_batch_size(16).set_max_epoch(5)
+           .set_learning_rate(0.05).set_optim_method("adam"))
+    nn_model = est.fit(df)
+    assert isinstance(nn_model, NNModel)
+    out = nn_model.transform(df)
+    assert "prediction" in out.columns
+    assert len(out) == len(df)
+    assert len(out["prediction"].iloc[0]) == 1
+
+
+def test_nnestimator_camelcase_setters():
+    est = NNEstimator(_reg_model(), "mse")
+    est.setBatchSize(8).setMaxEpoch(2).setFeaturesCol("f") \
+        .setPredictionCol("p")
+    assert est.batch_size == 8 and est.max_epoch == 2
+    assert est.features_col == "f" and est.prediction_col == "p"
+
+
+def test_nnclassifier_argmax_prediction():
+    df = _cls_df()
+    m = Sequential()
+    m.add(L.Dense(8, activation="relu", input_shape=(4,)))
+    m.add(L.Dense(2, activation="softmax"))
+    clf = (NNClassifier(m, "sparse_categorical_crossentropy")
+           .set_batch_size(16).set_max_epoch(8)
+           .set_learning_rate(0.05))
+    model = clf.fit(df)
+    out = model.transform(df)
+    preds = out["prediction"].to_numpy()
+    assert set(np.unique(preds)).issubset({0.0, 1.0})
+    acc = (preds == df["label"].to_numpy()).mean()
+    assert acc > 0.8
+
+
+def test_nnmodel_save_load(tmp_path):
+    df = _df(32)
+    est = NNEstimator(_reg_model(), "mse").set_batch_size(16) \
+        .set_max_epoch(1)
+    model = est.fit(df)
+    p = str(tmp_path / "nnmodel.bin")
+    model.save(p)
+    loaded = NNModel.load(p)
+    out1 = model.transform(df)["prediction"]
+    out2 = loaded.transform(df)["prediction"]
+    np.testing.assert_allclose(np.stack(out1), np.stack(out2),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_nnestimator_validation_and_checkpoint(tmp_path):
+    df = _df()
+    est = (NNEstimator(_reg_model(), "mse")
+           .set_batch_size(16).set_max_epoch(2)
+           .set_validation(_df(32, seed=1))
+           .set_checkpoint(str(tmp_path / "ck")))
+    est.fit(df)
+    assert any(f.startswith("ckpt_")
+               for f in os.listdir(tmp_path / "ck"))
+
+
+def test_nnimage_reader(tmp_path):
+    from PIL import Image
+    rs = np.random.RandomState(0)
+    for i in range(3):
+        Image.fromarray(
+            rs.randint(0, 255, (10, 12, 3)).astype(np.uint8)) \
+            .save(tmp_path / f"img{i}.png")
+    (tmp_path / "not_an_image.txt").write_text("hi")
+    df = NNImageReader.read_images(str(tmp_path))
+    assert len(df) == 3
+    assert list(df.columns) == NNImageSchema.COLUMNS
+    arr = NNImageSchema.to_ndarray(df.iloc[0])
+    assert arr.shape == (10, 12, 3)
+
+    df2 = NNImageReader.read_images(str(tmp_path), resize_h=6,
+                                    resize_w=8)
+    assert NNImageSchema.to_ndarray(df2.iloc[0]).shape == (6, 8, 3)
+
+
+def test_nnframes_image_pipeline_end_to_end(tmp_path):
+    """The dogs-vs-cats transfer-learning shape (BASELINE config #2) at
+    toy scale: images → DataFrame → NNClassifier."""
+    from PIL import Image
+    rs = np.random.RandomState(0)
+    rows = []
+    for i in range(16):
+        label = i % 2
+        # class-dependent brightness so the model can learn
+        base = 40 if label == 0 else 200
+        arr = np.clip(rs.randn(8, 8, 3) * 10 + base, 0, 255) \
+            .astype(np.uint8)
+        rows.append({"features": arr.astype(np.float32) / 255.0,
+                     "label": float(label)})
+    df = pd.DataFrame(rows)
+    m = Sequential()
+    m.add(L.Flatten(input_shape=(8, 8, 3)))
+    m.add(L.Dense(2, activation="softmax"))
+    clf = (NNClassifier(m, "sparse_categorical_crossentropy")
+           .set_batch_size(8).set_max_epoch(10)
+           .set_learning_rate(0.1))
+    model = clf.fit(df)
+    out = model.transform(df)
+    acc = (out["prediction"].to_numpy() ==
+           df["label"].to_numpy()).mean()
+    assert acc > 0.8
