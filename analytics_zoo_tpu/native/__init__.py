@@ -1,0 +1,198 @@
+"""ctypes bindings for the native runtime (`/native/*.cpp`).
+
+The reference ships native code as JNI `.so`s in `zoo-core-dist-all`
+(SURVEY.md §2.11); here the C++ lives in-repo under `native/` and is
+built on first use with g++ (no pybind11 in the image — plain C ABI +
+ctypes). Every consumer has a pure-Python fallback, so the framework
+degrades gracefully where a toolchain is missing.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libzoo_native.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_failed = False
+
+
+def _build() -> bool:
+    srcs = [os.path.join(_NATIVE_DIR, f)
+            for f in ("host_arena.cpp", "serving_queue.cpp")]
+    cmd = ["g++", "-O2", "-fPIC", "-std=c++17", "-shared", "-o",
+           _SO_PATH] + srcs + ["-lpthread"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def load_native() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _build_failed
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        if not os.path.exists(_SO_PATH) and not _build():
+            _build_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError:
+            _build_failed = True
+            return None
+        # signatures
+        lib.arena_create.restype = ctypes.c_void_p
+        lib.arena_create.argtypes = [ctypes.c_size_t]
+        lib.arena_destroy.argtypes = [ctypes.c_void_p]
+        lib.arena_alloc.restype = ctypes.c_size_t
+        lib.arena_alloc.argtypes = [ctypes.c_void_p, ctypes.c_size_t,
+                                    ctypes.c_size_t]
+        lib.arena_base.restype = ctypes.c_void_p
+        lib.arena_base.argtypes = [ctypes.c_void_p]
+        lib.arena_used.restype = ctypes.c_size_t
+        lib.arena_used.argtypes = [ctypes.c_void_p]
+        lib.arena_capacity.restype = ctypes.c_size_t
+        lib.arena_capacity.argtypes = [ctypes.c_void_p]
+        lib.arena_reset.argtypes = [ctypes.c_void_p]
+        lib.arena_copy.argtypes = [ctypes.c_void_p, ctypes.c_size_t,
+                                   ctypes.c_void_p, ctypes.c_size_t]
+        lib.squeue_create.restype = ctypes.c_void_p
+        lib.squeue_destroy.argtypes = [ctypes.c_void_p]
+        lib.squeue_put.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.squeue_take.restype = ctypes.c_int
+        lib.squeue_take.argtypes = [ctypes.c_void_p, ctypes.c_long]
+        lib.squeue_size.restype = ctypes.c_int
+        lib.squeue_size.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+class HostArena:
+    """Bump-arena sample cache (PersistentMemoryAllocator analog).
+
+    `put(array) -> offset`; `view(offset, shape, dtype)` returns a
+    zero-copy numpy view into arena memory.
+    """
+
+    def __init__(self, capacity_bytes: int):
+        lib = load_native()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._handle = lib.arena_create(capacity_bytes)
+        if not self._handle:
+            raise MemoryError(f"arena_create({capacity_bytes}) failed")
+        self.capacity = capacity_bytes
+
+    def put(self, arr: np.ndarray) -> int:
+        arr = np.ascontiguousarray(arr)
+        off = self._lib.arena_alloc(self._handle, arr.nbytes, 64)
+        if off == ctypes.c_size_t(-1).value:
+            raise MemoryError("arena full")
+        self._lib.arena_copy(self._handle, off,
+                             arr.ctypes.data_as(ctypes.c_void_p),
+                             arr.nbytes)
+        return off
+
+    def view(self, offset: int, shape, dtype) -> np.ndarray:
+        base = self._lib.arena_base(self._handle)
+        nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
+        buf = (ctypes.c_char * nbytes).from_address(base + offset)
+        return np.frombuffer(buf, dtype=dtype).reshape(shape)
+
+    @property
+    def used(self) -> int:
+        return self._lib.arena_used(self._handle)
+
+    def reset(self):
+        self._lib.arena_reset(self._handle)
+
+    def close(self):
+        if self._handle:
+            self._lib.arena_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class ServingQueue:
+    """Blocking pool of slot ids (LinkedBlockingQueue analog)."""
+
+    def __init__(self):
+        lib = load_native()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._handle = lib.squeue_create()
+
+    def put(self, slot: int):
+        self._lib.squeue_put(self._handle, slot)
+
+    def take(self, timeout_ms: int = -1) -> int:
+        """Returns a slot id, or -1 on timeout."""
+        return self._lib.squeue_take(self._handle, timeout_ms)
+
+    def size(self) -> int:
+        return self._lib.squeue_size(self._handle)
+
+    def close(self):
+        if self._handle:
+            self._lib.squeue_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class PyServingQueue:
+    """Pure-Python fallback with the same surface."""
+
+    def __init__(self):
+        import queue
+        self._q = queue.Queue()
+
+    def put(self, slot: int):
+        self._q.put(slot)
+
+    def take(self, timeout_ms: int = -1) -> int:
+        import queue as _queue
+        try:
+            timeout = None if timeout_ms < 0 else timeout_ms / 1000.0
+            return self._q.get(timeout=timeout)
+        except _queue.Empty:
+            return -1
+
+    def size(self) -> int:
+        return self._q.qsize()
+
+    def close(self):
+        pass
+
+
+def make_serving_queue():
+    try:
+        return ServingQueue()
+    except RuntimeError:
+        return PyServingQueue()

@@ -1,0 +1,247 @@
+"""TF integration (L5, the TFPark analog).
+
+Reference: `P/pipeline/api/net.py` + `Z/pipeline/api/net/TFNet.scala` —
+TFNet executes a frozen TF graph via a JNI session inside BigDL
+(`TFNet.scala:216-384`), TFOptimizer exports the loss graph + gradients
+and drives BigDL's optimizer (`net.py:365-714`), TFDataset is the
+distributed tensor dataset (`net.py:724-931`).
+
+TPU-native redesign (the BASELINE.json north star: "TFNet/TFOptimizer
+exports its frozen TF graph straight to XLA HLO"):
+
+- :class:`TFNet` bridges a TF SavedModel / frozen GraphDef / concrete
+  `tf.function` into JAX with `jax2tf.call_tf` — the graph is compiled
+  by XLA and runs on TPU inside `jit`; no session, no JNI, no
+  per-batch tensor copies (`TFNet.scala:484-525`'s zero-copy dance is
+  simply gone).
+- :class:`TFOptimizer` trains a TF-authored differentiable function on
+  the TPU mesh: weights are explicit JAX arrays, gradients flow through
+  `call_tf` (TF computes the local VJP, XLA fuses it), and the update
+  loop is the framework's pjit Estimator step. After training the
+  trained weights are written back into the live TF objects —
+  preserving the reference's assign-back-to-session contract
+  (`net.py:703-714`).
+- :class:`TFDataset` keeps the API (batch_size divisibility over the
+  data-parallel size, `net.py:741-749`) over FeatureSet.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from analytics_zoo_tpu.common.nncontext import get_nncontext, logger
+from analytics_zoo_tpu.feature.feature_set import FeatureSet
+
+
+def _tf():
+    import tensorflow as tf
+    return tf
+
+
+class TFNet:
+    """A TF graph as a JAX-callable compiled by XLA.
+
+    Create via :meth:`from_saved_model`, :meth:`from_frozen_graph`, or
+    :meth:`from_function`; call with numpy/JAX arrays. Usable inside
+    `jit` and as a frozen feature extractor in a larger zoo model (the
+    reference's transfer-learning TFNet role).
+    """
+
+    def __init__(self, jax_fn: Callable, output_names: Optional[list] =
+                 None, keepalive: Any = None):
+        self._fn = jax_fn
+        self.output_names = output_names
+        # holds the loaded TF module so its variables outlive the closure
+        self._keepalive = keepalive
+
+    def __call__(self, *inputs):
+        return self._fn(*inputs)
+
+    # -- constructors -------------------------------------------------------
+    @staticmethod
+    def from_function(fn, output_names: Optional[list] = None) -> "TFNet":
+        """Wrap a `tf.function` (or python fn of TF ops)."""
+        from jax.experimental import jax2tf
+        return TFNet(jax2tf.call_tf(fn), output_names)
+
+    @staticmethod
+    def from_saved_model(path: str, signature: str = "serving_default",
+                         ) -> "TFNet":
+        """(reference `TFNet.fromSavedModel`)"""
+        tf = _tf()
+        loaded = tf.saved_model.load(path)
+        if signature in getattr(loaded, "signatures", {}):
+            sig = loaded.signatures[signature]
+            names = list(sig.structured_outputs.keys())
+
+            def fn(*xs):
+                kwargs = {k: v for k, v in
+                          zip(sig.structured_input_signature[1], xs)}
+                out = sig(**{name: x for name, x in
+                             zip(sig.structured_input_signature[1].keys(),
+                                 xs)})
+                return [out[k] for k in names]
+
+            from jax.experimental import jax2tf
+            return TFNet(jax2tf.call_tf(fn), names, keepalive=loaded)
+        # plain callable module
+        from jax.experimental import jax2tf
+        return TFNet(jax2tf.call_tf(loaded.__call__), keepalive=loaded)
+
+    @staticmethod
+    def from_frozen_graph(pb_path: str, inputs: Sequence[str],
+                          outputs: Sequence[str]) -> "TFNet":
+        """Frozen `GraphDef` → XLA (reference `TFNet(path)` over
+        `frozen_inference_graph.pb`, TFNet.scala:595-651)."""
+        tf = _tf()
+        gdef = tf.compat.v1.GraphDef()
+        with open(pb_path, "rb") as f:
+            gdef.ParseFromString(f.read())
+
+        def _norm(name):
+            return name if ":" in name else name + ":0"
+
+        in_names = [_norm(n) for n in inputs]
+        out_names = [_norm(n) for n in outputs]
+
+        def import_fn(*xs):
+            results = tf.graph_util.import_graph_def(
+                gdef,
+                input_map={n: x for n, x in zip(in_names, xs)},
+                return_elements=out_names)
+            return results if len(results) > 1 else results[0]
+
+        wrapped = tf.compat.v1.wrap_function(
+            import_fn,
+            [tf.TensorSpec(None, tf.float32) for _ in in_names])
+        from jax.experimental import jax2tf
+        return TFNet(jax2tf.call_tf(wrapped), list(outputs))
+
+    def predict(self, x, batch_size: int = 32,
+                distributed: bool = True) -> np.ndarray:
+        """Batched inference (reference `TFNet.predict`)."""
+        del distributed
+        import jax
+        fn = jax.jit(self._fn)
+        xs = x if isinstance(x, (list, tuple)) else [x]
+        n = xs[0].shape[0]
+        outs = []
+        for s in range(0, n, batch_size):
+            chunk = [a[s:s + batch_size] for a in xs]
+            outs.append(np.asarray(fn(*chunk)))
+        return np.concatenate(outs, axis=0)
+
+
+class TFDataset:
+    """(reference `TFDataset`, `P/pipeline/api/net.py:724-931`): the
+    batch-size contract over the data-parallel size, on FeatureSet."""
+
+    def __init__(self, feature_set: FeatureSet, batch_size: int):
+        ctx = get_nncontext()
+        ctx.check_batch_size(batch_size)
+        self.feature_set = feature_set
+        self.batch_size = batch_size
+
+    @staticmethod
+    def from_ndarrays(x, y=None, batch_size: int = 32) -> "TFDataset":
+        return TFDataset(FeatureSet.array(x, y), batch_size)
+
+    @staticmethod
+    def from_feature_set(fs: FeatureSet, batch_size: int = 32
+                         ) -> "TFDataset":
+        return TFDataset(fs, batch_size)
+
+    @property
+    def num_samples(self):
+        return self.feature_set.num_samples
+
+    def iter_batches(self, batch_size=None, **kw):
+        return self.feature_set.iter_batches(
+            batch_size or self.batch_size, **kw)
+
+
+class _TFFunctionNet:
+    """Internal KerasNet-protocol shim: a TF-authored function with
+    explicit weights, trained by the Estimator."""
+
+    def __init__(self, jax_fn, weight_template):
+        self._fn = jax_fn
+        self._template = weight_template
+        self.name = "tf_function_net"
+        self.layers = []
+
+    def init_params(self, rng=None):
+        return {"weights": [np.asarray(w) for w in self._template]}
+
+    def init(self, rng, input_shape=None):
+        return self.init_params(rng)
+
+    def apply(self, params, x, *, training=False, rng=None):
+        xs = x if isinstance(x, (list, tuple)) else [x]
+        return self._fn(*params["weights"], *xs), {}
+
+    def forward(self, params, x, *, training=False, rng=None):
+        out, _ = self.apply(params, x, training=training, rng=rng)
+        return out
+
+    def regularization_loss(self, params):
+        import jax.numpy as jnp
+        return jnp.zeros((), jnp.float32)
+
+    def trainable_mask(self, params):
+        import jax
+        return jax.tree_util.tree_map(lambda _: True, params)
+
+
+class TFOptimizer:
+    """Train a TF-authored model function on the TPU mesh (reference
+    `TFOptimizer`, `net.py:365-714`).
+
+    ``model_fn(*weights, *features) -> outputs`` is a TF-ops function;
+    gradients flow through `jax2tf.call_tf` (TF provides the VJP, XLA
+    compiles both directions). ``variables`` are live `tf.Variable`s:
+    their values seed training and receive the trained weights back at
+    the end (the reference's weights→session assign-back,
+    `net.py:703-714`).
+    """
+
+    def __init__(self, model_fn, variables: Sequence,
+                 loss="mse", optimizer="adam", metrics=None):
+        from jax.experimental import jax2tf
+
+        from analytics_zoo_tpu.pipeline.estimator import Estimator
+        self.variables = list(variables)
+        jax_fn = jax2tf.call_tf(model_fn)
+        net = _TFFunctionNet(jax_fn,
+                             [v.numpy() for v in self.variables])
+        self.net = net
+        self.estimator = Estimator(net, optimizer=optimizer, loss=loss,
+                                   metrics=metrics or [])
+
+    @staticmethod
+    def from_loss(model_fn, variables, loss="mse", optimizer="adam",
+                  **kw) -> "TFOptimizer":
+        return TFOptimizer(model_fn, variables, loss=loss,
+                           optimizer=optimizer, **kw)
+
+    def optimize(self, dataset, batch_size: int = 32,
+                 end_trigger=None, nb_epoch: int = 1):
+        """Run training then write trained weights back into the live TF
+        variables."""
+        if isinstance(dataset, tuple) and len(dataset) == 2:
+            data, y = dataset
+        else:
+            data, y = dataset, None
+        result = self.estimator.train(
+            data, y, batch_size=batch_size, nb_epoch=nb_epoch,
+            end_trigger=end_trigger)
+        import jax
+        trained = jax.device_get(self.estimator.params)["weights"]
+        for var, w in zip(self.variables, trained):
+            var.assign(w)
+        return result
+
+    def predict(self, x, batch_size: int = 32):
+        return self.estimator.predict(x, batch_size=batch_size)

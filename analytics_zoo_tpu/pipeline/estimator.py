@@ -434,11 +434,16 @@ class Estimator:
             self._predict_fn = self._build_predict_fn()
         outs = []
         n = ds.num_samples
+        # compiled batch must divide over the data-parallel size; pad
+        # every chunk (incl. full ones when batch_size itself doesn't
+        # divide) and trim after
+        dp = self.ctx.data_parallel_size
+        padded = -(-batch_size // dp) * dp
         for xb, _ in ds.iter_batches(batch_size, shuffle=False,
                                      drop_last=False):
             bsize = _batch_dim(xb)
-            if bsize < batch_size:  # pad to keep the compiled shape
-                xb = _pad_batch(xb, batch_size)
+            if bsize < padded:  # pad to keep the compiled shape
+                xb = _pad_batch(xb, padded)
             xb = shard_batch(xb, self.ctx.mesh)
             y = jax.device_get(self._predict_fn(self.params, xb))
             outs.append(_trim_batch(y, bsize))

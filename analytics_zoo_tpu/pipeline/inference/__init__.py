@@ -1,0 +1,5 @@
+from analytics_zoo_tpu.pipeline.inference.inference_model import (
+    InferenceModel)
+from analytics_zoo_tpu.pipeline.inference.serving import InferenceServer
+
+__all__ = ["InferenceModel", "InferenceServer"]

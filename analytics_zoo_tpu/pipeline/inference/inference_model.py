@@ -1,0 +1,139 @@
+"""InferenceModel (L9): thread-safe serving wrapper.
+
+Reference: `Z/pipeline/inference/InferenceModel.scala:29-120` — a
+`LinkedBlockingQueue` of `supportedConcurrentNum` weight-sharing model
+copies with loaders for BigDL/Caffe/TF/OpenVINO backends.
+
+TPU-native redesign:
+- the blocking pool is the native C++ queue (`native/serving_queue.cpp`),
+  holding slot ids; each slot is a *compiled executable* reference —
+  XLA-compiled programs are reentrant, so slots share one executable
+  (the exact analog of the reference's weight-sharing clones,
+  `FloatModel.scala:73-87`);
+- OpenVINO's accelerated-inference role is played by XLA ahead-of-time
+  compilation: `load_*` lowers + compiles the forward at load time for
+  the declared input shapes;
+- TF models load via a frozen `tf.function` bridged into XLA
+  (`jax2tf.call_tf`) — the TFNet serving path without a JNI session.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from analytics_zoo_tpu.common.nncontext import get_nncontext, logger
+from analytics_zoo_tpu.native import make_serving_queue
+
+
+class InferenceModel:
+    def __init__(self, supported_concurrent_num: int = 1):
+        self.supported_concurrent_num = int(supported_concurrent_num)
+        self._queue = make_serving_queue()
+        self._predict_fn: Optional[Callable] = None
+        self._compiled = False
+        self._lock = threading.Lock()
+
+    # -- loaders ------------------------------------------------------------
+    def _install(self, predict_fn: Callable,
+                 example_inputs: Optional[Sequence[np.ndarray]] = None):
+        import jax
+        fn = jax.jit(predict_fn)
+        if example_inputs is not None:
+            # AOT-compile for the declared shapes (the OpenVINO-IR role)
+            fn = fn.lower(*example_inputs).compile()
+        self._predict_fn = fn
+        for slot in range(self.supported_concurrent_num):
+            self._queue.put(slot)
+        self._compiled = example_inputs is not None
+
+    def load(self, model_path: str,
+             example_inputs: Optional[Sequence] = None):
+        """Load a saved ZooModel (`ZooModel.save_model` output) —
+        the `doLoad` BigDL path."""
+        from analytics_zoo_tpu.models.common import ZooModel
+        zm = ZooModel.load_model(model_path)
+        est = zm.model.estimator
+        params = est.params
+        model = zm.model
+
+        def predict_fn(*xs):
+            x = list(xs) if len(xs) > 1 else xs[0]
+            return model.forward(params, x, training=False)
+
+        self._install(predict_fn,
+                      None if example_inputs is None
+                      else [np.asarray(e) for e in example_inputs])
+        return self
+
+    def load_keras_net(self, net, params=None,
+                       example_inputs: Optional[Sequence] = None):
+        """Serve an in-memory KerasNet."""
+        if params is None:
+            params = net.estimator.params
+
+        def predict_fn(*xs):
+            x = list(xs) if len(xs) > 1 else xs[0]
+            return net.forward(params, x, training=False)
+
+        self._install(predict_fn,
+                      None if example_inputs is None
+                      else [np.asarray(e) for e in example_inputs])
+        return self
+
+    def load_tf(self, saved_model_path: str,
+                example_inputs: Optional[Sequence] = None,
+                signature: str = "serving_default"):
+        """TF SavedModel → XLA (the `doLoadTF` path,
+        InferenceModel.scala:69, without the TFNet JNI session)."""
+        from analytics_zoo_tpu.pipeline.api.net import TFNet
+        net = TFNet.from_saved_model(saved_model_path,
+                                     signature=signature)
+
+        def predict_fn(*xs):
+            return net(*xs)
+
+        self._install(predict_fn,
+                      None if example_inputs is None
+                      else [np.asarray(e) for e in example_inputs])
+        return self
+
+    def load_openvino(self, *args, **kwargs):
+        raise NotImplementedError(
+            "OpenVINO's role (ahead-of-time compiled serving) is played "
+            "by XLA AOT here: use load/load_tf with example_inputs to "
+            "pre-compile")
+
+    # -- predict ------------------------------------------------------------
+    def predict(self, inputs, timeout_ms: int = -1):
+        """Take a slot from the pool, run, return the slot (reference
+        `doPredict` contract)."""
+        if self._predict_fn is None:
+            raise RuntimeError("no model loaded")
+        slot = self._queue.take(timeout_ms)
+        if slot < 0:
+            raise TimeoutError(
+                f"no free model slot within {timeout_ms}ms "
+                f"(concurrency={self.supported_concurrent_num})")
+        try:
+            xs = (inputs if isinstance(inputs, (list, tuple))
+                  else [inputs])
+            xs = [np.asarray(x) for x in xs]
+            out = self._predict_fn(*xs)
+            if isinstance(out, (list, tuple)):
+                return [np.asarray(o) for o in out]
+            return np.asarray(out)
+        finally:
+            self._queue.put(slot)
+
+    @property
+    def concurrent_slots_free(self) -> int:
+        return self._queue.size()
+
+    def __repr__(self):
+        return (f"InferenceModel(concurrency="
+                f"{self.supported_concurrent_num}, "
+                f"loaded={self._predict_fn is not None}, "
+                f"aot={self._compiled})")

@@ -1,0 +1,95 @@
+"""HTTP serving facade over InferenceModel.
+
+Plays the role of the reference's plain-Java `AbstractInferenceModel`
+POJO + Spring-boot web-service samples (reference
+`java/.../inference/AbstractInferenceModel.java:25-103`,
+`apps/web-service-sample/`): a language-agnostic boundary for web
+services, here a stdlib HTTP/JSON endpoint (no framework deps).
+
+POST /predict  {"inputs": [[...], ...]}  →  {"outputs": [[...], ...]}
+GET  /health   →  {"status": "ok", "free_slots": N}
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from analytics_zoo_tpu.pipeline.inference.inference_model import (
+    InferenceModel)
+
+
+class InferenceServer:
+    def __init__(self, model: InferenceModel, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.model = model
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _reply(self, code: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/health":
+                    self._reply(200, {
+                        "status": "ok",
+                        "free_slots":
+                            server.model.concurrent_slots_free})
+                else:
+                    self._reply(404, {"error": "not found"})
+
+            def do_POST(self):
+                if self.path != "/predict":
+                    self._reply(404, {"error": "not found"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n))
+                    inputs = req["inputs"]
+                    if isinstance(inputs, list) and inputs and \
+                            isinstance(inputs[0], dict):
+                        xs = [np.asarray(i["data"], np.float32)
+                              for i in inputs]
+                    else:
+                        xs = np.asarray(inputs, np.float32)
+                    out = server.model.predict(xs)
+                    if isinstance(out, list):
+                        payload = {"outputs": [o.tolist() for o in out]}
+                    else:
+                        payload = {"outputs": out.tolist()}
+                    self._reply(200, payload)
+                except Exception as e:  # serving boundary: report, not die
+                    self._reply(400, {"error": str(e)})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self, background: bool = True):
+        if background:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever, daemon=True)
+            self._thread.start()
+        else:
+            self._httpd.serve_forever()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        if self._thread:
+            self._thread.join(timeout=5)

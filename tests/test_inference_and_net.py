@@ -1,0 +1,270 @@
+"""Inference/serving (L9) + TF bridge (L5) + native runtime tests."""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu import init_nncontext
+from analytics_zoo_tpu.pipeline.api.keras import Sequential, layers as L
+from analytics_zoo_tpu.pipeline.inference import (
+    InferenceModel, InferenceServer)
+
+
+@pytest.fixture(autouse=True)
+def _ctx():
+    init_nncontext(seed=0)
+    yield
+
+
+def _trained_model(tmp_path=None):
+    rs = np.random.RandomState(0)
+    x = rs.randn(32, 4).astype(np.float32)
+    y = (x.sum(1, keepdims=True) > 0).astype(np.float32)
+    m = Sequential()
+    m.add(L.Dense(8, activation="relu", input_shape=(4,)))
+    m.add(L.Dense(1, activation="sigmoid"))
+    m.compile(optimizer="adam", loss="binary_crossentropy")
+    m.fit(x, y, batch_size=16, nb_epoch=1)
+    return m, x
+
+
+# -- native runtime ---------------------------------------------------------
+
+def test_native_arena():
+    from analytics_zoo_tpu.native import HostArena, load_native
+    if load_native() is None:
+        pytest.skip("native toolchain unavailable")
+    arena = HostArena(1 << 20)
+    a = np.arange(100, dtype=np.float32)
+    off = arena.put(a)
+    view = arena.view(off, (100,), np.float32)
+    np.testing.assert_array_equal(view, a)
+    assert arena.used >= a.nbytes
+    b = np.ones((10, 10), np.int32)
+    off2 = arena.put(b)
+    np.testing.assert_array_equal(arena.view(off2, (10, 10), np.int32), b)
+    arena.reset()
+    assert arena.used == 0
+    arena.close()
+
+
+def test_native_arena_overflow():
+    from analytics_zoo_tpu.native import HostArena, load_native
+    if load_native() is None:
+        pytest.skip("native toolchain unavailable")
+    arena = HostArena(1024)
+    with pytest.raises(MemoryError):
+        arena.put(np.zeros(4096, np.float32))
+    arena.close()
+
+
+def test_native_serving_queue():
+    from analytics_zoo_tpu.native import ServingQueue, load_native
+    if load_native() is None:
+        pytest.skip("native toolchain unavailable")
+    q = ServingQueue()
+    q.put(0)
+    q.put(1)
+    assert q.size() == 2
+    assert q.take() in (0, 1)
+    assert q.take(timeout_ms=50) in (0, 1)
+    assert q.take(timeout_ms=50) == -1  # empty → timeout
+    q.close()
+
+
+def test_native_queue_blocking_handoff():
+    from analytics_zoo_tpu.native import make_serving_queue
+    q = make_serving_queue()
+    results = []
+
+    def taker():
+        results.append(q.take(timeout_ms=2000))
+
+    t = threading.Thread(target=taker)
+    t.start()
+    q.put(7)
+    t.join(timeout=3)
+    assert results == [7]
+
+
+# -- InferenceModel ---------------------------------------------------------
+
+def test_inference_model_from_saved_zoo_model(tmp_path):
+    from analytics_zoo_tpu.models.recommendation import NeuralCF
+    rs = np.random.RandomState(0)
+    x = np.stack([rs.randint(0, 10, 32),
+                  rs.randint(0, 15, 32)], 1).astype(np.float32)
+    y = rs.randint(0, 3, (32, 1)).astype(np.int32)
+    ncf = NeuralCF(10, 15, 3)
+    ncf.compile(optimizer="adam", loss="class_nll")
+    ncf.fit(x, y, batch_size=16, nb_epoch=1)
+    path = str(tmp_path / "m.model")
+    ncf.save_model(path)
+
+    im = InferenceModel(supported_concurrent_num=2)
+    im.load(path)
+    out = im.predict(x[:8])
+    np.testing.assert_allclose(out, ncf.predict(x[:8], batch_size=8),
+                               rtol=1e-5, atol=1e-6)
+    assert im.concurrent_slots_free == 2
+
+
+def test_inference_model_concurrent_predict():
+    m, x = _trained_model()
+    im = InferenceModel(supported_concurrent_num=4)
+    im.load_keras_net(m)
+    results = [None] * 8
+    errs = []
+
+    def worker(i):
+        try:
+            results[i] = im.predict(x[:4])
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    for r in results[1:]:
+        np.testing.assert_allclose(r, results[0], rtol=1e-6)
+
+
+def test_inference_model_timeout_and_errors():
+    im = InferenceModel()
+    with pytest.raises(RuntimeError):
+        im.predict(np.zeros((1, 4), np.float32))
+    m, x = _trained_model()
+    im.load_keras_net(m)
+    # drain the only slot, then timeout
+    slot = im._queue.take()
+    with pytest.raises(TimeoutError):
+        im.predict(x[:2], timeout_ms=50)
+    im._queue.put(slot)
+    assert im.predict(x[:2]).shape == (2, 1)
+
+
+def test_inference_server_http_roundtrip():
+    m, x = _trained_model()
+    im = InferenceModel(supported_concurrent_num=2)
+    im.load_keras_net(m)
+    srv = InferenceServer(im, port=0).start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}"
+        health = json.loads(urllib.request.urlopen(
+            url + "/health").read())
+        assert health["status"] == "ok"
+        req = urllib.request.Request(
+            url + "/predict",
+            data=json.dumps({"inputs": x[:3].tolist()}).encode(),
+            headers={"Content-Type": "application/json"})
+        out = json.loads(urllib.request.urlopen(req).read())["outputs"]
+        np.testing.assert_allclose(
+            np.asarray(out), m.predict(x[:3], batch_size=3),
+            rtol=1e-4, atol=1e-5)
+    finally:
+        srv.stop()
+
+
+# -- TF bridge (L5) ---------------------------------------------------------
+
+tf = pytest.importorskip("tensorflow")
+
+
+def test_tfnet_from_function():
+    from analytics_zoo_tpu.pipeline.api.net import TFNet
+
+    @tf.function
+    def fn(x):
+        return tf.nn.relu(x) * 2.0
+
+    net = TFNet.from_function(fn)
+    x = np.array([[-1.0, 2.0]], np.float32)
+    np.testing.assert_allclose(np.asarray(net(x)),
+                               [[0.0, 4.0]], rtol=1e-6)
+
+
+def test_tfnet_from_saved_model(tmp_path):
+    from analytics_zoo_tpu.pipeline.api.net import TFNet
+
+    class M(tf.Module):
+        def __init__(self):
+            self.w = tf.Variable(
+                np.array([[2.0], [3.0]], np.float32))
+
+        @tf.function(input_signature=[
+            tf.TensorSpec([None, 2], tf.float32)])
+        def __call__(self, x):
+            return tf.matmul(x, self.w)
+
+    m = M()
+    path = str(tmp_path / "sm")
+    tf.saved_model.save(m, path)
+    net = TFNet.from_saved_model(path)
+    x = np.array([[1.0, 1.0], [2.0, 0.0]], np.float32)
+    out = np.asarray(net(x))
+    np.testing.assert_allclose(out.reshape(2), [5.0, 4.0], rtol=1e-6)
+
+    preds = net.predict(x, batch_size=1)
+    assert preds.shape[0] == 2
+
+
+def test_tfnet_inside_jit():
+    import jax
+
+    from analytics_zoo_tpu.pipeline.api.net import TFNet
+
+    @tf.function
+    def fn(x):
+        return tf.sin(x)
+
+    net = TFNet.from_function(fn)
+
+    @jax.jit
+    def pipeline(x):
+        return net(x) + 1.0
+
+    x = np.linspace(0, 1, 4).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(pipeline(x)),
+                               np.sin(x) + 1.0, rtol=1e-5)
+
+
+def test_tfoptimizer_trains_tf_function_and_assigns_back():
+    from analytics_zoo_tpu.pipeline.api.net import TFOptimizer
+
+    w = tf.Variable(np.zeros((4, 1), np.float32))
+    b = tf.Variable(np.zeros((1,), np.float32))
+
+    @tf.function
+    def model_fn(w, b, x):
+        return tf.matmul(x, w) + b
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(128, 4).astype(np.float32)
+    true_w = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+    y = x @ true_w + 0.5
+
+    opt = TFOptimizer(model_fn, [w, b], loss="mse", optimizer="adam")
+    from analytics_zoo_tpu.ops.optimizers import Adam
+    opt.estimator._base_tx = Adam(lr=0.1).to_optax()
+    res = opt.optimize((x, y.astype(np.float32)), batch_size=32,
+                       nb_epoch=30)
+    assert res.history[-1]["loss"] < res.history[0]["loss"]
+    # assign-back contract: the live TF variables hold trained weights
+    np.testing.assert_allclose(w.numpy(), true_w, atol=0.2)
+    np.testing.assert_allclose(b.numpy(), [0.5], atol=0.2)
+
+
+def test_tfdataset_batch_contract():
+    from analytics_zoo_tpu.pipeline.api.net import TFDataset
+    x = np.zeros((32, 2), np.float32)
+    ds = TFDataset.from_ndarrays(x, batch_size=16)
+    assert ds.num_samples == 32
+    with pytest.raises(ValueError):
+        TFDataset.from_ndarrays(x, batch_size=9)  # 9 % 8 devices != 0
