@@ -1,0 +1,22 @@
+from analytics_zoo_tpu.models.image.objectdetection import bbox_util
+from analytics_zoo_tpu.models.image.objectdetection.bbox_util import (
+    iou_matrix, encode_boxes, decode_boxes, nms, clip_boxes)
+from analytics_zoo_tpu.models.image.objectdetection.prior_box import (
+    PriorBoxSpec, generate_ssd_priors)
+from analytics_zoo_tpu.models.image.objectdetection.multibox_loss import (
+    MultiBoxLoss, match_priors)
+from analytics_zoo_tpu.models.image.objectdetection.detection import (
+    DetectionOutput, Visualizer)
+from analytics_zoo_tpu.models.image.objectdetection.evaluation import (
+    MeanAveragePrecision)
+from analytics_zoo_tpu.models.image.objectdetection.ssd import (
+    SSDVGG, ssd300_vgg16)
+from analytics_zoo_tpu.models.image.objectdetection.object_detector \
+    import ObjectDetector
+
+__all__ = [
+    "bbox_util", "iou_matrix", "encode_boxes", "decode_boxes", "nms",
+    "clip_boxes", "PriorBoxSpec", "generate_ssd_priors", "MultiBoxLoss",
+    "match_priors", "DetectionOutput", "Visualizer",
+    "MeanAveragePrecision", "SSDVGG", "ssd300_vgg16", "ObjectDetector",
+]

@@ -1,0 +1,131 @@
+"""Bounding-box geometry: IoU, SSD codec, NMS, clipping.
+
+Reference: `Z/models/image/objectdetection/common/BboxUtil.scala` (1033
+LoC of loop-heavy geometry — SURVEY.md §2.6). Re-designed as fully
+vectorized jnp ops: everything here traces under jit with static shapes
+(NMS is a fixed-iteration suppression loop, not data-dependent control
+flow), so the whole detection head runs on-device.
+
+Box format: (x_min, y_min, x_max, y_max), normalized [0, 1].
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# SSD/Caffe variance defaults (BboxUtil encode/decode variances)
+DEFAULT_VARIANCES = (0.1, 0.1, 0.2, 0.2)
+
+
+def iou_matrix(boxes_a: jnp.ndarray, boxes_b: jnp.ndarray) -> jnp.ndarray:
+    """(N, 4) × (M, 4) → (N, M) pairwise IoU (reference
+    `BboxUtil.jaccardOverlap`)."""
+    a = boxes_a[:, None, :]  # (N, 1, 4)
+    b = boxes_b[None, :, :]  # (1, M, 4)
+    inter_min = jnp.maximum(a[..., :2], b[..., :2])
+    inter_max = jnp.minimum(a[..., 2:], b[..., 2:])
+    wh = jnp.maximum(inter_max - inter_min, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.maximum(boxes_a[:, 2] - boxes_a[:, 0], 0.0) * \
+        jnp.maximum(boxes_a[:, 3] - boxes_a[:, 1], 0.0)
+    area_b = jnp.maximum(boxes_b[:, 2] - boxes_b[:, 0], 0.0) * \
+        jnp.maximum(boxes_b[:, 3] - boxes_b[:, 1], 0.0)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1e-12), 0.0)
+
+
+def _to_center(boxes):
+    wh = boxes[..., 2:] - boxes[..., :2]
+    c = (boxes[..., :2] + boxes[..., 2:]) * 0.5
+    return c, wh
+
+
+def encode_boxes(gt_boxes: jnp.ndarray, priors: jnp.ndarray,
+                 variances=DEFAULT_VARIANCES) -> jnp.ndarray:
+    """GT corner boxes → SSD regression targets wrt priors (reference
+    `BboxUtil.encodeBBox`)."""
+    v = jnp.asarray(variances)
+    g_c, g_wh = _to_center(gt_boxes)
+    p_c, p_wh = _to_center(priors)
+    p_wh = jnp.maximum(p_wh, 1e-8)
+    g_wh = jnp.maximum(g_wh, 1e-8)
+    d_xy = (g_c - p_c) / (p_wh * v[:2])
+    d_wh = jnp.log(g_wh / p_wh) / v[2:]
+    return jnp.concatenate([d_xy, d_wh], axis=-1)
+
+
+def decode_boxes(loc: jnp.ndarray, priors: jnp.ndarray,
+                 variances=DEFAULT_VARIANCES) -> jnp.ndarray:
+    """Regression outputs → corner boxes (reference
+    `BboxUtil.decodeBBox`)."""
+    v = jnp.asarray(variances)
+    p_c, p_wh = _to_center(priors)
+    c = loc[..., :2] * v[:2] * p_wh + p_c
+    wh = jnp.exp(loc[..., 2:] * v[2:]) * p_wh
+    return jnp.concatenate([c - wh * 0.5, c + wh * 0.5], axis=-1)
+
+
+def clip_boxes(boxes: jnp.ndarray) -> jnp.ndarray:
+    return jnp.clip(boxes, 0.0, 1.0)
+
+
+def nms(boxes: jnp.ndarray, scores: jnp.ndarray,
+        iou_threshold: float = 0.45,
+        max_output: int = 100,
+        score_threshold: float = 0.0) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Non-maximum suppression, jit-friendly fixed-size output.
+
+    Returns (indices (max_output,), valid mask (max_output,)); invalid
+    slots hold index 0. (reference `BboxUtil.nms` / `Nms.scala`.)
+    """
+    n = boxes.shape[0]
+    max_output = min(max_output, n)
+    iou = iou_matrix(boxes, boxes)
+    order_scores = jnp.where(scores > score_threshold, scores, -jnp.inf)
+
+    def body(state, _):
+        remaining, = state
+        masked = jnp.where(remaining, order_scores, -jnp.inf)
+        idx = jnp.argmax(masked)
+        valid = masked[idx] > -jnp.inf
+        # suppress overlaps with the selected box
+        suppress = iou[idx] > iou_threshold
+        remaining = remaining & ~suppress & \
+            (jnp.arange(n) != idx)
+        return (remaining,), (idx, valid)
+
+    init = (jnp.ones((n,), jnp.bool_),)
+    _, (idxs, valids) = jax.lax.scan(body, init, None,
+                                     length=max_output)
+    return idxs, valids
+
+
+def bipartite_and_per_prediction_match(
+        iou: jnp.ndarray, threshold: float = 0.5
+        ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """SSD prior↔GT matching (reference `BboxUtil.matchBbox`):
+
+    1. bipartite: each GT claims its best prior (guaranteed match);
+    2. per-prediction: remaining priors match their best GT if
+       IoU > threshold.
+
+    iou: (num_gt, num_priors). Returns (match_idx (num_priors,) int —
+    GT index or -1, matched mask (num_priors,)).
+    """
+    num_gt, num_priors = iou.shape
+    best_gt = jnp.argmax(iou, axis=0)           # per prior
+    best_gt_iou = jnp.max(iou, axis=0)
+    matched = best_gt_iou > threshold
+    match_idx = jnp.where(matched, best_gt, -1)
+
+    # bipartite pass: each GT's best prior is forced to that GT
+    best_prior = jnp.argmax(iou, axis=1)        # (num_gt,)
+    gt_has_box = jnp.max(iou, axis=1) > 0.0
+    match_idx = match_idx.at[best_prior].set(
+        jnp.where(gt_has_box, jnp.arange(num_gt), match_idx[best_prior]))
+    matched = match_idx >= 0
+    return match_idx, matched

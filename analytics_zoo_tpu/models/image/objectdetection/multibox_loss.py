@@ -1,0 +1,112 @@
+"""MultiBoxLoss (reference
+`Z/models/image/objectdetection/common/loss/MultiBoxLoss.scala:39`,
+622 LoC): SSD training loss = SmoothL1 localization on matched priors +
+softmax confidence with 3:1 hard-negative mining, normalized by the
+match count.
+
+TPU-first: the whole loss — matching included — is vectorized and jit-
+compiled per batch element via vmap; hard-negative mining uses a sort
+(top-k) rather than the reference's per-image mutable heaps. Ground
+truth arrives as fixed-size padded arrays (label -1 = padding), keeping
+shapes static for XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.models.image.objectdetection.bbox_util import (
+    bipartite_and_per_prediction_match, encode_boxes, iou_matrix)
+
+
+def match_priors(gt_boxes: jnp.ndarray, gt_labels: jnp.ndarray,
+                 priors: jnp.ndarray, iou_threshold: float = 0.5
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Single image: (max_gt, 4) padded GT + (max_gt,) labels (-1 pad)
+    → (loc_targets (P, 4), cls_targets (P,) int [0 = background],
+    matched mask (P,))."""
+    valid = gt_labels >= 0
+    iou = iou_matrix(gt_boxes, priors)            # (max_gt, P)
+    iou = jnp.where(valid[:, None], iou, 0.0)
+    match_idx, matched = bipartite_and_per_prediction_match(
+        iou, iou_threshold)
+    safe_idx = jnp.maximum(match_idx, 0)
+    matched_boxes = gt_boxes[safe_idx]
+    loc_targets = encode_boxes(matched_boxes, priors)
+    # class targets: gt label + 1 (0 reserved for background)
+    cls_targets = jnp.where(matched, gt_labels[safe_idx] + 1, 0)
+    return loc_targets, cls_targets, matched
+
+
+def smooth_l1(x: jnp.ndarray) -> jnp.ndarray:
+    ax = jnp.abs(x)
+    return jnp.where(ax < 1.0, 0.5 * x * x, ax - 0.5)
+
+
+class MultiBoxLoss:
+    """Callable loss: ((loc_pred, conf_pred), (gt_boxes, gt_labels)) →
+    scalar. Shapes: loc_pred (B, P, 4); conf_pred (B, P, C) logits
+    (C includes background class 0); gt padded (B, max_gt, 4)/(B,
+    max_gt) with label -1 padding."""
+
+    def __init__(self, n_classes: int, iou_threshold: float = 0.5,
+                 neg_pos_ratio: float = 3.0, loc_weight: float = 1.0):
+        self.n_classes = int(n_classes)
+        self.iou_threshold = float(iou_threshold)
+        self.neg_pos_ratio = float(neg_pos_ratio)
+        self.loc_weight = float(loc_weight)
+
+    def __call__(self, priors: jnp.ndarray, loc_pred: jnp.ndarray,
+                 conf_pred: jnp.ndarray, gt_boxes: jnp.ndarray,
+                 gt_labels: jnp.ndarray) -> jnp.ndarray:
+        loc_t, cls_t, matched = jax.vmap(
+            lambda b, l: match_priors(b, l, priors,
+                                      self.iou_threshold))(
+            gt_boxes, gt_labels)
+        num_pos = jnp.sum(matched, axis=1)               # (B,)
+
+        # localization: SmoothL1 over matched priors
+        loc_loss = jnp.sum(
+            smooth_l1(loc_pred - loc_t) * matched[..., None], axis=(1, 2))
+
+        # confidence: softmax CE; hard negative mining 3:1 by loss rank
+        logp = jax.nn.log_softmax(conf_pred.astype(jnp.float32), axis=-1)
+        ce = -jnp.take_along_axis(logp, cls_t[..., None],
+                                  axis=-1)[..., 0]        # (B, P)
+        neg_ce = jnp.where(matched, -jnp.inf, ce)         # only negatives
+        n_neg = jnp.minimum(
+            (num_pos * self.neg_pos_ratio).astype(jnp.int32),
+            jnp.asarray(ce.shape[1] - 1, jnp.int32))
+        # rank negatives by loss; keep top n_neg per image
+        sorted_neg = jnp.sort(neg_ce, axis=1)[:, ::-1]    # desc
+        kth = jnp.take_along_axis(
+            sorted_neg, jnp.maximum(n_neg - 1, 0)[:, None], axis=1)
+        keep_neg = (neg_ce >= kth) & (n_neg[:, None] > 0) & \
+            jnp.isfinite(neg_ce)
+        conf_loss = jnp.sum(ce * (matched | keep_neg), axis=1)
+
+        norm = jnp.maximum(num_pos.astype(jnp.float32), 1.0)
+        total = (self.loc_weight * loc_loss + conf_loss) / norm
+        return jnp.mean(total)
+
+    def as_keras_loss(self, priors: jnp.ndarray):
+        """Adapt to the Estimator's (y_true, y_pred) contract:
+        y_pred = concat[loc (P·4), conf (P·C)] flattened per image;
+        y_true = concat[gt_boxes (max_gt·4), gt_labels (max_gt)]."""
+        p = priors.shape[0]
+        c = self.n_classes
+
+        def loss_fn(y_true, y_pred):
+            b = y_pred.shape[0]
+            loc = y_pred[:, :p * 4].reshape(b, p, 4)
+            conf = y_pred[:, p * 4:].reshape(b, p, c)
+            max_gt = (y_true.shape[1]) // 5
+            gt_boxes = y_true[:, :max_gt * 4].reshape(b, max_gt, 4)
+            gt_labels = y_true[:, max_gt * 4:].reshape(b, max_gt) \
+                .astype(jnp.int32)
+            return self(priors, loc, conf, gt_boxes, gt_labels)
+
+        return loss_fn
