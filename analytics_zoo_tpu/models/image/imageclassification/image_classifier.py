@@ -9,21 +9,46 @@ from typing import Optional, Tuple
 from analytics_zoo_tpu.models.common import ZooModel
 
 
+def _builders():
+    """Single name→builder registry; ARCHS derives from its keys so the
+    validation tuple and the dispatch can never drift."""
+    from analytics_zoo_tpu.models.image.imageclassification import archs
+    from analytics_zoo_tpu.models.image.imageclassification.lenet import \
+        lenet5
+    from analytics_zoo_tpu.models.image.imageclassification.resnet import \
+        ResNet
+    return {
+        "lenet-5": lenet5,
+        "resnet-50": lambda s, c: ResNet(50).build(s, c),
+        "resnet-101": lambda s, c: ResNet(101).build(s, c),
+        "resnet-152": lambda s, c: ResNet(152).build(s, c),
+        "vgg-16": archs.vgg16,
+        "vgg-19": archs.vgg19,
+        "inception-v1": archs.inception_v1,
+        "mobilenet": archs.mobilenet,
+        "mobilenet-v2": archs.mobilenet_v2,
+        "densenet-121": archs.densenet121,
+        "squeezenet": archs.squeezenet,
+    }
+
+
 class ImageClassifier(ZooModel):
     """``ImageClassifier(model_name="resnet-50")`` — named-architecture
     image classification (the pretrained-weight registry of the reference
     maps to `load_model` files here)."""
 
-    ARCHS = ("lenet-5", "resnet-50", "resnet-101", "resnet-152")
+    @property
+    def ARCHS(self):
+        return tuple(_builders())
 
     def __init__(self, model_name: str = "resnet-50",
                  input_shape: Tuple[int, int, int] = (224, 224, 3),
                  classes: int = 1000):
         super().__init__()
         name = model_name.lower()
-        if name not in self.ARCHS:
+        if name not in _builders():
             raise ValueError(f"unknown architecture '{model_name}'; "
-                             f"known: {self.ARCHS}")
+                             f"known: {tuple(_builders())}")
         self.model_name = name
         self.input_shape = tuple(input_shape)
         self.classes = int(classes)
@@ -34,11 +59,4 @@ class ImageClassifier(ZooModel):
                 "classes": self.classes}
 
     def build_model(self):
-        if self.model_name == "lenet-5":
-            from analytics_zoo_tpu.models.image.imageclassification \
-                .lenet import lenet5
-            return lenet5(self.input_shape, self.classes)
-        from analytics_zoo_tpu.models.image.imageclassification.resnet \
-            import ResNet
-        depth = int(self.model_name.split("-")[1])
-        return ResNet(depth).build(self.input_shape, self.classes)
+        return _builders()[self.model_name](self.input_shape, self.classes)

@@ -6,7 +6,8 @@ from analytics_zoo_tpu.pipeline.api.keras.layers.core import (
     Squeeze, ExpandDim, Narrow, Select, Masking)
 from analytics_zoo_tpu.pipeline.api.keras.layers.conv import (
     Convolution1D, Convolution2D, Convolution3D, AtrousConvolution2D,
-    SeparableConvolution2D, Deconvolution2D, ZeroPadding1D, ZeroPadding2D,
+    SeparableConvolution2D, DepthwiseConvolution2D, Deconvolution2D,
+    ZeroPadding1D, ZeroPadding2D,
     Cropping1D, Cropping2D, UpSampling1D, UpSampling2D, UpSampling3D,
     Conv1D, Conv2D, Conv3D, Conv2DTranspose, SeparableConv2D)
 from analytics_zoo_tpu.pipeline.api.keras.layers.pooling import (
@@ -37,7 +38,8 @@ __all__ = [
     "RepeatVector", "Squeeze", "ExpandDim", "Narrow", "Select", "Masking",
     # conv
     "Convolution1D", "Convolution2D", "Convolution3D",
-    "AtrousConvolution2D", "SeparableConvolution2D", "Deconvolution2D",
+    "AtrousConvolution2D", "SeparableConvolution2D",
+    "DepthwiseConvolution2D", "Deconvolution2D",
     "ZeroPadding1D", "ZeroPadding2D", "Cropping1D", "Cropping2D",
     "UpSampling1D", "UpSampling2D", "UpSampling3D",
     "Conv1D", "Conv2D", "Conv3D", "Conv2DTranspose", "SeparableConv2D",

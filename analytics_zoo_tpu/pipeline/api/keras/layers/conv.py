@@ -187,18 +187,24 @@ class AtrousConvolution2D(Convolution2D):
         super().__init__(nb_filter, nb_row, nb_col, **kwargs)
 
 
-class SeparableConvolution2D(KerasLayer):
-    """Depthwise-separable 2D conv (reference
-    `layers/SeparableConvolution2D.scala`). Depthwise via
-    `feature_group_count`, then 1x1 pointwise — both MXU-friendly."""
+class DepthwiseConvolution2D(KerasLayer):
+    """Depthwise 2D conv (MobileNet building block; the reference reaches
+    it through BigDL's `SpatialSeparableConvolution` used by
+    `SeparableConvolution2D.scala`). Implemented with
+    ``feature_group_count=in_channels`` so XLA lowers it to a grouped conv
+    on the MXU."""
 
-    def __init__(self, nb_filter: int, nb_row: int, nb_col=None,
-                 init="glorot_uniform", activation=None,
-                 border_mode="valid", subsample=(1, 1), depth_multiplier=1,
-                 dim_ordering="tf", w_regularizer=None, b_regularizer=None,
-                 bias=True, input_shape=None, name=None, **kwargs):
+    def __init__(self, nb_row: int, nb_col=None, init="glorot_uniform",
+                 activation=None, border_mode="valid", subsample=(1, 1),
+                 depth_multiplier=1, dim_ordering="tf", w_regularizer=None,
+                 b_regularizer=None, bias=True, input_shape=None, name=None,
+                 **kwargs):
         super().__init__(input_shape=input_shape, name=name, **kwargs)
-        self.nb_filter = int(nb_filter)
+        if border_mode not in ("valid", "same"):
+            raise ValueError(f"border_mode must be valid|same, "
+                             f"got {border_mode}")
+        if dim_ordering not in ("tf", "th"):
+            raise ValueError("dim_ordering must be 'tf' or 'th'")
         self.kernel_size = (_norm_tuple(nb_row, 1, "nb_row")[0],
                             _norm_tuple(nb_col if nb_col is not None
                                         else nb_row, 1, "nb_col")[0])
@@ -216,9 +222,82 @@ class SeparableConvolution2D(KerasLayer):
         return (input_shape[-1] if self.dim_ordering == "tf"
                 else input_shape[0])
 
+    def _out_channels(self, in_ch):
+        return in_ch * self.depth_multiplier
+
+    def _dn(self):
+        io = (("NHWC", "HWIO", "NHWC") if self.dim_ordering == "tf"
+              else ("NCHW", "HWIO", "NCHW"))
+        return jax.lax.conv_dimension_numbers((1, 1, 1, 1), (1, 1, 1, 1), io)
+
+    def _depthwise(self, x, params):
+        """The shared grouped-conv stage."""
+        in_ch = self._in_channels(tuple(x.shape[1:]))
+        return jax.lax.conv_general_dilated(
+            x, params["depthwise"].astype(x.dtype),
+            window_strides=self.subsample,
+            padding=self.border_mode.upper(),
+            feature_group_count=in_ch,
+            dimension_numbers=self._dn())
+
+    def _bias_act(self, y, params):
+        if self.bias:
+            b = params["bias"].astype(y.dtype)
+            y = y + (b if self.dim_ordering == "tf"
+                     else b.reshape((1, -1, 1, 1)))
+        if self.activation is not None:
+            y = self.activation(y)
+        return y
+
     def build(self, rng, input_shape):
         in_ch = self._in_channels(input_shape)
-        k1, k2, _ = jax.random.split(rng, 3)
+        k1, _ = jax.random.split(rng)
+        out_ch = self._out_channels(in_ch)
+        params = {"depthwise": self.kernel_init(
+            k1, self.kernel_size + (1, in_ch * self.depth_multiplier))}
+        if self.bias:
+            params["bias"] = jnp.zeros((out_ch,), jnp.float32)
+        return params
+
+    def call(self, params, x, *, training=False, rng=None):
+        return self._bias_act(self._depthwise(x, params), params)
+
+    def compute_output_shape(self, input_shape):
+        out_ch = self._out_channels(self._in_channels(input_shape))
+        spatial = (input_shape[:2] if self.dim_ordering == "tf"
+                   else input_shape[1:3])
+        out_sp = tuple(_conv_out_len(s, k, st, self.border_mode)
+                       for s, k, st in zip(spatial, self.kernel_size,
+                                           self.subsample))
+        if self.dim_ordering == "tf":
+            return out_sp + (out_ch,)
+        return (out_ch,) + out_sp
+
+    def regularizers(self):
+        out = []
+        if self.w_regularizer is not None:
+            out.append(("depthwise", self.w_regularizer))
+        if self.b_regularizer is not None:
+            out.append(("bias", self.b_regularizer))
+        return out
+
+
+class SeparableConvolution2D(DepthwiseConvolution2D):
+    """Depthwise-separable 2D conv (reference
+    `layers/SeparableConvolution2D.scala`): the depthwise stage of
+    `DepthwiseConvolution2D` followed by a 1x1 pointwise conv — both
+    MXU-friendly."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col=None, **kwargs):
+        super().__init__(nb_row, nb_col, **kwargs)
+        self.nb_filter = int(nb_filter)
+
+    def _out_channels(self, in_ch):
+        return self.nb_filter
+
+    def build(self, rng, input_shape):
+        in_ch = self._in_channels(input_shape)
+        k1, k2 = jax.random.split(rng)
         params = {
             "depthwise": self.kernel_init(
                 k1, self.kernel_size + (1, in_ch * self.depth_multiplier)),
@@ -229,49 +308,18 @@ class SeparableConvolution2D(KerasLayer):
             params["bias"] = jnp.zeros((self.nb_filter,), jnp.float32)
         return params
 
-    def _dn(self):
-        io = (("NHWC", "HWIO", "NHWC") if self.dim_ordering == "tf"
-              else ("NCHW", "HWIO", "NCHW"))
-        return jax.lax.conv_dimension_numbers((1, 1, 1, 1), (1, 1, 1, 1), io)
-
     def call(self, params, x, *, training=False, rng=None):
-        in_ch = self._in_channels(tuple(x.shape[1:]))
-        dn = self._dn()
-        y = jax.lax.conv_general_dilated(
-            x, params["depthwise"].astype(x.dtype),
-            window_strides=self.subsample,
-            padding=self.border_mode.upper(),
-            feature_group_count=in_ch,
-            dimension_numbers=dn)
+        y = self._depthwise(x, params)
         y = jax.lax.conv_general_dilated(
             y, params["pointwise"].astype(y.dtype),
             window_strides=(1, 1), padding="VALID",
-            dimension_numbers=dn)
-        if self.bias:
-            b = params["bias"].astype(y.dtype)
-            y = y + (b if self.dim_ordering == "tf"
-                     else b.reshape((1, -1, 1, 1)))
-        if self.activation is not None:
-            y = self.activation(y)
-        return y
-
-    def compute_output_shape(self, input_shape):
-        spatial = (input_shape[:2] if self.dim_ordering == "tf"
-                   else input_shape[1:3])
-        out_sp = tuple(_conv_out_len(s, k, st, self.border_mode)
-                       for s, k, st in zip(spatial, self.kernel_size,
-                                           self.subsample))
-        if self.dim_ordering == "tf":
-            return out_sp + (self.nb_filter,)
-        return (self.nb_filter,) + out_sp
+            dimension_numbers=self._dn())
+        return self._bias_act(y, params)
 
     def regularizers(self):
-        out = []
+        out = super().regularizers()
         if self.w_regularizer is not None:
-            out.append(("depthwise", self.w_regularizer))
-            out.append(("pointwise", self.w_regularizer))
-        if self.b_regularizer is not None:
-            out.append(("bias", self.b_regularizer))
+            out.insert(1, ("pointwise", self.w_regularizer))
         return out
 
 

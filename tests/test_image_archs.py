@@ -1,0 +1,77 @@
+"""Named-architecture specs for ImageClassifier (reference
+`ImageClassificationConfig.scala:31` registry — vgg/inception/mobilenet/
+densenet/squeezenet). Small inputs keep CPU runtime sane; shapes verify
+the arch topology end-to-end."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu import init_nncontext
+from analytics_zoo_tpu.models.image.imageclassification import (
+    ImageClassifier, densenet121, inception_v1, mobilenet, mobilenet_v2,
+    squeezenet, vgg16, vgg19)
+
+
+@pytest.fixture(autouse=True)
+def _ctx():
+    init_nncontext(seed=0)
+    yield
+
+
+def _check(model, hw=64, channels=3, classes=7, batch=2):
+    params = model.init_params()
+    x = np.random.RandomState(0).randn(
+        batch, hw, hw, channels).astype(np.float32)
+    y = model.forward(params, x, training=False)
+    assert y.shape == (batch, classes)
+    return params
+
+
+def test_vgg16_forward():
+    _check(vgg16(input_shape=(64, 64, 3), classes=7))
+
+
+def test_vgg19_forward():
+    _check(vgg19(input_shape=(64, 64, 3), classes=7))
+
+
+def test_inception_v1_forward():
+    _check(inception_v1(input_shape=(64, 64, 3), classes=7))
+
+
+def test_mobilenet_forward():
+    _check(mobilenet(input_shape=(64, 64, 3), classes=7))
+
+
+def test_mobilenet_v2_forward():
+    m = mobilenet_v2(input_shape=(64, 64, 3), classes=7)
+    _check(m)
+
+
+def test_densenet121_forward():
+    _check(densenet121(input_shape=(64, 64, 3), classes=7))
+
+
+def test_squeezenet_forward():
+    _check(squeezenet(input_shape=(64, 64, 3), classes=7))
+
+
+def test_image_classifier_registry_covers_archs():
+    for name in ("vgg-16", "vgg-19", "inception-v1", "mobilenet",
+                 "mobilenet-v2", "densenet-121", "squeezenet"):
+        ic = ImageClassifier(name, input_shape=(64, 64, 3), classes=5)
+        net = ic.build_model()
+        assert net.compute_output_shape((64, 64, 3))[-1] == 5
+
+
+def test_mobilenet_trains():
+    ic = ImageClassifier("mobilenet", input_shape=(32, 32, 3), classes=4)
+    from analytics_zoo_tpu.ops.optimizers import Adam
+    # mobilenet ends in raw logits — use the from_logits loss
+    ic.compile(optimizer=Adam(lr=0.01),
+               loss="sparse_categorical_crossentropy_from_logits")
+    rs = np.random.RandomState(0)
+    x = rs.randn(16, 32, 32, 3).astype(np.float32)
+    y = rs.randint(0, 4, (16, 1)).astype(np.int32)
+    res = ic.fit(x, y, batch_size=8, nb_epoch=1)
+    assert len(res.history) == 1
