@@ -18,6 +18,8 @@ TPU-first choices shared by all archs:
 
 from __future__ import annotations
 
+from analytics_zoo_tpu.models.image.imageclassification.resnet import (
+    conv_bn as _cbr)
 from analytics_zoo_tpu.pipeline.api.keras.engine import Input
 from analytics_zoo_tpu.pipeline.api.keras.models import Model, Sequential
 from analytics_zoo_tpu.pipeline.api.keras.layers import (
@@ -71,8 +73,6 @@ def vgg19(input_shape=(224, 224, 3), classes=1000) -> Model:
 # `examples/inception/Train.scala:70-107` — the ImageNet headline example)
 # ---------------------------------------------------------------------------
 
-from analytics_zoo_tpu.models.image.imageclassification.resnet import \
-    _conv_bn as _cbr
 
 
 def _inception_module(x, f1, f3r, f3, f5r, f5, fp, name):

@@ -37,9 +37,14 @@ class ImageClassifier(ZooModel):
     image classification (the pretrained-weight registry of the reference
     maps to `load_model` files here)."""
 
-    @property
-    def ARCHS(self):
-        return tuple(_builders())
+    class _ArchList:
+        """Class-level descriptor so both ``ImageClassifier.ARCHS`` and
+        ``instance.ARCHS`` yield the architecture-name tuple."""
+
+        def __get__(self, obj, objtype=None):
+            return tuple(_builders())
+
+    ARCHS = _ArchList()
 
     def __init__(self, model_name: str = "resnet-50",
                  input_shape: Tuple[int, int, int] = (224, 224, 3),

@@ -20,7 +20,7 @@ from analytics_zoo_tpu.pipeline.api.keras.layers import (
     Flatten, GlobalAveragePooling2D, Add, MaxPooling2D, ZeroPadding2D)
 
 
-def _conv_bn(x, filters, kernel, stride=1, activation="relu",
+def conv_bn(x, filters, kernel, stride=1, activation="relu",
              name=None):
     x = Convolution2D(filters, kernel, kernel, subsample=stride,
                       border_mode="same", bias=False, name=name)(x)
@@ -33,8 +33,8 @@ def _conv_bn(x, filters, kernel, stride=1, activation="relu",
 def _bottleneck(x, filters, stride=1, downsample=False, name=""):
     """v1.5 bottleneck: stride lives on the 3x3 conv."""
     shortcut = x
-    y = _conv_bn(x, filters, 1, 1, name=name + "_c1")
-    y = _conv_bn(y, filters, 3, stride, name=name + "_c2")
+    y = conv_bn(x, filters, 1, 1, name=name + "_c1")
+    y = conv_bn(y, filters, 3, stride, name=name + "_c2")
     y = Convolution2D(filters * 4, 1, 1, border_mode="same", bias=False,
                       name=name + "_c3")(y)
     y = BatchNormalization(name=name + "_c3_bn")(y)
@@ -63,7 +63,7 @@ class ResNet:
               ) -> Model:
         blocks = self.DEPTH_BLOCKS[self.depth]
         inp = Input(input_shape, name="image")
-        x = _conv_bn(inp, 64, 7, stride=2, name="stem")
+        x = conv_bn(inp, 64, 7, stride=2, name="stem")
         x = MaxPooling2D(pool_size=3, strides=2, border_mode="same")(x)
         filters = 64
         for stage, n_blocks in enumerate(blocks):

@@ -31,6 +31,19 @@ from analytics_zoo_tpu.pipeline.api.keras.layers.noise import (
     SpatialDropout3D)
 from analytics_zoo_tpu.pipeline.api.keras.layers.transformer import (
     MultiHeadAttention, TransformerLayer, BERT)
+from analytics_zoo_tpu.pipeline.api.keras.layers.elementwise import (
+    AddConstant, MulConstant, CAdd, CMul, Mul, Scale, Power, Negative,
+    Exp, Log, Sqrt, Square, Identity, BinaryThreshold, Threshold,
+    HardShrink, SoftShrink, HardTanh, RReLU, GaussianSampler, GetShape,
+    Expand, Max, ResizeBilinear, SelectTable, SplitTensor,
+    KerasLayerWrapper, Highway, MaxoutDense)
+from analytics_zoo_tpu.pipeline.api.keras.layers.local_conv import (
+    LocallyConnected1D, LocallyConnected2D, AtrousConvolution1D,
+    ShareConvolution2D, ZeroPadding3D, Cropping3D)
+from analytics_zoo_tpu.pipeline.api.keras.layers.convlstm import (
+    ConvLSTM2D, ConvLSTM3D)
+from analytics_zoo_tpu.pipeline.api.keras.layers.sparse import (
+    SparseEmbedding, SparseDense)
 
 __all__ = [
     # core
@@ -65,4 +78,18 @@ __all__ = [
     "SpatialDropout2D", "SpatialDropout3D",
     # transformer
     "MultiHeadAttention", "TransformerLayer", "BERT",
+    # elementwise / tensor utilities
+    "AddConstant", "MulConstant", "CAdd", "CMul", "Mul", "Scale", "Power",
+    "Negative", "Exp", "Log", "Sqrt", "Square", "Identity",
+    "BinaryThreshold", "Threshold", "HardShrink", "SoftShrink", "HardTanh",
+    "RReLU", "GaussianSampler", "GetShape", "Expand", "Max",
+    "ResizeBilinear", "SelectTable", "SplitTensor", "KerasLayerWrapper",
+    "Highway", "MaxoutDense",
+    # locally-connected / conv extras
+    "LocallyConnected1D", "LocallyConnected2D", "AtrousConvolution1D",
+    "ShareConvolution2D", "ZeroPadding3D", "Cropping3D",
+    # conv-lstm
+    "ConvLSTM2D", "ConvLSTM3D",
+    # sparse
+    "SparseEmbedding", "SparseDense",
 ]

@@ -1,0 +1,148 @@
+"""Convolutional LSTM layers.
+
+Reference surface: `Z/pipeline/api/keras/layers/{ConvLSTM2D,ConvLSTM3D}.scala`
+(BigDL ConvLSTMPeephole without peepholes by default; gate order i,f,c,o,
+inner activation hard_sigmoid — same Keras-1 semantics as `LSTM`).
+
+TPU-first: input-to-gate convolutions for ALL timesteps are hoisted out of
+the scan as one big (B·T) conv (maximal MXU utilisation); the scan body only
+does the hidden-to-gate conv. Layout is channels-last (NHWC), the native
+TPU conv layout, instead of the reference's CHANNEL_FIRST default.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.ops import activations, initializers, regularizers
+from analytics_zoo_tpu.pipeline.api.keras.engine import KerasLayer, Shape
+from analytics_zoo_tpu.pipeline.api.keras.layers.conv import (
+    _conv_out_len, _norm_tuple)
+
+
+class _ConvLSTMND(KerasLayer):
+    ndim = 2  # spatial dims
+
+    def __init__(self, nb_filter: int, nb_kernel, activation="tanh",
+                 inner_activation="hard_sigmoid", border_mode: str = "same",
+                 subsample=1, return_sequences: bool = False,
+                 go_backwards: bool = False, w_regularizer=None,
+                 u_regularizer=None, b_regularizer=None, input_shape=None,
+                 name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        n = self.ndim
+        if border_mode not in ("same", "valid"):
+            raise ValueError("border_mode must be same|valid")
+        self.nb_filter = int(nb_filter)
+        self.nb_kernel = _norm_tuple(nb_kernel, n, "nb_kernel")
+        self.subsample = _norm_tuple(subsample, n, "subsample")
+        self.border_mode = border_mode
+        self.activation = activations.get(activation)
+        self.inner_activation = activations.get(inner_activation)
+        self.return_sequences = return_sequences
+        self.go_backwards = go_backwards
+        self.w_regularizer = regularizers.get(w_regularizer)
+        self.u_regularizer = regularizers.get(u_regularizer)
+        self.b_regularizer = regularizers.get(b_regularizer)
+
+    def _dn(self):
+        n = self.ndim
+        sp = "DHW"[3 - n:]
+        io = ("N" + sp + "C", sp + "IO", "N" + sp + "C")
+        return jax.lax.conv_dimension_numbers(
+            (1,) * (n + 2), (1,) * (n + 2), io)
+
+    def build(self, rng, input_shape: Shape) -> dict:
+        # input_shape: (T, *spatial, C)
+        in_ch = input_shape[-1]
+        k1, k2 = jax.random.split(rng)
+        # glorot for both kernels — orthogonal init is 2D-only, and for
+        # conv-shaped recurrent kernels glorot's flattened fan behaves
+        # equivalently
+        init = initializers.get("glorot_uniform")
+        w_shape = self.nb_kernel + (in_ch, 4 * self.nb_filter)
+        u_shape = self.nb_kernel + (self.nb_filter, 4 * self.nb_filter)
+        return {"kernel": init(k1, w_shape),
+                "recurrent": init(k2, u_shape),
+                "bias": jnp.zeros((4 * self.nb_filter,), jnp.float32)}
+
+    def _conv(self, x, kernel, strides, padding):
+        return jax.lax.conv_general_dilated(
+            x, kernel.astype(x.dtype), window_strides=strides,
+            padding=padding, dimension_numbers=self._dn())
+
+    def _out_spatial(self, spatial) -> Tuple[int, ...]:
+        return tuple(_conv_out_len(s, k, st, self.border_mode)
+                     for s, k, st in zip(spatial, self.nb_kernel,
+                                         self.subsample))
+
+    def call(self, params, x, *, training=False, rng=None):
+        # x: (B, T, *spatial, C)
+        if self.go_backwards:
+            x = jnp.flip(x, axis=1)
+        b, t = x.shape[0], x.shape[1]
+        n = self.ndim
+        flat = x.reshape((b * t,) + x.shape[2:])
+        zx = self._conv(flat, params["kernel"], self.subsample,
+                        self.border_mode.upper())
+        zx = zx + params["bias"].astype(zx.dtype)
+        out_sp = zx.shape[1:1 + n]
+        zx = zx.reshape((b, t) + zx.shape[1:])
+        zx_t = jnp.swapaxes(zx, 0, 1)  # (T, B, *sp, 4F)
+
+        h0 = jnp.zeros((b,) + out_sp + (self.nb_filter,), x.dtype)
+        c0 = jnp.zeros_like(h0)
+        u = params["recurrent"]
+
+        def scan_fn(carry, z):
+            h, c = carry
+            gates = z + self._conv(h, u, (1,) * n, "SAME")
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i = self.inner_activation(i)
+            f = self.inner_activation(f)
+            g = self.activation(g)
+            o = self.inner_activation(o)
+            c_new = f * c + i * g
+            h_new = o * self.activation(c_new)
+            return (h_new, c_new), h_new
+
+        (_, _), outs = jax.lax.scan(scan_fn, (h0, c0), zx_t)
+        outs = jnp.swapaxes(outs, 0, 1)  # (B, T, *sp, F)
+        if self.return_sequences:
+            return outs
+        return outs[:, -1]
+
+    def compute_output_shape(self, input_shape: Shape) -> Shape:
+        t = input_shape[0]
+        out_sp = self._out_spatial(input_shape[1:1 + self.ndim])
+        core = out_sp + (self.nb_filter,)
+        if self.return_sequences:
+            return (t,) + core
+        return core
+
+    def regularizers(self):
+        out = []
+        if self.w_regularizer is not None:
+            out.append(("kernel", self.w_regularizer))
+        if self.u_regularizer is not None:
+            out.append(("recurrent", self.u_regularizer))
+        if self.b_regularizer is not None:
+            out.append(("bias", self.b_regularizer))
+        return out
+
+
+class ConvLSTM2D(_ConvLSTMND):
+    """2D convolutional LSTM (reference `layers/ConvLSTM2D.scala`).
+    Input (B, T, H, W, C)."""
+
+    ndim = 2
+
+
+class ConvLSTM3D(_ConvLSTMND):
+    """3D convolutional LSTM (reference `layers/ConvLSTM3D.scala`).
+    Input (B, T, D, H, W, C)."""
+
+    ndim = 3

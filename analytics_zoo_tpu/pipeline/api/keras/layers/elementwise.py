@@ -1,0 +1,522 @@
+"""Elementwise / tensor-utility layers.
+
+Reference surface: `Z/pipeline/api/keras/layers/{AddConstant,MulConstant,
+CAdd,CMul,Mul,Scale,Power,Negative,Exp,Log,Sqrt,Square,Identity,
+BinaryThreshold,Threshold,HardShrink,SoftShrink,HardTanh,RReLU,
+GaussianSampler,GetShape,Expand,Max,ResizeBilinear,SelectTable,SplitTensor,
+KerasLayerWrapper,Highway,MaxoutDense}.scala`.
+
+All of these are trivial XLA ops that fuse into their neighbours; the few
+parametrised ones (CAdd/CMul/Scale/Mul/Highway/MaxoutDense) follow the
+engine's pure-functional params convention.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.ops import activations, initializers, regularizers
+from analytics_zoo_tpu.pipeline.api.keras.engine import (
+    KerasLayer, Shape, ShapeLike, Variable, as_shape)
+
+
+class AddConstant(KerasLayer):
+    """y = x + constant (reference `layers/AddConstant.scala`)."""
+
+    def __init__(self, constant: float, input_shape=None, name=None,
+                 **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.constant = float(constant)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return x + self.constant
+
+
+class MulConstant(KerasLayer):
+    """y = x * constant (reference `layers/MulConstant.scala`)."""
+
+    def __init__(self, constant: float, input_shape=None, name=None,
+                 **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.constant = float(constant)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return x * self.constant
+
+
+class CAdd(KerasLayer):
+    """Learnable per-element bias, broadcast against the input
+    (reference `layers/CAdd.scala`)."""
+
+    def __init__(self, size: Sequence[int], b_regularizer=None,
+                 input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.size = tuple(int(d) for d in size)
+        self.b_regularizer = regularizers.get(b_regularizer)
+
+    def build(self, rng, input_shape: Shape) -> dict:
+        return {"bias": jnp.zeros(self.size, jnp.float32)}
+
+    def call(self, params, x, *, training=False, rng=None):
+        return x + params["bias"].astype(x.dtype)
+
+    def regularizers(self):
+        return ([("bias", self.b_regularizer)]
+                if self.b_regularizer is not None else [])
+
+
+class CMul(KerasLayer):
+    """Learnable per-element scale, broadcast against the input
+    (reference `layers/CMul.scala`)."""
+
+    def __init__(self, size: Sequence[int], w_regularizer=None,
+                 input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.size = tuple(int(d) for d in size)
+        self.w_regularizer = regularizers.get(w_regularizer)
+
+    def build(self, rng, input_shape: Shape) -> dict:
+        return {"weight": jnp.ones(self.size, jnp.float32)}
+
+    def call(self, params, x, *, training=False, rng=None):
+        return x * params["weight"].astype(x.dtype)
+
+    def regularizers(self):
+        return ([("weight", self.w_regularizer)]
+                if self.w_regularizer is not None else [])
+
+
+class Mul(KerasLayer):
+    """Single learnable scalar multiplier (reference `layers/Mul.scala`)."""
+
+    def build(self, rng, input_shape: Shape) -> dict:
+        return {"weight": jnp.ones((), jnp.float32)}
+
+    def call(self, params, x, *, training=False, rng=None):
+        return x * params["weight"].astype(x.dtype)
+
+
+class Scale(KerasLayer):
+    """CMul followed by CAdd over `size` (reference `layers/Scale.scala`)."""
+
+    def __init__(self, size: Sequence[int], input_shape=None, name=None,
+                 **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.size = tuple(int(d) for d in size)
+
+    def build(self, rng, input_shape: Shape) -> dict:
+        return {"weight": jnp.ones(self.size, jnp.float32),
+                "bias": jnp.zeros(self.size, jnp.float32)}
+
+    def call(self, params, x, *, training=False, rng=None):
+        return (x * params["weight"].astype(x.dtype)
+                + params["bias"].astype(x.dtype))
+
+
+class Power(KerasLayer):
+    """y = (shift + scale * x) ** power (reference `layers/Power.scala`)."""
+
+    def __init__(self, power: float, scale: float = 1.0, shift: float = 0.0,
+                 input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.power = float(power)
+        self.scale = float(scale)
+        self.shift = float(shift)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.power(self.shift + self.scale * x, self.power)
+
+
+class Negative(KerasLayer):
+    """y = -x (reference `layers/Negative.scala`)."""
+
+    def call(self, params, x, *, training=False, rng=None):
+        return -x
+
+
+class Exp(KerasLayer):
+    """y = exp(x) (reference `layers/Exp.scala`)."""
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.exp(x)
+
+
+class Log(KerasLayer):
+    """y = log(x) (reference `layers/Log.scala`)."""
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.log(x)
+
+
+class Sqrt(KerasLayer):
+    """y = sqrt(x) (reference `layers/Sqrt.scala`)."""
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.sqrt(x)
+
+
+class Square(KerasLayer):
+    """y = x^2 (reference `layers/Square.scala`)."""
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.square(x)
+
+
+class Identity(KerasLayer):
+    """y = x (reference `layers/Identity.scala`)."""
+
+    def call(self, params, x, *, training=False, rng=None):
+        return x
+
+
+class BinaryThreshold(KerasLayer):
+    """y = 1 if x > th else 0 (reference `layers/BinaryThreshold.scala`)."""
+
+    def __init__(self, value: float = 1e-6, input_shape=None, name=None,
+                 **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.value = float(value)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return (x > self.value).astype(x.dtype)
+
+
+class Threshold(KerasLayer):
+    """y = x if x > th else `value` (reference `layers/Threshold.scala`)."""
+
+    def __init__(self, th: float = 1e-6, value: float = 0.0,
+                 input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.th = float(th)
+        self.value = float(value)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.where(x > self.th, x, jnp.asarray(self.value, x.dtype))
+
+
+class HardShrink(KerasLayer):
+    """y = x if |x| > lambda else 0 (reference `layers/HardShrink.scala`)."""
+
+    def __init__(self, value: float = 0.5, input_shape=None, name=None,
+                 **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.value = float(value)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.where(jnp.abs(x) > self.value, x, jnp.zeros_like(x))
+
+
+class SoftShrink(KerasLayer):
+    """Soft shrinkage (reference `layers/SoftShrink.scala`)."""
+
+    def __init__(self, value: float = 0.5, input_shape=None, name=None,
+                 **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.value = float(value)
+
+    def call(self, params, x, *, training=False, rng=None):
+        lam = self.value
+        return jnp.where(x > lam, x - lam,
+                         jnp.where(x < -lam, x + lam, jnp.zeros_like(x)))
+
+
+class HardTanh(KerasLayer):
+    """Clip to [min_value, max_value] (reference `layers/HardTanh.scala`)."""
+
+    def __init__(self, min_value: float = -1.0, max_value: float = 1.0,
+                 input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.min_value = float(min_value)
+        self.max_value = float(max_value)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.clip(x, self.min_value, self.max_value)
+
+
+class RReLU(KerasLayer):
+    """Randomized leaky ReLU (reference `layers/RReLU.scala`): training
+    draws the negative slope uniformly from [lower, upper]; inference uses
+    the mean slope."""
+
+    def __init__(self, lower: float = 1.0 / 8, upper: float = 1.0 / 3,
+                 input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.lower = float(lower)
+        self.upper = float(upper)
+
+    def call(self, params, x, *, training=False, rng=None):
+        if training and rng is not None:
+            slope = jax.random.uniform(rng, x.shape, x.dtype,
+                                       self.lower, self.upper)
+        else:
+            slope = jnp.asarray((self.lower + self.upper) / 2.0, x.dtype)
+        return jnp.where(x >= 0, x, x * slope)
+
+
+class GaussianSampler(KerasLayer):
+    """VAE reparameterisation sampler over inputs [mean, log_var]
+    (reference `layers/GaussianSampler.scala`): y = mean +
+    exp(log_var / 2) * eps in training; deterministic mean at inference."""
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        mean, log_var = inputs
+        if not training or rng is None:
+            return mean
+        eps = jax.random.normal(rng, mean.shape, mean.dtype)
+        return mean + jnp.exp(log_var * 0.5) * eps
+
+    def compute_output_shape(self, input_shape: ShapeLike) -> Shape:
+        return tuple(input_shape[0])
+
+
+class GetShape(KerasLayer):
+    """Returns the (static) input shape as an int array, batch included
+    (reference `layers/GetShape.scala`)."""
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.asarray(x.shape, jnp.int32)
+
+    def compute_output_shape(self, input_shape: Shape) -> Shape:
+        return (len(input_shape) + 1,)
+
+
+class Expand(KerasLayer):
+    """Broadcast size-1 dims up to `tgt_sizes` (batch included, -1 keeps
+    a dim; reference `layers/Expand.scala`)."""
+
+    def __init__(self, tgt_sizes: Sequence[int], input_shape=None,
+                 name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.tgt_sizes = tuple(int(d) for d in tgt_sizes)
+
+    def _target(self, shape):
+        return tuple(s if t == -1 else t
+                     for s, t in zip(shape, self.tgt_sizes))
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.broadcast_to(x, self._target(x.shape))
+
+    def compute_output_shape(self, input_shape: Shape) -> Shape:
+        # tgt_sizes includes the batch dim; drop it for the symbolic shape
+        return self._target((None,) + tuple(input_shape))[1:]
+
+
+class Max(KerasLayer):
+    """Max over a 1-indexed non-batch dim (reference `layers/Max.scala`);
+    `return_value=False` returns argmax indices instead."""
+
+    def __init__(self, dim: int, return_value: bool = True,
+                 input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.dim = int(dim)
+        self.return_value = bool(return_value)
+
+    def call(self, params, x, *, training=False, rng=None):
+        if self.return_value:
+            return jnp.max(x, axis=self.dim)
+        return jnp.argmax(x, axis=self.dim).astype(jnp.int32)
+
+    def compute_output_shape(self, input_shape: Shape) -> Shape:
+        shape = list(input_shape)
+        del shape[self.dim - 1]
+        return tuple(shape)
+
+
+class ResizeBilinear(KerasLayer):
+    """Bilinear spatial resize (reference `layers/ResizeBilinear.scala`).
+
+    NHWC by default (`dim_ordering="tf"`); XLA lowers `jax.image.resize`
+    to gather/dot ops that stay on-device.
+    """
+
+    def __init__(self, output_height: int, output_width: int,
+                 align_corners: bool = False, dim_ordering: str = "tf",
+                 input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.output_height = int(output_height)
+        self.output_width = int(output_width)
+        self.align_corners = bool(align_corners)
+        if dim_ordering not in ("tf", "th"):
+            raise ValueError("dim_ordering must be 'tf' or 'th'")
+        self.dim_ordering = dim_ordering
+
+    def call(self, params, x, *, training=False, rng=None):
+        h, w = self.output_height, self.output_width
+        if self.dim_ordering == "tf":
+            out_shape = (x.shape[0], h, w, x.shape[3])
+            sp = (1, 2)
+        else:
+            out_shape = (x.shape[0], x.shape[1], h, w)
+            sp = (2, 3)
+        if not self.align_corners:
+            return jax.image.resize(x, out_shape, method="bilinear")
+        # corner-aligned: output pixel i samples input at i*(in-1)/(out-1).
+        # scale_and_translate uses half-pixel centers
+        # (in = (i+0.5)/scale - t/scale - 0.5), so with scale s =
+        # (out-1)/(in-1) the required translation is t = 0.5 - 0.5*s.
+        scale = jnp.array(
+            [max(out_shape[d] - 1, 1) / max(x.shape[d] - 1, 1)
+             for d in sp], jnp.float32)
+        return jax.image.scale_and_translate(
+            x, out_shape, sp, scale, 0.5 - 0.5 * scale,
+            method="linear", antialias=False)
+
+    def compute_output_shape(self, input_shape: Shape) -> Shape:
+        h, w = self.output_height, self.output_width
+        if self.dim_ordering == "tf":
+            return (h, w, input_shape[2])
+        return (input_shape[0], h, w)
+
+
+class SelectTable(KerasLayer):
+    """Select the index-th tensor from a multi-tensor input (reference
+    `layers/SelectTable.scala`; 0-indexed like the Python reference API)."""
+
+    def __init__(self, index: int, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.index = int(index)
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        return inputs[self.index]
+
+    def compute_output_shape(self, input_shape: ShapeLike) -> Shape:
+        return tuple(input_shape[self.index])
+
+
+class SplitTensor(KerasLayer):
+    """Split along a 1-indexed non-batch dim into `num` equal slices
+    (reference `layers/SplitTensor.scala`). Multi-output layer."""
+
+    def __init__(self, dimension: int, num: int, input_shape=None,
+                 name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.dimension = int(dimension)
+        self.num = int(num)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return [jnp.asarray(s) for s in
+                jnp.split(x, self.num, axis=self.dimension)]
+
+    def compute_output_shape(self, input_shape: Shape) -> ShapeLike:
+        shape = list(input_shape)
+        d = self.dimension - 1
+        if shape[d] % self.num != 0:
+            raise ValueError(
+                f"{self.name}: dim {self.dimension} size {shape[d]} not "
+                f"divisible by {self.num}")
+        shape[d] //= self.num
+        return [tuple(shape) for _ in range(self.num)]
+
+
+class KerasLayerWrapper(KerasLayer):
+    """Lift an arbitrary traceable function (params-free) into a layer
+    (reference `layers/KerasLayerWrapper.scala`, which lifts any BigDL
+    module). `output_shape_fn` maps input shape -> output shape; identity
+    when omitted."""
+
+    def __init__(self, fn: Callable, output_shape_fn: Optional[Callable] =
+                 None, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.fn = fn
+        self.output_shape_fn = output_shape_fn
+
+    def call(self, params, x, *, training=False, rng=None):
+        return self.fn(x)
+
+    def compute_output_shape(self, input_shape: ShapeLike) -> ShapeLike:
+        if self.output_shape_fn is not None:
+            return self.output_shape_fn(input_shape)
+        return input_shape
+
+
+class Highway(KerasLayer):
+    """Highway dense block: y = t * h(x) + (1 - t) * x
+    (reference `layers/Highway.scala`)."""
+
+    def __init__(self, activation=None, w_regularizer=None,
+                 b_regularizer=None, bias: bool = True, input_shape=None,
+                 name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.activation = activations.get(activation) or jnp.tanh
+        self.w_regularizer = regularizers.get(w_regularizer)
+        self.b_regularizer = regularizers.get(b_regularizer)
+        self.bias = bias
+
+    def build(self, rng, input_shape: Shape) -> dict:
+        dim = input_shape[-1]
+        k1, k2 = jax.random.split(rng)
+        init = initializers.get("glorot_uniform")
+        params = {"kernel": init(k1, (dim, dim)),
+                  "gate_kernel": init(k2, (dim, dim))}
+        if self.bias:
+            params["bias"] = jnp.zeros((dim,), jnp.float32)
+            # gate bias at -1 so untrained highways mostly carry the input
+            params["gate_bias"] = -jnp.ones((dim,), jnp.float32)
+        return params
+
+    def call(self, params, x, *, training=False, rng=None):
+        h = x @ params["kernel"].astype(x.dtype)
+        t = x @ params["gate_kernel"].astype(x.dtype)
+        if self.bias:
+            h = h + params["bias"].astype(x.dtype)
+            t = t + params["gate_bias"].astype(x.dtype)
+        t = jax.nn.sigmoid(t)
+        return t * self.activation(h) + (1.0 - t) * x
+
+    def regularizers(self):
+        out = []
+        if self.w_regularizer is not None:
+            out += [("kernel", self.w_regularizer),
+                    ("gate_kernel", self.w_regularizer)]
+        if self.b_regularizer is not None and self.bias:
+            out += [("bias", self.b_regularizer),
+                    ("gate_bias", self.b_regularizer)]
+        return out
+
+
+class MaxoutDense(KerasLayer):
+    """Dense with maxout over `nb_feature` linear pieces
+    (reference `layers/MaxoutDense.scala`)."""
+
+    def __init__(self, output_dim: int, nb_feature: int = 4,
+                 w_regularizer=None, b_regularizer=None, bias: bool = True,
+                 input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.output_dim = int(output_dim)
+        self.nb_feature = int(nb_feature)
+        self.w_regularizer = regularizers.get(w_regularizer)
+        self.b_regularizer = regularizers.get(b_regularizer)
+        self.bias = bias
+
+    def build(self, rng, input_shape: Shape) -> dict:
+        in_dim = input_shape[-1]
+        init = initializers.get("glorot_uniform")
+        k, _ = jax.random.split(rng)
+        params = {"kernel": init(
+            k, (self.nb_feature, in_dim, self.output_dim))}
+        if self.bias:
+            params["bias"] = jnp.zeros(
+                (self.nb_feature, self.output_dim), jnp.float32)
+        return params
+
+    def call(self, params, x, *, training=False, rng=None):
+        # (B, I) @ (F, I, O) -> (B, F, O); one batched MXU matmul
+        y = jnp.einsum("bi,fio->bfo", x, params["kernel"].astype(x.dtype))
+        if self.bias:
+            y = y + params["bias"].astype(y.dtype)
+        return jnp.max(y, axis=1)
+
+    def compute_output_shape(self, input_shape: Shape) -> Shape:
+        return tuple(input_shape[:-1]) + (self.output_dim,)
+
+    def regularizers(self):
+        out = []
+        if self.w_regularizer is not None:
+            out.append(("kernel", self.w_regularizer))
+        if self.b_regularizer is not None and self.bias:
+            out.append(("bias", self.b_regularizer))
+        return out

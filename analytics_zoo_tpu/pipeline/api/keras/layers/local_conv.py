@@ -1,0 +1,254 @@
+"""Locally-connected and remaining conv-family layers.
+
+Reference surface: `Z/pipeline/api/keras/layers/{LocallyConnected1D,
+LocallyConnected2D,AtrousConvolution1D,ShareConvolution2D,Cropping3D,
+ZeroPadding3D}.scala`.
+
+Locally-connected layers (unshared kernels) are expressed as
+patch-extraction (`lax.conv_general_dilated_patches`) followed by one
+batched einsum over per-position weights — a single large MXU contraction
+instead of the reference's per-position MKL gemm loop.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.ops import activations, initializers, regularizers
+from analytics_zoo_tpu.pipeline.api.keras.engine import (
+    KerasLayer, Shape)
+from analytics_zoo_tpu.pipeline.api.keras.layers.conv import (
+    Convolution1D, Convolution2D, _conv_out_len, _norm_tuple)
+
+
+class LocallyConnected1D(KerasLayer):
+    """1D conv with unshared (per-position) kernels
+    (reference `layers/LocallyConnected1D.scala`)."""
+
+    def __init__(self, nb_filter: int, filter_length: int, activation=None,
+                 subsample_length: int = 1, w_regularizer=None,
+                 b_regularizer=None, bias: bool = True, input_shape=None,
+                 name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.nb_filter = int(nb_filter)
+        self.filter_length = int(filter_length)
+        self.subsample_length = int(subsample_length)
+        self.activation = activations.get(activation)
+        self.w_regularizer = regularizers.get(w_regularizer)
+        self.b_regularizer = regularizers.get(b_regularizer)
+        self.bias = bias
+
+    def _out_len(self, steps: int) -> int:
+        return _conv_out_len(steps, self.filter_length,
+                             self.subsample_length, "valid")
+
+    def build(self, rng, input_shape: Shape) -> dict:
+        steps, in_ch = input_shape
+        out_len = self._out_len(steps)
+        init = initializers.get("glorot_uniform")
+        k, _ = jax.random.split(rng)
+        params = {"kernel": init(
+            k, (out_len, self.filter_length * in_ch, self.nb_filter))}
+        if self.bias:
+            params["bias"] = jnp.zeros((out_len, self.nb_filter),
+                                       jnp.float32)
+        return params
+
+    def call(self, params, x, *, training=False, rng=None):
+        # x: (B, L, C) -> patches (B, out_len, k*C)
+        patches = jax.lax.conv_general_dilated_patches(
+            x, (self.filter_length,), (self.subsample_length,), "VALID",
+            dimension_numbers=jax.lax.conv_dimension_numbers(
+                x.shape, (1, 1, 1), ("NWC", "WIO", "NWC")))
+        y = jnp.einsum("blp,lpf->blf", patches,
+                       params["kernel"].astype(x.dtype))
+        if self.bias:
+            y = y + params["bias"].astype(y.dtype)
+        if self.activation is not None:
+            y = self.activation(y)
+        return y
+
+    def compute_output_shape(self, input_shape: Shape) -> Shape:
+        return (self._out_len(input_shape[0]), self.nb_filter)
+
+    def regularizers(self):
+        out = []
+        if self.w_regularizer is not None:
+            out.append(("kernel", self.w_regularizer))
+        if self.b_regularizer is not None and self.bias:
+            out.append(("bias", self.b_regularizer))
+        return out
+
+
+class LocallyConnected2D(KerasLayer):
+    """2D conv with unshared kernels
+    (reference `layers/LocallyConnected2D.scala`). Channels-last."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation=None, border_mode: str = "valid", subsample=1,
+                 w_regularizer=None, b_regularizer=None, bias: bool = True,
+                 input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        if border_mode != "valid":
+            raise ValueError("LocallyConnected2D only supports "
+                             "border_mode='valid' (as the reference)")
+        self.nb_filter = int(nb_filter)
+        self.nb_row = int(nb_row)
+        self.nb_col = int(nb_col)
+        self.subsample = _norm_tuple(subsample, 2, "subsample")
+        self.activation = activations.get(activation)
+        self.w_regularizer = regularizers.get(w_regularizer)
+        self.b_regularizer = regularizers.get(b_regularizer)
+        self.bias = bias
+
+    def _out_hw(self, input_shape: Shape) -> Tuple[int, int]:
+        h = _conv_out_len(input_shape[0], self.nb_row,
+                          self.subsample[0], "valid")
+        w = _conv_out_len(input_shape[1], self.nb_col,
+                          self.subsample[1], "valid")
+        return h, w
+
+    def build(self, rng, input_shape: Shape) -> dict:
+        in_ch = input_shape[2]
+        oh, ow = self._out_hw(input_shape)
+        init = initializers.get("glorot_uniform")
+        k, _ = jax.random.split(rng)
+        patch = self.nb_row * self.nb_col * in_ch
+        params = {"kernel": init(
+            k, (oh * ow, patch, self.nb_filter))}
+        if self.bias:
+            params["bias"] = jnp.zeros((oh * ow, self.nb_filter),
+                                       jnp.float32)
+        return params
+
+    def call(self, params, x, *, training=False, rng=None):
+        b, h, w, c = x.shape
+        oh, ow = self._out_hw((h, w, c))
+        patches = jax.lax.conv_general_dilated_patches(
+            x, (self.nb_row, self.nb_col), self.subsample, "VALID",
+            dimension_numbers=jax.lax.conv_dimension_numbers(
+                x.shape, (1, 1, 1, 1), ("NHWC", "HWIO", "NHWC")))
+        patches = patches.reshape(b, oh * ow, -1)
+        y = jnp.einsum("blp,lpf->blf", patches,
+                       params["kernel"].astype(x.dtype))
+        if self.bias:
+            y = y + params["bias"].astype(y.dtype)
+        y = y.reshape(b, oh, ow, self.nb_filter)
+        if self.activation is not None:
+            y = self.activation(y)
+        return y
+
+    def compute_output_shape(self, input_shape: Shape) -> Shape:
+        oh, ow = self._out_hw(input_shape)
+        return (oh, ow, self.nb_filter)
+
+    def regularizers(self):
+        out = []
+        if self.w_regularizer is not None:
+            out.append(("kernel", self.w_regularizer))
+        if self.b_regularizer is not None and self.bias:
+            out.append(("bias", self.b_regularizer))
+        return out
+
+
+class AtrousConvolution1D(Convolution1D):
+    """Dilated 1D conv (reference `layers/AtrousConvolution1D.scala`)."""
+
+    def __init__(self, nb_filter: int, filter_length: int,
+                 init="glorot_uniform", activation=None,
+                 subsample_length: int = 1, atrous_rate: int = 1,
+                 w_regularizer=None, b_regularizer=None, bias: bool = True,
+                 input_shape=None, name=None, **kwargs):
+        super().__init__(nb_filter, filter_length, init=init,
+                         activation=activation,
+                         subsample_length=subsample_length,
+                         w_regularizer=w_regularizer,
+                         b_regularizer=b_regularizer, bias=bias,
+                         input_shape=input_shape, name=name, **kwargs)
+        self.dilation = (int(atrous_rate),)
+
+
+class ShareConvolution2D(Convolution2D):
+    """Conv2D with explicit pad_h/pad_w (reference
+    `layers/ShareConvolution2D.scala` — BigDL's weight-sharing variant;
+    on TPU all convs share weights, so only the padding semantics differ)."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 init="glorot_uniform", activation=None, subsample=1,
+                 pad_h: int = 0, pad_w: int = 0, w_regularizer=None,
+                 b_regularizer=None, bias: bool = True, input_shape=None,
+                 name=None, **kwargs):
+        if kwargs.get("border_mode", "valid") != "valid":
+            raise ValueError("ShareConvolution2D pads via pad_h/pad_w "
+                             "only (like the reference); border_mode is "
+                             "not supported")
+        super().__init__(nb_filter, nb_row, nb_col, init=init,
+                         activation=activation, subsample=subsample,
+                         w_regularizer=w_regularizer,
+                         b_regularizer=b_regularizer, bias=bias,
+                         input_shape=input_shape, name=name, **kwargs)
+        self.pad_h = int(pad_h)
+        self.pad_w = int(pad_w)
+
+    def _convolve(self, x, kernel):
+        return jax.lax.conv_general_dilated(
+            x, kernel.astype(x.dtype),
+            window_strides=self.subsample,
+            padding=((self.pad_h, self.pad_h), (self.pad_w, self.pad_w)),
+            rhs_dilation=self.dilation,
+            dimension_numbers=self._dn())
+
+    def compute_output_shape(self, input_shape: Shape) -> Shape:
+        h, w = input_shape[:2]
+        oh = (h + 2 * self.pad_h - self.kernel_size[0]) \
+            // self.subsample[0] + 1
+        ow = (w + 2 * self.pad_w - self.kernel_size[1]) \
+            // self.subsample[1] + 1
+        return (oh, ow, self.nb_filter)
+
+
+class ZeroPadding3D(KerasLayer):
+    """Symmetric zero-pad of the 3 spatial dims (channels-last;
+    reference `layers/ZeroPadding3D.scala`)."""
+
+    def __init__(self, padding=(1, 1, 1), input_shape=None, name=None,
+                 **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.padding = _norm_tuple(padding, 3, "padding")
+
+    def call(self, params, x, *, training=False, rng=None):
+        p = self.padding
+        return jnp.pad(x, ((0, 0), (p[0], p[0]), (p[1], p[1]),
+                           (p[2], p[2]), (0, 0)))
+
+    def compute_output_shape(self, input_shape: Shape) -> Shape:
+        p = self.padding
+        d, h, w, c = input_shape
+        return (d + 2 * p[0], h + 2 * p[1], w + 2 * p[2], c)
+
+
+class Cropping3D(KerasLayer):
+    """Crop the 3 spatial dims (channels-last;
+    reference `layers/Cropping3D.scala`)."""
+
+    def __init__(self, cropping=((1, 1), (1, 1), (1, 1)), input_shape=None,
+                 name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        if isinstance(cropping, int):
+            cropping = ((cropping, cropping),) * 3
+        self.cropping = tuple(
+            (int(a), int(b)) for a, b in cropping)
+
+    def call(self, params, x, *, training=False, rng=None):
+        (d0, d1), (h0, h1), (w0, w1) = self.cropping
+        return x[:, d0:x.shape[1] - d1, h0:x.shape[2] - h1,
+                 w0:x.shape[3] - w1, :]
+
+    def compute_output_shape(self, input_shape: Shape) -> Shape:
+        (d0, d1), (h0, h1), (w0, w1) = self.cropping
+        d, h, w, c = input_shape
+        return (d - d0 - d1, h - h0 - h1, w - w0 - w1, c)

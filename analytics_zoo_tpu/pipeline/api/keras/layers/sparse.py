@@ -1,0 +1,117 @@
+"""Sparse-input layers.
+
+Reference surface: `Z/pipeline/api/keras/layers/{SparseDense,
+SparseEmbedding}.scala` (BigDL `SparseLinear`/`LookupTableSparse` wrappers).
+
+TPU-first divergence: XLA has no sparse tensors — the idiomatic encoding of
+a batch of variable-length id lists is a dense padded (B, L) int array with
+a pad id < 0, turned into gathers + masked reductions (static shapes, no
+host round-trips). That is exactly what these layers consume.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.ops import activations, initializers, regularizers
+from analytics_zoo_tpu.pipeline.api.keras.engine import KerasLayer, Shape
+
+
+class SparseEmbedding(KerasLayer):
+    """Embedding over padded id lists with sum/mean/sqrtn combining
+    (reference `layers/SparseEmbedding.scala`). Input (B, L) ids, pad < 0;
+    output (B, output_dim)."""
+
+    def __init__(self, input_dim: int, output_dim: int,
+                 combiner: str = "sum", max_norm: float = -1.0,
+                 init="uniform", w_regularizer=None, input_shape=None,
+                 name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        if combiner not in ("sum", "mean", "sqrtn"):
+            raise ValueError("combiner must be sum|mean|sqrtn")
+        self.input_dim = int(input_dim)
+        self.output_dim = int(output_dim)
+        self.combiner = combiner
+        self.max_norm = float(max_norm)
+        self.kernel_init = initializers.get(init)
+        self.w_regularizer = regularizers.get(w_regularizer)
+
+    def build(self, rng, input_shape: Shape) -> dict:
+        k, _ = jax.random.split(rng)
+        return {"embeddings": self.kernel_init(
+            k, (self.input_dim, self.output_dim))}
+
+    def call(self, params, ids, *, training=False, rng=None):
+        table = params["embeddings"]
+        if self.max_norm > 0:
+            norms = jnp.linalg.norm(table, axis=-1, keepdims=True)
+            table = table * jnp.minimum(1.0, self.max_norm /
+                                        jnp.maximum(norms, 1e-12))
+        ids = ids.astype(jnp.int32)
+        mask = (ids >= 0).astype(table.dtype)  # (B, L)
+        vecs = table[jnp.clip(ids, 0, self.input_dim - 1)]  # (B, L, D)
+        vecs = vecs * mask[..., None]
+        total = jnp.sum(vecs, axis=1)
+        count = jnp.maximum(jnp.sum(mask, axis=1, keepdims=True), 1.0)
+        if self.combiner == "mean":
+            return total / count
+        if self.combiner == "sqrtn":
+            return total / jnp.sqrt(count)
+        return total
+
+    def compute_output_shape(self, input_shape: Shape) -> Shape:
+        return (self.output_dim,)
+
+    def regularizers(self):
+        return ([("embeddings", self.w_regularizer)]
+                if self.w_regularizer is not None else [])
+
+
+class SparseDense(KerasLayer):
+    """Dense over a (possibly mostly-zero) input (reference
+    `layers/SparseDense.scala`). On TPU the dense matmul IS the fast path —
+    a gather-based sparse gemm would leave the MXU idle — so this is a
+    Dense with the reference's arg surface (backward_start/backward_length
+    are accepted for API parity; XLA's autodiff handles the backward)."""
+
+    def __init__(self, output_dim: int, init="glorot_uniform",
+                 activation=None, w_regularizer=None, b_regularizer=None,
+                 backward_start: int = -1, backward_length: int = -1,
+                 bias: bool = True, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.output_dim = int(output_dim)
+        self.kernel_init = initializers.get(init)
+        self.activation = activations.get(activation)
+        self.w_regularizer = regularizers.get(w_regularizer)
+        self.b_regularizer = regularizers.get(b_regularizer)
+        self.backward_start = int(backward_start)
+        self.backward_length = int(backward_length)
+        self.bias = bias
+
+    def build(self, rng, input_shape: Shape) -> dict:
+        k, _ = jax.random.split(rng)
+        params = {"kernel": self.kernel_init(
+            k, (input_shape[-1], self.output_dim))}
+        if self.bias:
+            params["bias"] = jnp.zeros((self.output_dim,), jnp.float32)
+        return params
+
+    def call(self, params, x, *, training=False, rng=None):
+        y = x @ params["kernel"].astype(x.dtype)
+        if self.bias:
+            y = y + params["bias"].astype(y.dtype)
+        if self.activation is not None:
+            y = self.activation(y)
+        return y
+
+    def compute_output_shape(self, input_shape: Shape) -> Shape:
+        return tuple(input_shape[:-1]) + (self.output_dim,)
+
+    def regularizers(self):
+        out = []
+        if self.w_regularizer is not None:
+            out.append(("kernel", self.w_regularizer))
+        if self.b_regularizer is not None and self.bias:
+            out.append(("bias", self.b_regularizer))
+        return out

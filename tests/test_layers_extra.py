@@ -1,0 +1,214 @@
+"""Semantic tests for the elementwise / locally-connected / conv-lstm /
+sparse layer batch (reference specs under
+`zoo/src/test/scala/.../keras/layers/` — same golden-value philosophy,
+with torch/numpy as the oracle)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+from analytics_zoo_tpu.pipeline.api.keras import layers as L
+
+
+def run(layer, x, in_shape=None, training=False, rng=None, seed=0):
+    params = layer.init(jax.random.key(seed),
+                        in_shape or tuple(x.shape[1:]))
+    y, _ = layer.apply(params, x, training=training, rng=rng)
+    return np.asarray(y), params
+
+
+def test_elementwise_values():
+    x = np.array([[-2.0, -0.3, 0.0, 0.4, 3.0]], np.float32)
+    cases = [
+        (L.AddConstant(1.5), x + 1.5),
+        (L.MulConstant(2.0), x * 2.0),
+        (L.Power(2.0, 2.0, 1.0), (1.0 + 2.0 * x) ** 2),
+        (L.Negative(), -x),
+        (L.Square(), x * x),
+        (L.BinaryThreshold(0.0), (x > 0).astype(np.float32)),
+        (L.Threshold(0.0, -9.0), np.where(x > 0, x, -9.0)),
+        (L.HardShrink(0.5), np.where(np.abs(x) > 0.5, x, 0.0)),
+        (L.SoftShrink(0.5),
+         np.where(x > 0.5, x - 0.5, np.where(x < -0.5, x + 0.5, 0.0))),
+        (L.HardTanh(), np.clip(x, -1, 1)),
+        (L.Identity(), x),
+    ]
+    for lyr, expect in cases:
+        y, _ = run(lyr, x)
+        np.testing.assert_allclose(y, expect, rtol=1e-6, atol=1e-6,
+                                   err_msg=type(lyr).__name__)
+
+
+def test_cadd_cmul_scale_mul():
+    x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    y, p = run(L.CAdd((4,)), x)
+    np.testing.assert_allclose(y, x)  # zero-init bias
+    y, p = run(L.Scale((4,)), x)
+    np.testing.assert_allclose(y, x)  # identity-init scale
+    lyr = L.Mul()
+    params = lyr.init(jax.random.key(0), (4,))
+    params = {"weight": jnp.asarray(3.0)}
+    y = np.asarray(lyr.call(params, jnp.asarray(x)))
+    np.testing.assert_allclose(y, 3.0 * x, rtol=1e-6)
+
+
+def test_rrelu_eval_uses_mean_slope():
+    x = np.array([[-4.0, 4.0]], np.float32)
+    y, _ = run(L.RReLU(0.1, 0.3), x)
+    np.testing.assert_allclose(y, [[-4.0 * 0.2, 4.0]], rtol=1e-6)
+
+
+def test_gaussian_sampler_mean_when_deterministic():
+    mean = np.ones((2, 3), np.float32)
+    logv = np.zeros((2, 3), np.float32)
+    lyr = L.GaussianSampler()
+    out = lyr.call({}, [jnp.asarray(mean), jnp.asarray(logv)])
+    np.testing.assert_allclose(np.asarray(out), mean)
+    # rng without training stays deterministic (inference contract)
+    out_inf = lyr.call({}, [jnp.asarray(mean), jnp.asarray(logv)],
+                       rng=jax.random.key(0))
+    np.testing.assert_allclose(np.asarray(out_inf), mean)
+    out2 = lyr.call({}, [jnp.asarray(mean), jnp.asarray(logv)],
+                    training=True, rng=jax.random.key(0))
+    assert np.asarray(out2).shape == (2, 3)
+    assert not np.allclose(np.asarray(out2), mean)
+
+
+def test_get_shape_and_expand_and_split():
+    x = np.zeros((2, 1, 5), np.float32)
+    y, _ = run(L.GetShape(), x)
+    np.testing.assert_array_equal(y, [2, 1, 5])
+    y, _ = run(L.Expand((-1, 4, 5)), x)
+    assert y.shape == (2, 4, 5)
+    lyr = L.SplitTensor(2, 2)
+    parts = lyr.call({}, jnp.zeros((2, 3, 6)))
+    assert len(parts) == 2 and parts[0].shape == (2, 3, 3)
+
+
+def test_select_table():
+    a, b = np.zeros((2, 3), np.float32), np.ones((2, 5), np.float32)
+    lyr = L.SelectTable(1)
+    out = lyr.call({}, [jnp.asarray(a), jnp.asarray(b)])
+    np.testing.assert_allclose(np.asarray(out), b)
+    assert lyr.compute_output_shape([(3,), (5,)]) == (5,)
+
+
+def test_resize_bilinear_matches_torch():
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 5, 5, 3).astype(np.float32)
+    y, _ = run(L.ResizeBilinear(8, 10), x)
+    ref = F.interpolate(torch.from_numpy(x).permute(0, 3, 1, 2),
+                        size=(8, 10), mode="bilinear",
+                        align_corners=False)
+    ref = ref.permute(0, 2, 3, 1).numpy()
+    np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_resize_bilinear_align_corners_matches_torch():
+    rs = np.random.RandomState(7)
+    x = rs.randn(2, 5, 5, 3).astype(np.float32)
+    y, _ = run(L.ResizeBilinear(8, 10, align_corners=True), x)
+    ref = F.interpolate(torch.from_numpy(x).permute(0, 3, 1, 2),
+                        size=(8, 10), mode="bilinear", align_corners=True)
+    ref = ref.permute(0, 2, 3, 1).numpy()
+    np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_maxout_dense_matches_manual():
+    rs = np.random.RandomState(1)
+    x = rs.randn(3, 5).astype(np.float32)
+    lyr = L.MaxoutDense(4, nb_feature=3)
+    y, params = run(lyr, x)
+    k = np.asarray(params["kernel"])
+    b = np.asarray(params["bias"])
+    manual = np.max(np.einsum("bi,fio->bfo", x, k) + b, axis=1)
+    np.testing.assert_allclose(y, manual, rtol=1e-5, atol=1e-5)
+
+
+def test_highway_identity_at_closed_gate():
+    # with gate bias -inf the layer must pass the input through
+    x = np.random.RandomState(2).randn(3, 6).astype(np.float32)
+    lyr = L.Highway()
+    params = lyr.init(jax.random.key(0), (6,))
+    params = dict(params)
+    params["gate_bias"] = jnp.full((6,), -1e9)
+    params["gate_kernel"] = jnp.zeros((6, 6))
+    y = np.asarray(lyr.call(params, jnp.asarray(x)))
+    np.testing.assert_allclose(y, x, rtol=1e-5, atol=1e-5)
+
+
+def test_locally_connected1d_matches_torch_unfold():
+    rs = np.random.RandomState(3)
+    x = rs.randn(2, 8, 3).astype(np.float32)  # (B, L, C)
+    lyr = L.LocallyConnected1D(4, 3, subsample_length=2)
+    y, params = run(lyr, x)
+    # oracle: unfold patches (channels-first patch layout: C then K)
+    xt = torch.from_numpy(x).permute(0, 2, 1)  # (B, C, L)
+    patches = xt.unfold(2, 3, 2)               # (B, C, P, K)
+    patches = patches.permute(0, 2, 1, 3).reshape(2, -1, 3 * 3)
+    k = torch.from_numpy(np.asarray(params["kernel"]))
+    b = torch.from_numpy(np.asarray(params["bias"]))
+    ref = torch.einsum("blp,lpf->blf", patches, k) + b
+    np.testing.assert_allclose(y, ref.numpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_locally_connected2d_equals_conv_when_weights_tied():
+    rs = np.random.RandomState(4)
+    x = rs.randn(2, 6, 6, 2).astype(np.float32)
+    lc = L.LocallyConnected2D(5, 3, 3)
+    params = lc.init(jax.random.key(0), (6, 6, 2))
+    # tie all positions to the same kernel → must equal a valid conv
+    tied = jnp.broadcast_to(params["kernel"][:1],
+                            params["kernel"].shape)
+    params = {"kernel": tied,
+              "bias": jnp.zeros_like(params["bias"])}
+    y = np.asarray(lc.call(params, jnp.asarray(x)))
+    conv = L.Convolution2D(5, 3, 3, bias=False)
+    cp = {"kernel": np.asarray(params["kernel"])[0].reshape(2, 3, 3, 5)
+          .transpose(1, 2, 0, 3)}
+    # patch layout from conv_general_dilated_patches is (C, Kh, Kw)
+    ref = np.asarray(conv.call({"kernel": jnp.asarray(cp["kernel"])},
+                               jnp.asarray(x)))
+    np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_convlstm2d_shapes_and_last_step():
+    rs = np.random.RandomState(5)
+    x = rs.randn(2, 3, 6, 6, 2).astype(np.float32)
+    lyr = L.ConvLSTM2D(4, 3, return_sequences=True)
+    seq, _ = run(lyr, x)
+    assert seq.shape == (2, 3, 6, 6, 4)
+    lyr2 = L.ConvLSTM2D(4, 3)
+    params = lyr2.init(jax.random.key(0), (3, 6, 6, 2))
+    last = np.asarray(lyr2.call(params, jnp.asarray(x)))
+    # weights differ between the two instances; re-run first layer's
+    # params through the non-sequence variant for a strict check
+    lyr2.return_sequences = True
+    seq2 = np.asarray(lyr2.call(params, jnp.asarray(x)))
+    np.testing.assert_allclose(last, seq2[:, -1], rtol=1e-6)
+
+
+def test_sparse_embedding_combiners():
+    ids = np.array([[0, 1, -1], [2, -1, -1]], np.int32)
+    lyr = L.SparseEmbedding(4, 3, combiner="mean")
+    params = lyr.init(jax.random.key(0), (3,))
+    table = np.asarray(params["embeddings"])
+    out = np.asarray(lyr.call(params, jnp.asarray(ids)))
+    np.testing.assert_allclose(out[0], (table[0] + table[1]) / 2.0,
+                               rtol=1e-5)
+    np.testing.assert_allclose(out[1], table[2], rtol=1e-5)
+    lyr_s = L.SparseEmbedding(4, 3, combiner="sum")
+    ps = lyr_s.init(jax.random.key(0), (3,))
+    out_s = np.asarray(lyr_s.call(ps, jnp.asarray(ids)))
+    np.testing.assert_allclose(
+        out_s[0], np.asarray(ps["embeddings"])[0] +
+        np.asarray(ps["embeddings"])[1], rtol=1e-5)
+
+
+def test_kernel_layer_wrapper():
+    lyr = L.KerasLayerWrapper(lambda x: x * 2 + 1)
+    out = lyr.call({}, jnp.ones((2, 3)))
+    np.testing.assert_allclose(np.asarray(out), np.full((2, 3), 3.0))

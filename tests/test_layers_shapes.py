@@ -79,6 +79,43 @@ CASES = [
     (lambda: L.SpatialDropout1D(0.3), (6, 3)),
     (lambda: L.SpatialDropout2D(0.3), (6, 6, 3)),
     (lambda: L.SpatialDropout3D(0.3), (4, 4, 4, 2)),
+    (lambda: L.AddConstant(2.0), (5,)),
+    (lambda: L.MulConstant(2.0), (5,)),
+    (lambda: L.CAdd((4,)), (4,)),
+    (lambda: L.CMul((4,)), (4,)),
+    (lambda: L.Mul(), (5,)),
+    (lambda: L.Scale((4,)), (4,)),
+    (lambda: L.Power(2.0, 1.5, 0.5), (5,)),
+    (lambda: L.Negative(), (5,)),
+    (lambda: L.Exp(), (5,)),
+    (lambda: L.Log(), (5,)),
+    (lambda: L.Sqrt(), (5,)),
+    (lambda: L.Square(), (5,)),
+    (lambda: L.Identity(), (5,)),
+    (lambda: L.BinaryThreshold(0.0), (5,)),
+    (lambda: L.Threshold(0.0, -1.0), (5,)),
+    (lambda: L.HardShrink(0.5), (5,)),
+    (lambda: L.SoftShrink(0.5), (5,)),
+    (lambda: L.HardTanh(), (5,)),
+    (lambda: L.RReLU(), (5,)),
+    (lambda: L.Expand((-1, 4, 5)), (1, 5)),
+    (lambda: L.Max(1), (4, 5)),
+    (lambda: L.Max(2, return_value=False), (4, 5)),
+    (lambda: L.ResizeBilinear(7, 9), (5, 5, 3)),
+    (lambda: L.Highway(), (6,)),
+    (lambda: L.MaxoutDense(7, nb_feature=3), (5,)),
+    (lambda: L.LocallyConnected1D(4, 3), (8, 2)),
+    (lambda: L.LocallyConnected2D(4, 3, 3), (7, 7, 2)),
+    (lambda: L.LocallyConnected2D(4, 3, 3, subsample=2), (9, 9, 2)),
+    (lambda: L.AtrousConvolution1D(4, 3, atrous_rate=2), (10, 2)),
+    (lambda: L.ShareConvolution2D(4, 3, 3, pad_h=1, pad_w=1), (8, 8, 2)),
+    (lambda: L.ZeroPadding3D((1, 2, 1)), (4, 4, 4, 2)),
+    (lambda: L.Cropping3D(((1, 1), (1, 1), (1, 1))), (5, 5, 5, 2)),
+    (lambda: L.ConvLSTM2D(4, 3), (3, 6, 6, 2)),
+    (lambda: L.ConvLSTM2D(4, 3, return_sequences=True,
+                          border_mode="valid"), (3, 6, 6, 2)),
+    (lambda: L.ConvLSTM3D(3, 3), (2, 4, 4, 4, 2)),
+    (lambda: L.SparseDense(6), (5,)),
 ]
 
 
