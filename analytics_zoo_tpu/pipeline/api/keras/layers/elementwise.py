@@ -13,15 +13,14 @@ engine's pure-functional params convention.
 
 from __future__ import annotations
 
-from typing import Callable, Optional, Sequence, Tuple
+from typing import Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from analytics_zoo_tpu.ops import activations, initializers, regularizers
 from analytics_zoo_tpu.pipeline.api.keras.engine import (
-    KerasLayer, Shape, ShapeLike, Variable, as_shape)
+    KerasLayer, Shape, ShapeLike)
 
 
 class AddConstant(KerasLayer):
@@ -278,7 +277,9 @@ class GetShape(KerasLayer):
     (reference `layers/GetShape.scala`)."""
 
     def call(self, params, x, *, training=False, rng=None):
-        return jnp.asarray(x.shape, jnp.int32)
+        shape_vec = jnp.asarray(x.shape, jnp.int32)
+        # batched per-sample copies keep the engine's (B, ...) contract
+        return jnp.broadcast_to(shape_vec, (x.shape[0], shape_vec.size))
 
     def compute_output_shape(self, input_shape: Shape) -> Shape:
         return (len(input_shape) + 1,)
@@ -441,7 +442,7 @@ class Highway(KerasLayer):
                  b_regularizer=None, bias: bool = True, input_shape=None,
                  name=None, **kwargs):
         super().__init__(input_shape=input_shape, name=name, **kwargs)
-        self.activation = activations.get(activation) or jnp.tanh
+        self.activation = activations.get(activation) or activations.linear
         self.w_regularizer = regularizers.get(w_regularizer)
         self.b_regularizer = regularizers.get(b_regularizer)
         self.bias = bias

@@ -12,11 +12,10 @@ instead of the reference's per-position MKL gemm loop.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from analytics_zoo_tpu.ops import activations, initializers, regularizers
 from analytics_zoo_tpu.pipeline.api.keras.engine import (
@@ -186,6 +185,9 @@ class ShareConvolution2D(Convolution2D):
             raise ValueError("ShareConvolution2D pads via pad_h/pad_w "
                              "only (like the reference); border_mode is "
                              "not supported")
+        if kwargs.get("dim_ordering", "tf") != "tf":
+            raise ValueError("ShareConvolution2D supports channels-last "
+                             "(dim_ordering='tf') only")
         super().__init__(nb_filter, nb_row, nb_col, init=init,
                          activation=activation, subsample=subsample,
                          w_regularizer=w_regularizer,

@@ -44,13 +44,14 @@ class SparseEmbedding(KerasLayer):
 
     def call(self, params, ids, *, training=False, rng=None):
         table = params["embeddings"]
-        if self.max_norm > 0:
-            norms = jnp.linalg.norm(table, axis=-1, keepdims=True)
-            table = table * jnp.minimum(1.0, self.max_norm /
-                                        jnp.maximum(norms, 1e-12))
         ids = ids.astype(jnp.int32)
         mask = (ids >= 0).astype(table.dtype)  # (B, L)
         vecs = table[jnp.clip(ids, 0, self.input_dim - 1)]  # (B, L, D)
+        if self.max_norm > 0:
+            # renormalise only the gathered rows: O(B*L*D), not O(V*D)
+            norms = jnp.linalg.norm(vecs, axis=-1, keepdims=True)
+            vecs = vecs * jnp.minimum(1.0, self.max_norm /
+                                      jnp.maximum(norms, 1e-12))
         vecs = vecs * mask[..., None]
         total = jnp.sum(vecs, axis=1)
         count = jnp.maximum(jnp.sum(mask, axis=1, keepdims=True), 1.0)

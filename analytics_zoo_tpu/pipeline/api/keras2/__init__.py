@@ -1,0 +1,9 @@
+"""Keras-2-style API surface (reference `Z/pipeline/api/keras2/`,
+`P/pipeline/api/keras2/`). Layers carry Keras-2 argument names; the model
+containers are shared with the keras1 engine (the reference does the
+same — keras2 layers extend keras1's `KerasLayer`)."""
+
+from analytics_zoo_tpu.pipeline.api.keras import Sequential, Model
+from analytics_zoo_tpu.pipeline.api.keras2 import layers
+
+__all__ = ["Sequential", "Model", "layers"]

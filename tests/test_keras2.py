@@ -1,0 +1,52 @@
+"""keras2 arg-name adapters must behave identically to their keras1
+twins (reference keras2 specs under `zoo/src/test/scala/.../keras2/`)."""
+
+import jax
+import numpy as np
+
+from analytics_zoo_tpu.pipeline.api.keras2 import Sequential, layers as L2
+from analytics_zoo_tpu.pipeline.api.keras import layers as L1
+
+
+def test_dense_matches_keras1():
+    x = np.random.RandomState(0).randn(4, 6).astype(np.float32)
+    d2 = L2.Dense(5, use_bias=True)
+    d1 = L1.Dense(5)
+    p2 = d2.init(jax.random.key(0), (6,))
+    p1 = d1.init(jax.random.key(0), (6,))
+    np.testing.assert_allclose(np.asarray(d2.call(p2, x)),
+                               np.asarray(d1.call(p1, x)))
+
+
+def test_conv2d_channels_first_and_padding():
+    conv = L2.Conv2D(4, (3, 3), strides=2, padding="same",
+                     data_format="channels_first")
+    assert conv.compute_output_shape((2, 8, 8)) == (4, 4, 4)
+    conv_tf = L2.Conv2D(4, 3, padding="valid")
+    assert conv_tf.compute_output_shape((8, 8, 2)) == (6, 6, 4)
+
+
+def test_pooling_and_dropout_args():
+    p = L2.MaxPooling1D(pool_size=3, strides=2, padding="same")
+    assert p.compute_output_shape((9, 4)) == (5, 4)
+    d = L2.Dropout(rate=0.5)
+    assert d.p == 0.5
+
+
+def test_keras2_sequential_end_to_end():
+    m = Sequential()
+    m.add(L2.Conv1D(8, 3, input_shape=(12, 4)))
+    m.add(L2.MaxPooling1D(2))
+    m.add(L2.Flatten())
+    m.add(L2.Dense(3, activation="softmax"))
+    m.compile(optimizer="adam", loss="categorical_crossentropy")
+    x = np.random.RandomState(0).randn(16, 12, 4).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[np.random.RandomState(1)
+                                    .randint(0, 3, 16)]
+    m.fit(x, y, batch_size=8, nb_epoch=1)
+    assert m.predict(x, batch_size=8).shape == (16, 3)
+
+
+def test_merge_aliases_shared():
+    assert L2.Maximum is L1.Maximum
+    assert L2.GlobalAveragePooling2D is L1.GlobalAveragePooling2D

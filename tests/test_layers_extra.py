@@ -80,7 +80,8 @@ def test_gaussian_sampler_mean_when_deterministic():
 def test_get_shape_and_expand_and_split():
     x = np.zeros((2, 1, 5), np.float32)
     y, _ = run(L.GetShape(), x)
-    np.testing.assert_array_equal(y, [2, 1, 5])
+    assert y.shape == (2, 3)  # per-sample copies keep the (B, ...) contract
+    np.testing.assert_array_equal(y[0], [2, 1, 5])
     y, _ = run(L.Expand((-1, 4, 5)), x)
     assert y.shape == (2, 4, 5)
     lyr = L.SplitTensor(2, 2)
