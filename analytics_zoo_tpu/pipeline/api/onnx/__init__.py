@@ -1,0 +1,26 @@
+"""ONNX import (reference `P/pipeline/api/onnx/`): self-contained proto
+codec + graph-to-XLA importer; no external ``onnx`` dependency."""
+
+from analytics_zoo_tpu.pipeline.api.onnx import onnx_pb  # noqa: F401
+from analytics_zoo_tpu.pipeline.api.onnx.onnx_pb import (  # noqa: F401
+    ModelProto,
+    TensorProto,
+    load_model,
+    save_model,
+)
+
+__all__ = ["onnx_pb", "ModelProto", "TensorProto", "load_model",
+           "save_model", "OnnxLoader", "helper"]
+
+
+def __getattr__(name):
+    # lazy to avoid importing jax machinery for proto-only use
+    import importlib
+    if name == "OnnxLoader":
+        mod = importlib.import_module(
+            "analytics_zoo_tpu.pipeline.api.onnx.onnx_loader")
+        return mod.OnnxLoader
+    if name == "helper":
+        return importlib.import_module(
+            "analytics_zoo_tpu.pipeline.api.onnx.helper")
+    raise AttributeError(name)

@@ -1,0 +1,595 @@
+"""ONNX importer tests: codec round-trip, per-op golden vs torch, and
+end-to-end model import + fine-tune (reference test analog:
+`pyzoo/test/zoo/pipeline/onnx/` per-op mapper tests, SURVEY.md §4.8)."""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+from analytics_zoo_tpu.pipeline.api.onnx import helper, onnx_pb
+from analytics_zoo_tpu.pipeline.api.onnx.onnx_loader import (
+    OnnxLoader,
+    run_node,
+)
+from analytics_zoo_tpu.pipeline.api.onnx.onnx_pb import TensorProto
+
+
+def _t(x):
+    return torch.from_numpy(np.asarray(x))
+
+
+def assert_close(a, b, rtol=1e-5, atol=1e-5):
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=rtol, atol=atol)
+
+
+# -- codec --------------------------------------------------------------------
+
+def test_proto_roundtrip(rng, tmp_path):
+    w = rng.randn(4, 3).astype(np.float32)
+    node = helper.make_node("Gemm", ["x", "w"], ["y"], alpha=0.5,
+                            transB=1)
+    graph = helper.make_graph(
+        [node], "g",
+        [helper.make_tensor_value_info("x", TensorProto.FLOAT, [1, 3])],
+        [helper.make_tensor_value_info("y", TensorProto.FLOAT, [1, 4])],
+        [helper.make_tensor("w", w)])
+    model = helper.make_model(graph, opset_version=13)
+    path = str(tmp_path / "m.onnx")
+    onnx_pb.save_model(model, path)
+    loaded = onnx_pb.load_model(path)
+    assert loaded.producer_name == "analytics-zoo-tpu"
+    assert loaded.opset_import[0].version == 13
+    g = loaded.graph
+    assert g.node[0].op_type == "Gemm"
+    attrs = {a.name: helper.attribute_value(a) for a in g.node[0].attribute}
+    assert attrs["transB"] == 1 and abs(attrs["alpha"] - 0.5) < 1e-7
+    assert_close(onnx_pb.tensor_to_numpy(g.initializer[0]), w)
+    assert [d.dim_value for d in
+            g.input[0].type.tensor_type.shape.dim] == [1, 3]
+
+
+def test_tensor_dtypes_roundtrip(rng):
+    for arr in [rng.randn(2, 3).astype(np.float32),
+                rng.randn(3).astype(np.float64),
+                rng.randint(-5, 5, (4,)).astype(np.int64),
+                rng.randint(0, 5, (2, 2)).astype(np.int32),
+                np.array([True, False])]:
+        t = onnx_pb.numpy_to_tensor(arr, "t")
+        back = onnx_pb.tensor_to_numpy(
+            TensorProto.FromString(t.SerializeToString()))
+        assert back.dtype == arr.dtype and back.shape == arr.shape
+        np.testing.assert_array_equal(back, arr)
+
+
+def test_negative_int_varint():
+    t = TensorProto()
+    t.dims = [3]
+    t.data_type = TensorProto.INT64
+    t.int64_data = [-1, 0, 9223372036854775807]
+    back = TensorProto.FromString(t.SerializeToString())
+    assert list(back.int64_data) == [-1, 0, 9223372036854775807]
+
+
+# -- per-op golden tests vs torch --------------------------------------------
+
+def test_gemm_vs_torch(rng):
+    x = rng.randn(4, 5).astype(np.float32)
+    w = rng.randn(6, 5).astype(np.float32)
+    b = rng.randn(6).astype(np.float32)
+    node = helper.make_node("Gemm", ["x", "w", "b"], ["y"], alpha=1.0,
+                            beta=1.0, transB=1)
+    (out,) = run_node(node, [x, w, b])
+    assert_close(out, F.linear(_t(x), _t(w), _t(b)).numpy())
+
+
+def test_conv2d_vs_torch(rng):
+    x = rng.randn(2, 3, 9, 9).astype(np.float32)
+    w = rng.randn(8, 3, 3, 3).astype(np.float32)
+    b = rng.randn(8).astype(np.float32)
+    node = helper.make_node("Conv", ["x", "w", "b"], ["y"],
+                            kernel_shape=[3, 3], pads=[1, 1, 1, 1],
+                            strides=[2, 2])
+    (out,) = run_node(node, [x, w, b])
+    ref = F.conv2d(_t(x), _t(w), _t(b), stride=2, padding=1).numpy()
+    assert_close(out, ref, atol=1e-4)
+
+
+def test_conv2d_grouped_dilated(rng):
+    x = rng.randn(1, 4, 10, 10).astype(np.float32)
+    w = rng.randn(8, 2, 3, 3).astype(np.float32)
+    node = helper.make_node("Conv", ["x", "w"], ["y"],
+                            kernel_shape=[3, 3], group=2,
+                            dilations=[2, 2])
+    (out,) = run_node(node, [x, w])
+    ref = F.conv2d(_t(x), _t(w), groups=2, dilation=2).numpy()
+    assert_close(out, ref, atol=1e-4)
+
+
+def test_conv1d_and_conv3d(rng):
+    x1 = rng.randn(2, 3, 12).astype(np.float32)
+    w1 = rng.randn(5, 3, 3).astype(np.float32)
+    (out1,) = run_node(helper.make_node(
+        "Conv", ["x", "w"], ["y"], kernel_shape=[3], pads=[1, 1]),
+        [x1, w1])
+    assert_close(out1, F.conv1d(_t(x1), _t(w1), padding=1).numpy(),
+                 atol=1e-4)
+    x3 = rng.randn(1, 2, 5, 5, 5).astype(np.float32)
+    w3 = rng.randn(4, 2, 2, 2, 2).astype(np.float32)
+    (out3,) = run_node(helper.make_node(
+        "Conv", ["x", "w"], ["y"], kernel_shape=[2, 2, 2]), [x3, w3])
+    assert_close(out3, F.conv3d(_t(x3), _t(w3)).numpy(), atol=1e-4)
+
+
+def test_conv_transpose_vs_torch(rng):
+    x = rng.randn(1, 4, 7, 7).astype(np.float32)
+    w = rng.randn(4, 6, 3, 3).astype(np.float32)
+    node = helper.make_node("ConvTranspose", ["x", "w"], ["y"],
+                            kernel_shape=[3, 3], strides=[2, 2],
+                            pads=[1, 1, 1, 1],
+                            output_padding=[1, 1])
+    (out,) = run_node(node, [x, w])
+    ref = F.conv_transpose2d(_t(x), _t(w), stride=2, padding=1,
+                             output_padding=1).numpy()
+    assert_close(out, ref, atol=1e-4)
+
+
+def test_maxpool_avgpool_vs_torch(rng):
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    (mp,) = run_node(helper.make_node(
+        "MaxPool", ["x"], ["y"], kernel_shape=[2, 2], strides=[2, 2]),
+        [x])
+    assert_close(mp, F.max_pool2d(_t(x), 2).numpy())
+    (ap,) = run_node(helper.make_node(
+        "AveragePool", ["x"], ["y"], kernel_shape=[3, 3], strides=[2, 2],
+        pads=[1, 1, 1, 1]), [x])
+    ref = F.avg_pool2d(_t(x), 3, stride=2, padding=1,
+                       count_include_pad=False).numpy()
+    assert_close(ap, ref)
+    (api,) = run_node(helper.make_node(
+        "AveragePool", ["x"], ["y"], kernel_shape=[3, 3], strides=[2, 2],
+        pads=[1, 1, 1, 1], count_include_pad=1), [x])
+    refi = F.avg_pool2d(_t(x), 3, stride=2, padding=1,
+                        count_include_pad=True).numpy()
+    assert_close(api, refi)
+
+
+def test_global_pools(rng):
+    x = rng.randn(2, 4, 5, 6).astype(np.float32)
+    (g,) = run_node(helper.make_node("GlobalAveragePool", ["x"], ["y"]),
+                    [x])
+    assert_close(g, x.mean((2, 3), keepdims=True))
+    (m,) = run_node(helper.make_node("GlobalMaxPool", ["x"], ["y"]), [x])
+    assert_close(m, x.max((2, 3), keepdims=True))
+
+
+def test_batchnorm_vs_torch(rng):
+    x = rng.randn(3, 5, 4, 4).astype(np.float32)
+    scale = rng.rand(5).astype(np.float32) + 0.5
+    bias = rng.randn(5).astype(np.float32)
+    mean = rng.randn(5).astype(np.float32)
+    var = rng.rand(5).astype(np.float32) + 0.1
+    node = helper.make_node("BatchNormalization",
+                            ["x", "s", "b", "m", "v"], ["y"],
+                            epsilon=1e-5)
+    (out,) = run_node(node, [x, scale, bias, mean, var])
+    ref = F.batch_norm(_t(x), _t(mean), _t(var), _t(scale), _t(bias),
+                       training=False, eps=1e-5).numpy()
+    assert_close(out, ref, atol=1e-5)
+
+
+def test_instancenorm_layernorm_vs_torch(rng):
+    x = rng.randn(2, 3, 6, 6).astype(np.float32)
+    s = rng.rand(3).astype(np.float32) + 0.5
+    b = rng.randn(3).astype(np.float32)
+    (out,) = run_node(helper.make_node(
+        "InstanceNormalization", ["x", "s", "b"], ["y"], epsilon=1e-5),
+        [x, s, b])
+    assert_close(out, F.instance_norm(
+        _t(x), weight=_t(s), bias=_t(b), eps=1e-5).numpy(), atol=1e-5)
+    xl = rng.randn(4, 7).astype(np.float32)
+    sl = rng.rand(7).astype(np.float32)
+    bl = rng.randn(7).astype(np.float32)
+    (outl,) = run_node(helper.make_node(
+        "LayerNormalization", ["x", "s", "b"], ["y"], axis=-1), [xl, sl, bl])
+    assert_close(outl, F.layer_norm(_t(xl), (7,), _t(sl), _t(bl)).numpy(),
+                 atol=1e-5)
+
+
+def test_lrn_vs_torch(rng):
+    x = rng.randn(2, 8, 5, 5).astype(np.float32)
+    node = helper.make_node("LRN", ["x"], ["y"], size=3, alpha=1e-4,
+                            beta=0.75, bias=1.0)
+    (out,) = run_node(node, [x])
+    ref = F.local_response_norm(_t(x), 3, alpha=1e-4, beta=0.75,
+                                k=1.0).numpy()
+    assert_close(out, ref, atol=1e-5)
+
+
+@pytest.mark.parametrize("op,fn", [
+    ("Relu", lambda x: np.maximum(x, 0)),
+    ("Sigmoid", lambda x: 1 / (1 + np.exp(-x))),
+    ("Tanh", np.tanh),
+    ("Sqrt", np.sqrt),
+    ("Exp", np.exp),
+    ("Neg", lambda x: -x),
+    ("Abs", np.abs),
+    ("Softplus", lambda x: np.log1p(np.exp(-np.abs(x))) +
+     np.maximum(x, 0)),
+    ("Softsign", lambda x: x / (1 + np.abs(x))),
+    ("Erf", lambda x: torch.erf(_t(x)).numpy()),
+])
+def test_unary_ops(rng, op, fn):
+    x = rng.randn(3, 4).astype(np.float32)
+    if op == "Sqrt":
+        x = np.abs(x) + 1
+    (out,) = run_node(helper.make_node(op, ["x"], ["y"]), [x])
+    assert_close(out, fn(x), atol=1e-5)
+
+
+def test_activation_alphas(rng):
+    x = rng.randn(4, 4).astype(np.float32)
+    (leaky,) = run_node(helper.make_node("LeakyRelu", ["x"], ["y"],
+                                         alpha=0.2), [x])
+    assert_close(leaky, F.leaky_relu(_t(x), 0.2).numpy())
+    (elu,) = run_node(helper.make_node("Elu", ["x"], ["y"], alpha=1.5),
+                      [x])
+    assert_close(elu, F.elu(_t(x), 1.5).numpy(), atol=1e-6)
+    (selu,) = run_node(helper.make_node("Selu", ["x"], ["y"]), [x])
+    assert_close(selu, F.selu(_t(x)).numpy(), atol=1e-6)
+    slope = rng.rand(4).astype(np.float32)
+    (prelu,) = run_node(helper.make_node("PRelu", ["x", "s"], ["y"]),
+                        [x, slope])
+    assert_close(prelu, F.prelu(_t(x), _t(slope)).numpy())
+
+
+def test_softmax_ops(rng):
+    x = rng.randn(3, 5).astype(np.float32)
+    (sm,) = run_node(helper.make_node("Softmax", ["x"], ["y"], axis=-1),
+                     [x])
+    assert_close(sm, F.softmax(_t(x), -1).numpy(), atol=1e-6)
+    (lsm,) = run_node(helper.make_node("LogSoftmax", ["x"], ["y"],
+                                       axis=-1), [x])
+    assert_close(lsm, F.log_softmax(_t(x), -1).numpy(), atol=1e-6)
+
+
+def test_binary_broadcast(rng):
+    a = rng.randn(2, 3, 4).astype(np.float32)
+    b = rng.randn(4).astype(np.float32)
+    for op, fn in [("Add", np.add), ("Sub", np.subtract),
+                   ("Mul", np.multiply), ("Div", np.divide)]:
+        (out,) = run_node(helper.make_node(op, ["a", "b"], ["y"]), [a, b])
+        assert_close(out, fn(a, b), atol=1e-6)
+
+
+def test_clip_variants(rng):
+    x = rng.randn(5, 5).astype(np.float32) * 3
+    (c1,) = run_node(helper.make_node("Clip", ["x"], ["y"], min=-1.0,
+                                      max=1.0), [x])
+    assert_close(c1, np.clip(x, -1, 1))
+    (c2,) = run_node(helper.make_node("Clip", ["x", "lo", "hi"], ["y"]),
+                     [x, np.float32(-0.5), np.float32(0.5)])
+    assert_close(c2, np.clip(x, -0.5, 0.5))
+
+
+def test_shape_ops(rng):
+    x = rng.randn(2, 3, 4).astype(np.float32)
+    (r,) = run_node(helper.make_node("Reshape", ["x", "s"], ["y"]),
+                    [x, np.array([2, 12], np.int64)])
+    assert r.shape == (2, 12)
+    (r0,) = run_node(helper.make_node("Reshape", ["x", "s"], ["y"]),
+                     [x, np.array([0, -1], np.int64)])
+    assert r0.shape == (2, 12)
+    (f,) = run_node(helper.make_node("Flatten", ["x"], ["y"], axis=2),
+                    [x])
+    assert f.shape == (6, 4)
+    (t,) = run_node(helper.make_node("Transpose", ["x"], ["y"],
+                                     perm=[2, 0, 1]), [x])
+    assert_close(t, x.transpose(2, 0, 1))
+    (u,) = run_node(helper.make_node("Unsqueeze", ["x"], ["y"],
+                                     axes=[0, 3]), [x])
+    assert u.shape == (1, 2, 3, 1, 4)
+    (sq,) = run_node(helper.make_node("Squeeze", ["x"], ["y"],
+                                      axes=[0, 3]), [u])
+    assert sq.shape == (2, 3, 4)
+    (cat,) = run_node(helper.make_node("Concat", ["a", "b"], ["y"],
+                                       axis=1), [x, x])
+    assert cat.shape == (2, 6, 4)
+
+
+def test_split_slice_gather(rng):
+    x = rng.randn(2, 6, 4).astype(np.float32)
+    outs = run_node(helper.make_node("Split", ["x"], ["a", "b", "c"],
+                                     axis=1, split=[1, 2, 3]), [x])
+    assert [o.shape[1] for o in outs] == [1, 2, 3]
+    assert_close(np.concatenate(outs, 1), x)
+    (sl,) = run_node(
+        helper.make_node("Slice", ["x", "st", "en", "ax", "sp"], ["y"]),
+        [x, np.array([1], np.int64), np.array([5], np.int64),
+         np.array([1], np.int64), np.array([2], np.int64)])
+    assert_close(sl, x[:, 1:5:2])
+    idx = np.array([2, 0, 1], np.int64)
+    (g,) = run_node(helper.make_node("Gather", ["x", "i"], ["y"], axis=1),
+                    [x, idx])
+    assert_close(g, np.take(x, idx, axis=1))
+
+
+def test_split_inferred_from_outputs(rng):
+    x = rng.randn(1, 12).astype(np.float32)
+    outs = run_node(helper.make_node("Split", ["x"], ["a", "b", "c"],
+                                     axis=1), [x])
+    assert len(outs) == 3 and all(o.shape == (1, 4) for o in outs)
+    assert_close(np.concatenate(outs, 1), x)
+    # non-even: last chunk smaller (opset-18 semantics)
+    x2 = rng.randn(1, 7).astype(np.float32)
+    outs2 = run_node(helper.make_node("Split", ["x"], ["a", "b", "c"],
+                                      axis=1), [x2])
+    assert [o.shape[1] for o in outs2] == [3, 3, 1]
+
+
+def test_slice_negative_step_reverse(rng):
+    x = np.arange(5, dtype=np.float32)
+    int64_min = -(1 << 63)
+    (r,) = run_node(
+        helper.make_node("Slice", ["x", "st", "en", "ax", "sp"], ["y"]),
+        [x, np.array([-1], np.int64), np.array([int64_min], np.int64),
+         np.array([0], np.int64), np.array([-1], np.int64)])
+    assert_close(r, x[::-1])
+    (r2,) = run_node(
+        helper.make_node("Slice", ["x", "st", "en", "ax", "sp"], ["y"]),
+        [x, np.array([3], np.int64), np.array([-6], np.int64),
+         np.array([0], np.int64), np.array([-1], np.int64)])
+    assert_close(r2, np.array([3, 2, 1, 0], np.float32))
+
+
+def test_maxpool_dilations_vs_torch(rng):
+    x = rng.randn(1, 2, 9, 9).astype(np.float32)
+    node = helper.make_node("MaxPool", ["x"], ["y"], kernel_shape=[2, 2],
+                            strides=[1, 1], dilations=[2, 2])
+    (out,) = run_node(node, [x])
+    ref = F.max_pool2d(_t(x), 2, stride=1, dilation=2).numpy()
+    assert_close(out, ref)
+
+
+def test_conv_transpose_same_upper(rng):
+    x = rng.randn(1, 3, 5, 5).astype(np.float32)
+    w = rng.randn(3, 4, 3, 3).astype(np.float32)
+    node = helper.make_node("ConvTranspose", ["x", "w"], ["y"],
+                            kernel_shape=[3, 3], strides=[2, 2],
+                            auto_pad="SAME_UPPER")
+    (out,) = run_node(node, [x, w])
+    assert out.shape == (1, 4, 10, 10)  # in*stride
+    node2 = helper.make_node("ConvTranspose", ["x", "w"], ["y"],
+                             kernel_shape=[3, 3], strides=[2, 2],
+                             output_shape=[11, 11])
+    (out2,) = run_node(node2, [x, w])
+    assert out2.shape == (1, 4, 11, 11)
+
+
+def test_fp16_tensor_int32_encoding():
+    vals = np.array([1.5, -2.0, 0.25], np.float16)
+    t = TensorProto()
+    t.dims = [3]
+    t.data_type = TensorProto.FLOAT16
+    t.int32_data = [int(v) for v in vals.view(np.uint16)]
+    back = onnx_pb.tensor_to_numpy(
+        TensorProto.FromString(t.SerializeToString()))
+    assert back.dtype == np.float16
+    np.testing.assert_array_equal(back, vals)
+
+
+def test_flatten_unsqueeze_negative_axes(rng):
+    x = rng.randn(2, 3, 4).astype(np.float32)
+    (f,) = run_node(helper.make_node("Flatten", ["x"], ["y"], axis=-1),
+                    [x])
+    assert f.shape == (6, 4)
+    (u,) = run_node(helper.make_node("Unsqueeze", ["x"], ["y"],
+                                     axes=[1, 2]),
+                    [rng.randn(5).astype(np.float32)])
+    assert u.shape == (5, 1, 1)
+    (un,) = run_node(helper.make_node("Unsqueeze", ["x"], ["y"],
+                                      axes=[-1]),
+                     [rng.randn(5).astype(np.float32)])
+    assert un.shape == (5, 1)
+
+
+def test_pad_negative_crops(rng):
+    x = rng.randn(3, 5).astype(np.float32)
+    (p,) = run_node(helper.make_node("Pad", ["x", "p"], ["y"],
+                                     mode="constant"),
+                    [x, np.array([0, -1, 0, -2], np.int64)])
+    assert p.shape == (3, 2)
+    assert_close(p, x[:, 1:3])
+
+
+def test_pad_tile_expand(rng):
+    x = rng.randn(2, 3).astype(np.float32)
+    (p,) = run_node(helper.make_node("Pad", ["x", "p"], ["y"],
+                                     mode="constant"),
+                    [x, np.array([0, 1, 0, 2], np.int64)])
+    assert p.shape == (2, 6)
+    assert_close(p[:, 1:4], x)
+    (tl,) = run_node(helper.make_node("Tile", ["x", "r"], ["y"]),
+                     [x, np.array([2, 1], np.int64)])
+    assert_close(tl, np.tile(x, (2, 1)))
+    (e,) = run_node(helper.make_node("Expand", ["x", "s"], ["y"]),
+                    [x[:1], np.array([4, 3], np.int64)])
+    assert_close(e, np.broadcast_to(x[:1], (4, 3)))
+
+
+def test_reductions(rng):
+    x = rng.randn(2, 3, 4).astype(np.float32)
+    (m,) = run_node(helper.make_node("ReduceMean", ["x"], ["y"],
+                                     axes=[1], keepdims=0), [x])
+    assert_close(m, x.mean(1), atol=1e-6)
+    (s,) = run_node(helper.make_node("ReduceSum", ["x", "ax"], ["y"],
+                                     keepdims=1),
+                    [x, np.array([2], np.int64)])
+    assert_close(s, x.sum(2, keepdims=True), atol=1e-5)
+    (am,) = run_node(helper.make_node("ArgMax", ["x"], ["y"], axis=2,
+                                      keepdims=0), [x])
+    assert_close(am, x.argmax(2))
+
+
+def test_cast_where_compare(rng):
+    x = rng.randn(3, 3).astype(np.float32)
+    (c,) = run_node(helper.make_node("Cast", ["x"], ["y"],
+                                     to=TensorProto.INT32), [x])
+    assert c.dtype == np.int32
+    (gt,) = run_node(helper.make_node("Greater", ["a", "b"], ["y"]),
+                     [x, np.float32(0)])
+    (w,) = run_node(helper.make_node("Where", ["c", "a", "b"], ["y"]),
+                    [gt, x, -x])
+    assert_close(w, np.abs(x))
+
+
+def test_resize_nearest(rng):
+    x = rng.randn(1, 2, 4, 4).astype(np.float32)
+    node = helper.make_node("Resize", ["x", "roi", "scales"], ["y"],
+                            mode="nearest")
+    (out,) = run_node(node, [x, None,
+                             np.array([1, 1, 2, 2], np.float32)])
+    assert out.shape == (1, 2, 8, 8)
+
+
+def test_constant_of_shape_and_range():
+    (z,) = run_node(helper.make_node("ConstantOfShape", ["s"], ["y"]),
+                    [np.array([2, 3], np.int64)])
+    assert z.shape == (2, 3) and z.dtype == np.float32
+    (r,) = run_node(helper.make_node("Range", ["a", "b", "c"], ["y"]),
+                    [np.int64(0), np.int64(10), np.int64(2)])
+    assert_close(r, np.arange(0, 10, 2))
+
+
+# -- end-to-end model import --------------------------------------------------
+
+def _make_mlp_proto(rng):
+    w1 = rng.randn(16, 8).astype(np.float32) * 0.3
+    b1 = rng.randn(16).astype(np.float32) * 0.1
+    w2 = rng.randn(4, 16).astype(np.float32) * 0.3
+    b2 = rng.randn(4).astype(np.float32) * 0.1
+    nodes = [
+        helper.make_node("Gemm", ["x", "w1", "b1"], ["h"], transB=1),
+        helper.make_node("Relu", ["h"], ["hr"]),
+        helper.make_node("Gemm", ["hr", "w2", "b2"], ["logits"],
+                         transB=1),
+    ]
+    graph = helper.make_graph(
+        nodes, "mlp",
+        [helper.make_tensor_value_info("x", TensorProto.FLOAT,
+                                       ["N", 8])],
+        [helper.make_tensor_value_info("logits", TensorProto.FLOAT,
+                                       ["N", 4])],
+        [helper.make_tensor("w1", w1), helper.make_tensor("b1", b1),
+         helper.make_tensor("w2", w2), helper.make_tensor("b2", b2)])
+    return helper.make_model(graph), (w1, b1, w2, b2)
+
+
+def test_load_mlp_and_predict(rng, tmp_path):
+    model_proto, (w1, b1, w2, b2) = _make_mlp_proto(rng)
+    path = str(tmp_path / "mlp.onnx")
+    onnx_pb.save_model(model_proto, path)
+    net = OnnxLoader.load_model(path)
+    x = rng.randn(5, 8).astype(np.float32)
+    net.compile(optimizer="sgd", loss="mse")
+    out = net.predict(x, batch_size=5)
+    ref = np.maximum(x @ w1.T + b1, 0) @ w2.T + b2
+    assert_close(out, ref, atol=1e-5)
+
+
+def test_finetune_imported_model(rng):
+    model_proto, _ = _make_mlp_proto(rng)
+    net = OnnxLoader.load_model(model_proto)
+    x = rng.randn(32, 8).astype(np.float32)
+    y = rng.randn(32, 4).astype(np.float32)
+    from analytics_zoo_tpu.ops.optimizers import Adam
+    net.compile(optimizer=Adam(lr=0.02), loss="mse")
+    before = float(np.mean((net.predict(x, batch_size=32) - y) ** 2))
+    net.fit(x, y, batch_size=16, nb_epoch=40)
+    after = float(np.mean((net.predict(x, batch_size=32) - y) ** 2))
+    assert after < before * 0.7, (before, after)
+
+
+def test_load_convnet_vs_torch(rng, tmp_path):
+    torch.manual_seed(0)
+    tm = torch.nn.Sequential(
+        torch.nn.Conv2d(3, 4, 3, padding=1),
+        torch.nn.ReLU(),
+        torch.nn.MaxPool2d(2),
+        torch.nn.Flatten(),
+        torch.nn.Linear(4 * 4 * 4, 5),
+    )
+    tm.eval()
+    conv_w = tm[0].weight.detach().numpy()
+    conv_b = tm[0].bias.detach().numpy()
+    fc_w = tm[4].weight.detach().numpy()
+    fc_b = tm[4].bias.detach().numpy()
+    nodes = [
+        helper.make_node("Conv", ["x", "cw", "cb"], ["c"],
+                         kernel_shape=[3, 3], pads=[1, 1, 1, 1]),
+        helper.make_node("Relu", ["c"], ["cr"]),
+        helper.make_node("MaxPool", ["cr"], ["p"], kernel_shape=[2, 2],
+                         strides=[2, 2]),
+        helper.make_node("Flatten", ["p"], ["f"], axis=1),
+        helper.make_node("Gemm", ["f", "fw", "fb"], ["y"], transB=1),
+    ]
+    graph = helper.make_graph(
+        nodes, "convnet",
+        [helper.make_tensor_value_info("x", TensorProto.FLOAT,
+                                       ["N", 3, 8, 8])],
+        [helper.make_tensor_value_info("y", TensorProto.FLOAT,
+                                       ["N", 5])],
+        [helper.make_tensor("cw", conv_w), helper.make_tensor("cb", conv_b),
+         helper.make_tensor("fw", fc_w), helper.make_tensor("fb", fc_b)])
+    model_proto = helper.make_model(graph)
+    path = str(tmp_path / "conv.onnx")
+    onnx_pb.save_model(model_proto, path)
+
+    net = OnnxLoader.load_model(path)
+    net.compile(optimizer="sgd", loss="mse")
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    out = net.predict(x, batch_size=2)
+    with torch.no_grad():
+        ref = tm(_t(x)).numpy()
+    assert_close(out, ref, atol=1e-4)
+
+
+def test_multi_output_graph(rng):
+    nodes = [
+        helper.make_node("Relu", ["x"], ["pos"]),
+        helper.make_node("Neg", ["x"], ["neg"]),
+    ]
+    graph = helper.make_graph(
+        nodes, "two_out",
+        [helper.make_tensor_value_info("x", TensorProto.FLOAT,
+                                       ["N", 3])],
+        [helper.make_tensor_value_info("pos", TensorProto.FLOAT,
+                                       ["N", 3]),
+         helper.make_tensor_value_info("neg", TensorProto.FLOAT,
+                                       ["N", 3])])
+    from analytics_zoo_tpu.pipeline.api.onnx.onnx_loader import \
+        OnnxGraphLayer
+    layer = OnnxGraphLayer(helper.make_model(graph).graph)
+    params = layer.init(__import__("jax").random.PRNGKey(0), (3,))
+    x = rng.randn(2, 3).astype(np.float32)
+    out = layer.call(params, x)
+    assert isinstance(out, list) and len(out) == 2
+    assert_close(out[0], np.maximum(x, 0))
+    assert_close(out[1], -x)
+
+
+def test_unsupported_op_raises():
+    node = helper.make_node("NonexistentOp", ["x"], ["y"])
+    with pytest.raises(NotImplementedError):
+        run_node(node, [np.zeros((1,), np.float32)])
+
+
+def test_supported_ops_inventory():
+    ops = OnnxLoader.supported_ops()
+    # reference maps ~40 ops (SURVEY.md §2.9); we cover a superset
+    assert len(ops) >= 40
+    for required in ["Conv", "Gemm", "MaxPool", "AveragePool",
+                     "BatchNormalization", "Relu", "Softmax", "Reshape",
+                     "Concat", "Add", "MatMul", "Transpose", "Gather"]:
+        assert required in ops
