@@ -139,9 +139,22 @@ _register("Sigmoid")(lambda a, i: jax.nn.sigmoid(i[0]))
 _register("HardSigmoid")(lambda a, i: jnp.clip(
     a.get("alpha", 0.2) * i[0] + a.get("beta", 0.5), 0.0, 1.0))
 _register("Tanh")(lambda a, i: jnp.tanh(i[0]))
-_register("Softmax")(lambda a, i: jax.nn.softmax(i[0], a.get("axis", -1)))
-_register("LogSoftmax")(
-    lambda a, i: jax.nn.log_softmax(i[0], a.get("axis", -1)))
+def _softmax_family(jfn):
+    def fn(a, i):
+        x = i[0]
+        if a.get("__opset__", 13) >= 13:
+            return jfn(x, a.get("axis", -1))
+        # opset<13: default axis=1, flatten-to-2D coercion semantics
+        axis = a.get("axis", 1) % x.ndim
+        lead = int(np.prod(x.shape[:axis], dtype=np.int64)) if axis \
+            else 1
+        flat = x.reshape((lead, -1))
+        return jfn(flat, -1).reshape(x.shape)
+    return fn
+
+
+_register("Softmax")(_softmax_family(jax.nn.softmax))
+_register("LogSoftmax")(_softmax_family(jax.nn.log_softmax))
 _register("Elu")(lambda a, i: jnp.where(
     i[0] > 0, i[0], a.get("alpha", 1.0) * (jnp.exp(i[0]) - 1)))
 _register("Selu")(lambda a, i: a.get("gamma", 1.0507009873554805) * jnp.where(
@@ -529,7 +542,12 @@ def _pad(a, i):
     return x
 
 
-_register("Shape")(lambda a, i: np.asarray(i[0].shape, np.int64))
+@_register("Shape")
+def _shape(a, i):
+    shape = np.asarray(i[0].shape, np.int64)
+    start = a.get("start", 0)
+    end = a.get("end")
+    return shape[start:end]
 
 
 @_register("ConstantOfShape")
@@ -607,7 +625,8 @@ def _resize(a, i):
                 break
         if scales_in is None:
             scales_in = np.asarray(a.get("scales"))
-        sizes = [int(round(s * f)) for s, f in zip(x.shape, scales_in)]
+        # ONNX: output_dim = floor(input_dim * scale)
+        sizes = [int(np.floor(s * f)) for s, f in zip(x.shape, scales_in)]
     method = {"nearest": "nearest", "linear": "linear",
               "cubic": "cubic"}[mode]
     return jax.image.resize(x, sizes, method=method)
@@ -652,8 +671,10 @@ class OnnxGraphLayer(KerasLayer):
     """
 
     def __init__(self, graph: onnx_pb.GraphProto,
-                 name: Optional[str] = None):
+                 name: Optional[str] = None, opset: int = 13,
+                 input_shape=None):
         self.graph = graph
+        self.opset = int(opset)
         self._constants: Dict[str, np.ndarray] = {}
         self._param_names: List[str] = []
         for t in graph.initializer:
@@ -665,11 +686,21 @@ class OnnxGraphLayer(KerasLayer):
         self.input_names = [vi.name for vi in graph.input
                             if vi.name not in init_names]
         self.output_names = [vi.name for vi in graph.output]
-        in_shapes = [_vi_shape(vi) for vi in graph.input
-                     if vi.name not in init_names]
-        multi = len(in_shapes) > 1
-        shapes: Any = [s[1:] for s in in_shapes] if multi else \
-            in_shapes[0][1:]
+        if input_shape is not None:
+            shapes: Any = input_shape
+        else:
+            in_shapes = [_vi_shape(vi) for vi in graph.input
+                         if vi.name not in init_names]
+            for vi, s in zip(self.input_names, in_shapes):
+                if any(d is None for d in s[1:]):
+                    raise ValueError(
+                        f"ONNX input {vi!r} has symbolic non-batch "
+                        f"dims {s[1:]}; pass input_shape= to "
+                        "OnnxLoader.load_model with concrete shapes "
+                        "(batch dim excluded)")
+            multi = len(in_shapes) > 1
+            shapes = [s[1:] for s in in_shapes] if multi else \
+                in_shapes[0][1:]
         super().__init__(input_shape=shapes,
                          name=name or unique_name("onnxgraph"))
 
@@ -714,6 +745,7 @@ class OnnxGraphLayer(KerasLayer):
                     f"ONNX op {node.op_type} (node {node.name or k})")
             args = [env[n] if n else None for n in node.input]
             attrs = _attrs(node)
+            attrs["__opset__"] = self.opset
             if node.op_type == "Split":
                 attrs.setdefault("num_outputs", len(node.output))
             if node.op_type == "Dropout":
@@ -735,12 +767,15 @@ class OnnxGraphLayer(KerasLayer):
 
 
 def _vi_shape(vi: onnx_pb.ValueInfoProto) -> tuple:
+    """Shape from ValueInfo; symbolic (dim_param) / absent dims map to
+    None (the batch slot is ignored by the caller; non-batch Nones
+    require an explicit input_shape)."""
     tt = vi.type.tensor_type if vi.type else None
     if tt is None or tt.shape is None:
         raise ValueError(f"graph input {vi.name} has no shape info")
     dims = []
     for d in tt.shape.dim:
-        dims.append(int(d.dim_value) if d.dim_value else 1)
+        dims.append(int(d.dim_value) if d.dim_value else None)
     return tuple(dims)
 
 
@@ -750,13 +785,22 @@ class OnnxLoader:
     """Reference analog of `P/pipeline/api/onnx/onnx_loader.py:32`."""
 
     @staticmethod
-    def load_model(path_or_bytes) -> "Any":
-        """Load an ONNX model into a trainable zoo `Sequential`."""
+    def load_model(path_or_bytes, input_shape=None) -> "Any":
+        """Load an ONNX model into a trainable zoo `Sequential`.
+
+        ``input_shape`` (batch dim excluded; list of shapes for
+        multi-input graphs) overrides the graph's declared input
+        shapes — required when they contain symbolic dims."""
         model_proto = (path_or_bytes
                        if isinstance(path_or_bytes, ModelProto)
                        else onnx_pb.load_model(path_or_bytes))
+        opset = 13
+        for op in model_proto.opset_import:
+            if not op.domain:  # default ONNX domain
+                opset = int(op.version or 13)
         from analytics_zoo_tpu.pipeline.api.keras.models import Sequential
-        layer = OnnxGraphLayer(model_proto.graph)
+        layer = OnnxGraphLayer(model_proto.graph, opset=opset,
+                               input_shape=input_shape)
         net = Sequential([layer],
                          name=model_proto.graph.name or None)
         return net
@@ -774,6 +818,7 @@ class OnnxLoader:
         args = [np.asarray(x) if isinstance(x, (list, tuple, int, float))
                 else x for x in inputs]
         attrs = _attrs(node)
+        attrs["__opset__"] = int(kwargs.get("opset", 13))
         if node.op_type == "Split":
             attrs.setdefault("num_outputs", len(node.output))
         if node.op_type == "Dropout":

@@ -385,7 +385,17 @@ class Estimator:
                 tb.add_scalar("Throughput", throughput, self.step)
             if validation_data is not None and validation_trigger(
                     epoch, self.step, True):
-                val = self.evaluate(validation_data, batch_size=batch_size)
+                # keras-style (x_val, y_val) tuples are (data, labels),
+                # not a two-input feature list
+                if isinstance(validation_data, tuple) and \
+                        len(validation_data) == 2 and not hasattr(
+                            validation_data, "iter_batches"):
+                    val = self.evaluate(validation_data[0],
+                                        validation_data[1],
+                                        batch_size=batch_size)
+                else:
+                    val = self.evaluate(validation_data,
+                                        batch_size=batch_size)
                 entry.update({f"val_{k}": v for k, v in val.items()})
                 if tb is not None:
                     for k, v in val.items():
@@ -409,8 +419,16 @@ class Estimator:
         if self._eval_step is None:
             self._eval_step = self._build_eval_step()
         totals: "dict[str, dict[str, np.ndarray]]" = {}
+        dp = self.ctx.data_parallel_size
         for xb, yb in ds.iter_batches(batch_size, shuffle=False,
-                                      drop_last=True):
+                                      drop_last=False):
+            bsize = _batch_dim(xb)
+            if bsize % dp:  # tail must divide the data axis; trim the
+                keep = bsize - bsize % dp  # last <dp samples
+                if keep == 0:
+                    continue
+                xb = _trim_batch(xb, keep)
+                yb = _trim_batch(yb, keep) if yb is not None else None
             xb = shard_batch(xb, self.ctx.mesh)
             yb = shard_batch(yb, self.ctx.mesh)
             stats = jax.device_get(self._eval_step(self.params, xb, yb))

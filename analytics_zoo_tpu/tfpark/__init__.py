@@ -1,0 +1,28 @@
+"""tfpark: TensorFlow-model integration (reference `P/tfpark/`).
+
+- :class:`KerasModel` — train/serve a compiled `tf.keras` model on the
+  TPU mesh (reference `model.py:28`).
+- :class:`TFEstimator` / :class:`TFEstimatorSpec` — the
+  `model_fn(features, labels, mode)` API (reference `estimator.py:82`).
+- :mod:`analytics_zoo_tpu.tfpark.text` — pre-built NLP models
+  (IntentEntity, NER, SequenceTagger).
+
+TF imports are lazy: importing `analytics_zoo_tpu.tfpark` is cheap and
+the text models have no TF dependency at all.
+"""
+
+__all__ = ["KerasModel", "TFEstimator", "TFEstimatorSpec", "text"]
+
+
+def __getattr__(name):
+    import importlib
+    if name == "KerasModel":
+        return importlib.import_module(
+            "analytics_zoo_tpu.tfpark.model").KerasModel
+    if name in ("TFEstimator", "TFEstimatorSpec"):
+        mod = importlib.import_module(
+            "analytics_zoo_tpu.tfpark.estimator")
+        return getattr(mod, name)
+    if name == "text":
+        return importlib.import_module("analytics_zoo_tpu.tfpark.text")
+    raise AttributeError(name)

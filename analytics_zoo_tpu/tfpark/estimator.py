@@ -1,0 +1,294 @@
+"""tfpark.TFEstimator: TF-Estimator-style `model_fn` API on the mesh.
+
+Reference: `P/tfpark/estimator.py:29-238` — `model_fn(features, labels,
+mode)` returns a `TFEstimatorSpec`; `train/evaluate/predict` run over
+`input_fn → TFDataset`. Here the model_fn is traced per mode with a
+shared variable store (standing in for TF1 graph variable reuse), the
+traced graph is rewritten to explicit weights (`tf_graph`), and the
+loss is minimized directly by the pjit Estimator — the reference's
+IdentityCriterion trick (`TFTrainingHelper.scala:182-195`: the "loss"
+is just the model's last output) maps to an identity loss function.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+from analytics_zoo_tpu.common.nncontext import logger
+from analytics_zoo_tpu.tfpark.tf_graph import to_jax_fn
+
+
+def _tf():
+    import tensorflow as tf
+    return tf
+
+
+class TFEstimatorSpec:
+    """(reference `estimator.py:29-56`)"""
+
+    def __init__(self, mode: str, predictions=None, loss=None):
+        self.mode = mode
+        self.predictions = predictions
+        self.loss = loss
+
+
+class _VariableStore:
+    """Creates variables on the first trace, replays them (in creation
+    order) on later traces — the TF2 stand-in for TF1 variable reuse."""
+
+    def __init__(self):
+        self.variables: list = []
+        self._recording = True
+        self._cursor = 0
+
+    def creator(self, next_creator, **kwargs):
+        if self._recording:
+            var = next_creator(**kwargs)
+            self.variables.append(var)
+            return var
+        if not self.variables:
+            raise ValueError("model_fn created no variables")
+        # tf.function may retrace; each trace re-creates the same
+        # sequence, so replay cyclically in creation order
+        var = self.variables[self._cursor % len(self.variables)]
+        self._cursor += 1
+        return var
+
+    def replay(self):
+        self._recording = False
+        self._cursor = 0
+
+
+class _TFEstimatorNet:
+    """KerasNet-protocol shim: training forward returns the scalar loss
+    (inputs = [features..., labels]); inference forward returns
+    predictions (inputs = [features...])."""
+
+    def __init__(self, loss_fn, pred_fn, weights, pred_perm):
+        from analytics_zoo_tpu.tfpark.tf_graph import split_float_weights
+        self._loss_fn = loss_fn
+        self._pred_fn = pred_fn
+        self._n = len(weights)
+        self._float_idx, self._consts = split_float_weights(weights)
+        self._float_values = [np.asarray(weights[i])
+                              for i in self._float_idx]
+        self._pred_perm = pred_perm
+        self.name = "tf_estimator_net"
+        self.layers: list = []
+
+    def init_params(self, rng=None):
+        return {"weights": [w.copy() for w in self._float_values]}
+
+    def init(self, rng, input_shape=None):
+        return self.init_params(rng)
+
+    def _assemble(self, float_ws):
+        from analytics_zoo_tpu.tfpark.tf_graph import assemble_weights
+        return assemble_weights(float_ws, self._float_idx, self._consts,
+                                self._n)
+
+    def apply(self, params, x, *, training=False, rng=None):
+        xs = list(x) if isinstance(x, (list, tuple)) else [x]
+        full = self._assemble(params["weights"])
+        if training:
+            return self._loss_fn(*full, *xs, rng=rng), {}
+        if self._pred_fn is None:
+            raise RuntimeError("model_fn returned no predictions")
+        wp = [full[i] for i in self._pred_perm]
+        return self._pred_fn(*wp, *xs), {}
+
+    def forward(self, params, x, *, training=False, rng=None):
+        out, _ = self.apply(params, x, training=training, rng=rng)
+        return out
+
+    def regularization_loss(self, params):
+        import jax.numpy as jnp
+        return jnp.zeros((), jnp.float32)
+
+    def trainable_mask(self, params):
+        return {"weights": [True] * len(self._float_values)}
+
+
+class TFEstimator:
+    """(reference `P/tfpark/estimator.py:82`)"""
+
+    def __init__(self, model_fn: Callable, optimizer="adam",
+                 model_dir: Optional[str] = None):
+        self.model_fn = model_fn
+        self.optimizer = optimizer
+        self.model_dir = model_dir
+        self._store = _VariableStore()
+        self._net: Optional[_TFEstimatorNet] = None
+        self._estimator = None
+        self._feature_spec = None
+        self._label_spec = None
+
+    # -- lazy build on first data ------------------------------------------
+    def _specs_from_batch(self, features, labels):
+        tf = _tf()
+        feats = features if isinstance(features, (list, tuple)) \
+            else [features]
+        fspec = [tf.TensorSpec([None] + list(np.shape(f)[1:]),
+                               tf.as_dtype(np.asarray(f).dtype))
+                 for f in feats]
+        lspec = None
+        if labels is not None:
+            lspec = tf.TensorSpec([None] + list(np.shape(labels)[1:]),
+                                  tf.as_dtype(np.asarray(labels).dtype))
+        return fspec, lspec
+
+    def _build(self, features, labels):
+        tf = _tf()
+        fspec, lspec = self._specs_from_batch(features, labels)
+        n_feat = len(fspec)
+
+        def train_trace(*args):
+            feats = list(args[:n_feat])
+            lab = args[n_feat] if len(args) > n_feat else None
+            spec = self.model_fn(
+                feats if n_feat > 1 else feats[0], lab, "train")
+            if spec.loss is None:
+                raise ValueError("model_fn(mode='train') must set loss")
+            return spec.loss
+
+        # 1. create variables EAGERLY (tf.function forbids creation
+        #    inside a trace): run model_fn once on the sample batch
+        feats_e = [tf.constant(np.asarray(f)) for f in (
+            features if isinstance(features, (list, tuple))
+            else [features])]
+        lab_e = None if labels is None else tf.constant(
+            np.asarray(labels))
+        with tf.variable_creator_scope(self._store.creator):
+            self.model_fn(feats_e if n_feat > 1 else feats_e[0],
+                          lab_e, "train")
+        self._store.replay()
+
+        sig = fspec + ([lspec] if lspec is not None else [])
+        with tf.variable_creator_scope(self._store.creator):
+            loss_fn, train_vars = to_jax_fn(
+                train_trace, sig, variables=self._store.variables)
+
+        def pred_trace(*args):
+            spec = self.model_fn(
+                list(args) if n_feat > 1 else args[0], None, "infer")
+            out = spec.predictions
+            if out is None:
+                raise ValueError(
+                    "model_fn(mode='infer') must set predictions")
+            return out
+
+        pred_fn, pred_vars = None, []
+        with tf.variable_creator_scope(self._store.creator):
+            try:
+                pred_fn, pred_vars = to_jax_fn(
+                    pred_trace, fspec, variables=self._store.variables)
+            except ValueError as e:
+                if "must set predictions" not in str(e):
+                    raise  # real rewrite failure, not a mode limitation
+                logger.warning("TFEstimator: no inference graph (%s)", e)
+        perm = []
+        for v in pred_vars:
+            idx = next((i for i, t in enumerate(train_vars) if t is v),
+                       None)
+            if idx is None:
+                raise ValueError(
+                    f"inference graph reads variable {v.name} that the "
+                    "training graph does not; variables must be "
+                    "mode-independent")
+            perm.append(idx)
+
+        self._net = _TFEstimatorNet(
+            loss_fn, pred_fn, [v.numpy() for v in train_vars], perm)
+        from analytics_zoo_tpu.pipeline.estimator import Estimator
+        import jax.numpy as jnp
+        self._estimator = Estimator(
+            self._net, optimizer=self.optimizer,
+            loss=lambda y_true, y_pred: jnp.mean(y_pred))
+        self._train_vars = train_vars
+        if self.model_dir:
+            self._estimator.set_checkpoint(self.model_dir)
+
+    @staticmethod
+    def _first_batch(dataset):
+        for xb, yb in dataset.iter_batches(
+                getattr(dataset, "batch_size", 32), shuffle=False,
+                drop_last=False):
+            return xb, yb
+        raise ValueError("empty dataset")
+
+    # -- public API (reference estimator.py:120-238) -----------------------
+    def train(self, input_fn: Callable, steps: Optional[int] = None,
+              batch_size: int = 32, nb_epoch: int = 1):
+        dataset = input_fn()
+        xb, yb = self._first_batch(dataset)
+        if self._net is None:
+            self._build(xb, yb)
+        # pack labels into the input tuple; the identity loss reads the
+        # model's own loss output
+        feats = xb if isinstance(xb, (list, tuple)) else [xb]
+        packed = _PackedDataset(dataset, with_labels=yb is not None,
+                                n_feat=len(feats))
+        from analytics_zoo_tpu.pipeline.estimator import MaxIteration
+        end = MaxIteration(steps) if steps is not None else None
+        bs = getattr(dataset, "batch_size", batch_size)
+        return self._estimator.train(packed, None, batch_size=bs,
+                                     nb_epoch=nb_epoch, end_trigger=end)
+
+    def evaluate(self, input_fn: Callable, batch_size: int = 32):
+        dataset = input_fn()
+        xb, yb = self._first_batch(dataset)
+        if self._net is None:
+            self._build(xb, yb)
+        import jax
+        loss_sum, count = 0.0, 0
+        bs = getattr(dataset, "batch_size", batch_size)
+        fwd = jax.jit(
+            lambda p, x: self._net.forward(p, x, training=True))
+        params = (self._estimator.params or self._net.init_params())
+        for xb, yb in dataset.iter_batches(bs, shuffle=False,
+                                           drop_last=False):
+            feats = list(xb) if isinstance(xb, (list, tuple)) else [xb]
+            if yb is not None:
+                feats.append(yb)
+            n = feats[0].shape[0]
+            # weight per-batch mean losses by batch size (tail batches
+            # may be smaller; each shape compiles once)
+            loss_sum += float(fwd(params, feats)) * n
+            count += n
+        return {"loss": loss_sum / max(count, 1)}
+
+    def predict(self, input_fn: Callable, batch_size: int = 32):
+        dataset = input_fn()
+        xb, yb = self._first_batch(dataset)
+        if self._net is None:
+            self._build(xb, yb)
+        bs = getattr(dataset, "batch_size", batch_size)
+        # the Estimator's predict path shards over the mesh and handles
+        # tail-batch padding
+        self._estimator._ensure_initialized()
+        return self._estimator.predict(dataset, batch_size=bs)
+
+
+class _PackedDataset:
+    """Wraps a (features, labels) dataset into features+labels-as-x with
+    y=None (the training forward computes the loss internally)."""
+
+    def __init__(self, dataset, with_labels: bool, n_feat: int):
+        self._ds = dataset
+        self._with_labels = with_labels
+        self._n_feat = n_feat
+
+    @property
+    def num_samples(self):
+        return self._ds.num_samples
+
+    def iter_batches(self, batch_size, **kw):
+        for xb, yb in self._ds.iter_batches(batch_size, **kw):
+            feats = list(xb) if isinstance(xb, (list, tuple)) else [xb]
+            if self._with_labels:
+                if yb is None:
+                    raise ValueError("dataset stopped yielding labels")
+                feats.append(yb)
+            yield feats, np.zeros((feats[0].shape[0], 1), np.float32)

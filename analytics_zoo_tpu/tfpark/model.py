@@ -1,0 +1,201 @@
+"""tfpark.KerasModel: train/serve a `tf.keras` model on the TPU mesh.
+
+Reference: `P/tfpark/model.py:28-366` — wraps a compiled tf.keras model
+so `fit/evaluate/predict` run distributed (there: TFOptimizer on Spark;
+here: the graph is rewritten to explicit weights via
+`tfpark.tf_graph.make_explicit_fn`, bridged with `jax2tf.call_tf`, and
+trained by the framework's pjit Estimator). After `fit`, trained
+weights are assigned back into the live tf.keras model — preserving the
+reference's weights→session contract (`net.py:703-714`), so
+`model.save(...)`/`get_weights()` see the trained values.
+
+Known limitation (documented in `tf_graph`): BatchNorm moving averages
+do not update through the bridge (update side effects are stripped).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+from analytics_zoo_tpu.common.nncontext import logger
+from analytics_zoo_tpu.tfpark.tf_graph import (
+    keras_loss_to_zoo,
+    keras_optimizer_to_zoo,
+    to_jax_fn,
+)
+
+
+def _tf():
+    import tensorflow as tf
+    return tf
+
+
+class _TFKerasNet:
+    """KerasNet-protocol shim over (train_fn, infer_fn) explicit-weights
+    JAX functions sharing one weight order. Non-float variables (e.g.
+    Keras-3 dropout seed state) are baked as constants — `jax.grad`
+    rejects int inputs and they are never trainable."""
+
+    def __init__(self, train_fn, infer_fn, weight_values: List,
+                 trainable_flags: List[bool], infer_perm: List[int]):
+        from analytics_zoo_tpu.tfpark.tf_graph import split_float_weights
+        self._train_fn = train_fn
+        self._infer_fn = infer_fn
+        self._n = len(weight_values)
+        self._float_idx, self._consts = split_float_weights(weight_values)
+        self._float_values = [np.asarray(weight_values[i])
+                              for i in self._float_idx]
+        self._trainable = [bool(trainable_flags[i])
+                           for i in self._float_idx]
+        self._infer_perm = infer_perm
+        self.name = "tf_keras_net"
+        self.layers: list = []
+
+    def init_params(self, rng=None):
+        return {"weights": [w.copy() for w in self._float_values]}
+
+    def init(self, rng, input_shape=None):
+        return self.init_params(rng)
+
+    def _assemble(self, float_ws):
+        from analytics_zoo_tpu.tfpark.tf_graph import assemble_weights
+        return assemble_weights(float_ws, self._float_idx, self._consts,
+                                self._n)
+
+    def apply(self, params, x, *, training=False, rng=None):
+        xs = list(x) if isinstance(x, (list, tuple)) else [x]
+        full = self._assemble(params["weights"])
+        if training:
+            return self._train_fn(*full, *xs, rng=rng), {}
+        wi = [full[i] for i in self._infer_perm]
+        return self._infer_fn(*wi, *xs), {}
+
+    def forward(self, params, x, *, training=False, rng=None):
+        out, _ = self.apply(params, x, training=training, rng=rng)
+        return out
+
+    def regularization_loss(self, params):
+        import jax.numpy as jnp
+        return jnp.zeros((), jnp.float32)
+
+    def trainable_mask(self, params):
+        return {"weights": list(self._trainable)}
+
+
+class KerasModel:
+    """(reference `P/tfpark/model.py:28`)"""
+
+    def __init__(self, model, optimizer=None, loss=None, metrics=None):
+        tf = _tf()
+        self.model = model
+        if not model.inputs:
+            raise ValueError(
+                "the tf.keras model must be built (call it once or use "
+                "Input layers) before wrapping in KerasModel")
+        sig = [tf.TensorSpec([None] + list(t.shape[1:]), t.dtype)
+               for t in model.inputs]
+        n_in = len(sig)
+
+        def call_train(*xs):
+            return model(xs if n_in > 1 else xs[0], training=True)
+
+        def call_infer(*xs):
+            return model(xs if n_in > 1 else xs[0], training=False)
+
+        train_fn, train_vars = to_jax_fn(call_train, sig,
+                                         variables=model.variables)
+        infer_fn, infer_vars = to_jax_fn(call_infer, sig,
+                                         variables=model.variables)
+        # second trace may order/use variables differently; permute
+        perm = []
+        for v in infer_vars:
+            idx = next((i for i, t in enumerate(train_vars) if t is v),
+                       None)
+            if idx is None:
+                raise ValueError(
+                    f"inference graph reads variable {v.name} that the "
+                    "training graph does not")
+            perm.append(idx)
+        trainable_ids = {id(v) for v in model.trainable_variables}
+        self._vars = train_vars
+        self.net = _TFKerasNet(
+            train_fn, infer_fn,
+            [v.numpy() for v in train_vars],
+            [id(v) in trainable_ids for v in train_vars],
+            perm)
+
+        opt = optimizer if optimizer is not None else \
+            keras_optimizer_to_zoo(getattr(model, "optimizer", None))
+        lss = loss if loss is not None else \
+            keras_loss_to_zoo(getattr(model, "loss", None))
+        mets = metrics if metrics is not None else \
+            self._metric_names(model)
+        from analytics_zoo_tpu.pipeline.estimator import Estimator
+        self.estimator = Estimator(self.net, optimizer=opt, loss=lss,
+                                   metrics=mets)
+
+    @staticmethod
+    def _metric_names(model) -> List[str]:
+        names = []
+        for m in getattr(model, "metrics", []) or []:
+            n = getattr(m, "name", None)
+            if n in ("accuracy", "acc", "sparse_categorical_accuracy",
+                     "categorical_accuracy"):
+                names.append("accuracy")
+            elif n in ("mae", "mean_absolute_error"):
+                names.append("mae")
+        return names
+
+    # -- training surface (reference model.py:120-366) ---------------------
+    def fit(self, x, y=None, batch_size: int = 32, epochs: int = 1,
+            validation_data=None, distributed: bool = True, **kwargs):
+        del distributed  # always mesh-parallel
+        data, labels = self._unpack(x, y)
+        result = self.estimator.train(
+            data, labels, batch_size=batch_size, nb_epoch=epochs,
+            validation_data=validation_data, **kwargs)
+        self._assign_back()
+        return result
+
+    def evaluate(self, x, y=None, batch_size: int = 32,
+                 distributed: bool = True):
+        del distributed
+        data, labels = self._unpack(x, y)
+        return self.estimator.evaluate(data, labels,
+                                       batch_size=batch_size)
+
+    def predict(self, x, batch_size: int = 32,
+                distributed: bool = True) -> np.ndarray:
+        del distributed
+        data, _ = self._unpack(x, None)
+        return self.estimator.predict(data, batch_size=batch_size)
+
+    @staticmethod
+    def _unpack(x, y):
+        from analytics_zoo_tpu.pipeline.api.net import TFDataset
+        if isinstance(x, TFDataset):
+            return x.feature_set, None
+        return x, y
+
+    def _assign_back(self):
+        """Write trained weights into the live tf.keras variables."""
+        import jax
+        trained = jax.device_get(self.estimator.params)["weights"]
+        for fi, w in zip(self.net._float_idx, trained):
+            self._vars[fi].assign(np.asarray(w))
+        logger.info("KerasModel: %d trained weights assigned back into "
+                    "the tf.keras model", len(trained))
+
+    def save_weights(self, path: str):
+        self.model.save_weights(path)
+
+    def load_weights(self, path: str):
+        self.model.load_weights(path)
+        self.net._float_values = [
+            self._vars[i].numpy() for i in self.net._float_idx]
+        # re-seed estimator params if already initialized
+        if self.estimator.params is not None:
+            self.estimator.params = self.net.init_params()
+            self.estimator._train_step = None

@@ -1,0 +1,18 @@
+"""tfpark.text pre-built NLP models.
+
+Reference: `P/tfpark/text/*.py` — `IntentEntity`, `NER`,
+`SequenceTagger` wrap nlp-architect tf.keras models inside
+`TextKerasModel`. The TPU-native rebuild constructs the same
+architectures directly from the framework's own layer library (no TF
+dependency): embedding → BiLSTM stacks → per-token / per-sequence
+heads, trained with the standard Estimator.
+"""
+
+from analytics_zoo_tpu.tfpark.text.models import (  # noqa: F401
+    IntentEntity,
+    NER,
+    SequenceTagger,
+    TextKerasModel,
+)
+
+__all__ = ["TextKerasModel", "IntentEntity", "NER", "SequenceTagger"]

@@ -1,0 +1,152 @@
+"""Native NLP model builds for tfpark.text.
+
+Reference: `P/tfpark/text/keras/text_model.py` (`TextKerasModel` wraps
+an nlp-architect keras model) and its subclasses `IntentEntity`
+(`intent_entity.py`), `NER` (`ner.py`), `SequenceTagger`
+(`sequence_tagger.py`). Architectures are rebuilt from the zoo's own
+layer library; the reference's CRF output layer is replaced with a
+per-token softmax head (XLA-friendly: no Viterbi recursion in the
+train step).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.pipeline.api.keras import layers as L
+from analytics_zoo_tpu.pipeline.api.keras.engine import Input
+from analytics_zoo_tpu.pipeline.api.keras.models import Model, Sequential
+
+
+def _sparse_ce(labels, logits):
+    logp = jnp.log(jnp.maximum(logits, 1e-8))
+    lab = labels.astype(jnp.int32)
+    if lab.ndim == logp.ndim:  # (..., 1) trailing dim
+        lab = lab[..., 0]
+    onehot = jnp.take(jnp.eye(logp.shape[-1], dtype=logp.dtype), lab,
+                      axis=0)
+    return -jnp.mean(jnp.sum(onehot * logp, axis=-1))
+
+
+class TextKerasModel:
+    """Base wrapper: a zoo model + training glue (reference
+    `TextKerasModel`, `P/tfpark/text/keras/text_model.py`)."""
+
+    def __init__(self, model, optimizer="adam", loss=None,
+                 metrics: Optional[List[str]] = None):
+        self.model = model
+        self.labor = model  # reference field name for the inner model
+        model.compile(optimizer=optimizer,
+                      loss=loss or "sparse_categorical_crossentropy",
+                      metrics=metrics)
+
+    def fit(self, x, y, batch_size: int = 32, nb_epoch: int = 1, **kw):
+        return self.model.fit(x, y, batch_size=batch_size,
+                              nb_epoch=nb_epoch, **kw)
+
+    def evaluate(self, x, y, batch_size: int = 32):
+        return self.model.evaluate(x, y, batch_size=batch_size)
+
+    def predict(self, x, batch_size: int = 32):
+        return self.model.predict(x, batch_size=batch_size)
+
+    def save_model(self, path: str):
+        self.model.save_weights(path)
+
+    def load_weights(self, path: str):
+        self.model.load_weights(path)
+
+
+class NER(TextKerasModel):
+    """Named-entity recognition: embedding → BiLSTM → per-token softmax
+    (reference `P/tfpark/text/keras/ner.py`; CRF head → softmax)."""
+
+    def __init__(self, num_entities: int, word_vocab_size: int,
+                 word_length: int = 12, seq_len: int = 100,
+                 embed_dim: int = 100, lstm_dim: int = 100,
+                 dropout: float = 0.2, optimizer="adam"):
+        del word_length  # reference char-CNN branch: not rebuilt
+        self.seq_len = seq_len
+        net = Sequential(name="ner")
+        net.add(L.Embedding(word_vocab_size, embed_dim,
+                            input_shape=(seq_len,)))
+        net.add(L.Bidirectional(
+            L.LSTM(lstm_dim, return_sequences=True)))
+        net.add(L.Dropout(dropout))
+        net.add(L.TimeDistributed(L.Dense(num_entities,
+                                          activation="softmax")))
+        super().__init__(net, optimizer=optimizer, loss=_sparse_ce)
+
+    def predict_classes(self, x, batch_size: int = 32) -> np.ndarray:
+        probs = self.predict(x, batch_size=batch_size)
+        return np.argmax(probs, axis=-1)
+
+
+class SequenceTagger(TextKerasModel):
+    """POS/chunking tagger (reference
+    `P/tfpark/text/keras/sequence_tagger.py`)."""
+
+    def __init__(self, num_pos_labels: int, word_vocab_size: int,
+                 seq_len: int = 100, embed_dim: int = 100,
+                 lstm_dim: int = 64, num_lstm_layers: int = 2,
+                 dropout: float = 0.2, optimizer="adam"):
+        self.seq_len = seq_len
+        net = Sequential(name="sequence_tagger")
+        net.add(L.Embedding(word_vocab_size, embed_dim,
+                            input_shape=(seq_len,)))
+        for _ in range(num_lstm_layers):
+            net.add(L.Bidirectional(
+                L.LSTM(lstm_dim, return_sequences=True)))
+        net.add(L.Dropout(dropout))
+        net.add(L.TimeDistributed(L.Dense(num_pos_labels,
+                                          activation="softmax")))
+        super().__init__(net, optimizer=optimizer, loss=_sparse_ce)
+
+
+class IntentEntity(TextKerasModel):
+    """Joint intent classification + slot filling (reference
+    `P/tfpark/text/keras/intent_entity.py`).
+
+    Two heads over a shared BiLSTM encoder:
+    - intent: final-state dense softmax over `num_intents`;
+    - entities: per-token dense softmax over `num_entities`.
+    Labels for `fit` are packed as ``[intent_id, tag_1..tag_T]``
+    (shape ``(B, 1+seq_len)``).
+    """
+
+    def __init__(self, num_intents: int, num_entities: int,
+                 word_vocab_size: int, word_length: int = 12,
+                 seq_len: int = 100, embed_dim: int = 100,
+                 lstm_dim: int = 100, dropout: float = 0.2,
+                 optimizer="adam"):
+        del word_length
+        self.seq_len = seq_len
+        inp = Input(shape=(seq_len,), name="tokens")
+        emb = L.Embedding(word_vocab_size, embed_dim)(inp)
+        enc = L.Bidirectional(L.LSTM(lstm_dim,
+                                     return_sequences=True))(emb)
+        enc = L.Dropout(dropout)(enc)
+        last = L.Select(1, -1)(enc)
+        intent = L.Dense(num_intents, activation="softmax",
+                         name="intent_out")(last)
+        tags = L.TimeDistributed(
+            L.Dense(num_entities, activation="softmax"),
+            name="entity_out")(enc)
+        model = Model(inp, [intent, tags], name="intent_entity")
+
+        def joint_loss(y_true, y_pred):
+            intent_p, tag_p = y_pred
+            return (_sparse_ce(y_true[:, 0], intent_p) +
+                    _sparse_ce(y_true[:, 1:], tag_p))
+
+        super().__init__(model, optimizer=optimizer, loss=joint_loss)
+
+    @staticmethod
+    def pack_labels(intent_ids: np.ndarray,
+                    tag_ids: np.ndarray) -> np.ndarray:
+        intent_ids = np.asarray(intent_ids).reshape(-1, 1)
+        return np.concatenate(
+            [intent_ids, np.asarray(tag_ids)], axis=1).astype(np.int32)

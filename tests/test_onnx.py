@@ -579,6 +579,55 @@ def test_multi_output_graph(rng):
     assert_close(out[1], -x)
 
 
+def test_shape_start_end(rng):
+    x = rng.randn(2, 3, 4).astype(np.float32)
+    (s1,) = run_node(helper.make_node("Shape", ["x"], ["y"], start=1),
+                     [x])
+    np.testing.assert_array_equal(s1, [3, 4])
+    (s2,) = run_node(helper.make_node("Shape", ["x"], ["y"], end=-1),
+                     [x])
+    np.testing.assert_array_equal(s2, [2, 3])
+
+
+def test_softmax_opset_semantics(rng):
+    x = rng.randn(2, 3, 4).astype(np.float32)
+    # opset>=13: default axis -1
+    (s13,) = run_node(helper.make_node("Softmax", ["x"], ["y"]), [x])
+    assert_close(s13, F.softmax(_t(x), -1).numpy(), atol=1e-6)
+    # opset<13: default axis 1, flatten-to-2D coercion over C*H
+    (s11,) = run_node(helper.make_node("Softmax", ["x"], ["y"]), [x],
+                      opset=11)
+    flat = x.reshape(2, 12)
+    ref = F.softmax(_t(flat), -1).numpy().reshape(2, 3, 4)
+    assert_close(s11, ref, atol=1e-6)
+
+
+def test_resize_floor_sizes(rng):
+    x = rng.randn(1, 1, 5, 5).astype(np.float32)
+    node = helper.make_node("Resize", ["x", "roi", "scales"], ["y"],
+                            mode="nearest")
+    (out,) = run_node(node, [x, None,
+                             np.array([1, 1, 1.9, 1.9], np.float32)])
+    assert out.shape == (1, 1, 9, 9)  # floor(5*1.9)=9, not round->10
+
+
+def test_symbolic_nonbatch_dims_need_input_shape(rng):
+    nodes = [helper.make_node("Relu", ["x"], ["y"])]
+    graph = helper.make_graph(
+        nodes, "dyn",
+        [helper.make_tensor_value_info("x", TensorProto.FLOAT,
+                                       ["N", "H"])],
+        [helper.make_tensor_value_info("y", TensorProto.FLOAT,
+                                       ["N", "H"])])
+    proto = helper.make_model(graph)
+    with pytest.raises(ValueError, match="symbolic"):
+        OnnxLoader.load_model(proto)
+    net = OnnxLoader.load_model(proto, input_shape=(7,))
+    net.compile(optimizer="sgd", loss="mse")
+    x = rng.randn(3, 7).astype(np.float32)
+    assert_close(net.predict(x, batch_size=3), np.maximum(x, 0))
+
+
 def test_unsupported_op_raises():
     node = helper.make_node("NonexistentOp", ["x"], ["y"])
     with pytest.raises(NotImplementedError):

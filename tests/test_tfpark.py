@@ -1,0 +1,258 @@
+"""tfpark tests: explicit-weights TF bridge, KerasModel train +
+assign-back, TFEstimator model_fn API, native text models (reference
+analog: `pyzoo/test/zoo/tfpark/test_tfpark_model.py`,
+`test_tfpark_estimator.py`, SURVEY.md §4.6)."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+import jax  # noqa: E402
+
+from analytics_zoo_tpu.tfpark.tf_graph import (  # noqa: E402
+    make_explicit_fn,
+    to_jax_fn,
+)
+
+
+def _dense_model():
+    m = tf.keras.Sequential([
+        tf.keras.layers.Dense(8, activation="relu", input_shape=(4,)),
+        tf.keras.layers.Dense(3),
+    ])
+    return m
+
+
+# -- tf_graph -----------------------------------------------------------------
+
+def test_explicit_fn_forward_matches_tf(rng):
+    model = _dense_model()
+    fn, variables = to_jax_fn(
+        lambda x: model(x),
+        [tf.TensorSpec([None, 4], tf.float32)],
+        variables=model.variables)
+    ws = [v.numpy() for v in variables]
+    x = rng.randn(6, 4).astype(np.float32)
+    out = np.asarray(fn(*ws, x))
+    ref = model(x).numpy()
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+def test_explicit_fn_gradients_match_tf(rng):
+    model = _dense_model()
+    fn, variables = to_jax_fn(
+        lambda x: model(x),
+        [tf.TensorSpec([None, 4], tf.float32)],
+        variables=model.variables)
+    ws = [v.numpy() for v in variables]
+    x = rng.randn(6, 4).astype(np.float32)
+
+    grads = jax.grad(
+        lambda w: jax.numpy.sum(fn(*w, x) ** 2))(ws)
+    with tf.GradientTape() as t:
+        loss = tf.reduce_sum(model(x) ** 2)
+    ref = t.gradient(loss, variables)
+    for g, r in zip(grads, ref):
+        np.testing.assert_allclose(np.asarray(g), r.numpy(),
+                                   rtol=1e-3, atol=1e-3)
+
+
+def test_explicit_fn_under_jit(rng):
+    model = _dense_model()
+    fn, variables = to_jax_fn(
+        lambda x: model(x),
+        [tf.TensorSpec([None, 4], tf.float32)],
+        variables=model.variables)
+    ws = [v.numpy() for v in variables]
+    x = rng.randn(2, 4).astype(np.float32)
+    jitted = jax.jit(lambda w, x: fn(*w, x))
+    np.testing.assert_allclose(np.asarray(jitted(ws, x)),
+                               model(x).numpy(), atol=1e-5)
+
+
+def test_explicit_fn_raw_tf_variable(rng):
+    w = tf.Variable(np.ones((3, 2), np.float32))
+
+    fn, variables = to_jax_fn(
+        lambda x: tf.matmul(x, w),
+        [tf.TensorSpec([None, 3], tf.float32)])
+    assert len(variables) == 1 and variables[0] is w
+    x = rng.randn(4, 3).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(fn(w.numpy(), x)),
+                               x @ np.ones((3, 2), np.float32),
+                               atol=1e-6)
+
+
+# -- KerasModel ---------------------------------------------------------------
+
+def test_keras_model_fit_and_assign_back(rng):
+    from analytics_zoo_tpu import init_nncontext
+    from analytics_zoo_tpu.tfpark import KerasModel
+    init_nncontext(tpu_mesh={"data": 1}, devices=jax.devices("cpu")[:1])
+
+    model = _dense_model()
+    model.compile(optimizer=tf.keras.optimizers.Adam(0.05), loss="mse")
+    km = KerasModel(model)
+
+    x = rng.randn(64, 4).astype(np.float32)
+    true_w = rng.randn(4, 3).astype(np.float32)
+    y = x @ true_w
+    before_w = [v.numpy().copy() for v in model.variables]
+    before_loss = km.evaluate(x, y, batch_size=32)["loss"]
+    km.fit(x, y, batch_size=32, epochs=25)
+    after_loss = km.evaluate(x, y, batch_size=32)["loss"]
+    assert after_loss < before_loss * 0.5, (before_loss, after_loss)
+    # assign-back: tf.keras variables now hold the trained weights
+    changed = any(
+        not np.allclose(b, v.numpy())
+        for b, v in zip(before_w, model.variables))
+    assert changed
+    # and the live tf.keras model predicts like the zoo path
+    np.testing.assert_allclose(
+        km.predict(x, batch_size=32), model(x).numpy(), atol=1e-4)
+
+
+def test_keras_model_with_dropout_trains(rng):
+    from analytics_zoo_tpu import init_nncontext
+    from analytics_zoo_tpu.tfpark import KerasModel
+    init_nncontext(tpu_mesh={"data": 1}, devices=jax.devices("cpu")[:1])
+    model = tf.keras.Sequential([
+        tf.keras.layers.Dense(16, activation="relu", input_shape=(4,)),
+        tf.keras.layers.Dropout(0.2),
+        tf.keras.layers.Dense(1),
+    ])
+    model.compile(optimizer="adam", loss="mse")
+    km = KerasModel(model)
+    x = rng.randn(32, 4).astype(np.float32)
+    y = x.sum(axis=1, keepdims=True).astype(np.float32)
+    km.fit(x, y, batch_size=16, epochs=3)
+    out = km.predict(x, batch_size=16)
+    assert out.shape == (32, 1)
+
+
+def test_keras_model_validation_data(rng):
+    from analytics_zoo_tpu import init_nncontext
+    from analytics_zoo_tpu.tfpark import KerasModel
+    init_nncontext(tpu_mesh={"data": 1}, devices=jax.devices("cpu")[:1])
+    model = _dense_model()
+    model.compile(optimizer="adam", loss="mse")
+    km = KerasModel(model)
+    x = rng.randn(32, 4).astype(np.float32)
+    y = rng.randn(32, 3).astype(np.float32)
+    result = km.fit(x, y, batch_size=16, epochs=2,
+                    validation_data=(x[:8], y[:8]))
+    assert any("val_loss" in h for h in result.history)
+
+
+def test_explicit_fn_nonresource_capture(rng):
+    c = tf.constant(np.array([2.0, 3.0, 4.0], np.float32))
+    fn, variables = to_jax_fn(
+        lambda x: x * c, [tf.TensorSpec([None, 3], tf.float32)])
+    assert variables == []
+    x = rng.randn(4, 3).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(fn(x)), x * np.array(
+        [2.0, 3.0, 4.0], np.float32), atol=1e-6)
+
+
+def test_dropout_mask_varies_with_rng(rng):
+    model = tf.keras.Sequential([
+        tf.keras.layers.Dropout(0.5, input_shape=(64,)),
+    ])
+    fn, variables = to_jax_fn(
+        lambda x: model(x, training=True),
+        [tf.TensorSpec([None, 64], tf.float32)],
+        variables=model.variables)
+    ws = [v.numpy() for v in variables]
+    x = np.ones((2, 64), np.float32)
+    a = np.asarray(fn(*ws, x, rng=jax.random.PRNGKey(1)))
+    b = np.asarray(fn(*ws, x, rng=jax.random.PRNGKey(2)))
+    assert not np.allclose(a, b)  # different step rng -> different mask
+    c = np.asarray(fn(*ws, x, rng=jax.random.PRNGKey(1)))
+    np.testing.assert_allclose(a, c)  # same rng -> reproducible
+
+
+# -- TFEstimator --------------------------------------------------------------
+
+def test_tf_estimator_train_eval_predict(rng):
+    from analytics_zoo_tpu import init_nncontext
+    from analytics_zoo_tpu.pipeline.api.net import TFDataset
+    from analytics_zoo_tpu.tfpark import TFEstimator, TFEstimatorSpec
+    init_nncontext(tpu_mesh={"data": 1}, devices=jax.devices("cpu")[:1])
+
+    def model_fn(features, labels, mode):
+        w = tf.Variable(np.zeros((3, 1), np.float32), name="w")
+        b = tf.Variable(np.zeros((1,), np.float32), name="b")
+        pred = tf.matmul(features, w) + b
+        if mode == "train":
+            loss = tf.reduce_mean((pred - labels) ** 2)
+            return TFEstimatorSpec(mode, predictions=pred, loss=loss)
+        return TFEstimatorSpec(mode, predictions=pred)
+
+    true_w = np.array([[1.0], [-2.0], [0.5]], np.float32)
+    x = rng.randn(128, 3).astype(np.float32)
+    y = x @ true_w + 0.3
+
+    est = TFEstimator(model_fn, optimizer="adam")
+    from analytics_zoo_tpu.ops.optimizers import Adam
+    est.optimizer = Adam(lr=0.1)
+
+    def input_fn():
+        return TFDataset.from_ndarrays(x, y, batch_size=32)
+
+    est.train(input_fn, nb_epoch=40)
+    metrics = est.evaluate(input_fn)
+    assert metrics["loss"] < 0.05, metrics
+
+    def pred_input_fn():
+        return TFDataset.from_ndarrays(x, batch_size=32)
+
+    preds = est.predict(pred_input_fn)
+    assert preds.shape == (128, 1)
+    np.testing.assert_allclose(preds, y, atol=0.5)
+
+
+# -- text models (native) -----------------------------------------------------
+
+def test_ner_shapes_and_training(rng):
+    from analytics_zoo_tpu import init_nncontext
+    from analytics_zoo_tpu.tfpark.text import NER
+    init_nncontext(tpu_mesh={"data": 1}, devices=jax.devices("cpu")[:1])
+    ner = NER(num_entities=5, word_vocab_size=50, seq_len=12,
+              embed_dim=16, lstm_dim=8)
+    x = rng.randint(0, 50, (16, 12)).astype(np.int32)
+    y = rng.randint(0, 5, (16, 12)).astype(np.int32)
+    ner.fit(x, y, batch_size=8, nb_epoch=2)
+    probs = ner.predict(x, batch_size=8)
+    assert probs.shape == (16, 12, 5)
+    np.testing.assert_allclose(probs.sum(-1), 1.0, atol=1e-4)
+    classes = ner.predict_classes(x, batch_size=8)
+    assert classes.shape == (16, 12)
+
+
+def test_sequence_tagger(rng):
+    from analytics_zoo_tpu import init_nncontext
+    from analytics_zoo_tpu.tfpark.text import SequenceTagger
+    init_nncontext(tpu_mesh={"data": 1}, devices=jax.devices("cpu")[:1])
+    tagger = SequenceTagger(num_pos_labels=4, word_vocab_size=30,
+                            seq_len=8, embed_dim=12, lstm_dim=6,
+                            num_lstm_layers=2)
+    x = rng.randint(0, 30, (8, 8)).astype(np.int32)
+    y = rng.randint(0, 4, (8, 8)).astype(np.int32)
+    tagger.fit(x, y, batch_size=4, nb_epoch=1)
+    assert tagger.predict(x, batch_size=4).shape == (8, 8, 4)
+
+
+def test_intent_entity_joint(rng):
+    from analytics_zoo_tpu import init_nncontext
+    from analytics_zoo_tpu.tfpark.text import IntentEntity
+    init_nncontext(tpu_mesh={"data": 1}, devices=jax.devices("cpu")[:1])
+    m = IntentEntity(num_intents=3, num_entities=4, word_vocab_size=40,
+                     seq_len=10, embed_dim=12, lstm_dim=8)
+    x = rng.randint(0, 40, (12, 10)).astype(np.int32)
+    labels = IntentEntity.pack_labels(
+        rng.randint(0, 3, (12,)), rng.randint(0, 4, (12, 10)))
+    m.fit(x, labels, batch_size=4, nb_epoch=2)
+    intent, tags = m.predict(x, batch_size=4)
+    assert intent.shape == (12, 3)
+    assert tags.shape == (12, 10, 4)
