@@ -34,4 +34,12 @@ __all__ = [
     "get_nncontext",
     "NNContext",
     "ZooTpuConf",
+    "Net",
 ]
+
+
+def __getattr__(name):
+    if name == "Net":  # lazy: pulls in jax/layer machinery
+        from analytics_zoo_tpu.pipeline.api.net_load import Net
+        return Net
+    raise AttributeError(name)

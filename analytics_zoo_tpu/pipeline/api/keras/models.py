@@ -128,10 +128,15 @@ class KerasNet(KerasLayer):
     def compile(self, optimizer="adam", loss="mse", metrics=None):
         """Configure training (reference `KerasNet.compile`,
         `Topology.scala:128-184`; accepts string names, optimizer objects,
-        loss callables incl. `autograd.CustomLoss`)."""
+        loss callables incl. `autograd.CustomLoss`). Re-compiling keeps
+        already-initialized weights (keras semantics — imported/trained
+        params survive an optimizer/loss change)."""
         from analytics_zoo_tpu.pipeline.estimator import Estimator
+        old = getattr(self, "_estimator", None)
         self._estimator = Estimator(self, optimizer=optimizer, loss=loss,
                                     metrics=metrics)
+        if old is not None and old.params is not None:
+            self._estimator.params = old.params
         return self
 
     @property

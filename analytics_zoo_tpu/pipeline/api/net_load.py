@@ -1,0 +1,312 @@
+"""Interop model loaders (reference `Z/pipeline/api/Net.scala:91-189`:
+`Net.load{BigDL,Torch,Caffe,TF,Keras}`).
+
+TPU-native mapping:
+- :meth:`Net.load` — the framework's own saved models
+  (`ZooModel.save_model` pickles / `save_weights` npz), restored through
+  the class-whitelist safe unpickler (reference
+  `CheckedObjectInputStream`, SURVEY.md §2.1).
+- :meth:`Net.load_torch` — imports a `torch.nn.Sequential` of standard
+  modules into native zoo layers (weights transposed to our layouts:
+  Dense kernel (in,out), conv kernel HWIO) so the result runs as pure
+  XLA on TPU; the reference loaded legacy Torch7 `.t7` files.
+- :meth:`Net.load_keras` — tf.keras `.keras`/`.h5` files via
+  `tf.keras.models.load_model` + the tfpark GraphDef→XLA bridge.
+- :meth:`Net.load_tf` — SavedModel / frozen GraphDef via `TFNet`.
+- :meth:`Net.load_caffe` — unsupported in this image (no caffe); raises
+  with guidance (convert to ONNX and use `OnnxLoader`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from analytics_zoo_tpu.common.nncontext import logger
+
+
+class Net:
+    """(reference `pipeline/api/Net.scala:40-189`)"""
+
+    @staticmethod
+    def load(path: str):
+        """Load a model saved by `ZooModel.save_model` (safe pickle)."""
+        from analytics_zoo_tpu.models.common import ZooModel
+        return ZooModel.load_model(path)
+
+    @staticmethod
+    def load_tf(path: str, inputs: Optional[Sequence[str]] = None,
+                outputs: Optional[Sequence[str]] = None):
+        """SavedModel dir or frozen `.pb` → `TFNet` (reference
+        `Net.loadTF`)."""
+        from analytics_zoo_tpu.pipeline.api.net import TFNet
+        import os
+        if os.path.isdir(path):
+            return TFNet.from_saved_model(path)
+        if inputs is None or outputs is None:
+            raise ValueError(
+                "frozen-graph import needs inputs=[...] and "
+                "outputs=[...] tensor names")
+        return TFNet.from_frozen_graph(path, inputs, outputs)
+
+    @staticmethod
+    def load_keras(path_or_model, by_name: bool = False):
+        """tf.keras model file → trainable `tfpark.KerasModel`
+        (reference `Net.loadKeras`; `by_name` kept for API parity)."""
+        del by_name
+        import tensorflow as tf
+
+        from analytics_zoo_tpu.tfpark import KerasModel
+        model = (path_or_model
+                 if isinstance(path_or_model, tf.keras.Model)
+                 else tf.keras.models.load_model(path_or_model))
+        if not getattr(model, "optimizer", None):
+            model.compile(optimizer="adam", loss="mse")
+        return KerasModel(model)
+
+    @staticmethod
+    def load_caffe(def_path: str, model_path: str):
+        raise NotImplementedError(
+            "caffe is not available in this environment; convert the "
+            "model to ONNX and use "
+            "analytics_zoo_tpu.pipeline.api.onnx.OnnxLoader instead")
+
+    @staticmethod
+    def load_bigdl(path: str, weight_path: Optional[str] = None):
+        raise NotImplementedError(
+            "BigDL java serialization is JVM-specific; export the "
+            "model to ONNX or TF SavedModel and use OnnxLoader / "
+            "Net.load_tf")
+
+    # -- torch import -------------------------------------------------------
+    @staticmethod
+    def load_torch(module_or_path, input_shape) -> Any:
+        """Import a `torch.nn.Sequential` (or a path to a pickled one /
+        state-dict-compatible module) into a native zoo `Sequential`.
+
+        ``input_shape`` excludes the batch dim and uses torch's
+        channels-first layout for images (C, H, W). Weights are copied
+        in, so the returned model predicts identically (and can be
+        fine-tuned natively on TPU).
+        """
+        import torch
+
+        module = module_or_path
+        if isinstance(module_or_path, str):
+            module = torch.load(module_or_path, weights_only=False)
+        if not isinstance(module, torch.nn.Module):
+            raise TypeError(f"expected torch.nn.Module, got "
+                            f"{type(module)}")
+        zoo_layers, weight_map = _torch_to_zoo(module)
+        from analytics_zoo_tpu.pipeline.api.keras.models import \
+            Sequential
+        net = Sequential()
+        first = True
+        for lyr in zoo_layers:
+            if first:
+                lyr._given_input_shape = tuple(input_shape)
+                first = False
+            net.add(lyr)
+        net.compile(optimizer="sgd", loss="mse")
+        est = net.estimator
+        est._ensure_initialized()
+        import jax
+        params = jax.device_get(est.params)
+        for layer_name, assignments in weight_map.items():
+            sub = params[layer_name]
+            for key, value in assignments.items():
+                if key == "_state":
+                    for sk, sv in value.items():
+                        _check_and_set(sub["_state"], sk, sv,
+                                       layer_name)
+                else:
+                    _check_and_set(sub, key, value, layer_name)
+        from analytics_zoo_tpu.parallel.mesh import shard_params
+        est.params = shard_params(params, est.ctx.mesh)
+        est._train_step = None
+        est._predict_fn = None
+        logger.info("load_torch: imported %d layers, %d weighted",
+                    len(zoo_layers), len(weight_map))
+        return net
+
+
+def _check_and_set(sub: dict, key: str, value: np.ndarray, name: str):
+    if key not in sub:
+        raise KeyError(f"layer {name} has no param {key!r}")
+    if tuple(sub[key].shape) != tuple(value.shape):
+        raise ValueError(
+            f"{name}.{key}: shape {tuple(value.shape)} does not match "
+            f"model {tuple(sub[key].shape)}")
+    sub[key] = np.ascontiguousarray(value)
+
+
+def _flatten_torch(module):
+    import torch.nn as nn
+    if isinstance(module, nn.Sequential):
+        out = []
+        for child in module.children():
+            out.extend(_flatten_torch(child))
+        return out
+    return [module]
+
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def _torch_to_zoo(module):
+    """torch modules → (zoo layers, {zoo_layer_name: param assignments}).
+
+    Images stay in torch's NCHW layout via ``dim_ordering="th"`` — no
+    transpose nodes; XLA lays out either ordering onto the MXU.
+    """
+    import torch.nn as nn
+
+    from analytics_zoo_tpu.pipeline.api.keras import layers as L
+
+    zoo_layers = []
+    weights = {}
+
+    def emit(layer, assignments=None):
+        zoo_layers.append(layer)
+        if assignments:
+            weights[id(layer)] = assignments
+        return layer
+
+    for m in _flatten_torch(module):
+        if isinstance(m, nn.Identity):
+            continue
+        if isinstance(m, nn.Linear):
+            lyr = emit(L.Dense(m.out_features, bias=m.bias is not None))
+            asg = {"kernel": m.weight.detach().numpy().T}
+            if m.bias is not None:
+                asg["bias"] = m.bias.detach().numpy()
+            weights[id(lyr)] = asg
+        elif isinstance(m, nn.Conv2d):
+            if m.groups != 1:
+                raise NotImplementedError("grouped torch Conv2d")
+            pad = _pair(m.padding) if not isinstance(m.padding, str) \
+                else m.padding
+            if pad not in ("same", "valid") and any(pad):
+                emit(L.ZeroPadding2D(padding=pad, dim_ordering="th"))
+                border = "valid"
+            else:
+                border = pad if isinstance(pad, str) else "valid"
+            lyr = emit(L.Convolution2D(
+                m.out_channels, *_pair(m.kernel_size),
+                subsample=_pair(m.stride), border_mode=border,
+                dilation=_pair(m.dilation), dim_ordering="th",
+                bias=m.bias is not None))
+            # torch (O, I, kH, kW) → HWIO
+            asg = {"kernel":
+                   m.weight.detach().numpy().transpose(2, 3, 1, 0)}
+            if m.bias is not None:
+                asg["bias"] = m.bias.detach().numpy()
+            weights[id(lyr)] = asg
+        elif isinstance(m, (nn.MaxPool2d, nn.AvgPool2d)):
+            pad = _pair(m.padding)
+            if any(pad):
+                if isinstance(m, nn.AvgPool2d):
+                    raise NotImplementedError(
+                        "padded torch AvgPool2d (zero-inclusion "
+                        "semantics differ)")
+                emit(L.ZeroPadding2D(padding=pad, dim_ordering="th"))
+            cls = (L.MaxPooling2D if isinstance(m, nn.MaxPool2d)
+                   else L.AveragePooling2D)
+            stride = m.stride if m.stride is not None \
+                else m.kernel_size
+            emit(cls(pool_size=_pair(m.kernel_size),
+                     strides=_pair(stride), dim_ordering="th"))
+        elif isinstance(m, nn.AdaptiveAvgPool2d):
+            if _pair(m.output_size) != (1, 1):
+                raise NotImplementedError(
+                    "AdaptiveAvgPool2d only for output_size=1")
+            emit(L.GlobalAveragePooling2D(dim_ordering="th"))
+        elif isinstance(m, nn.BatchNorm2d):
+            lyr = emit(L.BatchNormalization(epsilon=m.eps,
+                                            momentum=1.0 - m.momentum,
+                                            dim_ordering="th"))
+            weights[id(lyr)] = {
+                "gamma": m.weight.detach().numpy(),
+                "beta": m.bias.detach().numpy(),
+                "_state": {
+                    "moving_mean": m.running_mean.detach().numpy(),
+                    "moving_var": m.running_var.detach().numpy(),
+                },
+            }
+        elif isinstance(m, nn.BatchNorm1d):
+            lyr = emit(L.BatchNormalization(epsilon=m.eps,
+                                            momentum=1.0 - m.momentum))
+            weights[id(lyr)] = {
+                "gamma": m.weight.detach().numpy(),
+                "beta": m.bias.detach().numpy(),
+                "_state": {
+                    "moving_mean": m.running_mean.detach().numpy(),
+                    "moving_var": m.running_var.detach().numpy(),
+                },
+            }
+        elif isinstance(m, nn.LayerNorm):
+            lyr = emit(L.LayerNormalization(epsilon=m.eps))
+            weights[id(lyr)] = {
+                "gamma": m.weight.detach().numpy(),
+                "beta": m.bias.detach().numpy(),
+            }
+        elif isinstance(m, nn.Embedding):
+            lyr = emit(L.Embedding(m.num_embeddings, m.embedding_dim))
+            weights[id(lyr)] = {
+                "embeddings": m.weight.detach().numpy()}
+        elif isinstance(m, nn.Flatten):
+            emit(L.Flatten())
+        elif isinstance(m, nn.Dropout):
+            emit(L.Dropout(m.p))
+        elif isinstance(m, nn.ReLU):
+            emit(L.Activation("relu"))
+        elif isinstance(m, nn.Sigmoid):
+            emit(L.Activation("sigmoid"))
+        elif isinstance(m, nn.Tanh):
+            emit(L.Activation("tanh"))
+        elif isinstance(m, nn.GELU):
+            emit(L.Activation("gelu"))
+        elif isinstance(m, nn.SiLU):
+            emit(L.Activation("silu" if _has_act("silu") else "swish"))
+        elif isinstance(m, nn.Softmax):
+            emit(L.Activation("softmax"))
+        elif isinstance(m, nn.LeakyReLU):
+            emit(L.LeakyReLU(alpha=m.negative_slope))
+        elif isinstance(m, nn.ELU):
+            emit(L.ELU(alpha=m.alpha))
+        else:
+            raise NotImplementedError(
+                f"no zoo mapping for torch module {type(m).__name__}; "
+                "export to ONNX and use OnnxLoader for full coverage")
+
+    # resolve id()-keyed weights to final canonical layer names AFTER
+    # Sequential renames them — caller builds the Sequential, so defer
+    # by returning a name map bound late
+    return zoo_layers, _LateNameMap(zoo_layers, weights)
+
+
+def _has_act(name: str) -> bool:
+    from analytics_zoo_tpu.ops import activations
+    try:
+        return activations.get(name) is not None
+    except Exception:
+        return False
+
+
+class _LateNameMap:
+    """Maps layer-id-keyed weight assignments to layer NAMES lazily —
+    Sequential canonicalizes names at add() time, after construction."""
+
+    def __init__(self, layers, by_id):
+        self._layers = layers
+        self._by_id = by_id
+
+    def items(self):
+        for lyr in self._layers:
+            if id(lyr) in self._by_id:
+                yield lyr.name, self._by_id[id(lyr)]
+
+    def __len__(self):
+        return len(self._by_id)

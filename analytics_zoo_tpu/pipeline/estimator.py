@@ -425,6 +425,10 @@ class Estimator:
             bsize = _batch_dim(xb)
             if bsize % dp:  # tail must divide the data axis; trim the
                 keep = bsize - bsize % dp  # last <dp samples
+                logger.warning(
+                    "evaluate: dropping %d tail samples (batch %d not "
+                    "divisible by data-parallel size %d)",
+                    bsize - keep, bsize, dp)
                 if keep == 0:
                     continue
                 xb = _trim_batch(xb, keep)
@@ -500,8 +504,8 @@ class Estimator:
         else:
             with open(os.path.join(path, "LATEST")) as f:
                 fname = os.path.join(path, f.read().strip())
-        with open(fname, "rb") as f:
-            state = pickle.load(f)
+        from analytics_zoo_tpu.common.safe_pickle import checked_load
+        state = checked_load(fname)  # class-whitelist deserialization
         params = state["params"]
         _check_params_compatible(self.model, params)
         self.params = self._place_params(params)

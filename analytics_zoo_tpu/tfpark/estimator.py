@@ -124,6 +124,8 @@ class TFEstimator:
         self._estimator = None
         self._feature_spec = None
         self._label_spec = None
+        self._eval_fn = None
+        self._eval_perm: list = []
 
     # -- lazy build on first data ------------------------------------------
     def _specs_from_batch(self, features, labels):
@@ -199,6 +201,38 @@ class TFEstimator:
                     "mode-independent")
             perm.append(idx)
 
+        # eval-mode graph (reference ModeKeys.EVAL): dropout off etc.;
+        # falls back to the train graph if model_fn only handles
+        # train/infer
+        def eval_trace(*args):
+            feats = list(args[:n_feat])
+            lab = args[n_feat] if len(args) > n_feat else None
+            spec = self.model_fn(
+                feats if n_feat > 1 else feats[0], lab, "eval")
+            if spec.loss is None:
+                raise ValueError("model_fn(mode='eval') must set loss")
+            return spec.loss
+
+        self._eval_fn, self._eval_perm = None, []
+        with tf.variable_creator_scope(self._store.creator):
+            try:
+                eval_fn, eval_vars = to_jax_fn(
+                    eval_trace, sig, variables=self._store.variables)
+                self._eval_perm = []
+                for v in eval_vars:
+                    idx = next((i for i, t in enumerate(train_vars)
+                                if t is v), None)
+                    if idx is None:
+                        raise ValueError(
+                            f"eval graph reads variable {v.name} "
+                            "unknown to the training graph")
+                    self._eval_perm.append(idx)
+                self._eval_fn = eval_fn
+            except Exception as e:  # noqa: BLE001 — model_fn is user code
+                logger.warning(
+                    "TFEstimator: no eval-mode graph (%s); evaluate() "
+                    "will use the training graph", e)
+
         self._net = _TFEstimatorNet(
             loss_fn, pred_fn, [v.numpy() for v in train_vars], perm)
         from analytics_zoo_tpu.pipeline.estimator import Estimator
@@ -244,8 +278,16 @@ class TFEstimator:
         import jax
         loss_sum, count = 0.0, 0
         bs = getattr(dataset, "batch_size", batch_size)
-        fwd = jax.jit(
-            lambda p, x: self._net.forward(p, x, training=True))
+        if self._eval_fn is not None:
+            eval_fn, eperm = self._eval_fn, self._eval_perm
+
+            def fwd_fn(p, x):
+                full = self._net._assemble(p["weights"])
+                return eval_fn(*[full[i] for i in eperm], *x)
+        else:
+            def fwd_fn(p, x):
+                return self._net.forward(p, x, training=True)
+        fwd = jax.jit(fwd_fn)
         params = (self._estimator.params or self._net.init_params())
         for xb, yb in dataset.iter_batches(bs, shuffle=False,
                                            drop_last=False):

@@ -358,11 +358,19 @@ def _tf_splitv(node, i):
 @_op("GatherV2", "Gather", "ResourceGather")
 def _gather(node, i):
     axis = int(_static(i[2])) if len(i) > 2 else 0
+    batch_dims = _attr(node, "batch_dims", 0) or 0
     idx = i[1]
     if _is_jax(idx):
         idx = idx.astype(jnp.int32)
     else:
         idx = np.asarray(idx).astype(np.int32)
+    if batch_dims:
+        if axis < 0:
+            axis += np.ndim(i[0])
+        fn = lambda p, ix: jnp.take(p, ix, axis=axis - batch_dims)  # noqa: E731
+        for _ in range(batch_dims):
+            fn = jax.vmap(fn)
+        return fn(jnp.asarray(i[0]), idx)
     if not _is_jax(i[0]) and not _is_jax(idx):
         return np.take(i[0], idx, axis=axis)
     return jnp.take(i[0], idx, axis=axis)

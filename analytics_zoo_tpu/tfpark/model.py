@@ -195,7 +195,11 @@ class KerasModel:
         self.model.load_weights(path)
         self.net._float_values = [
             self._vars[i].numpy() for i in self.net._float_idx]
-        # re-seed estimator params if already initialized
-        if self.estimator.params is not None:
-            self.estimator.params = self.net.init_params()
-            self.estimator._train_step = None
+        # re-seed estimator params if already initialized: place on the
+        # mesh and drop stale optimizer state (Adam moments belong to
+        # the OLD weights)
+        est = self.estimator
+        if est.params is not None:
+            est.params = est._place_params(self.net.init_params())
+            est.opt_state = None
+            est._train_step = None

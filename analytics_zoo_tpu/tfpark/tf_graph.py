@@ -104,25 +104,58 @@ def _rewrite(fn: Callable, input_signature: Sequence,
     const_reads: dict = {}  # read output tensor name -> constant value
     swapped = set()
     new_nodes = []
+
+    def _weight_placeholder(name, dtype_attr, src):
+        """Placeholder standing in for a variable value; records how it
+        gets fed (weight arg vs baked constant)."""
+        if src in ph_to_var:
+            vi = ph_to_var[src]
+            read_map[name + ":0"] = vi
+            var_shape = used_vars[vi].shape
+        else:
+            const_reads[name + ":0"] = ph_to_const[src]
+            var_shape = np.shape(ph_to_const[src])
+        ph = tf.compat.v1.NodeDef()
+        ph.name = name
+        ph.op = "Placeholder"
+        ph.attr["dtype"].type = dtype_attr
+        ph.attr["shape"].shape.CopyFrom(
+            tf.TensorShape(var_shape).as_proto())
+        return ph
+
     for node in gd.node:
         src = node.input[0].split(":")[0] if node.input else ""
         if node.op == "ReadVariableOp" and (src in ph_to_var or
                                             src in ph_to_const):
-            if src in ph_to_var:
-                vi = ph_to_var[src]
-                read_map[node.name + ":0"] = vi
-                var_shape = used_vars[vi].shape
-            else:
-                const_reads[node.name + ":0"] = ph_to_const[src]
-                var_shape = np.shape(ph_to_const[src])
             swapped.add(node.name)
-            ph = tf.compat.v1.NodeDef()
-            ph.name = node.name
-            ph.op = "Placeholder"
-            ph.attr["dtype"].type = node.attr["dtype"].type
-            ph.attr["shape"].shape.CopyFrom(
-                tf.TensorShape(var_shape).as_proto())
-            new_nodes.append(ph)
+            new_nodes.append(_weight_placeholder(
+                node.name, node.attr["dtype"].type, src))
+        elif node.op == "ResourceGather" and (src in ph_to_var or
+                                              src in ph_to_const):
+            # tf.keras Embedding: gathers FROM the resource directly.
+            # Split into params-placeholder + axis const + GatherV2.
+            ph_name = node.name + "/params"
+            new_nodes.append(_weight_placeholder(
+                ph_name, node.attr["dtype"].type, src))
+            axis_name = node.name + "/axis"
+            axis_node = tf.compat.v1.NodeDef()
+            axis_node.name = axis_name
+            axis_node.op = "Const"
+            axis_node.attr["dtype"].type = tf.int32.as_datatype_enum
+            axis_node.attr["value"].tensor.CopyFrom(
+                tf.make_tensor_proto(0, dtype=tf.int32))
+            new_nodes.append(axis_node)
+            gather = tf.compat.v1.NodeDef()
+            gather.name = node.name
+            gather.op = "GatherV2"
+            gather.input.extend([ph_name, node.input[1], axis_name])
+            gather.attr["Tparams"].type = node.attr["dtype"].type
+            gather.attr["Tindices"].CopyFrom(node.attr["Tindices"])
+            gather.attr["Taxis"].type = tf.int32.as_datatype_enum
+            if "batch_dims" in node.attr:
+                gather.attr["batch_dims"].CopyFrom(
+                    node.attr["batch_dims"])
+            new_nodes.append(gather)
         elif node.op == "Placeholder" and (node.name in ph_to_var or
                                            node.name in ph_to_const):
             continue
@@ -132,11 +165,26 @@ def _rewrite(fn: Callable, input_signature: Sequence,
         else:
             new_nodes.append(node)
 
-    # -- 4./5. strip control edges to swapped/stripped nodes --------------
+    # any remaining consumer of a dropped resource placeholder is an
+    # op the rewrite does not understand — fail with the op names
+    # rather than a KeyError deep in the interpreter
+    dropped = set(ph_to_var) | set(ph_to_const)
+    leftovers = sorted({n.op for n in new_nodes
+                        if any(x.split(":")[0] in dropped
+                               for x in n.input
+                               if not x.startswith("^"))})
+    if leftovers:
+        raise NotImplementedError(
+            f"ops {leftovers} consume tf.Variable resources directly; "
+            "the explicit-weights rewrite only handles ReadVariableOp "
+            "and ResourceGather")
+
+    # -- 4./5. strip control edges to swapped/stripped/dropped nodes ------
+    gone = swapped | dropped
     for node in new_nodes:
         if any(i.startswith("^") for i in node.input):
             kept = [i for i in node.input
-                    if not (i.startswith("^") and i[1:] in swapped)]
+                    if not (i.startswith("^") and i[1:] in gone)]
             del node.input[:]
             node.input.extend(kept)
 
@@ -158,6 +206,7 @@ def _rewrite(fn: Callable, input_signature: Sequence,
 
 def make_explicit_fn(fn: Callable, input_signature: Sequence,
                      variables: Optional[Sequence] = None,
+                     _rewritten: Optional[_Rewritten] = None,
                      ) -> Tuple[Callable, List]:
     """Rewrite ``fn`` (TF ops; may read `tf.Variable`s) into a pure TF
     function ``g(*weights, *inputs)`` suitable for `jax2tf.call_tf`.
@@ -168,7 +217,7 @@ def make_explicit_fn(fn: Callable, input_signature: Sequence,
     (the reference's weights→session contract, `net.py:703-714`).
     """
     tf = _tf()
-    rw = _rewrite(fn, input_signature, variables)
+    rw = _rewritten or _rewrite(fn, input_signature, variables)
     n_w = len(rw.used_vars)
 
     def import_fn(*args):
@@ -227,7 +276,8 @@ def to_jax_fn(fn: Callable, input_signature: Sequence,
             "graphdef_jax: ops %s not interpreted; falling back to "
             "jax2tf.call_tf (CPU-only TF kernels)", missing)
     from jax.experimental import jax2tf
-    wrapped, used_vars = make_explicit_fn(fn, input_signature, variables)
+    wrapped, used_vars = make_explicit_fn(fn, input_signature, variables,
+                                          _rewritten=rw)
     ctf = jax2tf.call_tf(wrapped)
 
     def jax_fn(*args, rng=None):
@@ -273,7 +323,17 @@ def keras_optimizer_to_zoo(optimizer):
         return optimizer  # let ops.optimizers.get resolve it
     name = type(optimizer).__name__.lower()
     lr = optimizer.learning_rate
-    lr = float(lr.numpy() if hasattr(lr, "numpy") else lr)
+    try:
+        lr = float(lr.numpy() if hasattr(lr, "numpy") else lr)
+    except (TypeError, ValueError):
+        # LearningRateSchedule object: a TF-graph schedule can't run
+        # inside the XLA step; freeze at its step-0 value
+        lr0 = float(np.asarray(lr(0)))
+        logger.warning(
+            "keras optimizer uses a LearningRateSchedule (%s); using "
+            "its step-0 value %g — pass a zoo optimizer with an optax "
+            "schedule for a decaying lr", type(lr).__name__, lr0)
+        lr = lr0
     if name == "sgd":
         momentum = float(getattr(optimizer, "momentum", 0.0) or 0.0)
         return zoo_opt.SGD(lr=lr, momentum=momentum)

@@ -1,0 +1,160 @@
+"""Common-layer utils tests: ZooDictionary, safe deserialization, file
+IO helpers (reference `Z/common/{ZooDictionary,CheckedObjectInputStream,
+Utils}.scala`, SURVEY.md §2.1)."""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common import utils
+from analytics_zoo_tpu.common.dictionary import ZooDictionary
+from analytics_zoo_tpu.common.safe_pickle import (
+    UnsafePickleError,
+    checked_load,
+    checked_loads,
+)
+
+
+# -- ZooDictionary ------------------------------------------------------------
+
+def test_dictionary_build_and_lookup():
+    d = ZooDictionary.from_corpus(
+        [["the", "cat", "sat"], ["the", "dog", "sat", "the"]])
+    assert d.get_word(d.get_index("the")) == "the"
+    assert d.get_index("the") == 0  # most frequent first
+    assert len(d) == 4
+    assert "cat" in d and "bird" not in d
+    with pytest.raises(KeyError):
+        d.get_index("bird")
+    assert d.get_index("bird", default=99) == 99
+
+
+def test_dictionary_encode_decode_roundtrip():
+    d = ZooDictionary(["a", "b", "c"])
+    ids = d.encode(["c", "a", "b"])
+    assert d.decode(ids) == ["c", "a", "b"]
+
+
+def test_dictionary_case_and_vocab_cap():
+    d = ZooDictionary.from_corpus(
+        [["The", "the", "THE", "cat"]], case_sensitive=False,
+        max_vocab=1)
+    assert len(d) == 1 and d.get_index("tHe") == 0
+
+
+def test_dictionary_save_load(tmp_path):
+    d = ZooDictionary(["x", "y", "z"])
+    path = str(tmp_path / "vocab.json")
+    d.save(path)
+    d2 = ZooDictionary.load(path)
+    assert d2.idx2word() == ["x", "y", "z"]
+    assert d2.get_index("z") == 2
+
+
+# -- safe pickle --------------------------------------------------------------
+
+def test_checked_load_allows_numpy_trees(tmp_path):
+    state = {"params": {"dense_1": {"kernel": np.eye(3)}},
+             "step": 7, "names": ("a", "b")}
+    path = str(tmp_path / "ok.pkl")
+    with open(path, "wb") as f:
+        pickle.dump(state, f)
+    loaded = checked_load(path)
+    np.testing.assert_array_equal(loaded["params"]["dense_1"]["kernel"],
+                                  np.eye(3))
+    assert loaded["step"] == 7
+
+
+def test_checked_load_rejects_malicious_reduce():
+    class Evil:
+        def __reduce__(self):
+            return (os.system, ("echo pwned",))
+
+    payload = pickle.dumps(Evil())
+    with pytest.raises(UnsafePickleError, match="whitelist"):
+        checked_loads(payload)
+
+
+def test_checked_load_rejects_arbitrary_class():
+    import subprocess
+    payload = pickle.dumps(subprocess.Popen.__init__)
+    with pytest.raises(Exception):
+        checked_loads(payload)
+
+
+def test_zoo_model_load_rejects_foreign_class(tmp_path):
+    from analytics_zoo_tpu.models.common import ZooModel
+    path = str(tmp_path / "bad.zoomodel")
+    with open(path, "wb") as f:
+        pickle.dump({"module": "os", "class": "system",
+                     "hyper_parameters": {}, "params": {}}, f)
+    with pytest.raises(ValueError, match="not a framework model"):
+        ZooModel.load_model(path)
+
+
+# -- file utils ---------------------------------------------------------------
+
+def test_read_save_bytes_roundtrip(tmp_path):
+    path = str(tmp_path / "sub" / "blob.bin")
+    utils.save_bytes(b"hello tpu", path)
+    assert utils.read_bytes(path) == b"hello tpu"
+    with pytest.raises(FileExistsError):
+        utils.save_bytes(b"again", path)
+    utils.save_bytes(b"again", path, is_overwrite=True)
+    assert utils.read_bytes(path) == b"again"
+
+
+def test_list_files_and_remove(tmp_path):
+    for name in ("a.txt", "b.txt", "c.log"):
+        utils.save_bytes(b"x", str(tmp_path / name))
+    assert [os.path.basename(p) for p in
+            utils.list_files(str(tmp_path / "*.txt"))] == ["a.txt",
+                                                           "b.txt"]
+    assert len(utils.list_files(str(tmp_path))) == 3
+    with pytest.raises(IsADirectoryError):
+        utils.remove(str(tmp_path))
+    utils.remove(str(tmp_path / "a.txt"))
+    assert len(utils.list_files(str(tmp_path))) == 2
+
+
+def test_remote_scheme_rejected():
+    with pytest.raises(NotImplementedError, match="hdfs"):
+        utils.read_bytes("hdfs://namenode/data/x.bin")
+
+
+def test_log_usage_error():
+    with pytest.raises(ValueError, match="bad arg"):
+        utils.log_usage_error_and_throw("bad arg")
+
+
+def test_checkpoint_resume_uses_checked_loader(tmp_path, rng):
+    """End-to-end: Estimator checkpoint round-trip still works through
+    the whitelist (reference resume semantics, SURVEY.md §5)."""
+    import jax
+
+    from analytics_zoo_tpu import init_nncontext
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential, layers as L
+    init_nncontext(tpu_mesh={"data": 1}, devices=jax.devices("cpu")[:1])
+    net = Sequential()
+    net.add(L.Dense(4, input_shape=(3,)))
+    net.compile(optimizer="sgd", loss="mse")
+    x = rng.randn(16, 3).astype(np.float32)
+    y = rng.randn(16, 4).astype(np.float32)
+    net.fit(x, y, batch_size=8, nb_epoch=1)
+    ckpt = str(tmp_path / "ckpt")
+    net.estimator.save_checkpoint(ckpt)
+    step = net.estimator.step
+    params_before = jax.device_get(net.estimator.params)
+
+    net2 = Sequential()
+    net2.add(L.Dense(4, input_shape=(3,)))
+    net2.compile(optimizer="sgd", loss="mse")
+    net2.estimator.load_checkpoint(ckpt)
+    assert net2.estimator.step == step
+    leaves1 = jax.tree_util.tree_leaves(params_before)
+    leaves2 = jax.tree_util.tree_leaves(
+        jax.device_get(net2.estimator.params))
+    for a, b in zip(leaves1, leaves2):
+        np.testing.assert_allclose(a, b)

@@ -1,0 +1,125 @@
+"""Net interop loader tests (reference `Z/pipeline/api/Net.scala:91-189`
+load{Torch,Keras,TF,Caffe} — SURVEY.md §2.4 "Net loaders")."""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn as nn
+
+import jax
+
+from analytics_zoo_tpu import Net, init_nncontext
+
+
+@pytest.fixture(autouse=True)
+def _ctx():
+    init_nncontext(tpu_mesh={"data": 1}, devices=jax.devices("cpu")[:1])
+    yield
+
+
+def assert_close(a, b, atol=1e-4):
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=1e-4, atol=atol)
+
+
+def test_load_torch_mlp(rng):
+    torch.manual_seed(0)
+    tm = nn.Sequential(
+        nn.Linear(6, 16), nn.ReLU(),
+        nn.Dropout(0.0),
+        nn.Linear(16, 3), nn.Softmax(dim=-1),
+    )
+    tm.eval()
+    net = Net.load_torch(tm, input_shape=(6,))
+    x = rng.randn(5, 6).astype(np.float32)
+    with torch.no_grad():
+        ref = tm(torch.from_numpy(x)).numpy()
+    assert_close(net.predict(x, batch_size=5), ref)
+
+
+def test_load_torch_convnet(rng):
+    torch.manual_seed(1)
+    tm = nn.Sequential(
+        nn.Conv2d(3, 8, 3, stride=1, padding=1),
+        nn.BatchNorm2d(8),
+        nn.ReLU(),
+        nn.MaxPool2d(2),
+        nn.Conv2d(8, 4, 3),
+        nn.ReLU(),
+        nn.AdaptiveAvgPool2d(1),
+        nn.Flatten(),
+        nn.Linear(4, 5),
+    )
+    tm.eval()
+    net = Net.load_torch(tm, input_shape=(3, 12, 12))
+    x = rng.randn(2, 3, 12, 12).astype(np.float32)
+    with torch.no_grad():
+        ref = tm(torch.from_numpy(x)).numpy()
+    assert_close(net.predict(x, batch_size=2), ref, atol=1e-3)
+
+
+def test_load_torch_finetunable(rng):
+    tm = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 1))
+    net = Net.load_torch(tm, input_shape=(4,))
+    x = rng.randn(32, 4).astype(np.float32)
+    y = x.sum(1, keepdims=True).astype(np.float32)
+    from analytics_zoo_tpu.ops.optimizers import Adam
+    net.compile(optimizer=Adam(lr=0.05), loss="mse")  # recompile keeps weights
+    with torch.no_grad():
+        ref = tm(torch.from_numpy(x)).numpy()
+    assert_close(net.predict(x, batch_size=32), ref)  # weights survived
+    before = float(np.mean((net.predict(x, batch_size=32) - y) ** 2))
+    net.fit(x, y, batch_size=16, nb_epoch=30)
+    after = float(np.mean((net.predict(x, batch_size=32) - y) ** 2))
+    assert after < before * 0.5
+
+
+def test_load_torch_embedding(rng):
+    tm = nn.Sequential(nn.Embedding(20, 8), nn.Flatten(),
+                       nn.Linear(5 * 8, 2))
+    tm.eval()
+    net = Net.load_torch(tm, input_shape=(5,))
+    x = rng.randint(0, 20, (3, 5)).astype(np.int32)
+    with torch.no_grad():
+        ref = tm(torch.from_numpy(x).long()).numpy()
+    assert_close(net.predict(x, batch_size=3), ref)
+
+
+def test_load_torch_unsupported_module():
+    tm = nn.Sequential(nn.Linear(4, 4), nn.TransformerEncoderLayer(4, 2))
+    with pytest.raises(NotImplementedError, match="ONNX"):
+        Net.load_torch(tm, input_shape=(4,))
+
+
+def test_load_caffe_raises():
+    with pytest.raises(NotImplementedError, match="ONNX"):
+        Net.load_caffe("deploy.prototxt", "weights.caffemodel")
+
+
+def test_load_keras_file(rng, tmp_path):
+    tf = pytest.importorskip("tensorflow")
+    model = tf.keras.Sequential([
+        tf.keras.layers.Dense(8, activation="relu", input_shape=(4,)),
+        tf.keras.layers.Dense(2),
+    ])
+    path = str(tmp_path / "m.keras")
+    model.save(path)
+    km = Net.load_keras(path)
+    x = rng.randn(6, 4).astype(np.float32)
+    ref = model(x).numpy()
+    assert_close(km.predict(x, batch_size=6), ref)
+
+
+def test_load_zoo_model_roundtrip(rng, tmp_path):
+    from analytics_zoo_tpu.models.recommendation import NeuralCF
+    ncf = NeuralCF(user_count=20, item_count=30, num_classes=2,
+                   user_embed=8, item_embed=8, hidden_layers=(16, 8))
+    ncf.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    x = np.stack([rng.randint(1, 21, 16), rng.randint(1, 31, 16)],
+                 axis=1).astype(np.int32)
+    before = ncf.predict(x, batch_size=16)
+    path = str(tmp_path / "ncf.zoomodel")
+    ncf.save_model(path)
+    loaded = Net.load(path)
+    after = loaded.predict(x, batch_size=16)
+    assert_close(after, before, atol=1e-5)

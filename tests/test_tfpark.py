@@ -212,6 +212,51 @@ def test_tf_estimator_train_eval_predict(rng):
     np.testing.assert_allclose(preds, y, atol=0.5)
 
 
+def test_keras_model_embedding_resource_gather(rng):
+    """tf.keras Embedding gathers straight from the variable resource
+    (ResourceGather) — the rewrite must map it to explicit weights."""
+    from analytics_zoo_tpu import init_nncontext
+    from analytics_zoo_tpu.tfpark import KerasModel
+    init_nncontext(tpu_mesh={"data": 1}, devices=jax.devices("cpu")[:1])
+    model = tf.keras.Sequential([
+        tf.keras.layers.Embedding(20, 6, input_shape=(5,)),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(2),
+    ])
+    model.compile(optimizer="adam", loss="mse")
+    km = KerasModel(model)
+    x = rng.randint(0, 20, (8, 5)).astype(np.int32)
+    ref = model(x).numpy()
+    np.testing.assert_allclose(km.predict(x, batch_size=8), ref,
+                               atol=1e-5)
+    y = rng.randn(8, 2).astype(np.float32)
+    km.fit(x, y, batch_size=8, epochs=2)  # embedding weights trainable
+
+
+def test_keras_optimizer_schedule_freezes_lr():
+    from analytics_zoo_tpu.tfpark.tf_graph import keras_optimizer_to_zoo
+    sched = tf.keras.optimizers.schedules.ExponentialDecay(0.01, 100,
+                                                           0.9)
+    zopt = keras_optimizer_to_zoo(tf.keras.optimizers.Adam(sched))
+    assert abs(zopt.lr - 0.01) < 1e-7
+
+
+def test_gather_batch_dims(rng):
+    from analytics_zoo_tpu.tfpark.graphdef_jax import GraphDefFunction
+    params = rng.randn(4, 6, 3).astype(np.float32)
+    idx = rng.randint(0, 6, (4, 2)).astype(np.int32)
+    cf = tf.function(
+        lambda p, i: tf.gather(p, i, axis=1, batch_dims=1)
+    ).get_concrete_function(tf.TensorSpec([4, 6, 3]),
+                            tf.TensorSpec([4, 2], tf.int32))
+    gfn = GraphDefFunction(cf.graph.as_graph_def(),
+                           [t.name for t in cf.inputs],
+                           [t.name for t in cf.outputs])
+    out = np.asarray(gfn(params, idx))
+    ref = tf.gather(params, idx, axis=1, batch_dims=1).numpy()
+    np.testing.assert_allclose(out, ref, atol=1e-6)
+
+
 # -- text models (native) -----------------------------------------------------
 
 def test_ner_shapes_and_training(rng):
