@@ -15,12 +15,13 @@ import pickle
 from typing import Any, BinaryIO
 
 _SAFE_MODULE_PREFIXES = (
+    # CLASSES only (enforced in find_class): a function admitted by
+    # prefix would be a REDUCE gadget (e.g. utils.remove)
     "analytics_zoo_tpu.",
     # optimizer-state containers inside checkpoints (data classes /
     # namedtuples, no side-effecting constructors)
     "optax.",
     "chex.",
-    "numpy.",
 )
 
 _SAFE_CLASSES = {
@@ -48,11 +49,17 @@ class CheckedUnpickler(pickle.Unpickler):
     def find_class(self, module: str, name: str):
         if (module, name) in _SAFE_CLASSES:
             return super().find_class(module, name)
-        if any(module == p[:-1] or module.startswith(p)
-               for p in _SAFE_MODULE_PREFIXES):
-            return super().find_class(module, name)
         if module.startswith("numpy") and name in ("ndarray", "dtype"):
             return super().find_class(module, name)
+        if any(module == p[:-1] or module.startswith(p)
+               for p in _SAFE_MODULE_PREFIXES):
+            obj = super().find_class(module, name)
+            if not isinstance(obj, type):
+                raise UnsafePickleError(
+                    f"refusing to deserialize {module}.{name}: only "
+                    "classes are admitted by prefix (functions are "
+                    "REDUCE code-execution gadgets)")
+            return obj
         raise UnsafePickleError(
             f"refusing to deserialize {module}.{name}: not in the "
             "checkpoint class whitelist (tampered or foreign file?)")

@@ -1,0 +1,22 @@
+"""3D image (volumetric / medical) transforms.
+
+Reference: `Z/feature/image3d/*.scala` (~640 LoC): `AffineTransform3D`,
+`Crop3D` (+ random/center), `Rotation3D`, `WarpTransformer`, on
+`ImageFeature3D` records. Host-side numpy/scipy preprocessing like the
+2D pipeline; volumes are (D, H, W) or (D, H, W, C) float arrays.
+"""
+
+from analytics_zoo_tpu.feature.image3d.transforms import (  # noqa: F401
+    AffineTransform3D,
+    CenterCrop3D,
+    Crop3D,
+    ImageFeature3D,
+    RandomCrop3D,
+    Rotation3D,
+    WarpTransformer,
+)
+
+__all__ = [
+    "ImageFeature3D", "AffineTransform3D", "Crop3D", "RandomCrop3D",
+    "CenterCrop3D", "Rotation3D", "WarpTransformer",
+]

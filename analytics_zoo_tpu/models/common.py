@@ -102,6 +102,10 @@ class ZooModel:
                 "is not a framework model (tampered file?)")
         mod = importlib.import_module(state["module"])
         klass = getattr(mod, state["class"])
+        if not (isinstance(klass, type) and issubclass(klass, ZooModel)):
+            raise ValueError(
+                f"{state['module']}.{state['class']} is not a ZooModel "
+                "subclass (tampered file?)")
         inst = klass(**state["hyper_parameters"])
         inst.compile()  # default compile; caller may re-compile
         est = inst.model.estimator

@@ -131,12 +131,23 @@ class KerasNet(KerasLayer):
         loss callables incl. `autograd.CustomLoss`). Re-compiling keeps
         already-initialized weights (keras semantics — imported/trained
         params survive an optimizer/loss change)."""
-        from analytics_zoo_tpu.pipeline.estimator import Estimator
+        from analytics_zoo_tpu.pipeline.estimator import (
+            Estimator,
+            _check_params_compatible,
+        )
         old = getattr(self, "_estimator", None)
         self._estimator = Estimator(self, optimizer=optimizer, loss=loss,
                                     metrics=metrics)
         if old is not None and old.params is not None:
-            self._estimator.params = old.params
+            try:
+                _check_params_compatible(self, old.params)
+                self._estimator.params = old.params
+            except (KeyError, ValueError):
+                # topology changed since the old compile — re-init
+                from analytics_zoo_tpu.common.nncontext import logger
+                logger.warning(
+                    "compile: existing params no longer match the "
+                    "model topology; weights will be re-initialized")
         return self
 
     @property

@@ -186,6 +186,10 @@ def _torch_to_zoo(module):
         elif isinstance(m, nn.Conv2d):
             if m.groups != 1:
                 raise NotImplementedError("grouped torch Conv2d")
+            if m.padding_mode != "zeros":
+                raise NotImplementedError(
+                    f"Conv2d padding_mode={m.padding_mode!r}; only "
+                    "'zeros' imports exactly")
             pad = _pair(m.padding) if not isinstance(m.padding, str) \
                 else m.padding
             if pad not in ("same", "valid") and any(pad):
@@ -205,6 +209,12 @@ def _torch_to_zoo(module):
                 asg["bias"] = m.bias.detach().numpy()
             weights[id(lyr)] = asg
         elif isinstance(m, (nn.MaxPool2d, nn.AvgPool2d)):
+            if getattr(m, "ceil_mode", False):
+                raise NotImplementedError(
+                    "torch pooling ceil_mode=True (floor-mode output "
+                    "shapes would silently differ)")
+            if getattr(m, "dilation", 1) not in (1, (1, 1)):
+                raise NotImplementedError("dilated torch MaxPool2d")
             pad = _pair(m.padding)
             if any(pad):
                 if isinstance(m, nn.AvgPool2d):
@@ -223,30 +233,28 @@ def _torch_to_zoo(module):
                 raise NotImplementedError(
                     "AdaptiveAvgPool2d only for output_size=1")
             emit(L.GlobalAveragePooling2D(dim_ordering="th"))
-        elif isinstance(m, nn.BatchNorm2d):
-            lyr = emit(L.BatchNormalization(epsilon=m.eps,
-                                            momentum=1.0 - m.momentum,
-                                            dim_ordering="th"))
-            weights[id(lyr)] = {
-                "gamma": m.weight.detach().numpy(),
-                "beta": m.bias.detach().numpy(),
-                "_state": {
-                    "moving_mean": m.running_mean.detach().numpy(),
-                    "moving_var": m.running_var.detach().numpy(),
-                },
-            }
-        elif isinstance(m, nn.BatchNorm1d):
-            lyr = emit(L.BatchNormalization(epsilon=m.eps,
-                                            momentum=1.0 - m.momentum))
-            weights[id(lyr)] = {
-                "gamma": m.weight.detach().numpy(),
-                "beta": m.bias.detach().numpy(),
-                "_state": {
-                    "moving_mean": m.running_mean.detach().numpy(),
-                    "moving_var": m.running_var.detach().numpy(),
-                },
-            }
+        elif isinstance(m, (nn.BatchNorm1d, nn.BatchNorm2d)):
+            if m.running_mean is None:
+                raise NotImplementedError(
+                    "BatchNorm with track_running_stats=False (eval "
+                    "semantics differ: batch stats vs moving stats)")
+            affine = m.weight is not None
+            ordering = "th" if isinstance(m, nn.BatchNorm2d) else "tf"
+            lyr = emit(L.BatchNormalization(
+                epsilon=m.eps, momentum=1.0 - (m.momentum or 0.1),
+                dim_ordering=ordering, scale=affine, center=affine))
+            asg = {"_state": {
+                "moving_mean": m.running_mean.detach().numpy(),
+                "moving_var": m.running_var.detach().numpy(),
+            }}
+            if affine:
+                asg["gamma"] = m.weight.detach().numpy()
+                asg["beta"] = m.bias.detach().numpy()
+            weights[id(lyr)] = asg
         elif isinstance(m, nn.LayerNorm):
+            if m.weight is None:
+                raise NotImplementedError(
+                    "LayerNorm with elementwise_affine=False")
             lyr = emit(L.LayerNormalization(epsilon=m.eps))
             weights[id(lyr)] = {
                 "gamma": m.weight.detach().numpy(),

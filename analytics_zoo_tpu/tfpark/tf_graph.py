@@ -137,13 +137,16 @@ def _rewrite(fn: Callable, input_signature: Sequence,
             ph_name = node.name + "/params"
             new_nodes.append(_weight_placeholder(
                 ph_name, node.attr["dtype"].type, src))
+            # TF semantics: ResourceGather gathers along axis=batch_dims
+            bd = int(node.attr["batch_dims"].i) \
+                if "batch_dims" in node.attr else 0
             axis_name = node.name + "/axis"
             axis_node = tf.compat.v1.NodeDef()
             axis_node.name = axis_name
             axis_node.op = "Const"
             axis_node.attr["dtype"].type = tf.int32.as_datatype_enum
             axis_node.attr["value"].tensor.CopyFrom(
-                tf.make_tensor_proto(0, dtype=tf.int32))
+                tf.make_tensor_proto(bd, dtype=tf.int32))
             new_nodes.append(axis_node)
             gather = tf.compat.v1.NodeDef()
             gather.name = node.name

@@ -94,6 +94,49 @@ def test_zoo_model_load_rejects_foreign_class(tmp_path):
         ZooModel.load_model(path)
 
 
+def test_checked_load_rejects_framework_function_gadget():
+    """Functions under whitelisted prefixes are REDUCE gadgets — only
+    classes may resolve."""
+    class Gadget:
+        def __reduce__(self):
+            return (utils.remove, ("/nonexistent-path", True))
+
+    payload = pickle.dumps(Gadget())
+    with pytest.raises(UnsafePickleError, match="gadget"):
+        checked_loads(payload)
+
+
+def test_zoo_model_load_rejects_non_model_class(tmp_path):
+    from analytics_zoo_tpu.models.common import ZooModel
+    path = str(tmp_path / "bad2.zoomodel")
+    with open(path, "wb") as f:
+        pickle.dump({"module": "analytics_zoo_tpu.common.utils",
+                     "class": "remove",
+                     "hyper_parameters": {"path": "/nonexist",
+                                          "recursive": True},
+                     "params": {}}, f)
+    with pytest.raises(ValueError, match="not a ZooModel"):
+        ZooModel.load_model(path)
+
+
+def test_recompile_after_topology_change_reinitializes(rng):
+    import jax
+
+    from analytics_zoo_tpu import init_nncontext
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential, layers as L
+    init_nncontext(tpu_mesh={"data": 1}, devices=jax.devices("cpu")[:1])
+    net = Sequential()
+    net.add(L.Dense(4, input_shape=(3,)))
+    net.compile(optimizer="sgd", loss="mse")
+    x = rng.randn(8, 3).astype(np.float32)
+    net.fit(x, rng.randn(8, 4).astype(np.float32), batch_size=8,
+            nb_epoch=1)
+    net.add(L.Dense(2))
+    net.compile(optimizer="sgd", loss="mse")  # params dropped, no crash
+    out = net.predict(x, batch_size=8)
+    assert out.shape == (8, 2)
+
+
 # -- file utils ---------------------------------------------------------------
 
 def test_read_save_bytes_roundtrip(tmp_path):

@@ -1,0 +1,123 @@
+"""image3d transform tests (reference `Z/feature/image3d/` specs,
+SURVEY.md §2.2 "3D image ops"). Golden checks vs scipy.ndimage."""
+
+import numpy as np
+import pytest
+from scipy import ndimage
+
+from analytics_zoo_tpu.feature.image3d import (
+    AffineTransform3D,
+    CenterCrop3D,
+    Crop3D,
+    ImageFeature3D,
+    RandomCrop3D,
+    Rotation3D,
+    WarpTransformer,
+)
+
+
+@pytest.fixture
+def vol(rng):
+    return rng.rand(12, 14, 16).astype(np.float32)
+
+
+def test_crop3d(vol):
+    out = Crop3D(start=(2, 3, 4), patch_size=(5, 6, 7)).apply(
+        ImageFeature3D(vol))
+    assert out.image.shape == (5, 6, 7)
+    np.testing.assert_array_equal(out.image, vol[2:7, 3:9, 4:11])
+    with pytest.raises(ValueError, match="exceeds"):
+        Crop3D((10, 0, 0), (5, 6, 7)).apply(ImageFeature3D(vol))
+
+
+def test_center_and_random_crop(vol):
+    c = CenterCrop3D(4, 6, 8).apply(ImageFeature3D(vol))
+    np.testing.assert_array_equal(c.image, vol[4:8, 4:10, 4:12])
+    r1 = RandomCrop3D(4, 6, 8, seed=0).apply(ImageFeature3D(vol))
+    assert r1.image.shape == (4, 6, 8)
+    # crop content must be a contiguous sub-block of the source
+    found = False
+    for z in range(9):
+        for y in range(9):
+            for x in range(9):
+                if np.array_equal(vol[z:z+4, y:y+6, x:x+8], r1.image):
+                    found = True
+    assert found
+
+
+def test_affine_identity(vol):
+    out = AffineTransform3D(np.eye(3)).apply(ImageFeature3D(vol))
+    np.testing.assert_allclose(out.image, vol, atol=1e-5)
+
+
+def test_affine_translation_matches_scipy(vol):
+    t = (1.5, -2.0, 0.5)
+    out = AffineTransform3D(np.eye(3), translation=t,
+                            clamp_mode="padding").apply(
+        ImageFeature3D(vol))
+    # our convention: output(o) = input(o - t); scipy shift moves
+    # content by +t with the same relation
+    ref = ndimage.shift(vol, t, order=1, mode="constant", cval=0.0)
+    # compare away from borders (border handling differs slightly)
+    np.testing.assert_allclose(out.image[3:-3, 3:-3, 3:-3],
+                               ref[3:-3, 3:-3, 3:-3], atol=1e-4)
+
+
+def test_rotation_matches_scipy(vol):
+    angle = 0.3
+    rot = Rotation3D((angle, 0.0, 0.0), clamp_mode="padding")
+    out = rot.apply(ImageFeature3D(vol))
+    # rotation about the z axis = in-plane rotation of each (H, W)...
+    # no: our Rz rotates the (y, x) plane per z-slice
+    ref = ndimage.rotate(vol, np.degrees(angle), axes=(1, 2),
+                         reshape=False, order=1, mode="constant")
+    np.testing.assert_allclose(out.image[2:-2, 3:-3, 3:-3],
+                               ref[2:-2, 3:-3, 3:-3], atol=5e-2)
+
+
+def test_rotation_preserves_energy(vol):
+    out = Rotation3D((0.1, 0.2, 0.05)).apply(ImageFeature3D(vol))
+    assert out.image.shape == vol.shape
+    assert 0.5 < out.image.mean() / vol.mean() < 1.5
+
+
+def test_warp_identity_and_shift(vol):
+    zero = np.zeros(vol.shape + (3,))
+    out = WarpTransformer(zero).apply(ImageFeature3D(vol))
+    np.testing.assert_allclose(out.image, vol, atol=1e-5)
+    shift = np.zeros(vol.shape + (3,))
+    shift[..., 0] = 1.0  # sample one voxel deeper in z
+    warped = WarpTransformer(shift, clamp_mode="padding").apply(
+        ImageFeature3D(vol))
+    np.testing.assert_allclose(warped.image[:-1], vol[1:], atol=1e-5)
+
+
+def test_multichannel_volume(rng):
+    v = rng.rand(6, 7, 8, 2).astype(np.float32)
+    out = Rotation3D((0.0, 0.0, 0.0)).apply(ImageFeature3D(v))
+    np.testing.assert_allclose(out.image, v, atol=1e-5)
+    c = Crop3D((1, 1, 1), (4, 4, 4)).apply(ImageFeature3D(v))
+    assert c.image.shape == (4, 4, 4, 2)
+
+
+def test_chaining_with_preprocessing_algebra(vol):
+    pipeline = CenterCrop3D(8, 8, 8) >> Rotation3D((0.0, 0.0, 0.1))
+    outs = list(pipeline([ImageFeature3D(vol)]))
+    assert len(outs) == 1 and outs[0].image.shape == (8, 8, 8)
+
+
+def test_raw_ndarray_is_wrapped(vol):
+    out = CenterCrop3D(4, 4, 4).apply(vol)
+    assert isinstance(out, ImageFeature3D)
+    assert out.image.shape == (4, 4, 4)
+
+
+def test_bad_inputs():
+    with pytest.raises(ValueError, match="D,H,W"):
+        ImageFeature3D(np.zeros((4, 4)))
+    with pytest.raises(ValueError, match="clamp_mode"):
+        AffineTransform3D(np.eye(3), clamp_mode="wrap")
+    with pytest.raises(ValueError, match="length 3"):
+        Crop3D((0, 0), (1, 1, 1))
+    with pytest.raises(ValueError, match="offset"):
+        WarpTransformer(np.zeros((4, 4, 4, 2)))

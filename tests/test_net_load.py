@@ -91,6 +91,37 @@ def test_load_torch_unsupported_module():
         Net.load_torch(tm, input_shape=(4,))
 
 
+def test_load_torch_bn_no_affine(rng):
+    torch.manual_seed(2)
+    tm = nn.Sequential(nn.Conv2d(2, 3, 3), nn.BatchNorm2d(3, affine=False),
+                       nn.Flatten(), nn.Linear(3 * 4 * 4, 2))
+    tm.eval()
+    # seed running stats with non-trivial values
+    tm.train()
+    with torch.no_grad():
+        for _ in range(3):
+            tm(torch.randn(4, 2, 6, 6))
+    tm.eval()
+    net = Net.load_torch(tm, input_shape=(2, 6, 6))
+    x = rng.randn(2, 2, 6, 6).astype(np.float32)
+    with torch.no_grad():
+        ref = tm(torch.from_numpy(x)).numpy()
+    assert_close(net.predict(x, batch_size=2), ref, atol=1e-3)
+
+
+def test_load_torch_unsupported_pool_modes():
+    tm = nn.Sequential(nn.MaxPool2d(3, stride=2, ceil_mode=True))
+    with pytest.raises(NotImplementedError, match="ceil_mode"):
+        Net.load_torch(tm, input_shape=(3, 8, 8))
+    tm2 = nn.Sequential(nn.Conv2d(3, 4, 3, padding=1,
+                                  padding_mode="reflect"))
+    with pytest.raises(NotImplementedError, match="padding_mode"):
+        Net.load_torch(tm2, input_shape=(3, 8, 8))
+    tm3 = nn.Sequential(nn.BatchNorm2d(3, track_running_stats=False))
+    with pytest.raises(NotImplementedError, match="track_running_stats"):
+        Net.load_torch(tm3, input_shape=(3, 8, 8))
+
+
 def test_load_caffe_raises():
     with pytest.raises(NotImplementedError, match="ONNX"):
         Net.load_caffe("deploy.prototxt", "weights.caffemodel")
