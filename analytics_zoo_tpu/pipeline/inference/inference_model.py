@@ -72,7 +72,10 @@ class InferenceModel:
                        example_inputs: Optional[Sequence] = None):
         """Serve an in-memory KerasNet."""
         if params is None:
-            params = net.estimator.params
+            est = net.estimator
+            if est.params is None:
+                est._ensure_initialized()
+            params = est.params
 
         def predict_fn(*xs):
             x = list(xs) if len(xs) > 1 else xs[0]

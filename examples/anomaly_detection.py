@@ -1,0 +1,55 @@
+"""Anomaly detection example (reference
+`pyzoo/zoo/examples/anomalydetection/anomaly_detection.py`): unroll a
+univariate time series, train the stacked-LSTM AnomalyDetector, flag
+the top-N largest prediction errors. Synthetic NYC-taxi-shaped series."""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--points", type=int, default=600)
+    p.add_argument("--unroll", type=int, default=24)
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--anomalies", type=int, default=5)
+    args = p.parse_args(argv)
+
+    from analytics_zoo_tpu import init_nncontext
+    from analytics_zoo_tpu.models.anomalydetection import AnomalyDetector
+
+    init_nncontext()
+    rng = np.random.RandomState(0)
+    t = np.arange(args.points)
+    series = (np.sin(t / 24 * 2 * np.pi) +
+              0.1 * rng.randn(args.points)).astype(np.float32)
+    spikes = rng.choice(args.points, args.anomalies, replace=False)
+    series[spikes] += 3.0  # injected anomalies
+
+    indexed = AnomalyDetector.unroll(series[:, None], args.unroll)
+    x, y = AnomalyDetector.to_arrays(indexed)
+    split = int(len(x) * 0.8)
+    x_train, y_train = x[:split], y[:split]
+    x_test, y_test = x[split:], y[split:]
+
+    ad = AnomalyDetector(feature_shape=(args.unroll, 1),
+                         hidden_layers=(16, 8, 4),
+                         dropouts=(0.1, 0.1, 0.1))
+    ad.compile(optimizer="adam", loss="mse")
+    ad.fit(x_train, y_train, batch_size=args.batch_size,
+           nb_epoch=args.epochs)
+
+    y_pred = ad.predict(x_test, batch_size=args.batch_size).reshape(-1)
+    flagged, threshold = AnomalyDetector.detect_anomalies(
+        y_test.reshape(-1), y_pred, anomaly_size=args.anomalies)
+    print(f"flagged {len(flagged)} anomalies (threshold "
+          f"{threshold:.3f}) at test indices {flagged.tolist()}")
+    return flagged
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+"""Serving example (reference `pyzoo/zoo/examples` web-service samples
++ `InferenceModel`): load a model into the concurrent serving pool
+(native C++ queue under the hood) and answer predictions from several
+threads."""
+
+from __future__ import annotations
+
+import argparse
+import threading
+
+import numpy as np
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--concurrency", type=int, default=4)
+    p.add_argument("--requests", type=int, default=16)
+    args = p.parse_args(argv)
+
+    from analytics_zoo_tpu import init_nncontext
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential, layers as L
+    from analytics_zoo_tpu.pipeline.inference import InferenceModel
+
+    init_nncontext()
+    net = Sequential()
+    net.add(L.Dense(32, input_shape=(8,), activation="relu"))
+    net.add(L.Dense(3, activation="softmax"))
+    net.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+
+    model = InferenceModel(supported_concurrent_num=args.concurrency)
+    model.load_keras_net(net)
+
+    rng = np.random.RandomState(0)
+    results = [None] * args.requests
+
+    def worker(i):
+        x = rng.rand(4, 8).astype(np.float32)
+        results[i] = model.predict(x)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(args.requests)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    shapes = {np.asarray(r).shape for r in results}
+    print(f"served {args.requests} requests over "
+          f"{args.concurrency} model copies; output shapes: {shapes}")
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,49 @@
+"""nnframes example (reference
+`pyzoo/zoo/examples/nnframes/imageTransferLearning`): Spark-ML-style
+NNClassifier over a pandas DataFrame — fit returns an NNClassifierModel
+transformer that appends a prediction column."""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+import pandas as pd
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--samples", type=int, default=256)
+    p.add_argument("--epochs", type=int, default=5)
+    args = p.parse_args(argv)
+
+    from analytics_zoo_tpu import init_nncontext
+    from analytics_zoo_tpu.feature.common import SeqToTensor
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential, layers as L
+    from analytics_zoo_tpu.pipeline.nnframes import NNClassifier
+
+    init_nncontext()
+    rng = np.random.RandomState(0)
+    feats = rng.randn(args.samples, 6).astype(np.float32)
+    labels = (feats.sum(axis=1) > 0).astype(np.int64) + 1  # 1-based
+    df = pd.DataFrame({"features": list(feats), "label": labels})
+
+    net = Sequential()
+    net.add(L.Dense(16, input_shape=(6,), activation="relu"))
+    net.add(L.Dense(2, activation="softmax"))
+
+    clf = (NNClassifier(net, "sparse_categorical_crossentropy",
+                        SeqToTensor((6,)))
+           .set_batch_size(32)
+           .set_max_epoch(args.epochs)
+           .set_learning_rate(0.05)
+           .set_optim_method("adam"))
+    model = clf.fit(df)
+    out = model.transform(df)
+    acc = float((out["prediction"] == out["label"]).mean())
+    print(f"train accuracy: {acc:.3f}")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

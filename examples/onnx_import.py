@@ -1,0 +1,59 @@
+"""ONNX import example (reference `pyzoo/zoo/examples/onnx/`): build an
+ONNX model with the framework's own proto builder (stand-in for a file
+exported elsewhere), load it with `OnnxLoader`, predict, fine-tune."""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--path", default="/tmp/example_mlp.onnx")
+    p.add_argument("--epochs", type=int, default=10)
+    args = p.parse_args(argv)
+
+    from analytics_zoo_tpu import init_nncontext
+    from analytics_zoo_tpu.pipeline.api.onnx import (
+        OnnxLoader,
+        helper,
+        onnx_pb,
+    )
+    from analytics_zoo_tpu.pipeline.api.onnx.onnx_pb import TensorProto
+
+    init_nncontext()
+    rng = np.random.RandomState(0)
+
+    # fabricate an MLP .onnx file (any exporter's file works the same)
+    w1 = (rng.randn(32, 8) * 0.3).astype(np.float32)
+    b1 = np.zeros(32, np.float32)
+    w2 = (rng.randn(4, 32) * 0.3).astype(np.float32)
+    nodes = [
+        helper.make_node("Gemm", ["x", "w1", "b1"], ["h"], transB=1),
+        helper.make_node("Relu", ["h"], ["hr"]),
+        helper.make_node("Gemm", ["hr", "w2"], ["out"], transB=1),
+    ]
+    graph = helper.make_graph(
+        nodes, "mlp",
+        [helper.make_tensor_value_info("x", TensorProto.FLOAT,
+                                       ["N", 8])],
+        [helper.make_tensor_value_info("out", TensorProto.FLOAT,
+                                       ["N", 4])],
+        [helper.make_tensor("w1", w1), helper.make_tensor("b1", b1),
+         helper.make_tensor("w2", w2)])
+    onnx_pb.save_model(helper.make_model(graph), args.path)
+    print(f"wrote {args.path}")
+
+    net = OnnxLoader.load_model(args.path)
+    net.compile(optimizer="adam", loss="mse")
+    x = rng.randn(128, 8).astype(np.float32)
+    y = rng.randn(128, 4).astype(np.float32)
+    print("imported forward:", net.predict(x, batch_size=64).shape)
+    net.fit(x, y, batch_size=64, nb_epoch=args.epochs)
+    print("fine-tuned imported ONNX model on TPU")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,82 @@
+"""Smoke-runs every example with tiny arguments (reference analog:
+example mains exercised in CI, SURVEY.md §2.12 L12)."""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+
+def _run(name, argv):
+    path = os.path.join(EXAMPLES, name + ".py")
+    spec = importlib.util.spec_from_file_location(f"example_{name}",
+                                                 path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.main(argv)
+
+
+def test_lenet_mnist():
+    metrics = _run("lenet_mnist", ["--n-train", "64", "--n-test", "32",
+                                   "--batch-size", "32", "--epochs",
+                                   "1"])
+    assert "loss" in metrics
+
+
+def test_ncf_recommendation():
+    recs = _run("ncf_recommendation",
+                ["--samples", "256", "--users", "20", "--items", "30",
+                 "--batch-size", "64", "--epochs", "1"])
+    assert len(recs) > 0
+
+
+def test_text_classification():
+    metrics = _run("text_classification",
+                   ["--per-class", "16", "--epochs", "1",
+                    "--sequence-length", "16"])
+    assert "loss" in metrics
+
+
+def test_anomaly_detection():
+    flagged = _run("anomaly_detection",
+                   ["--points", "200", "--unroll", "12", "--epochs",
+                    "1", "--batch-size", "32"])
+    assert len(flagged) >= 1
+
+
+def test_object_detection():
+    results = _run("object_detection", ["--images", "1"])
+    assert len(results) == 1
+
+
+def test_tfpark_keras():
+    pytest.importorskip("tensorflow")
+    after = _run("tfpark_keras", ["--samples", "128", "--epochs", "2",
+                                  "--batch-size", "32"])
+    assert after < 100
+
+
+def test_nnframes_classification():
+    acc = _run("nnframes_classification",
+               ["--samples", "64", "--epochs", "2"])
+    assert 0.0 <= acc <= 1.0
+
+
+def test_onnx_import(tmp_path):
+    _run("onnx_import", ["--path", str(tmp_path / "m.onnx"),
+                         "--epochs", "1"])
+
+
+def test_distributed_training():
+    _run("distributed_training", ["--devices", "4",
+                                  "--batch-per-device", "2",
+                                  "--steps", "2"])
+
+
+def test_inference_serving():
+    results = _run("inference_serving", ["--concurrency", "2",
+                                         "--requests", "4"])
+    assert all(r is not None for r in results)
