@@ -6,6 +6,16 @@ The BASELINE.json target is the nnframes ResNet-50 ImageNet recipe at
 >=45% MFU (v5e). vs_baseline here = achieved MFU / 0.45, with FLOPs taken
 from XLA's own cost analysis of the compiled train step and peak chip
 FLOPs from ZOO_TPU_PEAK_TFLOPS (default 197, TPU v5e bf16).
+
+Round-2 hardening (VERDICT.md "What's weak" #1): round 1 timed out with
+no JSON emitted (rc=124, parsed: null). Now:
+  * a hard watchdog ALWAYS prints a JSON line and exits before
+    ZOO_TPU_BENCH_BUDGET_S (default 480s) — a hanging backend init or a
+    slow compile can no longer produce zero signal;
+  * the train step is compiled exactly ONCE (one lax.scan chain; round 1
+    compiled three program variants before printing anything);
+  * platform/backend init time is measured and reported separately in
+    the diagnostic stderr line, so a slow 'axon' init is visible.
 """
 
 from __future__ import annotations
@@ -13,27 +23,96 @@ from __future__ import annotations
 import json
 import os
 import sys
+import threading
 import time
 
 import numpy as np
 
+_t_start = time.perf_counter()
+_emit_lock = threading.Lock()
+_emitted = False
+# progressively-updated best-known result; the watchdog prints this
+_result = {
+    "metric": "resnet50_train_images_per_sec_per_chip",
+    "value": 0.0,
+    "unit": "images/sec",
+    "vs_baseline": 0.0,
+    "diag": "startup",
+}
+
+
+def _emit(final: bool = False) -> bool:
+    """Print the (single) JSON line; idempotent across threads.
+    Returns True iff this call did the printing."""
+    global _emitted
+    with _emit_lock:
+        if _emitted:
+            return False
+        _emitted = True
+        out = dict(_result)
+        if final:
+            out.pop("diag", None)
+        print(json.dumps(out), flush=True)
+        return True
+
+
+def _watchdog(budget_s: float) -> None:
+    deadline = _t_start + budget_s
+    while True:
+        time.sleep(min(5.0, max(deadline - time.perf_counter(), 0.01)))
+        if _emitted:
+            return
+        if time.perf_counter() >= deadline:
+            _result["diag"] = (
+                f"watchdog: budget {budget_s:.0f}s exceeded at stage "
+                f"'{_result.get('diag', '?')}'")
+            if _emit():  # False ⇒ main already printed; let it finish
+                sys.stdout.flush()
+                os._exit(0)
+            return
+
 
 def main():
-    import jax
-
-    from analytics_zoo_tpu import init_nncontext
-    from analytics_zoo_tpu.models.image.imageclassification import resnet50
-    from analytics_zoo_tpu.ops import losses, optimizers
-    import optax
+    # fire before the parent supervisor's kill (budget-15s) so the
+    # stage diagnostic reaches the driver when the hang is in
+    # GIL-releasing code; the supervisor covers GIL-holding hangs
+    raw = float(os.environ.get("ZOO_TPU_BENCH_BUDGET_S", "480"))
+    budget = max(raw - 40.0, 0.5 * raw)
+    threading.Thread(target=_watchdog, args=(budget,),
+                     daemon=True).start()
 
     batch = int(os.environ.get("ZOO_TPU_BENCH_BATCH", "128"))
     image = int(os.environ.get("ZOO_TPU_BENCH_IMAGE", "224"))
-    steps = int(os.environ.get("ZOO_TPU_BENCH_STEPS", "10"))
+    steps = int(os.environ.get("ZOO_TPU_BENCH_STEPS", "20"))
     peak_tflops = float(os.environ.get("ZOO_TPU_PEAK_TFLOPS", "197"))
 
-    ctx = init_nncontext(tpu_mesh={"data": 1},
-                         devices=jax.devices()[:1],
-                         log_level="WARNING")
+    _result["diag"] = "importing jax"
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    # Optional platform pin (e.g. ZOO_TPU_BENCH_PLATFORM=cpu for a local
+    # smoke run): the JAX_PLATFORMS env var alone does not stop the axon
+    # plugin from hanging device init; the config update does.
+    plat = os.environ.get("ZOO_TPU_BENCH_PLATFORM")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+
+    _result["diag"] = "backend init (jax.devices)"
+    t0 = time.perf_counter()
+    devices = jax.devices()
+    t_init = time.perf_counter() - t0
+    print(f"# backend={devices[0].platform} n_devices={len(devices)} "
+          f"init={t_init:.1f}s", file=sys.stderr, flush=True)
+
+    _result["diag"] = "building model"
+    from analytics_zoo_tpu import init_nncontext
+    from analytics_zoo_tpu.models.image.imageclassification import resnet50
+    from analytics_zoo_tpu.ops import losses, optimizers
+    from analytics_zoo_tpu.pipeline.estimator import Estimator
+
+    init_nncontext(tpu_mesh={"data": 1}, devices=devices[:1],
+                   log_level="WARNING")
     model = resnet50(input_shape=(image, image, 3), classes=1000)
     params = model.init_params()
     loss_fn = losses.softmax_cross_entropy
@@ -49,74 +128,143 @@ def main():
             compute_loss, has_aux=True)(params)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
-        from analytics_zoo_tpu.pipeline.estimator import Estimator
         params = Estimator._merge_updates(params, upd)
         return params, opt_state, loss
 
     rs = np.random.RandomState(0)
     # bf16 inputs: layers compute in input dtype, params stay f32
-    x = jax.numpy.asarray(
-        rs.randn(batch, image, image, 3), jax.numpy.bfloat16)
-    y = jax.numpy.asarray(rs.randint(0, 1000, size=(batch, 1)),
-                          jax.numpy.int32)
+    x = jnp.asarray(rs.randn(batch, image, image, 3), jnp.bfloat16)
+    y = jnp.asarray(rs.randint(0, 1000, size=(batch, 1)), jnp.int32)
 
-    # Remote-device transports make per-call host syncs expensive and
-    # async dispatch unreliable for timing: chain K steps inside ONE jit
-    # via lax.scan, force a scalar to host to sync, and difference two
-    # chain lengths to cancel the constant round-trip/dispatch overhead.
-    def chain(k):
-        def run(params, opt_state, x, y):
-            def body(carry, _):
-                p, o = carry
-                p, o, loss = train_step(p, o, x, y)
-                return (p, o), loss
-            (p, o), losses_seq = jax.lax.scan(
-                body, (params, opt_state), None, length=k)
-            return p, o, losses_seq[-1]
-        return jax.jit(run)
+    # ONE compiled program: a lax.scan chain of `steps` train steps.
+    # Remote-device transports make per-call host syncs expensive, so the
+    # whole measurement is one dispatch + one scalar fetch; the constant
+    # round-trip overhead is estimated with a trivial jitted op and
+    # subtracted.
+    def run(params, opt_state, x, y):
+        def body(carry, _):
+            p, o = carry
+            p, o, loss = train_step(p, o, x, y)
+            return (p, o), loss
+        (p, o), losses_seq = jax.lax.scan(
+            body, (params, opt_state), None, length=steps)
+        return p, o, losses_seq[-1]
 
-    single = jax.jit(train_step)
+    _result["diag"] = "compiling train step"
+    t0 = time.perf_counter()
+    compiled = jax.jit(run).lower(params, opt_state, x, y).compile()
+    t_compile = time.perf_counter() - t0
+    print(f"# compile={t_compile:.1f}s", file=sys.stderr, flush=True)
+
+    # analytic estimate: fwd ~4.09 GFLOPs/img @224, train ~3x fwd
+    flops_analytic = 3 * 4.09e9 * batch * (image / 224.0) ** 2
     try:
-        cost = single.lower(params, opt_state, x, y).compile() \
-            .cost_analysis()
+        cost = compiled.cost_analysis()
         cost = cost[0] if isinstance(cost, (list, tuple)) else cost
+        # XLA's HloCostAnalysis counts a while/scan body ONCE, not per
+        # trip (verified empirically), so the chain's flops ~= one step's
         flops_per_step = float(cost.get("flops", 0.0))
     except Exception:
         flops_per_step = 0.0
-    if not flops_per_step or flops_per_step != flops_per_step:
-        # analytic fallback: fwd ~4.09 GFLOPs/img @224, train ~3x fwd
-        flops_per_step = 3 * 4.09e9 * batch * (image / 224.0) ** 2
+    if not (0.2 * flops_analytic < flops_per_step < 5 * flops_analytic):
+        # nan/zero, or a cost-model change (e.g. per-trip counting)
+        flops_per_step = flops_analytic
 
-    k_short, k_long = 2, 2 + steps
-    run_short = chain(k_short)
-    run_long = chain(k_long)
-
-    def timed(fn):
+    # constant dispatch/round-trip overhead estimate (min of 5 samples:
+    # a single transient RPC spike must not inflate the reported MFU)
+    tiny = jax.jit(lambda a: a + 1.0).lower(
+        jnp.zeros((), jnp.float32)).compile()
+    float(np.asarray(tiny(jnp.zeros((), jnp.float32))))  # warm
+    overhead = float("inf")
+    for _ in range(5):
         t0 = time.perf_counter()
-        p, o, loss = fn(params, opt_state, x, y)
+        float(np.asarray(tiny(jnp.zeros((), jnp.float32))))
+        overhead = min(overhead, time.perf_counter() - t0)
+
+    def timed():
+        t0 = time.perf_counter()
+        p, o, loss = compiled(params, opt_state, x, y)
         loss_val = float(np.asarray(loss))  # host fetch = real sync
         return time.perf_counter() - t0, loss_val
 
-    timed(run_short)  # warmup (compile)
-    timed(run_long)
-    t_short, _ = timed(run_short)
-    t_long, loss = timed(run_long)
-    dt = max(t_long - t_short, 1e-9)
+    def derive(best_dt):
+        dt = max(best_dt - overhead, 1e-9)
+        images_per_sec = batch * steps / dt
+        mfu = (flops_per_step * steps / dt) / (peak_tflops * 1e12)
+        return dt, images_per_sec, mfu
 
-    images_per_sec = batch * steps / dt
-    steps_per_sec = steps / dt
-    mfu = (flops_per_step * steps_per_sec) / (peak_tflops * 1e12)
-    print(json.dumps({
-        "metric": "resnet50_train_images_per_sec_per_chip",
-        "value": round(images_per_sec, 2),
-        "unit": "images/sec",
-        "vs_baseline": round(mfu / 0.45, 4),
-    }))
+    _result["diag"] = "warmup run"
+    timed()  # warmup (execution path, allocator)
+    _result["diag"] = "timing"
+    best_dt = None
+    loss = float("nan")
+    for _ in range(2):
+        dt_i, loss = timed()
+        best_dt = dt_i if best_dt is None else min(best_dt, dt_i)
+        # record a result as soon as one measurement exists so the
+        # watchdog has something real to print
+        dt, images_per_sec, mfu = derive(best_dt)
+        _result.update(value=round(images_per_sec, 2),
+                       vs_baseline=round(mfu / 0.45, 4),
+                       diag="timed")
+
+    dt, _, mfu = derive(best_dt)
+    _emit(final=True)
     print(f"# batch={batch} image={image} steps={steps} "
           f"step_time={dt / steps * 1000:.1f}ms mfu={mfu:.3f} "
-          f"loss={float(loss):.3f} flops/step={flops_per_step:.3e}",
+          f"loss={loss:.3f} flops/step={flops_per_step:.3e} "
+          f"overhead={overhead * 1000:.1f}ms init={t_init:.1f}s "
+          f"compile={t_compile:.1f}s total={time.perf_counter() - _t_start:.1f}s",
           file=sys.stderr)
 
 
+def _supervise(budget_s: float) -> None:
+    """Run the measurement in a child process; the parent never imports
+    jax, so a C-level hang holding the GIL in the child (the round-1
+    axon-init failure mode) cannot starve this timeout. The parent
+    relays the child's output and prints the fallback JSON itself if
+    the child produces no JSON line in time."""
+    import subprocess
+
+    deadline = _t_start + budget_s
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--child"],
+        stdout=subprocess.PIPE, text=True)
+    json_line = None
+    try:
+        out, _ = proc.communicate(
+            timeout=max(deadline - time.perf_counter(), 1.0))
+        for line in out.splitlines():
+            if line.startswith("{"):
+                json_line = line
+            else:
+                print(line)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out = proc.communicate()[0] or ""
+        for line in out.splitlines():
+            if line.startswith("{"):
+                json_line = line
+    if json_line is not None:
+        print(json_line, flush=True)
+    else:
+        _result["diag"] = (
+            f"supervisor: child produced no JSON within {budget_s:.0f}s "
+            f"(rc={proc.returncode})")
+        _emit()
+    sys.exit(0 if json_line is not None else 1 if proc.returncode else 0)
+
+
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv:
+        try:
+            main()
+        except Exception as e:  # emit signal even on crash
+            _result["diag"] = f"error: {type(e).__name__}: {e}"
+            _emit()
+            raise
+    else:
+        raw = float(os.environ.get("ZOO_TPU_BENCH_BUDGET_S", "480"))
+        # leave headroom under the driver's timeout, but never zero out
+        # a small (smoke-run) budget
+        _supervise(max(raw - 15.0, 0.6 * raw))
