@@ -16,13 +16,24 @@ from typing import Any, BinaryIO
 
 _SAFE_MODULE_PREFIXES = (
     # CLASSES only (enforced in find_class): a function admitted by
-    # prefix would be a REDUCE gadget (e.g. utils.remove)
-    "analytics_zoo_tpu.",
-    # optimizer-state containers inside checkpoints (data classes /
-    # namedtuples, no side-effecting constructors)
-    "optax.",
-    "chex.",
+    # prefix would be a REDUCE gadget (e.g. utils.remove). Scoped to
+    # the subtrees whose classes legitimately appear in saved files
+    # (layers/models/preprocessing); `native` (ctypes), `inference`
+    # (file-loading constructors), `tfpark`, and `common` stay out of
+    # the gadget surface.
+    # every entry ends with "."; `module == p[:-1]` below handles the
+    # exact package/module name itself
+    "analytics_zoo_tpu.pipeline.api.",
+    "analytics_zoo_tpu.pipeline.estimator.",
+    "analytics_zoo_tpu.pipeline.nnframes.",
+    "analytics_zoo_tpu.feature.",
+    "analytics_zoo_tpu.models.",
+    "analytics_zoo_tpu.ops.",
 )
+
+# optimizer-state containers inside checkpoints: admitted only if the
+# class is a NamedTuple (tuple subclass — no side-effecting __init__)
+_SAFE_STATE_PREFIXES = ("optax.", "chex.")
 
 _SAFE_CLASSES = {
     ("builtins", "dict"), ("builtins", "list"), ("builtins", "tuple"),
@@ -59,6 +70,15 @@ class CheckedUnpickler(pickle.Unpickler):
                     f"refusing to deserialize {module}.{name}: only "
                     "classes are admitted by prefix (functions are "
                     "REDUCE code-execution gadgets)")
+            return obj
+        if any(module == p[:-1] or module.startswith(p)
+               for p in _SAFE_STATE_PREFIXES):
+            obj = super().find_class(module, name)
+            if not (isinstance(obj, type) and issubclass(obj, tuple)):
+                raise UnsafePickleError(
+                    f"refusing to deserialize {module}.{name}: only "
+                    "NamedTuple state containers are admitted from "
+                    "optimizer libraries")
             return obj
         raise UnsafePickleError(
             f"refusing to deserialize {module}.{name}: not in the "

@@ -23,11 +23,26 @@ import numpy as np
 class Metric:
     name = "metric"
 
-    def batch_stats(self, y_true, y_pred) -> "dict[str, jnp.ndarray]":
+    def batch_stats(self, y_true, y_pred,
+                    mask=None) -> "dict[str, jnp.ndarray]":
+        """``mask`` is an optional per-sample {0,1} float vector of
+        length batch; samples with mask 0 (padding added so a tail
+        batch divides the data-parallel size) contribute nothing."""
         raise NotImplementedError
 
     def aggregate(self, stats: "dict[str, np.ndarray]") -> float:
         raise NotImplementedError
+
+
+def _sample_mask(mask, ref):
+    """Broadcast a per-sample mask over a (batch, ...) values array;
+    returns (masked values multiplier, effective element count)."""
+    if mask is None:
+        return None, jnp.asarray(ref.size, jnp.float32)
+    m = jnp.broadcast_to(
+        mask.astype(jnp.float32).reshape((-1,) + (1,) * (ref.ndim - 1)),
+        ref.shape)
+    return m, jnp.sum(m)
 
 
 class Accuracy(Metric):
@@ -38,7 +53,7 @@ class Accuracy(Metric):
 
     name = "accuracy"
 
-    def batch_stats(self, y_true, y_pred):
+    def batch_stats(self, y_true, y_pred, mask=None):
         if y_pred.ndim >= 2 and y_pred.shape[-1] > 1:
             pred = jnp.argmax(y_pred, axis=-1)
             if y_true.ndim == y_pred.ndim and y_true.shape[-1] > 1:
@@ -50,8 +65,9 @@ class Accuracy(Metric):
                     0.5).astype(jnp.int32)
             true = y_true.reshape(y_true.shape[0], -1)[:, 0] \
                 .astype(jnp.int32)
-        correct = jnp.sum((pred == true).astype(jnp.float32))
-        count = jnp.asarray(pred.size, jnp.float32)
+        hits = (pred == true).astype(jnp.float32)
+        m, count = _sample_mask(mask, hits)
+        correct = jnp.sum(hits if m is None else hits * m)
         return {"correct": correct, "count": count}
 
     def aggregate(self, stats):
@@ -68,15 +84,15 @@ class Top5Accuracy(Metric):
 
     name = "top5accuracy"
 
-    def batch_stats(self, y_true, y_pred):
+    def batch_stats(self, y_true, y_pred, mask=None):
         true = (jnp.argmax(y_true, axis=-1)
                 if y_true.ndim == y_pred.ndim and y_true.shape[-1] > 1
                 else y_true.reshape(y_pred.shape[0]).astype(jnp.int32))
         _, top5 = jax.lax.top_k(y_pred, 5)
-        correct = jnp.sum(jnp.any(top5 == true[:, None], axis=-1)
-                          .astype(jnp.float32))
-        return {"correct": correct,
-                "count": jnp.asarray(true.size, jnp.float32)}
+        hits = jnp.any(top5 == true[:, None], axis=-1).astype(jnp.float32)
+        m, count = _sample_mask(mask, hits)
+        return {"correct": jnp.sum(hits if m is None else hits * m),
+                "count": count}
 
     def aggregate(self, stats):
         return float(stats["correct"] / np.maximum(stats["count"], 1.0))
@@ -87,10 +103,11 @@ class MAE(Metric):
 
     name = "mae"
 
-    def batch_stats(self, y_true, y_pred):
-        return {"abs_sum": jnp.sum(jnp.abs(y_pred - y_true))
-                .astype(jnp.float32),
-                "count": jnp.asarray(y_pred.size, jnp.float32)}
+    def batch_stats(self, y_true, y_pred, mask=None):
+        err = jnp.abs(y_pred - y_true).astype(jnp.float32)
+        m, count = _sample_mask(mask, err)
+        return {"abs_sum": jnp.sum(err if m is None else err * m),
+                "count": count}
 
     def aggregate(self, stats):
         return float(stats["abs_sum"] / np.maximum(stats["count"], 1.0))
@@ -99,10 +116,11 @@ class MAE(Metric):
 class MSE(Metric):
     name = "mse"
 
-    def batch_stats(self, y_true, y_pred):
-        return {"sq_sum": jnp.sum(jnp.square(y_pred - y_true))
-                .astype(jnp.float32),
-                "count": jnp.asarray(y_pred.size, jnp.float32)}
+    def batch_stats(self, y_true, y_pred, mask=None):
+        err = jnp.square(y_pred - y_true).astype(jnp.float32)
+        m, count = _sample_mask(mask, err)
+        return {"sq_sum": jnp.sum(err if m is None else err * m),
+                "count": count}
 
     def aggregate(self, stats):
         return float(stats["sq_sum"] / np.maximum(stats["count"], 1.0))
@@ -116,9 +134,17 @@ class Loss(Metric):
     def __init__(self, loss_fn: Callable):
         self.loss_fn = loss_fn
 
-    def batch_stats(self, y_true, y_pred):
-        n = jnp.asarray(y_pred.shape[0], jnp.float32)
-        return {"loss_sum": self.loss_fn(y_true, y_pred) * n, "count": n}
+    def batch_stats(self, y_true, y_pred, mask=None):
+        if mask is None:
+            n = jnp.asarray(y_pred.shape[0], jnp.float32)
+            return {"loss_sum": self.loss_fn(y_true, y_pred) * n,
+                    "count": n}
+        # per-sample losses (each a mean over one sample's elements) so
+        # padded samples can be zeroed out
+        per = jax.vmap(
+            lambda t, p: self.loss_fn(t[None], p[None]))(y_true, y_pred)
+        m = mask.astype(jnp.float32)
+        return {"loss_sum": jnp.sum(per * m), "count": jnp.sum(m)}
 
     def aggregate(self, stats):
         return float(stats["loss_sum"] / np.maximum(stats["count"], 1.0))
@@ -133,18 +159,24 @@ class AUC(Metric):
     def __init__(self, thresholds: int = 200):
         self.n_thresholds = int(thresholds)
 
-    def batch_stats(self, y_true, y_pred):
+    def batch_stats(self, y_true, y_pred, mask=None):
         scores = y_pred.reshape(-1).astype(jnp.float32)
         labels = y_true.reshape(-1).astype(jnp.float32)
+        if mask is None:
+            w = jnp.ones_like(scores)
+        else:
+            w, _ = _sample_mask(mask, y_pred)
+            w = w.reshape(-1)
         ts = jnp.linspace(0.0, 1.0, self.n_thresholds)
         pred_pos = scores[None, :] >= ts[:, None]  # (T, N)
         is_pos = labels[None, :] > 0.5
-        tp = jnp.sum(pred_pos & is_pos, axis=1).astype(jnp.float32)
-        fp = jnp.sum(pred_pos & ~is_pos, axis=1).astype(jnp.float32)
-        pos = jnp.sum(is_pos.astype(jnp.float32))
-        neg = labels.size - pos
-        return {"tp": tp, "fp": fp,
-                "pos": pos, "neg": jnp.asarray(neg, jnp.float32)}
+        tp = jnp.sum(jnp.where(pred_pos & is_pos, w[None, :], 0.0),
+                     axis=1)
+        fp = jnp.sum(jnp.where(pred_pos & ~is_pos, w[None, :], 0.0),
+                     axis=1)
+        pos = jnp.sum(jnp.where(is_pos[0], w, 0.0))
+        neg = jnp.sum(w) - pos
+        return {"tp": tp, "fp": fp, "pos": pos, "neg": neg}
 
     def aggregate(self, stats):
         tpr = stats["tp"] / np.maximum(stats["pos"], 1.0)

@@ -406,19 +406,30 @@ class ZeroPadding1D(KerasLayer):
 
 
 class ZeroPadding2D(KerasLayer):
-    """(reference `layers/ZeroPadding2D.scala`)"""
+    """(reference `layers/ZeroPadding2D.scala`)
+
+    ``value`` (default 0) sets the pad constant — e.g. ``-inf`` when a
+    torch padded MaxPool2d is imported, whose implicit padding must
+    never win the max (torch pads with -inf, not 0)."""
 
     def __init__(self, padding=(1, 1), dim_ordering="tf", input_shape=None,
-                 name=None, **kwargs):
+                 name=None, value=0.0, **kwargs):
         super().__init__(input_shape=input_shape, name=name, **kwargs)
         p = _norm_tuple(padding, 2, "padding")
         self.padding = ((p[0], p[0]), (p[1], p[1]))
         self.dim_ordering = dim_ordering
+        self.value = value
 
     def call(self, params, x, *, training=False, rng=None):
         if self.dim_ordering == "tf":
-            return jnp.pad(x, ((0, 0),) + self.padding + ((0, 0),))
-        return jnp.pad(x, ((0, 0), (0, 0)) + self.padding)
+            pads = ((0, 0),) + self.padding + ((0, 0),)
+        else:
+            pads = ((0, 0), (0, 0)) + self.padding
+        val = self.value
+        if val == float("-inf"):  # representable floor for the dtype
+            val = jnp.finfo(x.dtype).min if jnp.issubdtype(
+                x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return jnp.pad(x, pads, constant_values=val)
 
     def compute_output_shape(self, input_shape):
         s = list(input_shape)

@@ -19,6 +19,8 @@ TPU-native mapping:
 
 from __future__ import annotations
 
+import os
+import pickle
 from typing import Any, Optional, Sequence
 
 import numpy as np
@@ -94,7 +96,7 @@ class Net:
 
         module = module_or_path
         if isinstance(module_or_path, str):
-            module = torch.load(module_or_path, weights_only=False)
+            module = _safe_torch_load(module_or_path)
         if not isinstance(module, torch.nn.Module):
             raise TypeError(f"expected torch.nn.Module, got "
                             f"{type(module)}")
@@ -129,6 +131,44 @@ class Net:
         logger.info("load_torch: imported %d layers, %d weighted",
                     len(zoo_layers), len(weight_map))
         return net
+
+
+def _safe_torch_load(path: str):
+    """Load a pickled torch module WITHOUT executing arbitrary pickle
+    code: ``weights_only=True`` plus an allowlist of exactly the
+    ``torch.nn`` classes the importer can map. Arbitrary-code pickles
+    require the explicit opt-in env ``ZOO_TPU_TRUST_TORCH_PICKLE=1``
+    (mirrors the framework-wide CheckedUnpickler hardening)."""
+    import torch
+    import torch.nn as nn
+
+    safe = [
+        nn.Sequential, nn.Linear, nn.Conv2d, nn.MaxPool2d, nn.AvgPool2d,
+        nn.AdaptiveAvgPool2d, nn.BatchNorm1d, nn.BatchNorm2d,
+        nn.LayerNorm, nn.Embedding, nn.Flatten, nn.Dropout, nn.Identity,
+        nn.ReLU, nn.Sigmoid, nn.Tanh, nn.GELU, nn.SiLU, nn.Softmax,
+        nn.LeakyReLU, nn.ELU,
+    ]
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    try:
+        with torch.serialization.safe_globals(safe):
+            return torch.load(path, weights_only=True)
+    except (pickle.UnpicklingError, RuntimeError, ValueError) as e:
+        # only unpickling-safety failures reach the trust gate;
+        # missing/corrupt-file errors propagate as themselves
+        if os.environ.get("ZOO_TPU_TRUST_TORCH_PICKLE") == "1":
+            logger.warning(
+                "load_torch: %s failed the weights-only safety check "
+                "(%s); loading with arbitrary pickle execution because "
+                "ZOO_TPU_TRUST_TORCH_PICKLE=1 — only do this for "
+                "trusted files", path, e)
+            return torch.load(path, weights_only=False)
+        raise RuntimeError(
+            f"refusing to unpickle {path!r} with code execution "
+            f"(weights-only load failed: {e}); if the file is trusted, "
+            "set ZOO_TPU_TRUST_TORCH_PICKLE=1 or pass the live module "
+            "object instead of a path") from e
 
 
 def _check_and_set(sub: dict, key: str, value: np.ndarray, name: str):
@@ -221,7 +261,11 @@ def _torch_to_zoo(module):
                     raise NotImplementedError(
                         "padded torch AvgPool2d (zero-inclusion "
                         "semantics differ)")
-                emit(L.ZeroPadding2D(padding=pad, dim_ordering="th"))
+                # torch MaxPool pads implicitly with -inf, NOT zeros: a
+                # window of all-negative activations must keep its true
+                # max, so pad with the dtype floor
+                emit(L.ZeroPadding2D(padding=pad, dim_ordering="th",
+                                     value=float("-inf")))
             cls = (L.MaxPooling2D if isinstance(m, nn.MaxPool2d)
                    else L.AveragePooling2D)
             stride = m.stride if m.stride is not None \

@@ -609,8 +609,7 @@ _register("ArgMin")(lambda a, i: jnp.argmin(
     i[0], axis=a.get("axis", 0), keepdims=bool(a.get("keepdims", 1))))
 
 
-@_register("Resize", "Upsample")
-def _resize(a, i):
+def _resize_impl(a, i, ct, default_nearest="round_prefer_floor"):
     x = i[0]
     mode = a.get("mode", "nearest")
     sizes = None
@@ -627,9 +626,48 @@ def _resize(a, i):
             scales_in = np.asarray(a.get("scales"))
         # ONNX: output_dim = floor(input_dim * scale)
         sizes = [int(np.floor(s * f)) for s, f in zip(x.shape, scales_in)]
+    if mode == "nearest" and ct == "asymmetric":
+        # exact opset-10 Upsample / torch Upsample semantics:
+        # src = f(dst / scale) per axis via integer gathers
+        nearest = a.get("nearest_mode", default_nearest)
+        out = x
+        for axis, (insz, outsz) in enumerate(zip(x.shape, sizes)):
+            if insz == outsz:
+                continue
+            pos = np.arange(outsz) * (insz / outsz)
+            if nearest == "floor":
+                src = np.floor(pos)
+            elif nearest == "ceil":
+                src = np.ceil(pos)
+            elif nearest == "round_prefer_floor":
+                src = np.ceil(pos - 0.5)
+            else:  # round_prefer_ceil
+                src = np.floor(pos + 0.5)
+            src = np.clip(src.astype(np.int64), 0, insz - 1)
+            out = jnp.take(out, jnp.asarray(src), axis=axis)
+        return out
+    if ct not in ("half_pixel", "pytorch_half_pixel"):
+        # silently falling back to half-pixel shifts pixels for
+        # asymmetric/align_corners exports (ADVICE r1)
+        raise NotImplementedError(
+            f"Resize coordinate_transformation_mode={ct!r} with "
+            f"mode={mode!r}: only half_pixel(/pytorch_half_pixel), or "
+            "nearest+asymmetric, are supported")
     method = {"nearest": "nearest", "linear": "linear",
               "cubic": "cubic"}[mode]
     return jax.image.resize(x, sizes, method=method)
+
+
+@_register("Resize")
+def _resize(a, i):
+    return _resize_impl(
+        a, i, a.get("coordinate_transformation_mode", "half_pixel"))
+
+
+@_register("Upsample")
+def _upsample(a, i):
+    # opset<=10 Upsample is defined as asymmetric coordinates + floor
+    return _resize_impl(a, i, "asymmetric", default_nearest="floor")
 
 
 @_register("Dropout")

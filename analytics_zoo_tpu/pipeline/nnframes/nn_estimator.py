@@ -259,9 +259,9 @@ class NNModel(_Params):
 
     @classmethod
     def load(cls, path: str) -> "NNModel":
+        from analytics_zoo_tpu.common.safe_pickle import checked_load
         from analytics_zoo_tpu.parallel.mesh import shard_params
-        with open(path, "rb") as f:
-            state = pickle.load(f)
+        state = checked_load(path)  # class-whitelist deserialization
         klass = (NNClassifierModel
                  if state.get("class") == "NNClassifierModel" else cls)
         m = klass(state["model"], state["feature_preprocessing"])

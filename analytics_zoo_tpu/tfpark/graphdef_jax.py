@@ -264,6 +264,10 @@ _OPS["AvgPool"] = lambda node, i: _pool(node, i, lax.add, 0.0,
 
 @_op("FusedBatchNormV3", "FusedBatchNorm", "FusedBatchNormV2")
 def _fused_bn(node, i):
+    """Multi-output like TF: :0 = y, :1/:2 = batch mean/var (training
+    graphs read them for the moving-average update chain), :3+ =
+    reserve spaces (backward-pass intermediates; bound to mean/var so
+    consumers resolve — the backward ops themselves are not run here)."""
     x, scale, offset, mean, var = i[:5]
     eps = _attr(node, "epsilon", 1e-3)
     if _attr(node, "is_training", True):
@@ -271,7 +275,8 @@ def _fused_bn(node, i):
         mean = jnp.mean(x, axes)
         var = jnp.var(x, axes)
     inv = lax.rsqrt(var + eps) * scale
-    return (x - mean) * inv + offset
+    y = (x - mean) * inv + offset
+    return (y, mean, var, mean, var, mean)
 
 
 # -- shape / indexing ---------------------------------------------------------

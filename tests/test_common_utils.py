@@ -97,13 +97,40 @@ def test_zoo_model_load_rejects_foreign_class(tmp_path):
 def test_checked_load_rejects_framework_function_gadget():
     """Functions under whitelisted prefixes are REDUCE gadgets — only
     classes may resolve."""
+    from analytics_zoo_tpu.ops import losses
+
+    class Gadget:
+        def __reduce__(self):
+            return (losses.get, ("mse",))
+
+    payload = pickle.dumps(Gadget())
+    with pytest.raises(UnsafePickleError, match="gadget"):
+        checked_loads(payload)
+
+
+def test_checked_load_rejects_unlisted_framework_module():
+    """`common`/`native`/`inference` subtrees are no longer admitted at
+    all (ADVICE r1: shrink the prefix gadget surface)."""
     class Gadget:
         def __reduce__(self):
             return (utils.remove, ("/nonexistent-path", True))
 
     payload = pickle.dumps(Gadget())
-    with pytest.raises(UnsafePickleError, match="gadget"):
+    with pytest.raises(UnsafePickleError, match="whitelist"):
         checked_loads(payload)
+
+
+def test_checked_load_rejects_non_namedtuple_optax():
+    """optax/chex admit only NamedTuple state containers."""
+    payload = pickle.dumps(Gadget2())
+    with pytest.raises(UnsafePickleError, match="NamedTuple"):
+        checked_loads(payload)
+
+
+class Gadget2:
+    def __reduce__(self):
+        import optax
+        return (optax.sgd, (0.1,))
 
 
 def test_zoo_model_load_rejects_non_model_class(tmp_path):

@@ -85,6 +85,53 @@ def test_load_torch_embedding(rng):
     assert_close(net.predict(x, batch_size=3), ref)
 
 
+def test_load_torch_padded_maxpool_negative_window(rng):
+    """Torch pads MaxPool2d implicitly with -inf, not zeros: a window
+    of all-negative activations must keep its true (negative) max
+    (ADVICE r1 medium #1)."""
+    torch.manual_seed(3)
+    tm = nn.Sequential(nn.MaxPool2d(2, stride=2, padding=1))
+    tm.eval()
+    net = Net.load_torch(tm, input_shape=(1, 4, 4))
+    x = -np.abs(rng.randn(2, 1, 4, 4).astype(np.float32)) - 1.0
+    with torch.no_grad():
+        ref = tm(torch.from_numpy(x)).numpy()
+    assert_close(net.predict(x, batch_size=2), ref)
+    assert np.asarray(net.predict(x, batch_size=2)).max() < 0
+
+
+def test_load_torch_from_path_weights_only(rng, tmp_path):
+    """Path loads go through torch's weights_only unpickler with an
+    nn-class allowlist — no arbitrary pickle code execution
+    (ADVICE r1 medium #2)."""
+    torch.manual_seed(4)
+    tm = nn.Sequential(nn.Linear(6, 8), nn.ReLU(), nn.Linear(8, 2))
+    tm.eval()
+    p = str(tmp_path / "model.pt")
+    torch.save(tm, p)
+    net = Net.load_torch(p, input_shape=(6,))
+    x = rng.randn(3, 6).astype(np.float32)
+    with torch.no_grad():
+        ref = tm(torch.from_numpy(x)).numpy()
+    assert_close(net.predict(x, batch_size=3), ref)
+
+
+def test_load_torch_path_rejects_code_pickle(tmp_path):
+    """A pickle that smuggles a non-allowlisted callable is refused
+    unless explicitly trusted via env."""
+    import pickle
+
+    class Evil:
+        def __reduce__(self):
+            return (print, ("pwned",))
+
+    p = str(tmp_path / "evil.pt")
+    with open(p, "wb") as f:
+        pickle.dump(Evil(), f)
+    with pytest.raises(RuntimeError, match="refusing to unpickle"):
+        Net.load_torch(p, input_shape=(4,))
+
+
 def test_load_torch_unsupported_module():
     tm = nn.Sequential(nn.Linear(4, 4), nn.TransformerEncoderLayer(4, 2))
     with pytest.raises(NotImplementedError, match="ONNX"):
