@@ -2,3 +2,5 @@ from analytics_zoo_tpu.feature.common import (
     Preprocessing, ChainedPreprocessing, ArrayToTensor, SeqToTensor,
     ScalarToTensor, TensorToSample, FeatureLabelPreprocessing, Sample)
 from analytics_zoo_tpu.feature.feature_set import FeatureSet, MemoryType
+from analytics_zoo_tpu.feature.rdd import LocalRdd, collect_shard, \
+    is_rdd_like, is_spark_dataframe, process_shard_spec

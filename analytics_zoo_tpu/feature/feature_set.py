@@ -143,6 +143,39 @@ class FeatureSet:
         return FeatureSet(x_cols, y_col, memory_type=memory_type, **kw)
 
     @staticmethod
+    def from_rdd(rdd: Any,
+                 preprocessing: Optional[Preprocessing] = None,
+                 memory_type="dram",
+                 shard_index: Optional[int] = None,
+                 num_shards: Optional[int] = None, **kw) -> "FeatureSet":
+        """Ingest from anything implementing the RDD protocol — a real
+        ``pyspark.RDD`` or :class:`~analytics_zoo_tpu.feature.rdd.LocalRdd`
+        (reference: ``FeatureSet.rdd``, `Z/feature/FeatureSet.scala:308`).
+
+        Each JAX process collects only its round-robin share of the
+        partitions (defaults wired to ``jax.process_index()`` /
+        ``jax.process_count()``), so multi-host ingest needs no flags.
+        Records may be `Sample`s or raw values run through
+        ``preprocessing``.
+        """
+        from analytics_zoo_tpu.feature.rdd import collect_shard, \
+            is_spark_dataframe
+        if is_spark_dataframe(rdd):
+            rdd = rdd.rdd
+        records = collect_shard(rdd, shard_index, num_shards)
+        if records and not isinstance(records[0], Sample) \
+                and preprocessing is None:
+            # raw (feature, label) tuples or bare feature arrays
+            records = [Sample(feature=r[0], label=r[1])
+                       if isinstance(r, tuple) and len(r) == 2
+                       else Sample(feature=r) for r in records]
+        # the shard filter already ran; the row-range splitter must not
+        # re-shard what is now purely local data
+        return FeatureSet.from_iterable(
+            records, preprocessing, memory_type=memory_type,
+            shard_index=0, num_shards=1, **kw)
+
+    @staticmethod
     def from_iterable(records: Iterable[Any],
                       preprocessing: Optional[Preprocessing] = None,
                       memory_type="dram", **kw) -> "FeatureSet":

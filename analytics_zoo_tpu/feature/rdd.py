@@ -1,0 +1,145 @@
+"""Spark RDD/DataFrame ingest adapter (the L2↔Spark bridge).
+
+Reference: the defining trait of analytics-zoo is that data arrives as
+Spark `RDD[Sample]` / DataFrames — `FeatureSet.rdd`
+(`Z/feature/FeatureSet.scala:308-335`), `KerasNet.fit(RDD[Sample])`
+(`Z/pipeline/api/keras/models/Topology.scala:411`), and nnframes'
+`NNEstimator.getDataSet` (`Z/pipeline/nnframes/NNEstimator.scala:361-390`).
+
+TPU-native redesign: Spark stays an *ingest role*, not a runtime
+dependency (SURVEY.md §2.10). Anything that quacks like an RDD —
+``getNumPartitions()`` + ``mapPartitionsWithIndex(f)`` + ``collect()``
+— can feed a :class:`FeatureSet`:
+
+- a real ``pyspark.RDD`` (when pyspark is installed; none of the code
+  here imports pyspark — the protocol is duck-typed, and the lambdas
+  shipped to executors use only the stdlib);
+- :class:`LocalRdd`, the in-process reference implementation used by
+  tests and by no-Spark deployments.
+
+Multi-host sharding: each JAX process keeps only the partitions
+``p % process_count == process_index`` (round-robin over partitions, the
+same per-host ownership Spark locality gave the reference's executors),
+so an N-host TPU pod ingests 1/N of the RDD per host without any
+cross-host traffic beyond what Spark itself does.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, Iterator, List, Optional, \
+    Sequence, Tuple
+
+import numpy as np
+
+from analytics_zoo_tpu.common.nncontext import logger
+from analytics_zoo_tpu.feature.common import Preprocessing, Sample
+
+
+def process_shard_spec() -> "tuple[int, int]":
+    """(shard_index, num_shards) for this host = (process_index,
+    process_count). Single-process (the common case, incl. tests) is
+    (0, 1)."""
+    import jax
+
+    try:
+        return jax.process_index(), jax.process_count()
+    except Exception:  # backend not initialized yet
+        return 0, 1
+
+
+def is_rdd_like(obj: Any) -> bool:
+    """The duck-typed RDD protocol."""
+    return all(hasattr(obj, m) for m in
+               ("mapPartitionsWithIndex", "collect", "getNumPartitions"))
+
+
+def is_spark_dataframe(obj: Any) -> bool:
+    """A pyspark DataFrame quacks: has .rdd, .columns and .toPandas but
+    is not a pandas DataFrame (pandas has no .rdd)."""
+    return hasattr(obj, "rdd") and hasattr(obj, "toPandas") \
+        and hasattr(obj, "columns")
+
+
+def _partition_filter(shard_index: int, num_shards: int) -> Callable:
+    """Closure shipped to executors: keep round-robin-owned partitions.
+
+    Stdlib-only on purpose — a real pyspark executor pickles this and
+    must not need analytics_zoo_tpu installed cluster-side."""
+
+    def keep(pid, it):
+        return it if pid % num_shards == shard_index else iter(())
+
+    return keep
+
+
+def collect_shard(rdd: Any, shard_index: Optional[int] = None,
+                  num_shards: Optional[int] = None) -> "list":
+    """Collect this host's round-robin share of an RDD-like's records."""
+    if shard_index is None or num_shards is None:
+        shard_index, num_shards = process_shard_spec()
+    if num_shards == 1:
+        return list(rdd.collect())
+    n_parts = rdd.getNumPartitions()
+    if n_parts < num_shards:
+        logger.warning(
+            "RDD has %d partitions < %d ingest hosts; repartition the "
+            "RDD for balanced multi-host ingest", n_parts, num_shards)
+    owned = rdd.mapPartitionsWithIndex(
+        _partition_filter(shard_index, num_shards))
+    return list(owned.collect())
+
+
+class LocalRdd:
+    """In-process reference implementation of the RDD ingest protocol.
+
+    Plays the role pyspark's RDD plays in the reference, for tests and
+    Spark-less deployments; the FeatureSet/nnframes ingest code treats
+    it and a real ``pyspark.RDD`` identically.
+    """
+
+    def __init__(self, records: Iterable[Any], num_partitions: int = 4):
+        records = list(records)
+        self._parts: "list[list]" = [[] for _ in range(num_partitions)]
+        if records:
+            # contiguous split, like sc.parallelize
+            n = len(records)
+            k = num_partitions
+            lo = 0
+            for i in range(k):
+                hi = lo + n // k + (1 if i < n % k else 0)
+                self._parts[i] = records[lo:hi]
+                lo = hi
+
+    @staticmethod
+    def of_partitions(parts: "list[list]") -> "LocalRdd":
+        r = LocalRdd([], num_partitions=len(parts))
+        r._parts = [list(p) for p in parts]
+        return r
+
+    def getNumPartitions(self) -> int:
+        return len(self._parts)
+
+    def mapPartitionsWithIndex(self, f) -> "LocalRdd":
+        return LocalRdd.of_partitions(
+            [list(f(i, iter(p))) for i, p in enumerate(self._parts)])
+
+    def mapPartitions(self, f) -> "LocalRdd":
+        return self.mapPartitionsWithIndex(lambda i, it: f(it))
+
+    def map(self, f) -> "LocalRdd":
+        return self.mapPartitionsWithIndex(
+            lambda i, it: (f(x) for x in it))
+
+    def filter(self, f) -> "LocalRdd":
+        return self.mapPartitionsWithIndex(
+            lambda i, it: (x for x in it if f(x)))
+
+    def repartition(self, n: int) -> "LocalRdd":
+        return LocalRdd(self.collect(), num_partitions=n)
+
+    def collect(self) -> "list":
+        return list(itertools.chain.from_iterable(self._parts))
+
+    def count(self) -> int:
+        return sum(len(p) for p in self._parts)

@@ -142,6 +142,14 @@ class ArrayDataset:
 def to_dataset(data, y=None):
     if hasattr(data, "iter_batches"):
         return data
+    from analytics_zoo_tpu.feature.rdd import is_rdd_like, \
+        is_spark_dataframe
+    if is_rdd_like(data) or is_spark_dataframe(data):
+        # RDD[Sample] / Spark-DataFrame ingest (reference
+        # `KerasNet.fit(RDD[Sample])`, Topology.scala:411): this host
+        # collects its partition share into a cached FeatureSet
+        from analytics_zoo_tpu.feature.feature_set import FeatureSet
+        return FeatureSet.from_rdd(data)
     return ArrayDataset(data, y)
 
 

@@ -129,23 +129,59 @@ class NNEstimator(_Params):
             return self.feature_preprocessing.apply(value)
         return np.asarray(value, np.float32)
 
-    def _df_to_feature_set(self, df: pd.DataFrame,
+    def _collect_rows(self, df, with_label: bool):
+        """Yield (feature_value, label_value|None) from a pandas
+        DataFrame, a Spark DataFrame, or an RDD of (feature, label)
+        tuples/Samples. Spark rows are narrowed to the needed columns
+        executor-side, and each JAX process collects only its partition
+        share (reference NNEstimator.scala:361-390 maps df.rdd the same
+        way; here multi-host replaces multi-executor)."""
+        from analytics_zoo_tpu.feature.rdd import collect_shard, \
+            is_rdd_like, is_spark_dataframe
+        if isinstance(df, pd.DataFrame):
+            has_label = with_label and self.label_col in df.columns
+            for _, row in df.iterrows():
+                yield row[self.features_col], \
+                    (row[self.label_col] if has_label else None)
+            return
+        if is_spark_dataframe(df):
+            has_label = with_label and self.label_col in df.columns
+            cols = [self.features_col] + \
+                ([self.label_col] if has_label else [])
+            rdd = df.select(*cols).rdd
+            for row in collect_shard(rdd):
+                yield row[0], (row[1] if has_label else None)
+            return
+        if is_rdd_like(df):
+            for rec in collect_shard(df):
+                if isinstance(rec, Sample):
+                    yield rec, None
+                elif isinstance(rec, tuple) and len(rec) == 2:
+                    yield rec[0], (rec[1] if with_label else None)
+                else:
+                    yield rec, None
+            return
+        raise TypeError(
+            f"unsupported DataFrame/RDD type: {type(df).__name__}")
+
+    def _df_to_feature_set(self, df,
                            with_label: bool = True) -> FeatureSet:
         samples = []
-        has_label = with_label and self.label_col in df.columns
-        for _, row in df.iterrows():
-            feat = self._row_to_feature(row[self.features_col])
+        for value, label_val in self._collect_rows(df, with_label):
+            if isinstance(value, Sample):
+                samples.append(value)
+                continue
+            feat = self._row_to_feature(value)
             if isinstance(feat, Sample):
                 samples.append(feat)
                 continue
             label = None
-            if has_label:
-                label = row[self.label_col]
+            if label_val is not None:
                 if self.label_preprocessing is not None:
-                    label = self.label_preprocessing.apply(label)
+                    label = self.label_preprocessing.apply(label_val)
                 else:
                     label = np.atleast_1d(
-                        np.asarray(label, np.float32))
+                        np.asarray(label_val, np.float32))
             samples.append(Sample(feature=feat, label=label))
         return FeatureSet.sample_rdd(samples)
 
@@ -157,7 +193,7 @@ class NNEstimator(_Params):
             opt = optim_lib._REGISTRY[opt.lower()](lr=self.learning_rate)
         return opt
 
-    def fit(self, df: pd.DataFrame) -> "NNModel":
+    def fit(self, df) -> "NNModel":
         """(reference `NNEstimator.fit → internalFit`,
         NNEstimator.scala:392-450)"""
         fs = self._df_to_feature_set(df)
@@ -218,6 +254,18 @@ class NNModel(_Params):
         self.batch_size = int(v)
         return self
 
+    @staticmethod
+    def _spark_session_of(df):
+        return getattr(df, "sparkSession", None) or \
+            df.sql_ctx.sparkSession
+
+    @staticmethod
+    def _spark_safe(pdf: pd.DataFrame) -> pd.DataFrame:
+        # createDataFrame rejects ndarray cells (e.g. a features column
+        # that round-tripped through toPandas) — listify them
+        return pdf.apply(lambda col: col.map(
+            lambda v: v.tolist() if isinstance(v, np.ndarray) else v))
+
     def _features_array(self, df: pd.DataFrame) -> np.ndarray:
         rows = []
         for v in df[self.features_col]:
@@ -233,7 +281,20 @@ class NNModel(_Params):
         x = self._features_array(df)
         return self.estimator.predict(x, batch_size=self.batch_size)
 
-    def transform(self, df: pd.DataFrame) -> pd.DataFrame:
+    def transform(self, df):
+        """Append the prediction column. Spark DataFrames round-trip
+        through pandas on this host (driver-side inference on the TPU
+        slice; the reference's executor-side broadcast-predict has no
+        analog when the accelerator lives with the driver)."""
+        from analytics_zoo_tpu.feature.rdd import is_spark_dataframe
+        if is_spark_dataframe(df):
+            pdf = df.toPandas()
+            out = self.transform(pdf)
+            out[self.prediction_col] = [
+                [float(v) for v in np.asarray(p).reshape(-1)]
+                for p in out[self.prediction_col]]
+            return self._spark_session_of(df).createDataFrame(
+                self._spark_safe(out))
         preds = self._raw_predict(df)
         out = df.copy()
         out[self.prediction_col] = [np.asarray(p).reshape(-1)
@@ -277,7 +338,7 @@ class NNClassifier(NNEstimator):
     """Classification sugar (reference `NNClassifier.scala:42`): float
     labels, argmax prediction."""
 
-    def fit(self, df: pd.DataFrame) -> "NNClassifierModel":
+    def fit(self, df) -> "NNClassifierModel":
         nn_model = super().fit(df)
         m = NNClassifierModel(self.model, self.feature_preprocessing,
                               estimator=nn_model.estimator)
@@ -291,7 +352,14 @@ class NNClassifierModel(NNModel):
     """(reference `NNClassifierModel`, NNClassifier.scala:140): appends
     the argmax class as a scalar prediction."""
 
-    def transform(self, df: pd.DataFrame) -> pd.DataFrame:
+    def transform(self, df):
+        from analytics_zoo_tpu.feature.rdd import is_spark_dataframe
+        if is_spark_dataframe(df):
+            out = self.transform(df.toPandas())
+            out[self.prediction_col] = [float(v) for v in
+                                        out[self.prediction_col]]
+            return self._spark_session_of(df).createDataFrame(
+                self._spark_safe(out))
         preds = self._raw_predict(df)
         out = df.copy()
         if preds.ndim > 1 and preds.shape[-1] > 1:

@@ -1,0 +1,129 @@
+"""Spark/RDD ingest adapter tests (VERDICT round-1 item 3).
+
+Protocol-level tests run against LocalRdd (the in-process reference
+implementation of the duck-typed RDD protocol); the pyspark tests run
+the same code paths over a real ``local[4]`` SparkContext and are
+skipped when pyspark isn't installed (reference test style:
+`pyzoo/test/zoo/pipeline/utils/test_utils.py:34-48` builds a local[4]
+SparkContext per test).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from analytics_zoo_tpu import init_nncontext
+from analytics_zoo_tpu.feature import (FeatureSet, LocalRdd, Sample,
+                                       collect_shard, is_rdd_like)
+from analytics_zoo_tpu.pipeline.api.keras import Sequential, layers as L
+from analytics_zoo_tpu.pipeline.nnframes import NNClassifier, NNEstimator
+
+
+def _small_model(in_dim=4, classes=3):
+    m = Sequential()
+    m.add(L.Dense(8, activation="relu", input_shape=(in_dim,)))
+    m.add(L.Dense(classes))
+    return m
+
+
+class TestRddProtocol:
+    def test_local_rdd_protocol(self):
+        r = LocalRdd(range(10), num_partitions=4)
+        assert is_rdd_like(r)
+        assert r.getNumPartitions() == 4
+        assert r.collect() == list(range(10))
+        assert r.map(lambda x: x * 2).collect() == \
+            [x * 2 for x in range(10)]
+        assert r.filter(lambda x: x % 2 == 0).count() == 5
+
+    def test_collect_shard_round_robin(self):
+        r = LocalRdd(range(12), num_partitions=4)
+        # partitions: [0,1,2],[3,4,5],[6,7,8],[9,10,11]
+        s0 = collect_shard(r, shard_index=0, num_shards=2)
+        s1 = collect_shard(r, shard_index=1, num_shards=2)
+        assert sorted(s0 + s1) == list(range(12))
+        assert s0 == [0, 1, 2, 6, 7, 8]
+        assert s1 == [3, 4, 5, 9, 10, 11]
+
+    def test_collect_shard_default_single_process(self):
+        r = LocalRdd(range(5), num_partitions=2)
+        assert collect_shard(r) == list(range(5))
+
+    def test_feature_set_from_rdd_samples(self, rng):
+        samples = [Sample(feature=rng.randn(4).astype(np.float32),
+                          label=np.array([i % 3], np.float32))
+                   for i in range(20)]
+        fs = FeatureSet.from_rdd(LocalRdd(samples, num_partitions=4))
+        assert fs.num_samples == 20
+        xb, yb = next(fs.iter_batches(8, shuffle=False))
+        assert xb.shape == (8, 4) and yb.shape == (8, 1)
+
+    def test_feature_set_from_rdd_tuples_sharded(self, rng):
+        recs = [(rng.randn(4).astype(np.float32),
+                 np.array([1.0], np.float32)) for _ in range(16)]
+        rdd = LocalRdd(recs, num_partitions=4)
+        fs0 = FeatureSet.from_rdd(rdd, shard_index=0, num_shards=2)
+        fs1 = FeatureSet.from_rdd(rdd, shard_index=1, num_shards=2)
+        assert fs0.num_samples + fs1.num_samples == 16
+
+    def test_estimator_train_accepts_rdd(self, rng):
+        init_nncontext(tpu_mesh={"data": -1})
+        samples = [Sample(feature=rng.randn(4).astype(np.float32),
+                          label=np.array([i % 3], np.int32))
+                   for i in range(32)]
+        model = _small_model()
+        model.compile(optimizer="adam",
+                      loss="softmax_cross_entropy")
+        model.fit(LocalRdd(samples, num_partitions=4), batch_size=8,
+                  nb_epoch=1)
+
+    def test_nnframes_fit_rdd_of_tuples(self, rng):
+        init_nncontext(tpu_mesh={"data": -1})
+        recs = [(rng.randn(4).astype(np.float32), float(i % 3))
+                for i in range(24)]
+        est = NNClassifier(_small_model(),
+                           criterion="softmax_cross_entropy")
+        est.set_batch_size(8).set_max_epoch(1)
+        nn_model = est.fit(LocalRdd(recs, num_partitions=4))
+        pdf = pd.DataFrame(
+            {"features": [rng.randn(4).astype(np.float32)
+                          for _ in range(6)]})
+        out = nn_model.transform(pdf)
+        assert set(out["prediction"]) <= {0.0, 1.0, 2.0}
+
+
+# ---------------------------------------------------------------------------
+# real pyspark (skip-if-absent)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def spark():
+    pytest.importorskip("pyspark")
+    from pyspark.sql import SparkSession
+    s = (SparkSession.builder.master("local[4]")
+         .appName("zoo-tpu-test").getOrCreate())
+    yield s
+    s.stop()
+
+
+class TestPySpark:
+    def test_feature_set_from_spark_rdd(self, spark, rng):
+        recs = [([float(v) for v in rng.randn(4)], float(i % 3))
+                for i in range(20)]
+        rdd = spark.sparkContext.parallelize(recs, 4)
+        fs = FeatureSet.from_rdd(rdd)
+        assert fs.num_samples == 20
+
+    def test_nnframes_fit_spark_dataframe(self, spark, rng):
+        init_nncontext(tpu_mesh={"data": -1})
+        rows = [([float(v) for v in rng.randn(4)], float(i % 3))
+                for i in range(24)]
+        df = spark.createDataFrame(rows, ["features", "label"])
+        est = NNClassifier(_small_model(),
+                           criterion="softmax_cross_entropy")
+        est.set_batch_size(8).set_max_epoch(1)
+        nn_model = est.fit(df)
+        out = nn_model.transform(df.select("features"))
+        assert "prediction" in out.columns
+        got = out.toPandas()
+        assert len(got) == 24
