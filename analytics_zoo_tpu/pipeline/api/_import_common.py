@@ -1,0 +1,64 @@
+"""Shared machinery for external-model importers (BigDL, Caffe):
+build a native Sequential from converted layers and install the saved
+weights after shape inference."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from analytics_zoo_tpu.common.nncontext import logger
+
+
+def assign_param(sub: dict, key: str, value, name: str) -> None:
+    if key not in sub:
+        raise KeyError(f"imported layer {name} has no param {key!r}")
+    if tuple(sub[key].shape) != tuple(np.shape(value)):
+        raise ValueError(
+            f"{name}.{key}: saved shape {tuple(np.shape(value))} does "
+            f"not match model {tuple(sub[key].shape)}")
+    sub[key] = np.asarray(value, np.float32)
+
+
+def build_sequential(converted: "Sequence[Tuple[object, Dict]]",
+                     input_shape: Tuple[int, ...], origin: str):
+    """(layer, weights) pairs → compiled Sequential with the saved
+    weights installed (same install contract as Net.load_torch:
+    shape-checked assignment into the initialized param tree, then
+    re-sharded)."""
+    from analytics_zoo_tpu.pipeline.api.keras.models import Sequential
+
+    net = Sequential()
+    first = True
+    for lyr, _ in converted:
+        if first:
+            lyr._given_input_shape = tuple(input_shape)
+            first = False
+        net.add(lyr)
+    net.compile(optimizer="sgd", loss="mse")
+    est = net.estimator
+    est._ensure_initialized()
+
+    import jax
+    params = jax.device_get(est.params)
+    n_assigned = 0
+    for lyr, ws in converted:
+        if not ws:
+            continue
+        sub = params[lyr.name]
+        for key, value in ws.items():
+            if key == "_state":
+                for sk, sv in value.items():
+                    assign_param(sub["_state"], sk, sv, lyr.name)
+                    n_assigned += 1
+            else:
+                assign_param(sub, key, value, lyr.name)
+                n_assigned += 1
+    from analytics_zoo_tpu.parallel.mesh import shard_params
+    est.params = shard_params(params, est.ctx.mesh)
+    est._train_step = None
+    est._predict_fn = None
+    logger.info("%s: imported %d layers, %d weight tensors",
+                origin, len(converted), n_assigned)
+    return net

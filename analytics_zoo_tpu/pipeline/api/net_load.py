@@ -68,18 +68,31 @@ class Net:
         return KerasModel(model)
 
     @staticmethod
-    def load_caffe(def_path: str, model_path: str):
-        raise NotImplementedError(
-            "caffe is not available in this environment; convert the "
-            "model to ONNX and use "
-            "analytics_zoo_tpu.pipeline.api.onnx.OnnxLoader instead")
+    def load_caffe(def_path: str, model_path: Optional[str] = None,
+                   input_shape=None):
+        """Caffe prototxt (+ caffemodel weights) → native Sequential
+        (reference `Net.loadCaffe`, Net.scala:130-146); self-contained
+        codec, no caffe/protobuf install needed."""
+        from analytics_zoo_tpu.pipeline.api.caffe_load import load_caffe
+        return load_caffe(def_path, model_path, input_shape=input_shape)
 
     @staticmethod
-    def load_bigdl(path: str, weight_path: Optional[str] = None):
-        raise NotImplementedError(
-            "BigDL java serialization is JVM-specific; export the "
-            "model to ONNX or TF SavedModel and use OnnxLoader / "
-            "Net.load_tf")
+    def load_bigdl(path: str, weight_path: Optional[str] = None,
+                   input_shape=None):
+        """BigDL ``.model`` protobuf → native Sequential (reference
+        `Net.loadBigDL`, Net.scala:91-118). ``weight_path`` is accepted
+        for API parity (weights are embedded in the proto)."""
+        del weight_path
+        from analytics_zoo_tpu.pipeline.api.bigdl_load import load_bigdl
+        return load_bigdl(path, input_shape=input_shape)
+
+    @staticmethod
+    def load(path: str, weight_path: Optional[str] = None,
+             input_shape=None):
+        """Load an analytics-zoo Keras-style saved model (reference
+        `Net.load`, Net.scala:91 — same BigDL serialization)."""
+        return Net.load_bigdl(path, weight_path,
+                              input_shape=input_shape)
 
     # -- torch import -------------------------------------------------------
     @staticmethod

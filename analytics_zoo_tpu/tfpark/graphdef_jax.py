@@ -12,9 +12,12 @@ bridge, per SURVEY.md §2.11.1 ("a C++ GraphDef→HLO bridge is the
 analog").
 
 Coverage: the feed-forward op set traced from tf.keras models (Dense /
-Conv / BN / pooling / dropout / losses / elementwise). Control-flow ops
-(`While`, `TensorList*` — keras LSTM) are not interpreted; callers fall
-back to `jax2tf.call_tf` (CPU-only) for those graphs.
+Conv / BN / pooling / dropout / losses / elementwise), plus v1
+while-loop control flow (`Enter/Merge/Switch/NextIteration/Exit` +
+`TensorList*` — the frozen form of keras LSTM/GRU): each while frame is
+collapsed to `lax.scan` (static trip count ⇒ differentiable, so
+imported recurrent models train on TPU) or `lax.while_loop`. Remaining
+unsupported graphs fall back to `jax2tf.call_tf` (CPU-only).
 """
 
 from __future__ import annotations
@@ -526,6 +529,111 @@ def _stateless_normal(node, i):
                              dtype=_attr(node, "dtype", np.float32))
 
 
+# -- TensorList (TensorArray v2) ----------------------------------------------
+# A TensorList is represented as a dense stacked array with the list
+# index as axis 0 (keras RNNs transpose to time-major before
+# TensorListFromTensor, so axis 0 is already time). Static shapes only —
+# the XLA-friendly representation; dynamically-shaped lists raise and
+# the caller falls back to call_tf.
+
+@_op("TensorListFromTensor")
+def _tl_from_tensor(node, i):
+    return i[0]
+
+
+@_op("TensorListStack")
+def _tl_stack(node, i):
+    if isinstance(i[0], _PendingTensorList):
+        raise NotImplementedError(
+            "TensorListStack of a never-written TensorList")
+    return i[0]
+
+
+@_op("TensorListLength")
+def _tl_length(node, i):
+    if isinstance(i[0], _PendingTensorList):
+        return np.int32(i[0].num)
+    return np.int32(np.shape(i[0])[0])
+
+
+@_op("TensorListElementShape")
+def _tl_element_shape(node, i):
+    if isinstance(i[0], _PendingTensorList):
+        raise NotImplementedError(
+            "TensorListElementShape of a never-written TensorList "
+            "(unknown element shape)")
+    return np.asarray(np.shape(i[0])[1:], np.int32)
+
+
+class _PendingTensorList:
+    """A TensorListReserve whose element shape has unknown dims: XLA
+    needs static shapes, so materialization is deferred to the first
+    SetItem (whose item fixes the open dims)."""
+
+    def __init__(self, num: int, shape, dtype):
+        self.num = num
+        self.shape = [int(d) for d in shape]
+        self.dtype = dtype
+
+    def materialize_like(self, item):
+        got = list(np.shape(item))
+        if len(got) != len(self.shape):
+            raise NotImplementedError(
+                f"TensorList element rank mismatch: reserved "
+                f"{self.shape}, wrote {got}")
+        shape = [s if s >= 0 else g for s, g in zip(self.shape, got)]
+        return jnp.zeros((self.num, *shape), self.dtype)
+
+
+@_op("TensorListReserve")
+def _tl_reserve(node, i):
+    shape = _static(i[0], "TensorListReserve element_shape").reshape(-1)
+    num = int(_static(i[1], "TensorListReserve num_elements"))
+    dtype = _attr(node, "element_dtype", np.float32)
+    if any(int(d) < 0 for d in shape):
+        return _PendingTensorList(num, shape, dtype)
+    return np.zeros((num,) + tuple(int(d) for d in shape), dtype)
+
+
+@_op("TensorListGetItem")
+def _tl_get(node, i):
+    arr, idx = i[0], i[1]
+    if isinstance(arr, _PendingTensorList):
+        raise NotImplementedError(
+            "TensorList read before first write (unknown element shape)")
+    if not _is_jax(idx):
+        return arr[int(np.asarray(idx))]
+    return lax.dynamic_index_in_dim(jnp.asarray(arr), idx, axis=0,
+                                    keepdims=False)
+
+
+@_op("TensorListSetItem")
+def _tl_set(node, i):
+    arr, idx, item = i[0], i[1], i[2]
+    if isinstance(arr, _PendingTensorList):
+        arr = arr.materialize_like(item)
+    arr = jnp.asarray(arr)
+    item = jnp.asarray(item, arr.dtype)
+    if not _is_jax(idx):
+        idx = int(np.asarray(idx))
+    return lax.dynamic_update_index_in_dim(arr, item, idx, axis=0)
+
+
+# -- v1 while-loop control flow -----------------------------------------------
+# TF freezes tf.function while loops (keras LSTM/GRU) into v1 dataflow
+# control flow: Enter/Merge/Switch/NextIteration/Exit per loop variable,
+# one LoopCond per frame. The interpreter collapses each frame into ONE
+# XLA loop: `lax.scan` when the trip count is compile-time static (the
+# keras-RNN case — scan is reverse-mode differentiable, so imported
+# recurrent models TRAIN on TPU), else `lax.while_loop` (inference).
+# Reference behavior being replaced: TFNet runs these graphs via the TF
+# JNI session (`Z/pipeline/api/net/TFNet.scala:216-296`).
+
+_CTRL_OPS = {"Enter", "RefEnter", "Exit", "RefExit", "Merge", "RefMerge",
+             "Switch", "RefSwitch", "NextIteration", "RefNextIteration",
+             "LoopCond"}
+
+
 # -- interpreter --------------------------------------------------------------
 
 class GraphDefFunction:
@@ -550,6 +658,8 @@ class GraphDefFunction:
         for n in graph_def.node:
             if n.op == "Const":
                 self._consts[n.name + ":0"] = _attr(n, "value")
+        self._frame_list: Optional[List[dict]] = None
+        self._member_frame: Dict[str, dict] = {}
 
     @staticmethod
     def _norm(name: str) -> str:
@@ -558,17 +668,284 @@ class GraphDefFunction:
     def unsupported_ops(self) -> List[str]:
         """Uninterpreted ops among the nodes actually REACHABLE from the
         outputs (dead subgraphs never run, so they don't force the
-        call_tf fallback)."""
+        call_tf fallback). v1 while-loop control flow counts as
+        supported when the frame structure is regular enough to lower
+        (see `_frames`)."""
         fed = {n.split(":")[0] for n in self.input_names}
         fed |= {n.split(":")[0] for n in self.const_feeds}
         out = set()
+        has_ctrl = False
         for name in self._reachable(fed):
             node = self._nodes[name]
             if node.op in ("Const", "Placeholder", "NoOp"):
                 continue
+            if node.op in _CTRL_OPS:
+                has_ctrl = True
+                continue
             if node.op not in _OPS:
                 out.add(node.op)
+        if has_ctrl:
+            try:
+                self._frames()
+                for name in self._reachable(fed):
+                    node = self._nodes[name]
+                    if node.op in _CTRL_OPS and \
+                            name not in self._member_frame:
+                        # e.g. Switch/Merge from a lowered If — no
+                        # Enter ancestry, so not lowerable as a loop
+                        out.add(f"{node.op}[non-while]")
+            except NotImplementedError as e:
+                out.add(f"WhileLoopV1[{e}]")
         return sorted(out)
+
+    # -- while-frame extraction -------------------------------------------
+
+    def _frames(self) -> List[dict]:
+        """Group v1 control-flow nodes into while frames and validate
+        the structure this interpreter can lower (single-level frames,
+        one LoopCond, regular Merge/Enter/NextIteration/Switch/Exit
+        wiring). Raises NotImplementedError otherwise."""
+        if self._frame_list is not None:
+            return self._frame_list
+        consumers: Dict[str, List[str]] = {}
+        for n in self.gd.node:
+            for x in n.input:
+                if not x.startswith("^"):
+                    consumers.setdefault(x.split(":")[0], []).append(n.name)
+        by_frame: Dict[str, List] = {}
+        for n in self.gd.node:
+            if n.op in ("Enter", "RefEnter"):
+                by_frame.setdefault(_attr(n, "frame_name"), []).append(n)
+        frame_list: List[dict] = []
+        member_frame: Dict[str, dict] = {}
+        for fname, enters in by_frame.items():
+            members = {e.name for e in enters}
+            stack = [e.name for e in enters]
+            while stack:
+                nm = stack.pop()
+                if self._nodes[nm].op in ("Exit", "RefExit"):
+                    continue  # Exit output lives outside the frame
+                for c in consumers.get(nm, ()):
+                    if c in members:
+                        continue
+                    cn = self._nodes[c]
+                    if cn.op in ("Enter", "RefEnter"):
+                        raise NotImplementedError(
+                            f"nested while frames ({fname} feeds "
+                            f"{_attr(cn, 'frame_name')})")
+                    members.add(c)
+                    stack.append(c)
+            merges = [n for n in self.gd.node if n.name in members
+                      and n.op in ("Merge", "RefMerge")]
+            loopconds = [self._nodes[m] for m in members
+                         if self._nodes[m].op == "LoopCond"]
+            if len(loopconds) != 1:
+                raise NotImplementedError(
+                    f"while frame {fname} has {len(loopconds)} LoopCond "
+                    "nodes (expected 1)")
+            merge_enter, merge_next, merge_index = {}, {}, {}
+            for i, m in enumerate(merges):
+                ins = [self._nodes[x.split(":")[0]] for x in m.input
+                       if not x.startswith("^")]
+                ent = [n for n in ins if n.op in ("Enter", "RefEnter")]
+                nxt = [n for n in ins
+                       if n.op in ("NextIteration", "RefNextIteration")]
+                if len(ent) != 1 or len(nxt) != 1:
+                    raise NotImplementedError(
+                        f"irregular Merge {m.name} in while frame")
+                merge_enter[m.name] = ent[0]
+                merge_next[m.name] = nxt[0]
+                merge_index[m.name] = i
+            exits = [self._nodes[m] for m in members
+                     if self._nodes[m].op in ("Exit", "RefExit")]
+            exit_var = {}
+            for ex in exits:
+                sw = self._nodes[ex.input[0].split(":")[0]]
+                if sw.op not in ("Switch", "RefSwitch") or \
+                        sw.input[0].split(":")[0] not in merge_index:
+                    raise NotImplementedError(
+                        f"Exit {ex.name} not wired Switch(Merge, ...)")
+                exit_var[ex.name] = merge_index[sw.input[0].split(":")[0]]
+            fr = dict(name=fname, enters=enters, merges=merges,
+                      loopcond=loopconds[0], merge_enter=merge_enter,
+                      merge_next=merge_next, merge_index=merge_index,
+                      exits=exits, exit_var=exit_var, members=members)
+            frame_list.append(fr)
+            for m in members:
+                member_frame[m] = fr
+        self._frame_list = frame_list
+        self._member_frame = member_frame
+        return frame_list
+
+    def _frame_eval(self, fr: dict, target: str, env2: Dict[str, Any],
+                    env: Dict[str, Any], rng=None):
+        """Memoized iterative eval of a frame-internal tensor given a
+        seeded env2 (merge values + invariant Enters); falls through to
+        the outer env for non-member producers (consts)."""
+        members = fr["members"]
+        stack = [self._norm(target)]
+        while stack:
+            t = stack[-1]
+            if t in env2:
+                stack.pop()
+                continue
+            name = t.split(":")[0]
+            if name not in members:
+                if t in env:
+                    env2[t] = env[t]
+                    stack.pop()
+                    continue
+                raise KeyError(
+                    f"while frame {fr['name']} references unevaluated "
+                    f"outer tensor {t}")
+            node = self._nodes[name]
+            if node.op in ("Switch", "RefSwitch"):
+                deps = [self._norm(node.input[0])]
+            elif node.op in _CTRL_OPS:
+                raise NotImplementedError(
+                    f"unexpected control op {node.op} inside while body")
+            elif node.op not in _OPS:
+                raise NotImplementedError(
+                    f"TF op {node.op} inside while body")
+            else:
+                deps = [self._norm(x) for x in node.input
+                        if not x.startswith("^")]
+            missing = [d for d in deps if d not in env2]
+            if missing:
+                stack.extend(missing)
+                continue
+            stack.pop()
+            if node.op in ("Switch", "RefSwitch"):
+                v = env2[self._norm(node.input[0])]
+                env2[name + ":0"] = v  # false/exit arm == current value
+                env2[name + ":1"] = v  # true/body arm
+                continue
+            args = [env2[self._norm(x)] for x in node.input
+                    if not x.startswith("^")]
+            out = self._apply_node(node, args, rng)
+            if isinstance(out, tuple):
+                for k, v in enumerate(out):
+                    env2[f"{name}:{k}"] = v
+            else:
+                env2[name + ":0"] = out
+        return env2[self._norm(target)]
+
+    def _seed_frame_env(self, fr: dict, var_vals,
+                        enter_vals: Dict[str, Any]) -> Dict[str, Any]:
+        env2: Dict[str, Any] = {}
+        for e in fr["enters"]:
+            if _attr(e, "is_constant", False):
+                env2[e.name + ":0"] = enter_vals[e.name]
+        for m, v in zip(fr["merges"], var_vals):
+            env2[m.name + ":0"] = v
+            env2[m.name + ":1"] = np.int32(0)  # Merge value_index
+        return env2
+
+    def _merges_read(self, fr: dict, target: str) -> set:
+        """Names of this frame's Merge nodes that `target` transitively
+        reads (via Switch data inputs)."""
+        out, seen = set(), set()
+        stack = [target.split(":")[0]]
+        while stack:
+            nm = stack.pop()
+            if nm in seen or nm not in fr["members"]:
+                continue
+            seen.add(nm)
+            node = self._nodes[nm]
+            if node.op in ("Merge", "RefMerge"):
+                out.add(nm)
+            elif node.op in ("Switch", "RefSwitch"):
+                stack.append(node.input[0].split(":")[0])
+            else:
+                stack.extend(x.split(":")[0] for x in node.input
+                             if not x.startswith("^"))
+        return out
+
+    def _static_trip_count(self, fr: dict, init: list,
+                           env: Dict[str, Any],
+                           enter_vals: Dict[str, Any]) -> Optional[int]:
+        """Trip count when the loop predicate depends only on
+        compile-time-static loop vars (keras RNN counters); simulated
+        with numpy. None ⇒ dynamic (lower to while_loop)."""
+        needed = self._merges_read(fr, fr["loopcond"].input[0])
+        for _ in range(len(fr["merges"]) + 1):
+            extra = set()
+            for mn in needed:
+                extra |= self._merges_read(
+                    fr, fr["merge_next"][mn].input[0])
+            if extra <= needed:
+                break
+            needed |= extra
+        idx = fr["merge_index"]
+        vals = {mn: init[idx[mn]] for mn in needed}
+        if any(_is_jax(v) for v in vals.values()):
+            return None
+        try:
+            for trips in range(32_768):
+                env2 = self._seed_frame_env(
+                    fr, [vals.get(m.name) for m in fr["merges"]],
+                    enter_vals)
+                # unrelated merges seeded None: touching one raises
+                env2 = {k: v for k, v in env2.items() if v is not None}
+                pred = self._frame_eval(fr, fr["loopcond"].input[0],
+                                        env2, env)
+                if _is_jax(pred):
+                    return None
+                if not bool(np.asarray(pred)):
+                    return trips
+                nxt = {}
+                for mn in needed:
+                    v = self._frame_eval(
+                        fr, fr["merge_next"][mn].input[0], env2, env)
+                    if _is_jax(v):
+                        return None
+                    nxt[mn] = v
+                vals = nxt
+        except (KeyError, NotImplementedError, ValueError):
+            return None
+        return None
+
+    def _eval_frame(self, fr: dict, env: Dict[str, Any], rng) -> None:
+        """Lower one while frame to lax.scan/while_loop and bind its
+        Exit outputs into env."""
+        merges = fr["merges"]
+        init = [env[self._norm(fr["merge_enter"][m.name].input[0])]
+                for m in merges]
+        enter_vals = {
+            e.name: env[self._norm(e.input[0])] for e in fr["enters"]}
+
+        def body_vals(var_vals):
+            env2 = self._seed_frame_env(fr, var_vals, enter_vals)
+            return tuple(
+                self._frame_eval(fr, fr["merge_next"][m.name].input[0],
+                                 env2, env, rng)
+                for m in merges)
+
+        def cond_fn(var_vals):
+            env2 = self._seed_frame_env(fr, var_vals, enter_vals)
+            pred = self._frame_eval(fr, fr["loopcond"].input[0],
+                                    env2, env)
+            return jnp.reshape(jnp.asarray(pred), ())
+
+        if any(isinstance(v, _PendingTensorList) for v in init):
+            # probe one body step to learn the deferred TensorList
+            # shapes (under jit this only adds dead traced ops; XLA
+            # DCEs them), then enter the loop fully materialized
+            probe = body_vals(init)
+            init = [jnp.zeros(jnp.asarray(p).shape, jnp.asarray(p).dtype)
+                    if isinstance(v, _PendingTensorList) else v
+                    for v, p in zip(init, probe)]
+
+        trip = self._static_trip_count(fr, init, env, enter_vals)
+        init_t = tuple(jnp.asarray(v) for v in init)
+        if trip is not None:
+            # static trip count ⇒ scan: differentiable, unrollable
+            finals, _ = lax.scan(lambda vs, _: (body_vals(vs), None),
+                                 init_t, None, length=trip)
+        else:
+            finals = lax.while_loop(cond_fn, body_vals, init_t)
+        for ex in fr["exits"]:
+            env[ex.name + ":0"] = finals[fr["exit_var"][ex.name]]
 
     def _reachable(self, fed: set) -> List[str]:
         """Node names reachable from the outputs, stopping at fed
@@ -588,11 +965,29 @@ class GraphDefFunction:
                     stack.append(x.split(":")[0])
         return [n.name for n in self.gd.node if n.name in seen]
 
+    def _apply_node(self, node, args, rng):
+        """Evaluate one (non-control) node. ``rng`` overrides baked
+        stateless-random seeds (per-step dropout masks)."""
+        if rng is not None and node.op in (
+                "StatelessRandomUniformV2", "StatelessRandomNormalV2"):
+            import zlib
+            shape = [int(v) for v in _static(args[0], "random shape")]
+            sub = jax.random.fold_in(
+                rng, zlib.crc32(node.name.encode()) & 0x7FFFFFFF)
+            sampler = (jax.random.uniform
+                       if node.op == "StatelessRandomUniformV2"
+                       else jax.random.normal)
+            return sampler(sub, shape,
+                           dtype=_attr(node, "dtype", np.float32))
+        return _OPS[node.op](node, args)
+
     def __call__(self, *inputs, rng=None):
-        """Evaluate. ``rng`` (a JAX PRNG key) overrides the graph's
-        baked stateless-random seeds so dropout masks differ per step —
-        the stripped seed-increment side effect (`tf_graph` step 5)
-        would otherwise freeze the mask."""
+        """Evaluate (demand-driven, memoized, iterative — only the
+        subgraph reachable from the outputs runs; while frames are
+        evaluated as single lax.scan/while_loop units). ``rng`` (a JAX
+        PRNG key) overrides the graph's baked stateless-random seeds so
+        dropout masks differ per step — the stripped seed-increment side
+        effect (`tf_graph` step 5) would otherwise freeze the mask."""
         if len(inputs) != len(self.input_names):
             raise ValueError(
                 f"expected {len(self.input_names)} inputs, "
@@ -600,45 +995,61 @@ class GraphDefFunction:
         env: Dict[str, Any] = dict(self._consts)
         env.update(self.const_feeds)
         env.update(zip(self.input_names, inputs))
-        fed = {n.split(":")[0] for n in env}
-        # FuncGraph GraphDefs are emitted in creation (topological)
-        # order; evaluate reachable nodes in that order
-        for op_name in self._reachable(fed):
-            node = self._nodes[op_name]
-            if node.op == "Const" or op_name + ":0" in env:
+        self._frames()
+        done_frames: set = set()
+        stack = [self._norm(n) for n in self.output_names]
+        budget = 1000 + 50 * sum(
+            len(n.input) + 1 for n in self.gd.node)
+        while stack:
+            budget -= 1
+            if budget < 0:
+                raise RuntimeError(
+                    "graphdef evaluation did not converge (cyclic "
+                    "non-frame graph?)")
+            t = stack[-1]
+            if t in env:
+                stack.pop()
                 continue
-            if node.op == "Placeholder":
+            name = t.split(":")[0]
+            node = self._nodes.get(name)
+            if node is None:
+                raise KeyError(f"no node named {name}")
+            fr = self._member_frame.get(name)
+            if fr is not None:
+                deps = [self._norm(e.input[0]) for e in fr["enters"]]
+            elif node.op == "Placeholder":
                 raise ValueError(
-                    f"unfed placeholder {op_name} (feed it via "
+                    f"unfed placeholder {name} (feed it via "
                     "input_names or const_feeds)")
-            if node.op not in _OPS:
+            elif node.op not in _OPS:
                 raise NotImplementedError(
-                    f"TF op {node.op} (node {op_name}); use the "
+                    f"TF op {node.op} (node {name}); use the "
                     "call_tf fallback for this graph")
-            try:
-                args = [env[self._norm(x)] for x in node.input
-                        if not x.startswith("^")]
-            except KeyError as e:
-                raise AssertionError(
-                    f"GraphDef is not topologically sorted at "
-                    f"{op_name} (missing {e})") from e
-            if rng is not None and node.op in (
-                    "StatelessRandomUniformV2", "StatelessRandomNormalV2"):
-                import zlib
-                shape = [int(v) for v in _static(args[0], "random shape")]
-                sub = jax.random.fold_in(
-                    rng, zlib.crc32(op_name.encode()) & 0x7FFFFFFF)
-                sampler = (jax.random.uniform
-                           if node.op == "StatelessRandomUniformV2"
-                           else jax.random.normal)
-                out = sampler(sub, shape,
-                              dtype=_attr(node, "dtype", np.float32))
             else:
-                out = _OPS[node.op](node, args)
+                deps = [self._norm(x) for x in node.input
+                        if not x.startswith("^")]
+            missing = [d for d in deps if d not in env]
+            if missing:
+                stack.extend(missing)
+                continue
+            stack.pop()
+            if fr is not None:
+                if fr["name"] not in done_frames:
+                    self._eval_frame(fr, env, rng)
+                    done_frames.add(fr["name"])
+                if t not in env:
+                    raise NotImplementedError(
+                        f"tensor {t} of while frame {fr['name']} is "
+                        "consumed outside the loop (only Exit outputs "
+                        "may be)")
+                continue
+            args = [env[self._norm(x)] for x in node.input
+                    if not x.startswith("^")]
+            out = self._apply_node(node, args, rng)
             if isinstance(out, tuple):
                 for k, v in enumerate(out):
-                    env[f"{op_name}:{k}"] = v
+                    env[f"{name}:{k}"] = v
             else:
-                env[op_name + ":0"] = out
+                env[name + ":0"] = out
         outs = [env[n] for n in self.output_names]
         return outs if len(outs) > 1 else outs[0]

@@ -99,6 +99,39 @@ def _rewrite(fn: Callable, input_signature: Sequence,
 
     gd = graph.as_graph_def()
 
+    # -- 2b. lower functional control flow to v1 dataflow form ------------
+    # keras LSTM/GRU trace to a functional `While` whose inputs include
+    # variable RESOURCES; the explicit-weights rewrite below only
+    # understands ReadVariableOp chains. TF's inline/lower pass (the
+    # same one freezing uses) turns While into Enter/Merge/Switch/
+    # NextIteration/Exit + in-body ReadVariableOps, which the
+    # graphdef_jax interpreter then collapses into lax.scan.
+    _FUNCTIONAL_CTRL = {"While", "StatelessWhile", "If", "StatelessIf",
+                        "Case", "StatelessCase"}
+    if any(op.type in _FUNCTIONAL_CTRL
+           for op in graph.get_operations()):
+        from tensorflow.python.framework import (
+            convert_to_constants as _ctc)
+        gd = _ctc._run_inline_graph_optimization(
+            cf, lower_control_flow=True, aggressive_inlining=True)
+
+    nodes_by_name = {n.name: n for n in gd.node}
+    _CHAIN_OPS = ("Identity", "Enter", "RefEnter", "Switch", "RefSwitch",
+                  "Merge", "RefMerge", "NextIteration",
+                  "RefNextIteration", "Exit", "RefExit")
+
+    def _resolve_src(src: str) -> str:
+        """Follow Identity/Enter/... chains back to the originating op
+        name (resource values ride these into while frames)."""
+        seen = set()
+        while src in nodes_by_name and src not in seen:
+            seen.add(src)
+            node = nodes_by_name[src]
+            if node.op not in _CHAIN_OPS or not node.input:
+                break
+            src = node.input[0].split(":")[0]
+        return src
+
     # -- 3. swap ReadVariableOps for Placeholders; drop resource phs ------
     read_map: dict = {}     # read output tensor name -> weight index
     const_reads: dict = {}  # read output tensor name -> constant value
@@ -123,8 +156,24 @@ def _rewrite(fn: Callable, input_signature: Sequence,
             tf.TensorShape(var_shape).as_proto())
         return ph
 
+    # resource-carrying chain nodes (Enter/Identity wrappers riding a
+    # variable resource into a while frame) get dropped with the
+    # resource placeholders; gd is topologically ordered, so one pass
+    # with a growing set suffices
+    resource_chain: set = set()
+
     for node in gd.node:
         src = node.input[0].split(":")[0] if node.input else ""
+        if src in resource_chain or src in ph_to_var or \
+                src in ph_to_const:
+            src = _resolve_src(src)
+        if node.op in _CHAIN_OPS and node.input and \
+                (node.input[0].split(":")[0] in resource_chain or
+                 node.input[0].split(":")[0] in ph_to_var or
+                 node.input[0].split(":")[0] in ph_to_const):
+            resource_chain.add(node.name)
+            swapped.add(node.name)
+            continue
         if node.op == "ReadVariableOp" and (src in ph_to_var or
                                             src in ph_to_const):
             swapped.add(node.name)
@@ -171,7 +220,7 @@ def _rewrite(fn: Callable, input_signature: Sequence,
     # any remaining consumer of a dropped resource placeholder is an
     # op the rewrite does not understand — fail with the op names
     # rather than a KeyError deep in the interpreter
-    dropped = set(ph_to_var) | set(ph_to_const)
+    dropped = set(ph_to_var) | set(ph_to_const) | resource_chain
     leftovers = sorted({n.op for n in new_nodes
                         if any(x.split(":")[0] in dropped
                                for x in n.input

@@ -1,0 +1,127 @@
+"""BigDL / zoo-Keras / Caffe saved-model import (VERDICT round-1
+item 6). Round-trip tests run against the reference's own checked-in
+fixtures (`zoo/src/test/resources/models/*`,
+`pyzoo/test/zoo/resources/test.{prototxt,caffemodel}`) and skip when
+the reference tree isn't present."""
+
+import os
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.pipeline.api.net_load import Net
+
+_REF = "/root/reference"
+_MODELS = os.path.join(_REF, "zoo/src/test/resources/models")
+_PYRES = os.path.join(_REF, "pyzoo/test/zoo/resources")
+
+
+def _need(path):
+    if not os.path.exists(path):
+        pytest.skip(f"reference fixture {path} not present")
+    return path
+
+
+class TestBigDLLoad:
+    def test_lenet_loads_and_predicts(self, rng):
+        path = _need(os.path.join(_MODELS, "bigdl/bigdl_lenet.model"))
+        net = Net.load_bigdl(path)
+        x = rng.randn(2, 784).astype(np.float32)
+        out = net.predict(x)
+        assert out.shape == (2, 5)
+        # logSoftMax head: outputs are log-probs
+        np.testing.assert_allclose(np.exp(out).sum(-1), 1.0, atol=1e-4)
+
+    def test_lenet_weights_match_file(self, rng):
+        """Imported weights are the file's bytes, not re-inits."""
+        from analytics_zoo_tpu.pipeline.api import bigdl_pb as pb
+        path = _need(os.path.join(_MODELS, "bigdl/bigdl_lenet.model"))
+        root = pb.load_model(path)
+        table = pb.StorageTable(root)
+        fc2 = next(s for s in root.subModules if s.name == "fc2")
+        saved_w = table.tensor_to_numpy(fc2.weight)  # [out, in]
+        net = Net.load_bigdl(path)
+        import jax
+        params = jax.device_get(net.estimator.params)
+        got = params["fc2"]["kernel"]  # [in, out]
+        np.testing.assert_allclose(got, saved_w.T, atol=1e-6)
+
+    def test_zoo_keras_fixtures_load(self):
+        for name in ("small_seq.model", "small_model.model"):
+            path = _need(os.path.join(_MODELS, "zoo_keras", name))
+            net = Net.load(path)
+            ish = net.layers[0]._given_input_shape
+            out = net.predict(
+                np.zeros((3,) + tuple(ish), np.float32))
+            assert out.shape[0] == 3
+
+    def test_lenet_fine_tunes(self, rng):
+        """Imported models are native — they train."""
+        path = _need(os.path.join(_MODELS, "bigdl/bigdl_lenet.model"))
+        net = Net.load_bigdl(path)
+        x = rng.randn(16, 784).astype(np.float32)
+        y = rng.randint(0, 5, size=(16, 1)).astype(np.int32)
+        net.compile(optimizer="sgd", loss="class_nll")
+        net.fit(x, y, batch_size=8, nb_epoch=1)
+
+
+class TestCaffeLoad:
+    def test_pyzoo_fixture(self, rng):
+        proto = _need(os.path.join(_PYRES, "test.prototxt"))
+        model = _need(os.path.join(_PYRES, "test.caffemodel"))
+        net = Net.load_caffe(proto, model)
+        x = rng.randn(2, 3, 5, 5).astype(np.float32)
+        assert net.predict(x).shape == (2, 2)
+
+    def test_persist_fixture_softmax(self, rng):
+        proto = _need(os.path.join(_MODELS,
+                                   "caffe/test_persist.prototxt"))
+        model = _need(os.path.join(_MODELS,
+                                   "caffe/test_persist.caffemodel"))
+        net = Net.load_caffe(proto, model, input_shape=(3, 5, 5))
+        x = rng.randn(2, 3, 5, 5).astype(np.float32)
+        out = net.predict(x)
+        assert out.shape == (2, 2)
+        np.testing.assert_allclose(out.sum(-1), 1.0, atol=1e-4)
+
+    def test_weights_match_file(self, rng):
+        from analytics_zoo_tpu.pipeline.api.caffe_load import \
+            NetParameter
+        proto = _need(os.path.join(_PYRES, "test.prototxt"))
+        model = _need(os.path.join(_PYRES, "test.caffemodel"))
+        w = NetParameter()
+        with open(model, "rb") as f:
+            w.ParseFromString(f.read())
+        conv = next(l for l in w.layer if l.name == "conv")
+        saved = conv.blobs[0].to_numpy().reshape(4, 3, 2, 2)
+        net = Net.load_caffe(proto, model)
+        import jax
+        params = jax.device_get(net.estimator.params)
+        got = params["conv"]["kernel"]  # HWIO
+        np.testing.assert_allclose(
+            got, np.transpose(saved, (2, 3, 1, 0)), atol=1e-6)
+
+    def test_architecture_only_load(self, rng):
+        proto = _need(os.path.join(_PYRES, "test.prototxt"))
+        net = Net.load_caffe(proto)  # random init, no weights
+        x = rng.randn(2, 3, 5, 5).astype(np.float32)
+        assert net.predict(x).shape == (2, 2)
+
+
+class TestPrototxtParser:
+    def test_parse_nested(self):
+        from analytics_zoo_tpu.pipeline.api.caffe_load import \
+            parse_prototxt
+        d = parse_prototxt('''
+            name: "n"  # comment
+            input_dim: 1 input_dim: 3
+            layer { name: "c" type: "Convolution"
+                    convolution_param { num_output: 4 bias_term: false
+                                        pool: MAX } }
+        ''')
+        assert d["name"] == ["n"]
+        assert d["input_dim"] == [1, 3]
+        p = d["layer"][0]["convolution_param"][0]
+        assert p["num_output"] == [4]
+        assert p["bias_term"] == [False]
+        assert p["pool"] == ["MAX"]

@@ -301,3 +301,93 @@ def test_intent_entity_joint(rng):
     intent, tags = m.predict(x, batch_size=4)
     assert intent.shape == (12, 3)
     assert tags.shape == (12, 10, 4)
+
+
+# -- v1 while-loop control flow (keras recurrent models) ----------------------
+# VERDICT round-1 item 4: recurrent TF graphs must take the TPU path
+# (GraphDef interpreter -> lax.scan), not the CPU call_tf fallback.
+# Reference behavior: TFNet executes these graphs via the TF JNI session
+# (`Z/pipeline/api/net/TFNet.scala:216-296`).
+
+def _frozen_graphdef(model, input_spec):
+    f = tf.function(lambda x: model(x, training=False))
+    cf = f.get_concrete_function(input_spec)
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2)
+    frozen = convert_variables_to_constants_v2(cf)
+    gd = frozen.graph.as_graph_def()
+    return (frozen, gd, [t.name for t in frozen.inputs],
+            [t.name for t in frozen.outputs])
+
+
+def test_graphdef_lstm_interpreted_matches_tf(rng):
+    from analytics_zoo_tpu.tfpark.graphdef_jax import GraphDefFunction
+    model = tf.keras.Sequential([
+        tf.keras.layers.LSTM(8, input_shape=(5, 3)),
+        tf.keras.layers.Dense(2),
+    ])
+    frozen, gd, ins, outs = _frozen_graphdef(
+        model, tf.TensorSpec([4, 5, 3], tf.float32))
+    gfn = GraphDefFunction(gd, ins, outs)
+    assert gfn.unsupported_ops() == []  # While frame lowers natively
+    x = rng.randn(4, 5, 3).astype(np.float32)
+    want = model(x).numpy()
+    np.testing.assert_allclose(np.asarray(gfn(x)), want, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(jax.jit(lambda a: gfn(a))(x)), want, atol=1e-5)
+
+
+def test_graphdef_lstm_differentiates(rng):
+    # static trip count -> lax.scan -> reverse-mode AD works
+    import jax.numpy as jnp
+    from analytics_zoo_tpu.tfpark.graphdef_jax import GraphDefFunction
+    model = tf.keras.Sequential([
+        tf.keras.layers.LSTM(4, input_shape=(6, 2)),
+    ])
+    _, gd, ins, outs = _frozen_graphdef(
+        model, tf.TensorSpec([2, 6, 2], tf.float32))
+    gfn = GraphDefFunction(gd, ins, outs)
+    x = jnp.asarray(rng.randn(2, 6, 2).astype(np.float32))
+    g = jax.grad(lambda a: jnp.sum(gfn(a) ** 2))(x)
+    assert g.shape == x.shape
+    assert float(jnp.abs(g).sum()) > 0
+
+
+def test_graphdef_gru_return_sequences(rng):
+    from analytics_zoo_tpu.tfpark.graphdef_jax import GraphDefFunction
+    model = tf.keras.Sequential([
+        tf.keras.layers.GRU(5, return_sequences=True,
+                            input_shape=(4, 3)),
+    ])
+    frozen, gd, ins, outs = _frozen_graphdef(
+        model, tf.TensorSpec([2, 4, 3], tf.float32))
+    gfn = GraphDefFunction(gd, ins, outs)
+    assert gfn.unsupported_ops() == []
+    x = rng.randn(2, 4, 3).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(gfn(x)), model(x).numpy(),
+                               atol=1e-5)
+
+
+def test_keras_lstm_trains_via_interpreter(rng, caplog):
+    """The VERDICT item-4 'done' bar: a tf.keras LSTM model trains
+    through tfpark on the native path, with no call_tf fallback."""
+    import logging
+    from analytics_zoo_tpu import init_nncontext
+    from analytics_zoo_tpu.tfpark import KerasModel
+    init_nncontext(tpu_mesh={"data": 1}, devices=jax.devices("cpu")[:1])
+    model = tf.keras.Sequential([
+        tf.keras.layers.LSTM(8, input_shape=(5, 3)),
+        tf.keras.layers.Dense(1),
+    ])
+    model.compile(optimizer=tf.keras.optimizers.Adam(0.05), loss="mse")
+    with caplog.at_level(logging.WARNING):
+        km = KerasModel(model)
+        x = rng.randn(64, 5, 3).astype(np.float32)
+        y = (x.sum(axis=(1, 2)).reshape(-1, 1) * 0.1).astype(np.float32)
+        before = km.evaluate(x, y, batch_size=32)["loss"]
+        km.fit(x, y, batch_size=32, epochs=15)
+        after = km.evaluate(x, y, batch_size=32)["loss"]
+    assert "falling back" not in caplog.text  # stayed on the TPU path
+    assert after < before * 0.5, (before, after)
+    np.testing.assert_allclose(km.predict(x, batch_size=32),
+                               model(x).numpy(), atol=1e-4)
