@@ -1,0 +1,19 @@
+"""Runnable examples (reference: `pyzoo/zoo/examples/`, L12).
+
+Each module exposes ``main(argv)``; run via
+``python -m analytics_zoo_tpu.examples <name> [args...]`` or the
+``zoo-tpu-example`` console script.
+"""
+
+EXAMPLES = [
+    "lenet_mnist",
+    "ncf_recommendation",
+    "text_classification",
+    "anomaly_detection",
+    "object_detection",
+    "nnframes_classification",
+    "tfpark_keras",
+    "onnx_import",
+    "inference_serving",
+    "distributed_training",
+]

@@ -1,0 +1,32 @@
+"""Example dispatcher: ``python -m analytics_zoo_tpu.examples <name>``
+(the reference's per-example spark-submit mains, Net.scala L12 analog).
+"""
+
+import importlib
+import sys
+
+from analytics_zoo_tpu.examples import EXAMPLES
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help", "list"):
+        print("usage: python -m analytics_zoo_tpu.examples "
+              "<name> [args...]\n\nexamples:")
+        for e in EXAMPLES:
+            print(f"  {e}")
+        return 0
+    name = argv[0].replace("-", "_")
+    if name not in EXAMPLES:
+        print(f"unknown example {argv[0]!r}; run with 'list' to see "
+              "available names", file=sys.stderr)
+        return 2
+    mod = importlib.import_module(f"analytics_zoo_tpu.examples.{name}")
+    ret = mod.main(argv[1:])
+    # example mains return result payloads (metrics dicts etc.), not
+    # exit codes; only an explicit int is a process status
+    return ret if isinstance(ret, int) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,7 +1,7 @@
-"""ctypes bindings for the native runtime (`/native/*.cpp`).
+"""ctypes bindings for the native runtime (`analytics_zoo_tpu/native/src/*.cpp`).
 
 The reference ships native code as JNI `.so`s in `zoo-core-dist-all`
-(SURVEY.md §2.11); here the C++ lives in-repo under `native/` and is
+(SURVEY.md §2.11); here the C++ ships as package data (`native/src/`) and is
 built on first use with g++ (no pybind11 in the image — plain C ABI +
 ctypes). Every consumer has a pure-Python fallback, so the framework
 degrades gracefully where a toolchain is missing.
@@ -17,9 +17,10 @@ from typing import Optional
 
 import numpy as np
 
-_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))))
-_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+# sources ship as package data (src/); the .so is built next to them
+# on first use, so pip-installed copies work without a build step
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "src")
 _SO_PATH = os.path.join(_NATIVE_DIR, "libzoo_native.so")
 
 _lib = None

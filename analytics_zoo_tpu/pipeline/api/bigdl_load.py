@@ -163,6 +163,12 @@ def _convert_module(m: pb.BigDLModule, table: pb.StorageTable) \
 
     w = table.tensor_to_numpy(m.weight)
     b = table.tensor_to_numpy(m.bias)
+    if w is None and m.parameters:
+        # newer BigDL serializes weights into `parameters` (field 16)
+        # instead of the deprecated weight/bias fields
+        w = table.tensor_to_numpy(m.parameters[0])
+        if len(m.parameters) > 1:
+            b = table.tensor_to_numpy(m.parameters[1])
 
     if t == "Linear":
         out_dim = _attr_int(am, "outputSize", w.shape[0] if w is not None

@@ -104,11 +104,19 @@ _MESSAGE_TYPES.update({
     "NetParameter": NetParameter,
 })
 
-# V1 LayerType enum values → V2 type strings (subset)
+# V1 LayerType enum values → V2 type strings (subset); binary protos
+# carry the int, text prototxts the UPPERCASE enum identifier
 _V1_TYPES = {
     4: "Convolution", 14: "InnerProduct", 17: "Pooling", 18: "ReLU",
-    19: "Sigmoid", 20: "Softmax", 23: "TanH", 6: "Dropout", 5: "Data",
-    8: "Flatten", 15: "LRN",
+    19: "Sigmoid", 20: "Softmax", 21: "SoftmaxWithLoss", 23: "TanH",
+    6: "Dropout", 5: "Data", 8: "Flatten", 15: "LRN",
+}
+_V1_NAME_TYPES = {
+    "CONVOLUTION": "Convolution", "INNER_PRODUCT": "InnerProduct",
+    "POOLING": "Pooling", "RELU": "ReLU", "SIGMOID": "Sigmoid",
+    "SOFTMAX": "Softmax", "SOFTMAX_LOSS": "SoftmaxWithLoss",
+    "TANH": "TanH", "DROPOUT": "Dropout", "DATA": "Data",
+    "FLATTEN": "Flatten", "LRN": "LRN",
 }
 
 
@@ -233,6 +241,8 @@ def load_caffe(def_path: str, model_path: Optional[str] = None,
         ltype = _one(ld, "type")
         if isinstance(ltype, int):
             ltype = _V1_TYPES.get(ltype, str(ltype))
+        elif isinstance(ltype, str) and ltype in _V1_NAME_TYPES:
+            ltype = _V1_NAME_TYPES[ltype]  # V1 text-format enum name
         blobs = blobs_by_name.get(lname, [])
         if ltype in ("Input", "Data", "DummyData"):
             p = _one(ld, "input_param")

@@ -791,13 +791,42 @@ class GraphDefFunction:
                 continue
             name = t.split(":")[0]
             if name not in members:
+                # loop-invariant outer tensor (const-derived chains are
+                # not frame members — anything touching a member would
+                # be one); evaluate into the OUTER env, memoized
                 if t in env:
                     env2[t] = env[t]
                     stack.pop()
                     continue
-                raise KeyError(
-                    f"while frame {fr['name']} references unevaluated "
-                    f"outer tensor {t}")
+                node = self._nodes.get(name)
+                if node is None:
+                    raise KeyError(f"no node named {name}")
+                if node.op == "Placeholder":
+                    raise ValueError(f"unfed placeholder {name}")
+                if node.op not in _OPS:
+                    raise NotImplementedError(
+                        f"TF op {node.op} (node {name}); use the "
+                        "call_tf fallback for this graph")
+                deps = [self._norm(x) for x in node.input
+                        if not x.startswith("^")]
+                missing = [d for d in deps if d not in env]
+                if missing:
+                    stack.extend(missing)
+                    continue
+                stack.pop()
+                args = [env[self._norm(x)] for x in node.input
+                        if not x.startswith("^")]
+                out = self._apply_node(node, args, None)
+                if isinstance(out, tuple):
+                    for k, v in enumerate(out):
+                        env[f"{name}:{k}"] = v
+                else:
+                    env[name + ":0"] = out
+                if t not in env:
+                    raise KeyError(
+                        f"node {name} produced no output {t}")
+                env2[t] = env[t]
+                continue
             node = self._nodes[name]
             if node.op in ("Switch", "RefSwitch"):
                 deps = [self._norm(node.input[0])]

@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Build a distributable sdist+wheel into dist/ (reference analog:
+# `make-dist.sh`, which assembled the zoo jar + pyzoo zip).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+python -m pip wheel --no-deps -w dist . 2>/dev/null || \
+  python setup.py bdist_wheel 2>/dev/null || {
+    # fallback: plain sdist via setuptools build_meta
+    python - <<'EOF'
+import os
+from setuptools import build_meta
+os.makedirs("dist", exist_ok=True)
+print("built:", build_meta.build_sdist("dist"))
+EOF
+  }
+ls -l dist/

@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Run a python program with the analytics_zoo_tpu environment prepared
+# (reference analog: `scripts/spark-submit-with-zoo.sh` — there it
+# assembled Spark classpaths; here it pins JAX platform/mesh knobs).
+#
+# Usage:
+#   zoo-tpu-run.sh [--cpu-mesh N] program.py [args...]
+set -euo pipefail
+
+if [[ "${1:-}" == "--cpu-mesh" ]]; then
+  n="$2"; shift 2
+  export JAX_PLATFORMS=cpu
+  export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=${n}"
+fi
+
+# sensible TPU defaults (overridable from the caller's env)
+export TPU_STDERR_LOG_LEVEL="${TPU_STDERR_LOG_LEVEL:-3}"
+
+exec python "$@"

@@ -7,7 +7,8 @@ import sys
 
 import pytest
 
-EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..",
+                        "analytics_zoo_tpu", "examples")
 
 
 def _run(name, argv):
