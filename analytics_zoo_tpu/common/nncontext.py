@@ -36,6 +36,7 @@ logger = logging.getLogger("analytics_zoo_tpu")
 
 _lock = threading.RLock()
 _current: "NNContext | None" = None
+_distributed_done = False
 
 
 class NNContext:
@@ -124,6 +125,60 @@ def _build_mesh(mesh_conf: MeshConf) -> Mesh:
     return Mesh(dev_array, names)
 
 
+def _maybe_init_distributed(multi_host) -> None:
+    """Join the multi-host JAX cluster (the reference's
+    executor-registration role, played by `jax.distributed`).
+
+    ``multi_host=True`` forces it; ``multi_host=None`` auto-joins when
+    the standard coordinator env (``JAX_COORDINATOR_ADDRESS`` /
+    ``COORDINATOR_ADDRESS``) or a Cloud-TPU pod environment announces
+    one. After init, ``jax.devices()`` is the GLOBAL device set and
+    ``jax.process_index()`` feeds the per-host data sharding
+    (`feature/rdd.py:process_shard_spec`)."""
+    import os
+
+    global _distributed_done
+    if multi_host is False or _distributed_done:
+        return
+    announced = os.environ.get("JAX_COORDINATOR_ADDRESS") or \
+        os.environ.get("COORDINATOR_ADDRESS")
+    if not multi_host and not announced:
+        return
+    # NOTE: no jax.* probes before initialize() — touching the backend
+    # (even jax.process_count()) initializes XLA and makes
+    # jax.distributed.initialize() unconditionally raise
+    kwargs = {}
+    if announced and not os.environ.get("JAX_COORDINATOR_ADDRESS"):
+        # forward the generic spelling jax doesn't read itself
+        kwargs["coordinator_address"] = announced
+        npz = os.environ.get("JAX_NUM_PROCESSES") or \
+            os.environ.get("NUM_PROCESSES")
+        pid = os.environ.get("JAX_PROCESS_ID") or \
+            os.environ.get("PROCESS_ID")
+        if npz is not None:
+            kwargs["num_processes"] = int(npz)
+        if pid is not None:
+            kwargs["process_id"] = int(pid)
+    try:
+        jax.distributed.initialize(**kwargs)
+        _distributed_done = True
+        logger.info("jax.distributed initialized: process %d/%d",
+                    jax.process_index(), jax.process_count())
+    except RuntimeError as e:
+        if "already" in str(e).lower():  # initialized elsewhere — fine
+            _distributed_done = True
+            return
+        if multi_host:
+            raise
+        logger.warning("jax.distributed.initialize failed (%s); "
+                       "continuing single-host", e)
+    except Exception as e:  # single-host fallback stays usable
+        if multi_host:
+            raise
+        logger.warning("jax.distributed.initialize failed (%s); "
+                       "continuing single-host", e)
+
+
 def init_nncontext(
     conf: "ZooTpuConf | None" = None,
     *,
@@ -132,6 +187,7 @@ def init_nncontext(
     devices: Optional[Sequence[Any]] = None,
     seed: Optional[int] = None,
     log_level: Optional[str] = None,
+    multi_host: Optional[bool] = None,
 ) -> NNContext:
     """Create (or replace) the process-wide :class:`NNContext`.
 
@@ -144,11 +200,17 @@ def init_nncontext(
       app_name: convenience override of ``conf.app_name``.
       tpu_mesh: mesh axes spec (``"data=8"``, ``{"data": 4, "model": 2}``)
         or a prebuilt `jax.sharding.Mesh`. Default: all devices on ``data``.
-      devices: explicit device list (default ``jax.devices()``).
+      devices: explicit device list (default ``jax.devices()`` — the
+        GLOBAL device set after multi-host init).
       seed: root RNG seed.
       log_level: python logging level for the zoo logger.
+      multi_host: True → require `jax.distributed.initialize()` (all
+        hosts of the pod run the same program); None (default) →
+        auto-join when a coordinator address env is present; False →
+        never.
     """
     global _current
+    _maybe_init_distributed(multi_host)
     conf = ZooTpuConf.from_env(conf)
     if app_name is not None:
         conf.app_name = app_name

@@ -113,6 +113,74 @@ class ZooModel:
         est.params = shard_params(state["params"], get_nncontext().mesh)
         return inst
 
+    # -- weight files (the pretrained-registry storage format) --------------
+    def save_weights(self, path: str):
+        """Write weights as a flat ``.npz`` ("layer/param" keys) — the
+        published-weights format of the pretrained registry
+        (`models/config.py`; reference `ObjectDetectionConfig.scala:31`
+        published `.model` URLs)."""
+        est = self.model.estimator
+        if est.params is None:
+            est._ensure_initialized()
+        import jax
+        flat = {}
+
+        def walk(prefix, d):
+            for k, v in d.items():
+                key = f"{prefix}/{k}" if prefix else str(k)
+                if isinstance(v, dict):
+                    walk(key, v)
+                else:
+                    flat[key] = np.asarray(v)
+
+        walk("", jax.device_get(est.params))
+        np.savez(path, **flat)
+
+    def load_weights(self, path: str):
+        """Load a ``save_weights`` ``.npz`` with per-tensor shape
+        validation (reference `loadModel` weight checks)."""
+        import jax
+
+        from analytics_zoo_tpu.common.nncontext import get_nncontext
+        from analytics_zoo_tpu.parallel.mesh import shard_params
+        est = self.model.estimator
+        if est.params is None:
+            est._ensure_initialized()
+        params = jax.device_get(est.params)
+        with np.load(path) as data:
+            saved = {k: data[k] for k in data.files}
+
+        def walk(prefix, d):
+            for k, v in list(d.items()):
+                key = f"{prefix}/{k}" if prefix else str(k)
+                if isinstance(v, dict):
+                    walk(key, v)
+                    continue
+                if key not in saved:
+                    raise KeyError(
+                        f"weights file {path} is missing tensor "
+                        f"{key!r} (wrong architecture?)")
+                w = saved.pop(key)
+                if tuple(w.shape) != tuple(np.shape(v)):
+                    raise ValueError(
+                        f"{key}: file shape {tuple(w.shape)} does not "
+                        f"match model {tuple(np.shape(v))}")
+                d[k] = w
+
+        walk("", params)
+        if saved:
+            raise ValueError(
+                f"weights file {path} has {len(saved)} unused tensors "
+                f"(e.g. {sorted(saved)[:3]}) — wrong architecture?")
+        est.params = shard_params(params, get_nncontext().mesh)
+        # optimizer moments belong to the OLD weights — reset so the
+        # next fit re-inits rather than resuming stale state
+        est.opt_state = None
+        est._train_step = None
+        est._eval_step = None
+        est._predict_fn = None
+        return self
+
 
 class Ranker:
     """Ranking evaluation mixin (reference `models/common/Ranker.scala:33`):

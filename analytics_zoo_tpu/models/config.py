@@ -1,0 +1,112 @@
+"""Pretrained-model registries: published name → architecture +
+weights file.
+
+Reference: `ObjectDetectionConfig.scala:31` and
+`ImageClassificationConfig` map published model names (e.g.
+``"analytics-zoo_ssd-vgg16-300x300_PASCAL_0.1.0"``) to downloadable
+``.model`` artifacts. The TPU registry keeps the name→architecture
+mapping and loads weights from LOCAL ``.npz`` files (produced by
+``ZooModel.save_weights``) — TPU VMs have no implicit download path,
+and weight provenance stays explicit. Resolution order for weights:
+
+1. an explicit ``weights_path=`` argument;
+2. ``$ZOO_TPU_PRETRAINED_DIR/<name>.npz`` when the env var is set;
+3. none → randomly initialized (architecture only), with a log line.
+
+Every load shape-validates each tensor against the built architecture
+(`ZooModel.load_weights`).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Optional, Tuple
+
+from analytics_zoo_tpu.common.nncontext import logger
+
+
+def _resolve_weights(name: str, weights_path: Optional[str]) -> \
+        Optional[str]:
+    if weights_path is not None:
+        if not os.path.exists(weights_path):
+            raise FileNotFoundError(weights_path)
+        return weights_path
+    root = os.environ.get("ZOO_TPU_PRETRAINED_DIR")
+    if root:
+        cand = os.path.join(root, f"{name}.npz")
+        if os.path.exists(cand):
+            return cand
+    return None
+
+
+def _strip_published_name(name: str) -> str:
+    """Accept the reference's full published names
+    (``analytics-zoo_<arch>_<dataset>_<version>``) as well as bare
+    architecture names."""
+    parts = name.split("_")
+    if len(parts) >= 2 and parts[0] in ("analytics-zoo", "zoo"):
+        return parts[1]
+    return name
+
+
+class ImageClassificationConfig:
+    """(reference `ImageClassificationConfig`): published
+    classification models."""
+
+    @staticmethod
+    def names() -> Tuple[str, ...]:
+        from analytics_zoo_tpu.models.image.imageclassification import \
+            ImageClassifier
+        return tuple(ImageClassifier.ARCHS)
+
+    @staticmethod
+    def create(name: str, input_shape=(224, 224, 3), classes: int = 1000,
+               weights_path: Optional[str] = None):
+        from analytics_zoo_tpu.models.image.imageclassification import \
+            ImageClassifier
+        arch = _strip_published_name(name).lower()
+        model = ImageClassifier(model_name=arch,
+                                input_shape=input_shape,
+                                classes=classes)
+        model.compile()
+        wp = _resolve_weights(arch, weights_path)
+        if wp is not None:
+            model.load_weights(wp)
+            logger.info("ImageClassificationConfig: %s weights from %s",
+                        arch, wp)
+        else:
+            logger.info("ImageClassificationConfig: %s randomly "
+                        "initialized (no weights file)", arch)
+        return model
+
+
+class ObjectDetectionConfig:
+    """(reference `ObjectDetectionConfig.scala:31`): published
+    detection models."""
+
+    @staticmethod
+    def names() -> Tuple[str, ...]:
+        from analytics_zoo_tpu.models.image.objectdetection \
+            .object_detector import CONFIGS
+        return tuple(sorted(CONFIGS))
+
+    @staticmethod
+    def create(name: str, n_classes: Optional[int] = None,
+               img_size: Optional[int] = None,
+               weights_path: Optional[str] = None):
+        from analytics_zoo_tpu.models.image.objectdetection import \
+            ObjectDetector
+        arch = _strip_published_name(name).lower()
+        model = ObjectDetector(model_name=arch, n_classes=n_classes,
+                               img_size=img_size)
+        model.compile()
+        wp = _resolve_weights(arch, weights_path)
+        if wp is not None:
+            model.load_weights(wp)
+            logger.info("ObjectDetectionConfig: %s weights from %s",
+                        arch, wp)
+        else:
+            logger.info("ObjectDetectionConfig: %s randomly "
+                        "initialized (no weights file)", arch)
+        return model

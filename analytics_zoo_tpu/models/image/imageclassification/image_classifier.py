@@ -65,3 +65,20 @@ class ImageClassifier(ZooModel):
 
     def build_model(self):
         return _builders()[self.model_name](self.input_shape, self.classes)
+
+    @classmethod
+    def load_model(cls, path_or_name: str, weights_path=None,
+                   input_shape=(224, 224, 3), classes: int = 1000):
+        """Registry-aware load (reference
+        `ImageClassifier.loadModel` by published name): a known
+        architecture name (e.g. ``"resnet-50"``) builds it and loads
+        shape-validated weights from ``weights_path`` /
+        ``$ZOO_TPU_PRETRAINED_DIR``; anything else is a
+        ``save_model`` file path."""
+        from analytics_zoo_tpu.models.config import (
+            ImageClassificationConfig, _strip_published_name)
+        if _strip_published_name(path_or_name).lower() in _builders():
+            return ImageClassificationConfig.create(
+                path_or_name, input_shape=input_shape, classes=classes,
+                weights_path=weights_path)
+        return super().load_model(path_or_name)

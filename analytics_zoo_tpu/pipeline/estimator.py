@@ -153,6 +153,18 @@ def to_dataset(data, y=None):
     return ArrayDataset(data, y)
 
 
+def _cast_floats(x, dtype):
+    """Cast floating leaves of an input (array or list of arrays);
+    ints (ids/labels) pass through."""
+    def c(a):
+        a = jnp.asarray(a)
+        return a.astype(dtype) if jnp.issubdtype(a.dtype, jnp.floating) \
+            else a
+    if isinstance(x, (list, tuple)):
+        return [c(a) for a in x]
+    return c(x)
+
+
 # ---------------------------------------------------------------------------
 # Estimator
 # ---------------------------------------------------------------------------
@@ -172,9 +184,19 @@ class Estimator:
     def __init__(self, model, optimizer="adam", loss="mse",
                  metrics: Optional[List] = None,
                  ctx: Optional[NNContext] = None,
-                 parallel_mode: str = "dp"):
+                 parallel_mode: str = "dp",
+                 dtype_policy: Optional[str] = None):
         if parallel_mode not in ("dp", "fsdp"):
             raise ValueError("parallel_mode must be dp|fsdp")
+        dtype_policy = dtype_policy or os.environ.get(
+            "ZOO_TPU_DTYPE_POLICY", "float32")
+        if dtype_policy not in ("float32", "mixed_bfloat16"):
+            raise ValueError(
+                "dtype_policy must be float32|mixed_bfloat16")
+        # mixed_bfloat16: activations/compute in bf16 (the MXU-native
+        # dtype), params + loss in f32 — the framework-wide policy the
+        # round-1 bench applied ad hoc (VERDICT "What's weak" #8)
+        self.dtype_policy = dtype_policy
         self.model = model
         self.ctx = ctx or get_nncontext()
         self.parallel_mode = parallel_mode
@@ -197,6 +219,12 @@ class Estimator:
         self.tensorboard_dir: Optional[str] = None
         self.tensorboard_app: str = "zoo_tpu"
         self._tb_writer = None
+        # jax.profiler trace capture (SURVEY §5: the TPU analog of the
+        # reference's TrainSummary observability)
+        self._profile_dir: Optional[str] = None
+        self._profile_start = 0
+        self._profile_end = 0
+        self._profiling = False
 
     # -- knobs (reference `Topology.scala:197-284`) -------------------------
     @staticmethod
@@ -233,6 +261,30 @@ class Estimator:
     def set_tensorboard(self, log_dir: str, app_name: str = "zoo_tpu"):
         self.tensorboard_dir = log_dir
         self.tensorboard_app = app_name
+        return self
+
+    def set_dtype_policy(self, policy: str):
+        """"float32" or "mixed_bfloat16" (bf16 activations, f32
+        params/loss — the TPU mixed-precision recipe)."""
+        if policy not in ("float32", "mixed_bfloat16"):
+            raise ValueError(
+                "dtype_policy must be float32|mixed_bfloat16")
+        self.dtype_policy = policy
+        self._train_step = None
+        self._eval_step = None
+        self._predict_fn = None
+        return self
+
+    def set_profile(self, log_dir: str, start_step: int = 3,
+                    n_steps: int = 3):
+        """Capture a ``jax.profiler`` trace of training steps
+        [start_step, start_step + n_steps) into ``log_dir`` —
+        TensorBoard-viewable (reference observability analog,
+        Topology.scala:197-229 / SURVEY §5). Default skips the compile
+        step so the trace shows steady-state device time."""
+        self._profile_dir = log_dir
+        self._profile_start = int(start_step)
+        self._profile_end = int(start_step) + int(n_steps)
         return self
 
     def _tb(self):
@@ -278,10 +330,16 @@ class Estimator:
     def _build_train_step(self, tx):
         model = self.model
         loss_fn = self.loss_fn
+        mixed = self.dtype_policy == "mixed_bfloat16"
 
         def train_step(params, opt_state, rng, x, y):
+            if mixed:
+                x = _cast_floats(x, jnp.bfloat16)
+
             def compute_loss(p):
                 out, state_upd = model.apply(p, x, training=True, rng=rng)
+                if mixed:  # loss in f32 for numeric stability
+                    out = _cast_floats(out, jnp.float32)
                 loss = loss_fn(y, out)
                 loss = loss + model.regularization_loss(p)
                 return loss, state_upd
@@ -310,8 +368,14 @@ class Estimator:
         margin = float(getattr(loss_fn, "keywords", {})
                        .get("margin", 1.0)) if pairwise else 1.0
 
+        mixed = self.dtype_policy == "mixed_bfloat16"
+
         def eval_step(params, x, y, w):
+            if mixed:
+                x = _cast_floats(x, jnp.bfloat16)
             out = model.forward(params, x, training=False)
+            if mixed:
+                out = _cast_floats(out, jnp.float32)
             if pairwise:
                 # pairwise over adjacent (pos, neg) rows — mask pairs,
                 # not samples
@@ -345,9 +409,13 @@ class Estimator:
 
     def _build_predict_fn(self):
         model = self.model
+        mixed = self.dtype_policy == "mixed_bfloat16"
 
         def predict_fn(params, x):
-            return model.forward(params, x, training=False)
+            if mixed:
+                x = _cast_floats(x, jnp.bfloat16)
+            out = model.forward(params, x, training=False)
+            return _cast_floats(out, jnp.float32) if mixed else out
 
         return jax.jit(predict_fn)
 
@@ -377,6 +445,10 @@ class Estimator:
         base_rng = self.ctx.next_rng_key()
         history: "list[dict]" = []
         stop = False
+        # profile window is relative to THIS run (self.step may already
+        # be far along from a previous train() call)
+        p_start = self.step + self._profile_start
+        p_end = self.step + self._profile_end
 
         for epoch in range(1, nb_epoch + 1):
             t0 = time.time()
@@ -390,9 +462,18 @@ class Estimator:
                 xb = shard_batch(xb, self.ctx.mesh)
                 yb = shard_batch(yb, self.ctx.mesh)
                 rng = jax.random.fold_in(base_rng, self.step)
+                if self._profile_dir and not self._profiling and \
+                        self.step + 1 >= p_start:
+                    jax.profiler.start_trace(self._profile_dir)
+                    self._profiling = True
                 self.params, self.opt_state, loss = self._train_step(
                     self.params, self.opt_state, rng, xb, yb)
                 self.step += 1
+                if self._profiling and self.step >= p_end:
+                    jax.block_until_ready(loss)  # capture device time
+                    jax.profiler.stop_trace()
+                    self._profiling = False
+                    self._profile_dir = None
                 n_records += batch_size
                 pending.append((self.step, loss))
                 if self.checkpoint_path and self.checkpoint_trigger(
@@ -446,6 +527,10 @@ class Estimator:
             if stop or (end_trigger is not None and
                         end_trigger(epoch, self.step, True)):
                 break
+        if self._profiling:  # short run ended inside the trace window
+            jax.profiler.stop_trace()
+            self._profiling = False
+            self._profile_dir = None
         if tb is not None:
             tb.flush()
         return TrainResult(history, self.params, self.opt_state, self.step)

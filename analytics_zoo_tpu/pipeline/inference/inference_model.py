@@ -35,6 +35,7 @@ class InferenceModel:
         self._predict_fn: Optional[Callable] = None
         self._compiled = False
         self._lock = threading.Lock()
+        self.quantized = None  # QuantizedModel when loaded with int8
 
     # -- loaders ------------------------------------------------------------
     def _install(self, predict_fn: Callable,
@@ -50,36 +51,49 @@ class InferenceModel:
         self._compiled = example_inputs is not None
 
     def load(self, model_path: str,
-             example_inputs: Optional[Sequence] = None):
+             example_inputs: Optional[Sequence] = None,
+             quantize: bool = False):
         """Load a saved ZooModel (`ZooModel.save_model` output) —
-        the `doLoad` BigDL path."""
+        the `doLoad` BigDL path. ``quantize=True`` serves int8 (the
+        reference's quantized-inference claim, wp-bigdl.md:192-196;
+        requires example_inputs for calibration)."""
         from analytics_zoo_tpu.models.common import ZooModel
         zm = ZooModel.load_model(model_path)
-        est = zm.model.estimator
-        params = est.params
-        model = zm.model
-
-        def predict_fn(*xs):
-            x = list(xs) if len(xs) > 1 else xs[0]
-            return model.forward(params, x, training=False)
-
-        self._install(predict_fn,
-                      None if example_inputs is None
-                      else [np.asarray(e) for e in example_inputs])
-        return self
+        return self.load_keras_net(zm.model,
+                                   example_inputs=example_inputs,
+                                   quantize=quantize)
 
     def load_keras_net(self, net, params=None,
-                       example_inputs: Optional[Sequence] = None):
-        """Serve an in-memory KerasNet."""
+                       example_inputs: Optional[Sequence] = None,
+                       quantize: bool = False):
+        """Serve an in-memory KerasNet; ``quantize=True`` swaps
+        Dense/Conv kernels for int8 (MXU 8-bit path) calibrated on
+        ``example_inputs``."""
         if params is None:
             est = net.estimator
             if est.params is None:
                 est._ensure_initialized()
             params = est.params
 
-        def predict_fn(*xs):
-            x = list(xs) if len(xs) > 1 else xs[0]
-            return net.forward(params, x, training=False)
+        if quantize:
+            if example_inputs is None:
+                raise ValueError(
+                    "quantize=True needs example_inputs for "
+                    "activation-scale calibration")
+            from analytics_zoo_tpu.pipeline.inference.quantize import \
+                QuantizedModel
+            qm = QuantizedModel(net, params,
+                                np.asarray(example_inputs[0]))
+            self.quantized = qm
+
+            def predict_fn(*xs):
+                return qm.forward(xs[0] if len(xs) == 1 else list(xs))
+        else:
+            self.quantized = None
+
+            def predict_fn(*xs):
+                x = list(xs) if len(xs) > 1 else xs[0]
+                return net.forward(params, x, training=False)
 
         self._install(predict_fn,
                       None if example_inputs is None

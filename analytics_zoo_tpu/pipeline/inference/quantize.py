@@ -1,0 +1,155 @@
+"""Post-training INT8 quantization for serving.
+
+Reference: the BigDL white paper's headline serving claim — INT8
+quantized inference with ~2x speedup, 4x model-size reduction, <0.1%
+accuracy drop (`/root/reference/docs/docs/wp-bigdl.md:192-196`,
+SSD/VGG16/VGG19 on CPU via MKL int8 GEMM).
+
+TPU-native redesign: symmetric int8 quantization mapped onto the MXU —
+`lax.dot_general` / `lax.conv_general_dilated` accept int8 operands
+with `preferred_element_type=int32`, which XLA lowers to the MXU's
+native 8-bit multiply / 32-bit accumulate path (2× the bf16 MAC rate
+on v5e). Scheme:
+
+- weights: per-output-channel symmetric int8 (`w ≈ w_q · s_w`);
+- activations: per-tensor symmetric int8, scale calibrated as the
+  max-|x| each quantized layer sees over a calibration batch (the
+  reference's calibration-data flow);
+- matmul/conv accumulate in int32, one fused rescale
+  (`s_x · s_w`) back to float, then bias + activation as usual.
+
+Only Dense and Convolution2D are quantized (where the FLOPs are —
+same scope as the reference's GEMM/conv quantization); every other
+layer runs float through its normal `call`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.common.nncontext import logger
+
+
+def _quantize_per_channel(w: np.ndarray, channel_axis: int):
+    """Symmetric per-channel int8: returns (w_q int8, scale f32 with
+    singleton dims except channel_axis)."""
+    reduce_axes = tuple(a for a in range(w.ndim) if a != channel_axis)
+    amax = np.max(np.abs(w), axis=reduce_axes, keepdims=True)
+    scale = (amax / 127.0).astype(np.float32)
+    scale = np.where(scale == 0, 1.0, scale).astype(np.float32)
+    w_q = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
+    return w_q, scale
+
+
+def _quantize_activation(x, scale):
+    return jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+
+
+class QuantizedModel:
+    """A Sequential with its Dense/Conv2D layers swapped for int8
+    kernels (reference `InferenceModel` quantized load path)."""
+
+    def __init__(self, model, params, calibration_inputs,
+                 quantize_types=("Dense", "Convolution2D", "Conv2D")):
+        from analytics_zoo_tpu.pipeline.api.keras.models import \
+            Sequential
+        if not isinstance(model, Sequential):
+            raise TypeError(
+                "quantization requires a Sequential model (got "
+                f"{type(model).__name__})")
+        self.model = model
+        self.params = jax.device_get(params)
+        self._plan: List[Dict[str, Any]] = []
+        self._calibrate(calibration_inputs, quantize_types)
+
+    # -- calibration --------------------------------------------------------
+    def _calibrate(self, calibration_inputs, quantize_types) -> None:
+        x = jnp.asarray(np.asarray(calibration_inputs, np.float32))
+        n_q = 0
+        for layer in self.model.layers:
+            p = self.params.get(layer.name, {})
+            tname = type(layer).__name__
+            entry: Dict[str, Any] = {"layer": layer, "mode": "float"}
+            if tname in quantize_types and "kernel" in p:
+                kernel = np.asarray(p["kernel"])
+                # kernel layouts: Dense (in, out) / conv HWIO — the
+                # output channel is always the LAST axis
+                w_q, w_scale = _quantize_per_channel(
+                    kernel, kernel.ndim - 1)
+                a_scale = float(np.max(np.abs(np.asarray(x)))) / 127.0
+                entry.update(mode="int8", w_q=w_q,
+                             w_scale=w_scale.reshape(-1),
+                             a_scale=np.float32(a_scale or 1.0))
+                n_q += 1
+            self._plan.append(entry)
+            x = layer.call(p, x, training=False)
+        logger.info("quantize: %d/%d layers int8",
+                    n_q, len(self.model.layers))
+
+    # -- forward ------------------------------------------------------------
+    def forward(self, x):
+        for entry in self._plan:
+            layer = entry["layer"]
+            p = self.params.get(layer.name, {})
+            if entry["mode"] == "float":
+                x = layer.call(p, x, training=False)
+                continue
+            x = self._int8_layer(entry, layer, p, x)
+        return x
+
+    def __call__(self, x):
+        return self.forward(x)
+
+    def _int8_layer(self, entry, layer, p, x):
+        a_scale = entry["a_scale"]
+        w_q = entry["w_q"]
+        w_scale = entry["w_scale"]
+        x_q = _quantize_activation(x, a_scale)
+        tname = type(layer).__name__
+        if tname == "Dense":
+            acc = jax.lax.dot_general(
+                x_q, w_q, (((x_q.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            y = acc.astype(jnp.float32) * (a_scale * w_scale)
+            if layer.bias:
+                y = y + p["bias"]
+        else:  # Convolution2D
+            acc = jax.lax.conv_general_dilated(
+                x_q, w_q,
+                window_strides=layer.subsample,
+                padding=layer.border_mode.upper(),
+                rhs_dilation=layer.dilation,
+                dimension_numbers=layer._dn(),
+                preferred_element_type=jnp.int32)
+            scale = a_scale * w_scale
+            if layer.dim_ordering == "tf":
+                y = acc.astype(jnp.float32) * scale
+                if layer.bias:
+                    y = y + p["bias"]
+            else:
+                shape = (1, -1) + (1,) * layer.ndim
+                y = acc.astype(jnp.float32) * scale.reshape(shape)
+                if layer.bias:
+                    y = y + p["bias"].reshape(shape)
+        if getattr(layer, "activation", None) is not None:
+            y = layer.activation(y)
+        return y
+
+    # -- introspection ------------------------------------------------------
+    @property
+    def n_quantized(self) -> int:
+        return sum(1 for e in self._plan if e["mode"] == "int8")
+
+    def size_bytes(self) -> "tuple[int, int]":
+        """(float_bytes, int8_bytes) of the quantized kernels — the
+        reference's 4x model-size-reduction metric."""
+        f = q = 0
+        for e in self._plan:
+            if e["mode"] == "int8":
+                f += e["w_q"].size * 4
+                q += e["w_q"].size + e["w_scale"].size * 4
+        return f, q

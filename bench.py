@@ -195,6 +195,13 @@ def main():
 
     _result["diag"] = "warmup run"
     timed()  # warmup (execution path, allocator)
+    profile_dir = os.environ.get("ZOO_TPU_BENCH_PROFILE_DIR")
+    if profile_dir:  # jax.profiler trace of one measured chain
+        jax.profiler.start_trace(profile_dir)
+        timed()
+        jax.profiler.stop_trace()
+        print(f"# profile trace -> {profile_dir}", file=sys.stderr,
+              flush=True)
     _result["diag"] = "timing"
     best_dt = None
     loss = float("nan")

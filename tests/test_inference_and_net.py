@@ -268,3 +268,66 @@ def test_tfdataset_batch_contract():
     assert ds.num_samples == 32
     with pytest.raises(ValueError):
         TFDataset.from_ndarrays(x, batch_size=9)  # 9 % 8 devices != 0
+
+
+# -- INT8 quantized serving (VERDICT round-1 item 8) --------------------------
+# Reference claim: int8 inference, ~2x speedup / 4x model size / <0.1%
+# accuracy drop (`/root/reference/docs/docs/wp-bigdl.md:192-196`).
+
+class TestQuantizedInference:
+    def _trained_classifier(self, rng, n=256, d=16, classes=4):
+        from analytics_zoo_tpu.pipeline.api.keras import Sequential, \
+            layers as L
+        x = rng.randn(n, d).astype(np.float32)
+        w = rng.randn(d, classes).astype(np.float32)
+        y = np.argmax(x @ w + 0.1 * rng.randn(n, classes), -1) \
+            .astype(np.int32).reshape(-1, 1)
+        m = Sequential()
+        m.add(L.Dense(32, activation="relu", input_shape=(d,)))
+        m.add(L.Dense(classes))
+        m.compile(optimizer="adam", loss="softmax_cross_entropy")
+        m.fit(x, y, batch_size=64, nb_epoch=12)
+        return m, x, y
+
+    def test_int8_accuracy_within_1pct(self, rng):
+        from analytics_zoo_tpu.pipeline.inference import InferenceModel
+        m, x, y = self._trained_classifier(rng)
+        float_pred = np.argmax(m.predict(x), -1)
+
+        im = InferenceModel()
+        # example_inputs both calibrates scales and pins the AOT
+        # serving shape (the OpenVINO-IR fixed-shape contract)
+        im.load_keras_net(m, example_inputs=[x], quantize=True)
+        q_pred = np.argmax(im.predict(x), -1)
+        agree = float(np.mean(q_pred == float_pred))
+        assert agree >= 0.99, f"int8 disagreement too high: {agree}"
+        assert im.quantized.n_quantized == 2
+
+    def test_int8_conv_model(self, rng):
+        from analytics_zoo_tpu.pipeline.api.keras import Sequential, \
+            layers as L
+        from analytics_zoo_tpu.pipeline.inference import InferenceModel
+        m = Sequential()
+        m.add(L.Convolution2D(8, 3, border_mode="same",
+                              activation="relu",
+                              input_shape=(8, 8, 3)))
+        m.add(L.GlobalAveragePooling2D())
+        m.add(L.Dense(5))
+        m.compile(optimizer="sgd", loss="mse")
+        x = rng.randn(16, 8, 8, 3).astype(np.float32)
+        ref = m.predict(x)
+        im = InferenceModel()
+        im.load_keras_net(m, example_inputs=[x], quantize=True)
+        out = im.predict(x)
+        assert out.shape == ref.shape
+        # int8 error stays small relative to output magnitude
+        rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-6)
+        assert rel < 0.1, rel
+
+    def test_int8_size_reduction(self, rng):
+        from analytics_zoo_tpu.pipeline.inference import InferenceModel
+        m, x, _ = self._trained_classifier(rng)
+        im = InferenceModel()
+        im.load_keras_net(m, example_inputs=[x[:64]], quantize=True)
+        f_bytes, q_bytes = im.quantized.size_bytes()
+        assert f_bytes > 3 * q_bytes  # ~4x reduction on kernels

@@ -209,3 +209,79 @@ def test_image_classifier_named_archs():
     y = rs.randint(0, 10, (16, 1)).astype(np.int32)
     ic.fit(x, y, batch_size=8, nb_epoch=1)
     assert ic.predict(x, batch_size=8).shape == (16, 10)
+
+
+# -- pretrained registry (VERDICT round-1 item 9) -----------------------------
+# Reference: `ObjectDetectionConfig.scala:31` name→model registry,
+# `ImageClassifier.loadModel` by published name.
+
+class TestPretrainedRegistry:
+    def test_save_load_weights_roundtrip(self, rng, tmp_path):
+        from analytics_zoo_tpu.models.image.imageclassification import \
+            ImageClassifier
+        import jax
+        m = ImageClassifier("lenet-5", input_shape=(28, 28, 1), classes=10)
+        m.compile()
+        m.model.estimator._ensure_initialized()
+        wfile = str(tmp_path / "lenet-5.npz")
+        m.save_weights(wfile)
+
+        m2 = ImageClassifier.load_model(
+            "lenet-5", weights_path=wfile, input_shape=(28, 28, 1),
+            classes=10)
+        p1 = jax.device_get(m.model.estimator.params)
+        p2 = jax.device_get(m2.model.estimator.params)
+        leaves1 = jax.tree_util.tree_leaves(p1)
+        leaves2 = jax.tree_util.tree_leaves(p2)
+        assert all(np.allclose(a, b)
+                   for a, b in zip(leaves1, leaves2))
+
+    def test_load_by_published_name(self, tmp_path):
+        from analytics_zoo_tpu.models.image.imageclassification import \
+            ImageClassifier
+        m = ImageClassifier("squeezenet", input_shape=(32, 32, 3),
+                            classes=7)
+        m.compile()
+        m.model.estimator._ensure_initialized()
+        wfile = str(tmp_path / "squeezenet.npz")
+        m.save_weights(wfile)
+        # reference-style full published name resolves to the arch
+        m2 = ImageClassifier.load_model(
+            "analytics-zoo_squeezenet_imagenet_0.1.0",
+            weights_path=wfile, input_shape=(32, 32, 3), classes=7)
+        assert m2.model_name == "squeezenet"
+
+    def test_pretrained_dir_env(self, tmp_path, monkeypatch):
+        from analytics_zoo_tpu.models.config import \
+            ImageClassificationConfig
+        from analytics_zoo_tpu.models.image.imageclassification import \
+            ImageClassifier
+        m = ImageClassifier("lenet-5", input_shape=(28, 28, 1), classes=10)
+        m.compile()
+        m.model.estimator._ensure_initialized()
+        m.save_weights(str(tmp_path / "lenet-5.npz"))
+        monkeypatch.setenv("ZOO_TPU_PRETRAINED_DIR", str(tmp_path))
+        m2 = ImageClassificationConfig.create(
+            "lenet-5", input_shape=(28, 28, 1), classes=10)
+        assert m2.model_name == "lenet-5"
+
+    def test_wrong_shape_weights_rejected(self, tmp_path):
+        from analytics_zoo_tpu.models.image.imageclassification import \
+            ImageClassifier
+        m = ImageClassifier("lenet-5", input_shape=(28, 28, 1), classes=10)
+        m.compile()
+        m.model.estimator._ensure_initialized()
+        wfile = str(tmp_path / "lenet-5-10.npz")
+        m.save_weights(wfile)
+        with pytest.raises((ValueError, KeyError)):
+            ImageClassifier.load_model(
+                "lenet-5", weights_path=wfile, input_shape=(28, 28, 1),
+                classes=5)  # class-count mismatch -> shape error
+
+    def test_object_detection_registry_names(self):
+        from analytics_zoo_tpu.models.config import \
+            ObjectDetectionConfig
+        names = ObjectDetectionConfig.names()
+        assert len(names) >= 1
+        m = ObjectDetectionConfig.create(names[0])
+        assert m.model_name == names[0]

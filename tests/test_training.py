@@ -261,3 +261,73 @@ def test_tensorboard_scalars(tmp_path):
     for root, _, files in os.walk(tmp_path / "tb"):
         event_files += [f for f in files if "tfevents" in f]
     assert event_files, "no tensorboard event files written"
+
+
+# -- dtype policy / profiler / multi-host knobs (round-2) ---------------------
+
+class TestDtypePolicyAndProfile:
+    def _data(self, rng, n=64, d=8, classes=3):
+        x = rng.randn(n, d).astype(np.float32)
+        y = rng.randint(0, classes, size=(n, 1)).astype(np.int32)
+        return x, y
+
+    def test_mixed_bfloat16_trains_and_predicts(self, rng):
+        import jax
+        from analytics_zoo_tpu.pipeline.api.keras import Sequential, \
+            layers as L
+        from analytics_zoo_tpu.pipeline.estimator import Estimator
+        x, y = self._data(rng)
+        m = Sequential()
+        m.add(L.Dense(16, activation="relu", input_shape=(8,)))
+        m.add(L.Dense(3))
+        est = Estimator(m, optimizer="adam",
+                        loss="softmax_cross_entropy",
+                        dtype_policy="mixed_bfloat16")
+        est.train(x, y, batch_size=32, nb_epoch=2)
+        # params stay f32 under the mixed policy
+        leaves = jax.tree_util.tree_leaves(jax.device_get(est.params))
+        assert all(l.dtype == np.float32 for l in leaves
+                   if np.issubdtype(l.dtype, np.floating))
+        out = est.predict(x, batch_size=32)
+        assert out.dtype == np.float32 and out.shape == (64, 3)
+        est.evaluate(x, y, batch_size=32)
+
+    def test_set_dtype_policy_rejects_unknown(self, rng):
+        from analytics_zoo_tpu.pipeline.api.keras import Sequential, \
+            layers as L
+        from analytics_zoo_tpu.pipeline.estimator import Estimator
+        m = Sequential()
+        m.add(L.Dense(2, input_shape=(4,)))
+        est = Estimator(m)
+        with pytest.raises(ValueError):
+            est.set_dtype_policy("float8")
+
+    def test_profiler_trace_capture(self, rng, tmp_path):
+        import os
+        from analytics_zoo_tpu.pipeline.api.keras import Sequential, \
+            layers as L
+        from analytics_zoo_tpu.pipeline.estimator import Estimator
+        x, y = self._data(rng)
+        m = Sequential()
+        m.add(L.Dense(8, activation="relu", input_shape=(8,)))
+        m.add(L.Dense(3))
+        est = Estimator(m, optimizer="sgd",
+                        loss="softmax_cross_entropy")
+        trace_dir = str(tmp_path / "trace")
+        est.set_profile(trace_dir, start_step=1, n_steps=2)
+        est.train(x, y, batch_size=32, nb_epoch=1)
+        # a plugins/profile/<run>/ dir with trace artifacts appears
+        hits = []
+        for root, _, files in os.walk(trace_dir):
+            hits.extend(f for f in files
+                        if "trace" in f or f.endswith(".pb"))
+        assert hits, f"no trace files under {trace_dir}"
+        assert est._profiling is False
+
+    def test_multi_host_flags(self):
+        from analytics_zoo_tpu import init_nncontext
+        # single-process: auto mode is a no-op, False skips entirely
+        ctx = init_nncontext(tpu_mesh={"data": -1}, multi_host=False)
+        assert ctx.num_devices >= 1
+        ctx = init_nncontext(tpu_mesh={"data": -1}, multi_host=None)
+        assert ctx.num_devices >= 1
