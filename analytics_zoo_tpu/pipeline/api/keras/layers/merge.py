@@ -14,7 +14,8 @@ import jax.numpy as jnp
 from analytics_zoo_tpu.pipeline.api.keras.engine import (
     KerasLayer, Shape, ShapeLike)
 
-_MODES = ("sum", "mul", "concat", "ave", "cos", "dot", "max", "min")
+_MODES = ("sum", "sub", "mul", "concat", "ave", "cos", "dot", "max",
+          "min")
 
 
 class Merge(KerasLayer):
@@ -40,6 +41,11 @@ class Merge(KerasLayer):
             out = xs[0]
             for x in xs[1:]:
                 out = out * x
+            return out
+        if m == "sub":
+            out = xs[0]
+            for x in xs[1:]:
+                out = out - x
             return out
         if m == "ave":
             out = xs[0]
@@ -73,7 +79,7 @@ class Merge(KerasLayer):
 
     def compute_output_shape(self, input_shape: ShapeLike) -> Shape:
         shapes: "list[Shape]" = [tuple(s) for s in input_shape]
-        if self.mode in ("sum", "mul", "ave", "max", "min"):
+        if self.mode in ("sum", "sub", "mul", "ave", "max", "min"):
             return shapes[0]
         if self.mode == "concat":
             axis = self.concat_axis

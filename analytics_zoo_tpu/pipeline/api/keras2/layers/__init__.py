@@ -1,158 +1,74 @@
 """Keras-2-style layer variants (reference `Z/pipeline/api/keras2/layers/`
-and `P/pipeline/api/keras2/` — 21 files of Keras-2 arg-name adapters over
-the keras1 library).
+— 21 Scala files — and the full Python mirror
+`P/pipeline/api/keras2/layers/{core,convolutional,pooling,merge,
+recurrent,convolutional_recurrent,embeddings,normalization,
+advanced_activations,noise,local,wrappers}.py`).
 
-Exactly like the reference, these are thin subclasses translating Keras-2
-argument names (`units`, `filters`, `kernel_size`, `strides`, `padding`,
-`rate`, `use_bias`, `kernel_initializer`, ...) onto the keras1 engine —
-kernels and semantics are shared.
+Exactly like the reference, these are thin adapters translating Keras-2
+argument names (`units`, `filters`, `kernel_size`, `strides`,
+`padding`, `rate`, `use_bias`, `kernel_initializer`,
+`recurrent_activation`, ...) onto the keras1 engine — kernels and
+semantics are shared, so keras2 models run the same XLA programs.
 """
 
 from __future__ import annotations
 
-from analytics_zoo_tpu.pipeline.api.keras import layers as k1
-from analytics_zoo_tpu.pipeline.api.keras.layers.conv import _norm_tuple
-
-
-class Dense(k1.Dense):
-    """keras2 Dense (reference `keras2/layers/Dense.scala`)."""
-
-    def __init__(self, units: int, activation=None,
-                 use_bias: bool = True,
-                 kernel_initializer="glorot_uniform",
-                 kernel_regularizer=None, bias_regularizer=None,
-                 input_shape=None, name=None, **kwargs):
-        super().__init__(output_dim=units, init=kernel_initializer,
-                         activation=activation,
-                         w_regularizer=kernel_regularizer,
-                         b_regularizer=bias_regularizer, bias=use_bias,
-                         input_shape=input_shape, name=name, **kwargs)
-
-
-class Activation(k1.Activation):
-    """keras2 Activation (reference `keras2/layers/Activation.scala`)."""
-
-
-class Dropout(k1.Dropout):
-    """keras2 Dropout (reference `keras2/layers/Dropout.scala`)."""
-
-    def __init__(self, rate: float, input_shape=None, name=None, **kwargs):
-        super().__init__(p=rate, input_shape=input_shape, name=name,
-                         **kwargs)
-
-
-class Flatten(k1.Flatten):
-    """keras2 Flatten (reference `keras2/layers/Flatten.scala`)."""
-
-
-class Softmax(k1.Softmax):
-    """keras2 Softmax (reference `keras2/layers/Softmax.scala`)."""
-
-
-class Conv1D(k1.Convolution1D):
-    """keras2 Conv1D (reference `keras2/layers/Conv1D.scala`)."""
-
-    def __init__(self, filters: int, kernel_size, strides=1,
-                 padding: str = "valid", activation=None,
-                 use_bias: bool = True,
-                 kernel_initializer="glorot_uniform",
-                 kernel_regularizer=None, bias_regularizer=None,
-                 input_shape=None, name=None, **kwargs):
-        (k,) = _norm_tuple(kernel_size, 1, "kernel_size")
-        (s,) = _norm_tuple(strides, 1, "strides")
-        super().__init__(filters, k, init=kernel_initializer,
-                         activation=activation, border_mode=padding,
-                         subsample_length=s,
-                         w_regularizer=kernel_regularizer,
-                         b_regularizer=bias_regularizer, bias=use_bias,
-                         input_shape=input_shape, name=name, **kwargs)
-
-
-class Conv2D(k1.Convolution2D):
-    """keras2 Conv2D (reference `keras2/layers/Conv2D.scala`).
-    Channels-last by default (TPU-native), `data_format="channels_first"`
-    maps to the keras1 "th" ordering."""
-
-    def __init__(self, filters: int, kernel_size, strides=1,
-                 padding: str = "valid",
-                 data_format: str = "channels_last", activation=None,
-                 use_bias: bool = True,
-                 kernel_initializer="glorot_uniform",
-                 kernel_regularizer=None, bias_regularizer=None,
-                 input_shape=None, name=None, **kwargs):
-        kh, kw = _norm_tuple(kernel_size, 2, "kernel_size")
-        super().__init__(filters, kh, kw, init=kernel_initializer,
-                         activation=activation, border_mode=padding,
-                         subsample=_norm_tuple(strides, 2, "strides"),
-                         dim_ordering=("th" if data_format ==
-                                       "channels_first" else "tf"),
-                         w_regularizer=kernel_regularizer,
-                         b_regularizer=bias_regularizer, bias=use_bias,
-                         input_shape=input_shape, name=name, **kwargs)
-
-
-class MaxPooling1D(k1.MaxPooling1D):
-    """keras2 MaxPooling1D (reference `keras2/layers/MaxPooling1D.scala`)."""
-
-    def __init__(self, pool_size: int = 2, strides=None,
-                 padding: str = "valid", input_shape=None, name=None,
-                 **kwargs):
-        super().__init__(pool_length=pool_size, stride=strides,
-                         border_mode=padding, input_shape=input_shape,
-                         name=name, **kwargs)
-
-
-class AveragePooling1D(k1.AveragePooling1D):
-    """keras2 AveragePooling1D (reference
-    `keras2/layers/AveragePooling1D.scala`)."""
-
-    def __init__(self, pool_size: int = 2, strides=None,
-                 padding: str = "valid", input_shape=None, name=None,
-                 **kwargs):
-        super().__init__(pool_length=pool_size, stride=strides,
-                         border_mode=padding, input_shape=input_shape,
-                         name=name, **kwargs)
-
-
-class Cropping1D(k1.Cropping1D):
-    """keras2 Cropping1D (reference `keras2/layers/Cropping1D.scala`)."""
-
-
-class LocallyConnected1D(k1.LocallyConnected1D):
-    """keras2 LocallyConnected1D (reference
-    `keras2/layers/LocallyConnected1D.scala`)."""
-
-    def __init__(self, filters: int, kernel_size, strides=1,
-                 activation=None, use_bias: bool = True,
-                 kernel_regularizer=None, bias_regularizer=None,
-                 input_shape=None, name=None, **kwargs):
-        (k,) = _norm_tuple(kernel_size, 1, "kernel_size")
-        (s,) = _norm_tuple(strides, 1, "strides")
-        super().__init__(filters, k, activation=activation,
-                         subsample_length=s,
-                         w_regularizer=kernel_regularizer,
-                         b_regularizer=bias_regularizer, bias=use_bias,
-                         input_shape=input_shape, name=name, **kwargs)
-
-
-# merge-op layers: identical to keras1 merge aliases
-Maximum = k1.Maximum
-Minimum = k1.Minimum
-Average = k1.Average
-
-# global pooling: names are identical in keras2
-GlobalMaxPooling1D = k1.GlobalMaxPooling1D
-GlobalMaxPooling2D = k1.GlobalMaxPooling2D
-GlobalMaxPooling3D = k1.GlobalMaxPooling3D
-GlobalAveragePooling1D = k1.GlobalAveragePooling1D
-GlobalAveragePooling2D = k1.GlobalAveragePooling2D
-GlobalAveragePooling3D = k1.GlobalAveragePooling3D
+from analytics_zoo_tpu.pipeline.api.keras2.layers.core import (
+    Activation, Dense, Dropout, Flatten, Masking, Permute,
+    RepeatVector, Reshape, Softmax)
+from analytics_zoo_tpu.pipeline.api.keras2.layers.convolutional import (
+    Conv1D, Conv2D, Conv2DTranspose, Conv3D, Cropping1D, Cropping2D,
+    SeparableConv2D, UpSampling1D, UpSampling2D, ZeroPadding1D,
+    ZeroPadding2D)
+from analytics_zoo_tpu.pipeline.api.keras2.layers.pooling import (
+    AveragePooling1D, AveragePooling2D, AveragePooling3D,
+    GlobalAveragePooling1D, GlobalAveragePooling2D,
+    GlobalAveragePooling3D, GlobalMaxPooling1D, GlobalMaxPooling2D,
+    GlobalMaxPooling3D, MaxPooling1D, MaxPooling2D, MaxPooling3D)
+from analytics_zoo_tpu.pipeline.api.keras2.layers.merge import (
+    Add, Average, Concatenate, Dot, Maximum, Minimum, Multiply,
+    Subtract)
+from analytics_zoo_tpu.pipeline.api.keras2.layers.recurrent import (
+    GRU, LSTM, SimpleRNN)
+from analytics_zoo_tpu.pipeline.api.keras2.layers \
+    .convolutional_recurrent import ConvLSTM2D
+from analytics_zoo_tpu.pipeline.api.keras2.layers.embeddings import (
+    Embedding)
+from analytics_zoo_tpu.pipeline.api.keras2.layers.normalization import (
+    BatchNormalization)
+from analytics_zoo_tpu.pipeline.api.keras2.layers \
+    .advanced_activations import (ELU, LeakyReLU, PReLU,
+                                  ThresholdedReLU)
+from analytics_zoo_tpu.pipeline.api.keras2.layers.noise import (
+    GaussianDropout, GaussianNoise)
+from analytics_zoo_tpu.pipeline.api.keras2.layers.local import (
+    LocallyConnected1D, LocallyConnected2D)
+from analytics_zoo_tpu.pipeline.api.keras2.layers.wrappers import (
+    Bidirectional, TimeDistributed)
 
 __all__ = [
-    "Dense", "Activation", "Dropout", "Flatten", "Softmax",
-    "Conv1D", "Conv2D", "MaxPooling1D", "AveragePooling1D", "Cropping1D",
-    "LocallyConnected1D", "Maximum", "Minimum", "Average",
-    "GlobalMaxPooling1D", "GlobalMaxPooling2D", "GlobalMaxPooling3D",
+    # core
+    "Dense", "Activation", "Dropout", "Flatten", "Softmax", "Reshape",
+    "Permute", "RepeatVector", "Masking",
+    # convolutional
+    "Conv1D", "Conv2D", "Conv3D", "Conv2DTranspose", "SeparableConv2D",
+    "Cropping1D", "Cropping2D", "UpSampling1D", "UpSampling2D",
+    "ZeroPadding1D", "ZeroPadding2D",
+    # pooling
+    "MaxPooling1D", "MaxPooling2D", "MaxPooling3D", "AveragePooling1D",
+    "AveragePooling2D", "AveragePooling3D", "GlobalMaxPooling1D",
+    "GlobalMaxPooling2D", "GlobalMaxPooling3D",
     "GlobalAveragePooling1D", "GlobalAveragePooling2D",
     "GlobalAveragePooling3D",
+    # merge
+    "Add", "Subtract", "Multiply", "Average", "Maximum", "Minimum",
+    "Concatenate", "Dot",
+    # recurrent
+    "SimpleRNN", "LSTM", "GRU", "ConvLSTM2D",
+    # embeddings / normalization / activations / noise
+    "Embedding", "BatchNormalization", "LeakyReLU", "ELU", "PReLU",
+    "ThresholdedReLU", "GaussianNoise", "GaussianDropout",
+    # local / wrappers
+    "LocallyConnected1D", "LocallyConnected2D", "TimeDistributed",
+    "Bidirectional",
 ]

@@ -1,0 +1,14 @@
+"""Shared keras2 adapter helpers."""
+
+from __future__ import annotations
+
+
+def data_format_to_dim_ordering(data_format: str) -> str:
+    """Keras-2 ``data_format`` → keras1 ``dim_ordering``."""
+    if data_format == "channels_first":
+        return "th"
+    if data_format == "channels_last":
+        return "tf"
+    raise ValueError(
+        f"data_format must be channels_first|channels_last, "
+        f"got {data_format!r}")

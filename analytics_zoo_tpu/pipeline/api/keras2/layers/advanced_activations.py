@@ -1,0 +1,28 @@
+"""keras2 advanced activations (reference
+`P/pipeline/api/keras2/layers/advanced_activations.py`)."""
+
+from __future__ import annotations
+
+from analytics_zoo_tpu.pipeline.api.keras import layers as k1
+
+
+class LeakyReLU(k1.LeakyReLU):
+    """keras2 LeakyReLU: `alpha` spelling (same as keras1)."""
+
+
+class ELU(k1.ELU):
+    """keras2 ELU (same arg spelling)."""
+
+
+class PReLU(k1.PReLU):
+    """keras2 PReLU (same arg spelling)."""
+
+
+class ThresholdedReLU(k1.ThresholdedReLU):
+    """keras2 ThresholdedReLU: `theta` spelling."""
+
+    def __init__(self, theta: float = 1.0, input_shape=None, name=None,
+                 **kwargs):
+        super().__init__(theta=theta, input_shape=input_shape,
+                         name=name, **kwargs)
+

@@ -1,0 +1,151 @@
+"""keras2 convolution layers (reference
+`P/pipeline/api/keras2/layers/convolutional.py`,
+`Z/pipeline/api/keras2/layers/{Conv1D,Conv2D,Cropping1D}.scala`)."""
+
+from __future__ import annotations
+
+from analytics_zoo_tpu.pipeline.api.keras import layers as k1
+from analytics_zoo_tpu.pipeline.api.keras.layers.conv import _norm_tuple
+
+
+from analytics_zoo_tpu.pipeline.api.keras2.layers._utils import (
+    data_format_to_dim_ordering as _df)
+
+
+class Conv1D(k1.Convolution1D):
+    """keras2 Conv1D (reference `keras2/layers/Conv1D.scala`)."""
+
+    def __init__(self, filters: int, kernel_size, strides=1,
+                 padding: str = "valid", activation=None,
+                 use_bias: bool = True,
+                 kernel_initializer="glorot_uniform",
+                 kernel_regularizer=None, bias_regularizer=None,
+                 input_shape=None, name=None, **kwargs):
+        (k,) = _norm_tuple(kernel_size, 1, "kernel_size")
+        (s,) = _norm_tuple(strides, 1, "strides")
+        super().__init__(filters, k, init=kernel_initializer,
+                         activation=activation, border_mode=padding,
+                         subsample_length=s,
+                         w_regularizer=kernel_regularizer,
+                         b_regularizer=bias_regularizer, bias=use_bias,
+                         input_shape=input_shape, name=name, **kwargs)
+
+
+class Conv2D(k1.Convolution2D):
+    """keras2 Conv2D (reference `keras2/layers/Conv2D.scala`).
+    Channels-last by default (TPU-native), `data_format=
+    "channels_first"` maps to the keras1 "th" ordering."""
+
+    def __init__(self, filters: int, kernel_size, strides=1,
+                 padding: str = "valid",
+                 data_format: str = "channels_last", activation=None,
+                 use_bias: bool = True,
+                 kernel_initializer="glorot_uniform",
+                 kernel_regularizer=None, bias_regularizer=None,
+                 input_shape=None, name=None, **kwargs):
+        kh, kw = _norm_tuple(kernel_size, 2, "kernel_size")
+        super().__init__(filters, kh, kw, init=kernel_initializer,
+                         activation=activation, border_mode=padding,
+                         subsample=_norm_tuple(strides, 2, "strides"),
+                         dim_ordering=_df(data_format),
+                         w_regularizer=kernel_regularizer,
+                         b_regularizer=bias_regularizer, bias=use_bias,
+                         input_shape=input_shape, name=name, **kwargs)
+
+
+class Conv3D(k1.Convolution3D):
+    """keras2 Conv3D."""
+
+    def __init__(self, filters: int, kernel_size, strides=1,
+                 padding: str = "valid",
+                 data_format: str = "channels_last", activation=None,
+                 use_bias: bool = True,
+                 kernel_initializer="glorot_uniform",
+                 kernel_regularizer=None, bias_regularizer=None,
+                 input_shape=None, name=None, **kwargs):
+        k1_, k2_, k3_ = _norm_tuple(kernel_size, 3, "kernel_size")
+        super().__init__(filters, k1_, k2_, k3_,
+                         init=kernel_initializer,
+                         activation=activation, border_mode=padding,
+                         subsample=_norm_tuple(strides, 3, "strides"),
+                         dim_ordering=_df(data_format),
+                         w_regularizer=kernel_regularizer,
+                         b_regularizer=bias_regularizer, bias=use_bias,
+                         input_shape=input_shape, name=name, **kwargs)
+
+
+class SeparableConv2D(k1.SeparableConvolution2D):
+    """keras2 SeparableConv2D."""
+
+    def __init__(self, filters: int, kernel_size, strides=1,
+                 padding: str = "valid",
+                 data_format: str = "channels_last", activation=None,
+                 use_bias: bool = True, input_shape=None, name=None,
+                 **kwargs):
+        kh, kw = _norm_tuple(kernel_size, 2, "kernel_size")
+        super().__init__(filters, kh, kw, activation=activation,
+                         border_mode=padding,
+                         subsample=_norm_tuple(strides, 2, "strides"),
+                         dim_ordering=_df(data_format), bias=use_bias,
+                         input_shape=input_shape, name=name, **kwargs)
+
+
+class Conv2DTranspose(k1.Deconvolution2D):
+    """keras2 Conv2DTranspose."""
+
+    def __init__(self, filters: int, kernel_size, strides=1,
+                 padding: str = "valid",
+                 data_format: str = "channels_last", activation=None,
+                 use_bias: bool = True,
+                 kernel_initializer="glorot_uniform",
+                 input_shape=None, name=None, **kwargs):
+        kh, kw = _norm_tuple(kernel_size, 2, "kernel_size")
+        super().__init__(filters, kh, kw, init=kernel_initializer,
+                         activation=activation, border_mode=padding,
+                         subsample=_norm_tuple(strides, 2, "strides"),
+                         dim_ordering=_df(data_format), bias=use_bias,
+                         input_shape=input_shape, name=name, **kwargs)
+
+
+class Cropping1D(k1.Cropping1D):
+    """keras2 Cropping1D (reference
+    `keras2/layers/Cropping1D.scala`)."""
+
+
+class Cropping2D(k1.Cropping2D):
+    """keras2 Cropping2D (keras2 adds data_format)."""
+
+    def __init__(self, cropping=((0, 0), (0, 0)),
+                 data_format: str = "channels_last", input_shape=None,
+                 name=None, **kwargs):
+        super().__init__(cropping=cropping,
+                         dim_ordering=_df(data_format),
+                         input_shape=input_shape, name=name, **kwargs)
+
+
+class UpSampling1D(k1.UpSampling1D):
+    """keras2 UpSampling1D (same arg spelling)."""
+
+
+class UpSampling2D(k1.UpSampling2D):
+    """keras2 UpSampling2D."""
+
+    def __init__(self, size=(2, 2), data_format: str = "channels_last",
+                 input_shape=None, name=None, **kwargs):
+        super().__init__(size=size, dim_ordering=_df(data_format),
+                         input_shape=input_shape, name=name, **kwargs)
+
+
+class ZeroPadding1D(k1.ZeroPadding1D):
+    """keras2 ZeroPadding1D (same arg spelling)."""
+
+
+class ZeroPadding2D(k1.ZeroPadding2D):
+    """keras2 ZeroPadding2D."""
+
+    def __init__(self, padding=(1, 1),
+                 data_format: str = "channels_last", input_shape=None,
+                 name=None, **kwargs):
+        super().__init__(padding=padding,
+                         dim_ordering=_df(data_format),
+                         input_shape=input_shape, name=name, **kwargs)

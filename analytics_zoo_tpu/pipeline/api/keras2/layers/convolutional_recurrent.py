@@ -1,0 +1,24 @@
+"""keras2 convolutional-recurrent layers (reference
+`P/pipeline/api/keras2/layers/convolutional_recurrent.py`)."""
+
+from __future__ import annotations
+
+from analytics_zoo_tpu.pipeline.api.keras import layers as k1
+from analytics_zoo_tpu.pipeline.api.keras.layers.conv import _norm_tuple
+
+
+class ConvLSTM2D(k1.ConvLSTM2D):
+    """keras2 ConvLSTM2D: `filters`/`kernel_size` spellings."""
+
+    def __init__(self, filters: int, kernel_size,
+                 activation="tanh", recurrent_activation="hard_sigmoid",
+                 return_sequences: bool = False,
+                 go_backwards: bool = False, input_shape=None,
+                 name=None, **kwargs):
+        kh, kw = _norm_tuple(kernel_size, 2, "kernel_size")
+        super().__init__(nb_filter=filters, nb_kernel=(kh, kw),
+                         activation=activation,
+                         inner_activation=recurrent_activation,
+                         return_sequences=return_sequences,
+                         go_backwards=go_backwards,
+                         input_shape=input_shape, name=name, **kwargs)

@@ -1,0 +1,60 @@
+"""keras2 core layers (reference `P/pipeline/api/keras2/layers/core.py`,
+`Z/pipeline/api/keras2/layers/{Dense,Activation,Dropout,Flatten,
+Softmax}.scala`): thin Keras-2 arg-name adapters over the keras1
+engine."""
+
+from __future__ import annotations
+
+from analytics_zoo_tpu.pipeline.api.keras import layers as k1
+
+
+class Dense(k1.Dense):
+    """keras2 Dense (reference `keras2/layers/Dense.scala`)."""
+
+    def __init__(self, units: int, activation=None,
+                 use_bias: bool = True,
+                 kernel_initializer="glorot_uniform",
+                 kernel_regularizer=None, bias_regularizer=None,
+                 input_shape=None, name=None, **kwargs):
+        super().__init__(output_dim=units, init=kernel_initializer,
+                         activation=activation,
+                         w_regularizer=kernel_regularizer,
+                         b_regularizer=bias_regularizer, bias=use_bias,
+                         input_shape=input_shape, name=name, **kwargs)
+
+
+class Activation(k1.Activation):
+    """keras2 Activation (reference `keras2/layers/Activation.scala`)."""
+
+
+class Dropout(k1.Dropout):
+    """keras2 Dropout (reference `keras2/layers/Dropout.scala`)."""
+
+    def __init__(self, rate: float, input_shape=None, name=None,
+                 **kwargs):
+        super().__init__(p=rate, input_shape=input_shape, name=name,
+                         **kwargs)
+
+
+class Flatten(k1.Flatten):
+    """keras2 Flatten (reference `keras2/layers/Flatten.scala`)."""
+
+
+class Softmax(k1.Softmax):
+    """keras2 Softmax (reference `keras2/layers/Softmax.scala`)."""
+
+
+class Reshape(k1.Reshape):
+    """keras2 Reshape (same arg spelling as keras1)."""
+
+
+class Permute(k1.Permute):
+    """keras2 Permute (same arg spelling)."""
+
+
+class RepeatVector(k1.RepeatVector):
+    """keras2 RepeatVector (same arg spelling)."""
+
+
+class Masking(k1.Masking):
+    """keras2 Masking (same arg spelling)."""

@@ -1,0 +1,20 @@
+"""keras2 Embedding (reference
+`P/pipeline/api/keras2/layers/embeddings.py`)."""
+
+from __future__ import annotations
+
+from analytics_zoo_tpu.pipeline.api.keras import layers as k1
+
+
+class Embedding(k1.Embedding):
+    """keras2 Embedding: `embeddings_initializer`/`embeddings_regularizer`
+    spellings."""
+
+    def __init__(self, input_dim: int, output_dim: int,
+                 embeddings_initializer="uniform",
+                 embeddings_regularizer=None, input_shape=None,
+                 name=None, **kwargs):
+        super().__init__(input_dim, output_dim,
+                         init=embeddings_initializer,
+                         w_regularizer=embeddings_regularizer,
+                         input_shape=input_shape, name=name, **kwargs)

@@ -1,0 +1,45 @@
+"""keras2 locally-connected layers (reference
+`P/pipeline/api/keras2/layers/local.py`,
+`Z/pipeline/api/keras2/layers/LocallyConnected1D.scala`)."""
+
+from __future__ import annotations
+
+from analytics_zoo_tpu.pipeline.api.keras import layers as k1
+from analytics_zoo_tpu.pipeline.api.keras.layers.conv import _norm_tuple
+
+
+class LocallyConnected1D(k1.LocallyConnected1D):
+    """keras2 LocallyConnected1D (reference
+    `keras2/layers/LocallyConnected1D.scala`)."""
+
+    def __init__(self, filters: int, kernel_size, strides=1,
+                 activation=None, use_bias: bool = True,
+                 kernel_regularizer=None, bias_regularizer=None,
+                 input_shape=None, name=None, **kwargs):
+        (k,) = _norm_tuple(kernel_size, 1, "kernel_size")
+        (s,) = _norm_tuple(strides, 1, "strides")
+        super().__init__(filters, k, activation=activation,
+                         subsample_length=s,
+                         w_regularizer=kernel_regularizer,
+                         b_regularizer=bias_regularizer, bias=use_bias,
+                         input_shape=input_shape, name=name, **kwargs)
+
+
+class LocallyConnected2D(k1.LocallyConnected2D):
+    """keras2 LocallyConnected2D."""
+
+    def __init__(self, filters: int, kernel_size, strides=1,
+                 padding: str = "valid",
+                 data_format: str = "channels_last", activation=None,
+                 use_bias: bool = True, input_shape=None, name=None,
+                 **kwargs):
+        if data_format != "channels_last":
+            raise ValueError(
+                "LocallyConnected2D supports channels_last only")
+        kh, kw = _norm_tuple(kernel_size, 2, "kernel_size")
+        super().__init__(filters, kh, kw, activation=activation,
+                         border_mode=padding,
+                         subsample=_norm_tuple(strides, 2, "strides"),
+                         bias=use_bias, input_shape=input_shape,
+                         name=name, **kwargs)
+

@@ -1,0 +1,24 @@
+"""keras2 merge layers (reference
+`P/pipeline/api/keras2/layers/merge.py`,
+`Z/pipeline/api/keras2/layers/{Average,Maximum,Minimum}.scala`):
+identical multi-input semantics to the keras1 merge aliases."""
+
+from __future__ import annotations
+
+from analytics_zoo_tpu.pipeline.api.keras import layers as k1
+
+Add = k1.Add
+Multiply = k1.Multiply
+Average = k1.Average
+Maximum = k1.Maximum
+Minimum = k1.Minimum
+Concatenate = k1.Concatenate
+Dot = k1.Dot
+
+
+class Subtract(k1.Merge):
+    """keras2 Subtract: first input minus the second."""
+
+    def __init__(self, input_shape=None, name=None, **kwargs):
+        super().__init__(mode="sub", input_shape=input_shape,
+                         name=name, **kwargs)

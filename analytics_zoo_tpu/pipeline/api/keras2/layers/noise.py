@@ -1,0 +1,24 @@
+"""keras2 noise layers (reference
+`P/pipeline/api/keras2/layers/noise.py`)."""
+
+from __future__ import annotations
+
+from analytics_zoo_tpu.pipeline.api.keras import layers as k1
+
+
+class GaussianNoise(k1.GaussianNoise):
+    """keras2 GaussianNoise: `stddev` spelling."""
+
+    def __init__(self, stddev: float, input_shape=None, name=None,
+                 **kwargs):
+        super().__init__(sigma=stddev, input_shape=input_shape,
+                         name=name, **kwargs)
+
+
+class GaussianDropout(k1.GaussianDropout):
+    """keras2 GaussianDropout: `rate` spelling."""
+
+    def __init__(self, rate: float, input_shape=None, name=None,
+                 **kwargs):
+        super().__init__(p=rate, input_shape=input_shape, name=name,
+                         **kwargs)

@@ -1,0 +1,30 @@
+"""keras2 BatchNormalization (reference
+`P/pipeline/api/keras2/layers/normalization.py`)."""
+
+from __future__ import annotations
+
+from analytics_zoo_tpu.pipeline.api.keras import layers as k1
+
+
+class BatchNormalization(k1.BatchNormalization):
+    """keras2 BatchNormalization: `axis`/`momentum`/`epsilon` keras-2
+    conventions (momentum is the moving-average DECAY, same as our
+    keras1 layer)."""
+
+    def __init__(self, axis: int = -1, momentum: float = 0.99,
+                 epsilon: float = 1e-3, center: bool = True,
+                 scale: bool = True, input_shape=None, name=None,
+                 **kwargs):
+        # axis=-1 → channels_last ("tf"); axis=1 → channels_first ("th")
+        if axis in (-1, 3, 4):
+            dim_ordering = "tf"
+        elif axis == 1:
+            dim_ordering = "th"
+        else:
+            raise ValueError(
+                f"unsupported BatchNormalization axis {axis} "
+                "(use -1 for channels_last or 1 for channels_first)")
+        super().__init__(epsilon=epsilon, momentum=momentum,
+                         center=center, scale=scale,
+                         dim_ordering=dim_ordering,
+                         input_shape=input_shape, name=name, **kwargs)

@@ -1,0 +1,98 @@
+"""keras2 pooling layers (reference
+`P/pipeline/api/keras2/layers/pooling.py`,
+`Z/pipeline/api/keras2/layers/{MaxPooling1D,AveragePooling1D,
+Global*Pooling*}.scala`)."""
+
+from __future__ import annotations
+
+from analytics_zoo_tpu.pipeline.api.keras import layers as k1
+
+
+from analytics_zoo_tpu.pipeline.api.keras2.layers._utils import (
+    data_format_to_dim_ordering as _df)
+
+
+class MaxPooling1D(k1.MaxPooling1D):
+    """keras2 MaxPooling1D (reference
+    `keras2/layers/MaxPooling1D.scala`)."""
+
+    def __init__(self, pool_size: int = 2, strides=None,
+                 padding: str = "valid", input_shape=None, name=None,
+                 **kwargs):
+        super().__init__(pool_length=pool_size, stride=strides,
+                         border_mode=padding, input_shape=input_shape,
+                         name=name, **kwargs)
+
+
+class AveragePooling1D(k1.AveragePooling1D):
+    """keras2 AveragePooling1D (reference
+    `keras2/layers/AveragePooling1D.scala`)."""
+
+    def __init__(self, pool_size: int = 2, strides=None,
+                 padding: str = "valid", input_shape=None, name=None,
+                 **kwargs):
+        super().__init__(pool_length=pool_size, stride=strides,
+                         border_mode=padding, input_shape=input_shape,
+                         name=name, **kwargs)
+
+
+class MaxPooling2D(k1.MaxPooling2D):
+    """keras2 MaxPooling2D."""
+
+    def __init__(self, pool_size=2, strides=None,
+                 padding: str = "valid",
+                 data_format: str = "channels_last",
+                 input_shape=None, name=None, **kwargs):
+        super().__init__(pool_size=pool_size, strides=strides,
+                         border_mode=padding,
+                         dim_ordering=_df(data_format),
+                         input_shape=input_shape, name=name, **kwargs)
+
+
+class AveragePooling2D(k1.AveragePooling2D):
+    """keras2 AveragePooling2D."""
+
+    def __init__(self, pool_size=2, strides=None,
+                 padding: str = "valid",
+                 data_format: str = "channels_last",
+                 input_shape=None, name=None, **kwargs):
+        super().__init__(pool_size=pool_size, strides=strides,
+                         border_mode=padding,
+                         dim_ordering=_df(data_format),
+                         input_shape=input_shape, name=name, **kwargs)
+
+
+class MaxPooling3D(k1.MaxPooling3D):
+    """keras2 MaxPooling3D."""
+
+    def __init__(self, pool_size=2, strides=None,
+                 padding: str = "valid",
+                 data_format: str = "channels_last",
+                 input_shape=None, name=None, **kwargs):
+        super().__init__(pool_size=pool_size, strides=strides,
+                         border_mode=padding,
+                         dim_ordering=_df(data_format),
+                         input_shape=input_shape, name=name, **kwargs)
+
+
+class AveragePooling3D(k1.AveragePooling3D):
+    """keras2 AveragePooling3D."""
+
+    def __init__(self, pool_size=2, strides=None,
+                 padding: str = "valid",
+                 data_format: str = "channels_last",
+                 input_shape=None, name=None, **kwargs):
+        super().__init__(pool_size=pool_size, strides=strides,
+                         border_mode=padding,
+                         dim_ordering=_df(data_format),
+                         input_shape=input_shape, name=name, **kwargs)
+
+
+# global pooling: names identical in keras2 (reference
+# `keras2/layers/Global{Max,Average}Pooling{1,2,3}D.scala`)
+GlobalMaxPooling1D = k1.GlobalMaxPooling1D
+GlobalMaxPooling2D = k1.GlobalMaxPooling2D
+GlobalMaxPooling3D = k1.GlobalMaxPooling3D
+GlobalAveragePooling1D = k1.GlobalAveragePooling1D
+GlobalAveragePooling2D = k1.GlobalAveragePooling2D
+GlobalAveragePooling3D = k1.GlobalAveragePooling3D

@@ -1,0 +1,78 @@
+"""keras2 recurrent layers (reference
+`P/pipeline/api/keras2/layers/recurrent.py`): `units`/
+`recurrent_activation`/`recurrent_initializer` arg spellings over the
+keras1 RNN kernels (which run as one `lax.scan` XLA loop)."""
+
+from __future__ import annotations
+
+from analytics_zoo_tpu.pipeline.api.keras import layers as k1
+
+
+class SimpleRNN(k1.SimpleRNN):
+    """keras2 SimpleRNN."""
+
+    def __init__(self, units: int, activation="tanh",
+                 kernel_initializer="glorot_uniform",
+                 recurrent_initializer="orthogonal",
+                 kernel_regularizer=None, recurrent_regularizer=None,
+                 bias_regularizer=None,
+                 return_sequences: bool = False,
+                 go_backwards: bool = False, input_shape=None,
+                 name=None, **kwargs):
+        super().__init__(output_dim=units, activation=activation,
+                         init=kernel_initializer,
+                         inner_init=recurrent_initializer,
+                         w_regularizer=kernel_regularizer,
+                         u_regularizer=recurrent_regularizer,
+                         b_regularizer=bias_regularizer,
+                         return_sequences=return_sequences,
+                         go_backwards=go_backwards,
+                         input_shape=input_shape, name=name, **kwargs)
+
+
+class LSTM(k1.LSTM):
+    """keras2 LSTM."""
+
+    def __init__(self, units: int, activation="tanh",
+                 recurrent_activation="hard_sigmoid",
+                 kernel_initializer="glorot_uniform",
+                 recurrent_initializer="orthogonal",
+                 kernel_regularizer=None, recurrent_regularizer=None,
+                 bias_regularizer=None,
+                 return_sequences: bool = False,
+                 go_backwards: bool = False, input_shape=None,
+                 name=None, **kwargs):
+        super().__init__(output_dim=units, activation=activation,
+                         inner_activation=recurrent_activation,
+                         init=kernel_initializer,
+                         inner_init=recurrent_initializer,
+                         w_regularizer=kernel_regularizer,
+                         u_regularizer=recurrent_regularizer,
+                         b_regularizer=bias_regularizer,
+                         return_sequences=return_sequences,
+                         go_backwards=go_backwards,
+                         input_shape=input_shape, name=name, **kwargs)
+
+
+class GRU(k1.GRU):
+    """keras2 GRU."""
+
+    def __init__(self, units: int, activation="tanh",
+                 recurrent_activation="hard_sigmoid",
+                 kernel_initializer="glorot_uniform",
+                 recurrent_initializer="orthogonal",
+                 kernel_regularizer=None, recurrent_regularizer=None,
+                 bias_regularizer=None,
+                 return_sequences: bool = False,
+                 go_backwards: bool = False, input_shape=None,
+                 name=None, **kwargs):
+        super().__init__(output_dim=units, activation=activation,
+                         inner_activation=recurrent_activation,
+                         init=kernel_initializer,
+                         inner_init=recurrent_initializer,
+                         w_regularizer=kernel_regularizer,
+                         u_regularizer=recurrent_regularizer,
+                         b_regularizer=bias_regularizer,
+                         return_sequences=return_sequences,
+                         go_backwards=go_backwards,
+                         input_shape=input_shape, name=name, **kwargs)

@@ -1,0 +1,10 @@
+"""keras2 wrapper layers (reference
+`P/pipeline/api/keras2/layers/wrappers.py`)."""
+
+from __future__ import annotations
+
+from analytics_zoo_tpu.pipeline.api.keras import layers as k1
+
+# identical signatures in keras2
+TimeDistributed = k1.TimeDistributed
+Bidirectional = k1.Bidirectional

@@ -245,3 +245,36 @@ class TFOptimizer:
 
     def predict(self, x, batch_size: int = 32):
         return self.estimator.predict(x, batch_size=batch_size)
+
+
+class TFPredictor:
+    """Distributed-inference wrapper over a TF session-style (fn,
+    outputs) pair (reference `TFPredictor`, `P/pipeline/api/net.py:
+    1004-1054`: wraps sess+outputs as a TFNet and maps the dataset).
+
+    Here the "session" is a tf.function / keras model / TFNet; predict
+    runs the XLA-bridged graph over host batches (batched, single
+    process — multi-chip sharding comes from serving many predictors
+    or using `Estimator.predict` on a native model).
+    """
+
+    def __init__(self, net):
+        if not isinstance(net, TFNet):
+            net = TFNet.from_function(net)
+        self.net = net
+
+    @staticmethod
+    def from_keras(model) -> "TFPredictor":
+        """(reference `TFPredictor.from_keras`)"""
+        return TFPredictor(TFNet.from_function(
+            lambda x: model(x, training=False)))
+
+    @staticmethod
+    def from_session(fn, outputs=None) -> "TFPredictor":
+        """TF1-style (session, outputs) pairs map to a tf.function in
+        TF2; ``outputs`` kept for API parity."""
+        del outputs
+        return TFPredictor(TFNet.from_function(fn))
+
+    def predict(self, data, batch_size: int = 32):
+        return self.net.predict(data, batch_size=batch_size)

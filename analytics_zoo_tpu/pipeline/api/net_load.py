@@ -13,8 +13,11 @@ TPU-native mapping:
 - :meth:`Net.load_keras` — tf.keras `.keras`/`.h5` files via
   `tf.keras.models.load_model` + the tfpark GraphDef→XLA bridge.
 - :meth:`Net.load_tf` — SavedModel / frozen GraphDef via `TFNet`.
-- :meth:`Net.load_caffe` — unsupported in this image (no caffe); raises
-  with guidance (convert to ONNX and use `OnnxLoader`).
+- :meth:`Net.load_caffe` — prototxt+caffemodel via the self-contained
+  importer (`caffe_load.py`).
+- :meth:`Net.load_bigdl` / :meth:`Net.load` — BigDL/zoo-Keras ``.model``
+  protobuf files and this framework's own `ZooModel.save_model` files
+  (format-sniffed).
 """
 
 from __future__ import annotations
@@ -30,12 +33,6 @@ from analytics_zoo_tpu.common.nncontext import logger
 
 class Net:
     """(reference `pipeline/api/Net.scala:40-189`)"""
-
-    @staticmethod
-    def load(path: str):
-        """Load a model saved by `ZooModel.save_model` (safe pickle)."""
-        from analytics_zoo_tpu.models.common import ZooModel
-        return ZooModel.load_model(path)
 
     @staticmethod
     def load_tf(path: str, inputs: Optional[Sequence[str]] = None,
@@ -89,8 +86,15 @@ class Net:
     @staticmethod
     def load(path: str, weight_path: Optional[str] = None,
              input_shape=None):
-        """Load an analytics-zoo Keras-style saved model (reference
-        `Net.load`, Net.scala:91 — same BigDL serialization)."""
+        """Load an analytics-zoo saved model (reference `Net.load`,
+        Net.scala:91). Handles both formats by sniffing: the
+        reference's BigDL protobuf ``.model`` files AND this
+        framework's own ``ZooModel.save_model``/`saveModel` files."""
+        with open(path, "rb") as f:
+            head = f.read(2)
+        if head[:1] == b"\x80":  # pickle protocol marker → ZooModel
+            from analytics_zoo_tpu.models.common import ZooModel
+            return ZooModel.load_model(path)
         return Net.load_bigdl(path, weight_path,
                               input_shape=input_shape)
 

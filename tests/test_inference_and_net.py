@@ -331,3 +331,17 @@ class TestQuantizedInference:
         im.load_keras_net(m, example_inputs=[x[:64]], quantize=True)
         f_bytes, q_bytes = im.quantized.size_bytes()
         assert f_bytes > 3 * q_bytes  # ~4x reduction on kernels
+
+
+def test_tf_predictor(rng):
+    """TFPredictor parity class (reference `P/pipeline/api/net.py:1004`)."""
+    tf = pytest.importorskip("tensorflow")
+    from analytics_zoo_tpu.pipeline.api.net import TFPredictor
+    model = tf.keras.Sequential([
+        tf.keras.layers.Dense(4, input_shape=(3,)),
+    ])
+    pred = TFPredictor.from_keras(model)
+    x = rng.randn(10, 3).astype(np.float32)
+    out = pred.predict(x, batch_size=5)
+    np.testing.assert_allclose(np.asarray(out), model(x).numpy(),
+                               atol=1e-5)

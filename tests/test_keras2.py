@@ -50,3 +50,99 @@ def test_keras2_sequential_end_to_end():
 def test_merge_aliases_shared():
     assert L2.Maximum is L1.Maximum
     assert L2.GlobalAveragePooling2D is L1.GlobalAveragePooling2D
+
+
+# -- round-2 completion: recurrent/pooling/merge/etc (VERDICT item 10) -------
+
+class TestKeras2Completion:
+    def test_surface_counts(self):
+        from analytics_zoo_tpu.pipeline.api.keras2 import layers as k2
+        assert len(k2.__all__) >= 45
+        for name in k2.__all__:
+            assert getattr(k2, name) is not None
+
+    def test_recurrent_variants_train(self, rng):
+        from analytics_zoo_tpu.pipeline.api.keras import Sequential
+        from analytics_zoo_tpu.pipeline.api.keras2 import layers as k2
+        x = rng.randn(16, 6, 4).astype(np.float32)
+        y = rng.randn(16, 3).astype(np.float32)
+        for cls in (k2.SimpleRNN, k2.LSTM, k2.GRU):
+            m = Sequential()
+            m.add(cls(8, input_shape=(6, 4)))
+            m.add(k2.Dense(3))
+            m.compile(optimizer="adam", loss="mse")
+            m.fit(x, y, batch_size=8, nb_epoch=1)
+            assert m.predict(x).shape == (16, 3)
+
+    def test_lstm_return_sequences_and_wrappers(self, rng):
+        from analytics_zoo_tpu.pipeline.api.keras import Sequential
+        from analytics_zoo_tpu.pipeline.api.keras2 import layers as k2
+        m = Sequential()
+        m.add(k2.Bidirectional(k2.LSTM(5, return_sequences=True),
+                               input_shape=(6, 4)))
+        m.add(k2.TimeDistributed(k2.Dense(2)))
+        m.compile(optimizer="sgd", loss="mse")
+        x = rng.randn(4, 6, 4).astype(np.float32)
+        out = m.predict(x)
+        assert out.shape == (4, 6, 2)
+
+    def test_merge_variants(self, rng):
+        import jax.numpy as jnp
+        from analytics_zoo_tpu.pipeline.api.keras2 import layers as k2
+        a = rng.randn(4, 5).astype(np.float32)
+        b = rng.randn(4, 5).astype(np.float32)
+        cases = {
+            k2.Add(): a + b,
+            k2.Subtract(): a - b,
+            k2.Multiply(): a * b,
+            k2.Average(): (a + b) / 2,
+            k2.Maximum(): np.maximum(a, b),
+            k2.Minimum(): np.minimum(a, b),
+        }
+        for lyr, want in cases.items():
+            got = np.asarray(lyr.call({}, [jnp.asarray(a),
+                                           jnp.asarray(b)]))
+            np.testing.assert_allclose(got, want, atol=1e-6)
+        cat = np.asarray(k2.Concatenate(axis=-1).call(
+            {}, [jnp.asarray(a), jnp.asarray(b)]))
+        assert cat.shape == (4, 10)
+
+    def test_conv_pool_norm_stack(self, rng):
+        from analytics_zoo_tpu.pipeline.api.keras import Sequential
+        from analytics_zoo_tpu.pipeline.api.keras2 import layers as k2
+        m = Sequential()
+        m.add(k2.Conv2D(8, 3, padding="same", activation="relu",
+                        input_shape=(12, 12, 3)))
+        m.add(k2.BatchNormalization())
+        m.add(k2.MaxPooling2D(pool_size=2))
+        m.add(k2.SeparableConv2D(8, 3, padding="same"))
+        m.add(k2.GlobalAveragePooling2D())
+        m.add(k2.Dense(4))
+        m.compile(optimizer="adam", loss="mse")
+        x = rng.randn(8, 12, 12, 3).astype(np.float32)
+        y = rng.randn(8, 4).astype(np.float32)
+        m.fit(x, y, batch_size=8, nb_epoch=1)
+        assert m.predict(x).shape == (8, 4)
+
+    def test_embedding_and_noise(self, rng):
+        from analytics_zoo_tpu.pipeline.api.keras import Sequential
+        from analytics_zoo_tpu.pipeline.api.keras2 import layers as k2
+        m = Sequential()
+        m.add(k2.Embedding(50, 8, input_shape=(7,)))
+        m.add(k2.GaussianNoise(0.1))
+        m.add(k2.GlobalAveragePooling1D())
+        m.add(k2.Dense(2))
+        m.compile(optimizer="adam", loss="mse")
+        x = rng.randint(0, 50, size=(8, 7)).astype(np.int32)
+        y = rng.randn(8, 2).astype(np.float32)
+        m.fit(x, y, batch_size=8, nb_epoch=1)
+
+    def test_convlstm2d(self, rng):
+        from analytics_zoo_tpu.pipeline.api.keras import Sequential
+        from analytics_zoo_tpu.pipeline.api.keras2 import layers as k2
+        m = Sequential()
+        m.add(k2.ConvLSTM2D(4, 3, input_shape=(3, 8, 8, 2)))
+        m.compile(optimizer="sgd", loss="mse")
+        x = rng.randn(2, 3, 8, 8, 2).astype(np.float32)
+        out = m.predict(x)
+        assert out.shape[0] == 2
