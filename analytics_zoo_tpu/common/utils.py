@@ -1,9 +1,12 @@
 """File/IO helpers (reference `Z/common/Utils.scala`: HDFS/S3/local
 byte IO, `logUsageErrorAndThrowException`).
 
-TPU-native scope: local filesystem + optional GCS via ``gs://`` when
-`etils`/gcsfs-style backends are present; remote schemes degrade with a
-clear error instead of a stack trace (no Hadoop in this image).
+TPU-native redesign: the reference reached HDFS/S3 through the Hadoop
+FileSystem JNI stack; here remote schemes (``hdfs://``, ``s3://``,
+``gs://``, ``memory://``, ...) route through `fsspec` — the same
+read/save/list surface over whatever protocol backends the deployment
+installs (gcsfs, s3fs, pyarrow-HDFS). Missing backends degrade with a
+clear error naming the protocol instead of a stack trace.
 """
 
 from __future__ import annotations
@@ -11,64 +14,119 @@ from __future__ import annotations
 import glob as _glob
 import os
 import shutil
-from typing import List
+from typing import List, Optional
 
 from analytics_zoo_tpu.common.nncontext import logger
 
-_REMOTE_SCHEMES = ("hdfs://", "s3://", "s3a://", "s3n://")
+_SCHEME_ALIASES = {"s3a": "s3", "s3n": "s3"}
 
 
-def _check_scheme(path: str) -> str:
-    for scheme in _REMOTE_SCHEMES:
-        if path.startswith(scheme):
-            raise NotImplementedError(
-                f"{scheme} paths need a Hadoop/S3 client that is not in "
-                "this image; stage the file locally or on gs:// "
-                "(reference `Utils.scala` supported these via Hadoop FS)")
-    return path
+def _split_scheme(path: str) -> "tuple[Optional[str], str]":
+    if "://" not in path:
+        return None, path
+    raw, rest = path.split("://", 1)
+    scheme = _SCHEME_ALIASES.get(raw.lower(), raw.lower())
+    if scheme == "file":
+        return None, rest
+    # return the path re-rooted on the NORMALIZED scheme — backends
+    # like s3fs only strip the protocols they declare (s3/s3a, not s3n
+    # or uppercase spellings)
+    return scheme, f"{scheme}://{rest}"
+
+
+def _fs_for(scheme: str):
+    try:
+        import fsspec
+    except ImportError as e:
+        raise NotImplementedError(
+            f"{scheme}:// paths need fsspec (not installed): {e}"
+        ) from e
+    try:
+        return fsspec.filesystem(scheme)
+    except (ImportError, ValueError, OSError) as e:
+        # missing protocol backend (s3fs/gcsfs) or an unusable one
+        # (pyarrow-hdfs without a JVM)
+        hint = {"gs": "gcsfs", "s3": "s3fs",
+                "hdfs": "a pyarrow/Hadoop+JVM install"}.get(scheme,
+                                                            scheme)
+        raise NotImplementedError(
+            f"{scheme}:// needs a working fsspec backend ({hint}) in "
+            f"this environment: {e}") from e
 
 
 def read_bytes(path: str) -> bytes:
-    """(reference `Utils.readBytes`)"""
-    path = _check_scheme(path)
-    with open(path, "rb") as f:
+    """(reference `Utils.readBytes` — local or any fsspec scheme)"""
+    scheme, path = _split_scheme(path)
+    if scheme is None:
+        with open(path, "rb") as f:
+            return f.read()
+    with _fs_for(scheme).open(path, "rb") as f:
         return f.read()
 
 
 def save_bytes(data: bytes, path: str,
                is_overwrite: bool = False) -> None:
     """(reference `Utils.saveBytes`)"""
-    path = _check_scheme(path)
-    if os.path.exists(path) and not is_overwrite:
-        raise FileExistsError(
-            f"{path} exists; pass is_overwrite=True")
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    with open(path, "wb") as f:
+    scheme, path = _split_scheme(path)
+    if scheme is None:
+        if os.path.exists(path) and not is_overwrite:
+            raise FileExistsError(
+                f"{path} exists; pass is_overwrite=True")
+        os.makedirs(os.path.dirname(os.path.abspath(path)),
+                    exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(data)
+        return
+    fs = _fs_for(scheme)
+    if fs.exists(path) and not is_overwrite:
+        raise FileExistsError(f"{path} exists; pass is_overwrite=True")
+    with fs.open(path, "wb") as f:
         f.write(data)
 
 
 def list_files(pattern: str) -> List[str]:
     """Glob helper used by readers (reference `Utils.listPaths`)."""
-    _check_scheme(pattern)
-    if os.path.isdir(pattern):
-        return sorted(
-            os.path.join(pattern, p) for p in os.listdir(pattern)
-            if os.path.isfile(os.path.join(pattern, p)))
-    return sorted(_glob.glob(pattern))
+    scheme, local = _split_scheme(pattern)
+    if scheme is None:
+        if os.path.isdir(local):
+            return sorted(
+                os.path.join(local, p) for p in os.listdir(local)
+                if os.path.isfile(os.path.join(local, p)))
+        return sorted(_glob.glob(local))
+    pattern = local  # normalized-scheme form
+    fs = _fs_for(scheme)
+    if fs.isdir(pattern):
+        # one listing call; filtering on the returned type info avoids
+        # a per-entry stat round-trip on remote stores
+        out = [e["name"] for e in fs.ls(pattern, detail=True)
+               if e.get("type") == "file"]
+    else:
+        out = list(fs.glob(pattern))
+    # fsspec strips the scheme from results; restore for round-trips
+    return sorted(p if "://" in str(p) else f"{scheme}://{p}"
+                  for p in out)
 
 
 def mkdirs(path: str) -> None:
-    os.makedirs(path, exist_ok=True)
+    scheme, local = _split_scheme(path)
+    if scheme is None:
+        os.makedirs(local, exist_ok=True)
+    else:
+        _fs_for(scheme).makedirs(local, exist_ok=True)
 
 
 def remove(path: str, recursive: bool = False) -> None:
-    if os.path.isdir(path):
+    scheme, local = _split_scheme(path)
+    if scheme is not None:
+        _fs_for(scheme).rm(local, recursive=recursive)
+        return
+    if os.path.isdir(local):
         if not recursive:
-            raise IsADirectoryError(f"{path} is a directory; pass "
+            raise IsADirectoryError(f"{local} is a directory; pass "
                                     "recursive=True")
-        shutil.rmtree(path)
-    elif os.path.exists(path):
-        os.remove(path)
+        shutil.rmtree(local)
+    elif os.path.exists(local):
+        os.remove(local)
 
 
 def log_usage_error_and_throw(message: str) -> None:

@@ -30,7 +30,8 @@ _build_failed = False
 
 def _build() -> bool:
     srcs = [os.path.join(_NATIVE_DIR, f)
-            for f in ("host_arena.cpp", "serving_queue.cpp")]
+            for f in ("host_arena.cpp", "serving_queue.cpp",
+                      "serving_http.cpp")]
     cmd = ["g++", "-O2", "-fPIC", "-std=c++17", "-shared", "-o",
            _SO_PATH] + srcs + ["-lpthread"]
     try:
@@ -79,6 +80,22 @@ def load_native() -> Optional[ctypes.CDLL]:
         lib.squeue_take.argtypes = [ctypes.c_void_p, ctypes.c_long]
         lib.squeue_size.restype = ctypes.c_int
         lib.squeue_size.argtypes = [ctypes.c_void_p]
+        lib.zoo_http_create.restype = ctypes.c_void_p
+        lib.zoo_http_create.argtypes = [ctypes.c_int, ctypes.c_long]
+        lib.zoo_http_port.restype = ctypes.c_int
+        lib.zoo_http_port.argtypes = [ctypes.c_void_p]
+        lib.zoo_http_set_health.argtypes = [ctypes.c_void_p,
+                                            ctypes.c_char_p]
+        lib.zoo_http_next.restype = ctypes.c_long
+        lib.zoo_http_next.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_long,
+            ctypes.c_long, ctypes.POINTER(ctypes.c_long),
+            ctypes.c_char_p, ctypes.c_long]
+        lib.zoo_http_respond.restype = ctypes.c_int
+        lib.zoo_http_respond.argtypes = [
+            ctypes.c_void_p, ctypes.c_long, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_long]
+        lib.zoo_http_destroy.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
 
@@ -197,3 +214,64 @@ def make_serving_queue():
         return ServingQueue()
     except RuntimeError:
         return PyServingQueue()
+
+
+class NativeHttpServer:
+    """C++ HTTP front-end (`src/serving_http.cpp`): accept/parse/queue
+    run native (no GIL contention with the compute thread); Python
+    pulls request bytes and posts response bytes."""
+
+    def __init__(self, port: int = 0, max_body: int = 16 << 20):
+        lib = load_native()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._max_body = max_body
+        self._handle = lib.zoo_http_create(port, max_body)
+        if not self._handle:
+            raise OSError(f"zoo_http_create({port}) failed")
+        self._port = lib.zoo_http_port(self._handle)
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    def set_health(self, payload_json: str):
+        if self._handle:
+            self._lib.zoo_http_set_health(self._handle,
+                                          payload_json.encode())
+
+    def next_request(self, timeout_ms: int = -1):
+        """Returns (req_id, path, body_bytes), or None on timeout, or
+        raises StopIteration after close(). Buffers are per-call —
+        multiple worker threads may pull concurrently."""
+        if not self._handle:
+            raise StopIteration
+        buf = ctypes.create_string_buffer(self._max_body)
+        path = ctypes.create_string_buffer(1024)
+        rid = ctypes.c_long()
+        n = self._lib.zoo_http_next(
+            self._handle, buf, len(buf), timeout_ms,
+            ctypes.byref(rid), path, len(path))
+        if n == -1:
+            return None
+        if n == -2:
+            raise StopIteration
+        return rid.value, path.value.decode(), buf.raw[:n]
+
+    def respond(self, req_id: int, status: int, body: bytes) -> bool:
+        if not self._handle:
+            return False
+        return self._lib.zoo_http_respond(
+            self._handle, req_id, status, body, len(body)) == 0
+
+    def close(self):
+        if self._handle:
+            self._lib.zoo_http_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -93,3 +93,109 @@ class InferenceServer:
         self._httpd.shutdown()
         if self._thread:
             self._thread.join(timeout=5)
+
+
+class NativeInferenceServer:
+    """Same /predict contract as :class:`InferenceServer`, fronted by
+    the C++ HTTP server (`native/src/serving_http.cpp`): socket accept,
+    HTTP parsing, request queueing, and /health all run native (no GIL
+    contention with the XLA dispatch thread) — the role the reference's
+    JVM/Spring + JNI serving stack played (SURVEY §2.8/§2.11.2).
+
+    Worker threads (= model concurrency) pull raw request bytes over
+    the C ABI, run `InferenceModel.predict`, and post response bytes
+    back.
+    """
+
+    def __init__(self, model: InferenceModel, port: int = 0,
+                 workers: Optional[int] = None):
+        from analytics_zoo_tpu.native import NativeHttpServer
+        self.model = model
+        self._srv = NativeHttpServer(port=port)
+        self._workers = workers or model.supported_concurrent_num
+        self._threads: "list[threading.Thread]" = []
+        self._stopping = False
+
+    @property
+    def port(self) -> int:
+        return self._srv.port
+
+    def _serve_one(self, rid: int, path: str, body: bytes):
+        try:
+            if path != "/predict":
+                self._srv.respond(rid, 404,
+                                  b'{"error": "not found"}')
+                return
+            req = json.loads(body)
+            inputs = req["inputs"]
+            if isinstance(inputs, list) and inputs and \
+                    isinstance(inputs[0], dict):
+                xs = [np.asarray(i["data"], np.float32)
+                      for i in inputs]
+            else:
+                xs = np.asarray(inputs, np.float32)
+            out = self.model.predict(xs)
+            if isinstance(out, list):
+                payload = {"outputs": [o.tolist() for o in out]}
+            else:
+                payload = {"outputs": out.tolist()}
+            self._srv.respond(rid, 200, json.dumps(payload).encode())
+        except Exception as e:  # serving boundary: report, not die
+            try:
+                self._srv.respond(
+                    rid, 400, json.dumps({"error": str(e)}).encode())
+            except Exception:
+                pass
+
+    def _loop(self):
+        from analytics_zoo_tpu.common.nncontext import logger
+        while not self._stopping:
+            try:
+                got = self._srv.next_request(timeout_ms=200)
+            except StopIteration:
+                return
+            except Exception as e:  # transient — keep the worker alive
+                if self._stopping:
+                    return
+                logger.warning("native serving worker error: %s", e)
+                continue
+            if got is None:
+                continue
+            self._srv.set_health(json.dumps({
+                "status": "ok",
+                "free_slots": self.model.concurrent_slots_free}))
+            self._serve_one(*got)
+
+    def start(self, background: bool = True):
+        self._srv.set_health(json.dumps({
+            "status": "ok",
+            "free_slots": self.model.concurrent_slots_free}))
+        for _ in range(self._workers):
+            t = threading.Thread(target=self._loop, daemon=True)
+            t.start()
+            self._threads.append(t)
+        if not background:
+            for t in self._threads:
+                t.join()
+        return self
+
+    def stop(self):
+        # workers drain first (they poll with a 200ms timeout), THEN
+        # the native handle is destroyed — never while a thread may be
+        # inside zoo_http_next
+        self._stopping = True
+        for t in self._threads:
+            t.join(timeout=5)
+        self._srv.close()
+
+
+def make_inference_server(model: InferenceModel, port: int = 0,
+                          prefer_native: bool = True):
+    """Native C++ front-end when the toolchain built it, else the
+    stdlib ThreadingHTTPServer — same endpoints either way."""
+    if prefer_native:
+        try:
+            return NativeInferenceServer(model, port=port)
+        except (RuntimeError, OSError):
+            pass
+    return InferenceServer(model, port=port)

@@ -228,3 +228,34 @@ def test_checkpoint_resume_uses_checked_loader(tmp_path, rng):
         jax.device_get(net2.estimator.params))
     for a, b in zip(leaves1, leaves2):
         np.testing.assert_allclose(a, b)
+
+# -- fsspec-backed remote schemes (Utils.scala HDFS/S3 parity) ----------------
+
+class TestRemoteFS:
+    def test_memory_scheme_roundtrip(self):
+        pytest.importorskip("fsspec")
+        from analytics_zoo_tpu.common import utils
+        utils.save_bytes(b"hello-zoo", "memory://zoo/a.bin",
+                         is_overwrite=True)
+        assert utils.read_bytes("memory://zoo/a.bin") == b"hello-zoo"
+        utils.save_bytes(b"x", "memory://zoo/b.bin", is_overwrite=True)
+        files = utils.list_files("memory://zoo/*.bin")
+        assert any(f.endswith("a.bin") for f in files)
+        assert all(f.startswith("memory://") for f in files)
+        with pytest.raises(FileExistsError):
+            utils.save_bytes(b"y", "memory://zoo/a.bin")
+        utils.remove("memory://zoo/a.bin")
+        utils.remove("memory://zoo/b.bin")
+
+    def test_missing_backend_clear_error(self):
+        pytest.importorskip("fsspec")
+        from analytics_zoo_tpu.common import utils
+        # hdfs backend is not installed in this image
+        with pytest.raises(NotImplementedError, match="hdfs"):
+            utils.read_bytes("hdfs://namenode/a.bin")
+
+    def test_s3a_alias(self):
+        pytest.importorskip("fsspec")
+        from analytics_zoo_tpu.common import utils
+        with pytest.raises(NotImplementedError, match="s3"):
+            utils.read_bytes("s3a://bucket/key")

@@ -345,3 +345,50 @@ def test_tf_predictor(rng):
     out = pred.predict(x, batch_size=5)
     np.testing.assert_allclose(np.asarray(out), model(x).numpy(),
                                atol=1e-5)
+
+
+def test_native_http_serving(rng):
+    """C++ HTTP front-end (native/src/serving_http.cpp) serves the same
+    /predict+/health contract as the Python facade."""
+    import json
+    import urllib.request
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential, layers as L
+    from analytics_zoo_tpu.pipeline.inference import InferenceModel
+    from analytics_zoo_tpu.pipeline.inference.serving import (
+        NativeInferenceServer, make_inference_server)
+    pytest.importorskip("ctypes")
+    m = Sequential()
+    m.add(L.Dense(3, input_shape=(4,)))
+    m.compile(optimizer="sgd", loss="mse")
+    im = InferenceModel(supported_concurrent_num=2)
+    im.load_keras_net(m)
+    try:
+        srv = NativeInferenceServer(im)
+    except (RuntimeError, OSError):
+        pytest.skip("native toolchain unavailable")
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        health = json.load(urllib.request.urlopen(f"{base}/health"))
+        assert health["status"] == "ok"
+        x = rng.randn(5, 4).astype(np.float32)
+        req = urllib.request.Request(
+            f"{base}/predict",
+            data=json.dumps({"inputs": x.tolist()}).encode(),
+            headers={"Content-Type": "application/json"})
+        out = json.load(urllib.request.urlopen(req))
+        got = np.asarray(out["outputs"], np.float32)
+        want = m.predict(x)
+        np.testing.assert_allclose(got, want, atol=1e-4)
+        # unknown path -> 404
+        bad = urllib.request.Request(f"{base}/nope", data=b"{}")
+        try:
+            urllib.request.urlopen(bad)
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        srv.stop()
+    # factory falls back cleanly
+    srv2 = make_inference_server(im)
+    srv2.stop() if hasattr(srv2, "_srv") else None
