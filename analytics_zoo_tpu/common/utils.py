@@ -118,7 +118,10 @@ def mkdirs(path: str) -> None:
 def remove(path: str, recursive: bool = False) -> None:
     scheme, local = _split_scheme(path)
     if scheme is not None:
-        _fs_for(scheme).rm(local, recursive=recursive)
+        try:
+            _fs_for(scheme).rm(local, recursive=recursive)
+        except FileNotFoundError:
+            pass  # match the local branch's missing-path no-op
         return
     if os.path.isdir(local):
         if not recursive:

@@ -13,7 +13,12 @@ from __future__ import annotations
 
 from typing import Optional, Sequence
 
-from analytics_zoo_tpu.pipeline.api.keras.engine import Input
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.ops import initializers
+from analytics_zoo_tpu.pipeline.api.keras.engine import Input, KerasLayer
 from analytics_zoo_tpu.pipeline.api.keras.models import Model
 from analytics_zoo_tpu.pipeline.api.keras.layers import (
     Activation, AveragePooling2D, BatchNormalization, Convolution2D, Dense,
@@ -47,6 +52,77 @@ def _bottleneck(x, filters, stride=1, downsample=False, name=""):
     return Activation("relu")(out)
 
 
+class SpaceToDepth2D(KerasLayer):
+    """NHWC space-to-depth: (H, W, C) → (H/b, W/b, b²·C), channel
+    order (row-offset, col-offset, channel)."""
+
+    def __init__(self, block: int = 2, input_shape=None, name=None,
+                 **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.block = int(block)
+
+    def call(self, params, x, *, training=False, rng=None):
+        b = self.block
+        n, h, w, c = x.shape
+        x = x.reshape(n, h // b, b, w // b, b, c)
+        x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+        return x.reshape(n, h // b, w // b, b * b * c)
+
+    def compute_output_shape(self, input_shape):
+        h, w, c = input_shape
+        b = self.block
+        if h % b or w % b:
+            raise ValueError(f"spatial dims {h}x{w} not divisible by "
+                             f"block {b}")
+        return (h // b, w // b, b * b * c)
+
+
+class S2DStemConv(KerasLayer):
+    """The MLPerf-style space-to-depth stem: the 7×7/s2 SAME stem conv
+    re-expressed as a 4×4/s1 conv over the space-to-depth(2) input with
+    asymmetric padding ((1,2),(1,2)) — mathematically the same map
+    (see `s2d_stem_kernel` for the exact kernel correspondence), but
+    MXU-dense: 12 input channels instead of 3, no strided gather.
+    """
+
+    def __init__(self, nb_filter: int = 64, init="glorot_uniform",
+                 input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.nb_filter = int(nb_filter)
+        self.kernel_init = initializers.get(init)
+
+    def build(self, rng, input_shape):
+        in_ch = input_shape[-1]
+        return {"kernel": self.kernel_init(
+            rng, (4, 4, in_ch, self.nb_filter))}
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jax.lax.conv_general_dilated(
+            x, params["kernel"].astype(x.dtype),
+            window_strides=(1, 1), padding=((1, 2), (1, 2)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    def compute_output_shape(self, input_shape):
+        h, w, _ = input_shape
+        return (h, w, self.nb_filter)
+
+
+def s2d_stem_kernel(k7: np.ndarray) -> np.ndarray:
+    """Exact kernel correspondence: a (7,7,C,F) SAME/s2 stem kernel →
+    the (4,4,4C,F) kernel for `S2DStemConv` over `SpaceToDepth2D(2)`
+    input producing IDENTICAL outputs. (Derivation: pad 7→8 with a
+    zero last row/col so stride 2 tiles the kernel; fold the 2×2
+    phases into channels.)"""
+    kh, kw, c, f = k7.shape
+    assert (kh, kw) == (7, 7)
+    k8 = np.zeros((8, 8, c, f), k7.dtype)
+    k8[:7, :7] = k7
+    # K2d[u', v', (r, s, c)] = K8[2u'+r, 2v'+s, c]
+    k8 = k8.reshape(4, 2, 4, 2, c, f)           # (u', r, v', s, c, f)
+    k2d = np.transpose(k8, (0, 2, 1, 3, 4, 5))  # (u', v', r, s, c, f)
+    return np.ascontiguousarray(k2d.reshape(4, 4, 4 * c, f))
+
+
 class ResNet:
     """Builder; `ResNet(depth).build(input_shape, classes)` → keras Model."""
 
@@ -59,11 +135,18 @@ class ResNet:
                              f"{sorted(self.DEPTH_BLOCKS)}")
         self.depth = depth
 
-    def build(self, input_shape=(224, 224, 3), classes: int = 1000
-              ) -> Model:
+    def build(self, input_shape=(224, 224, 3), classes: int = 1000,
+              space_to_depth: bool = False) -> Model:
         blocks = self.DEPTH_BLOCKS[self.depth]
         inp = Input(input_shape, name="image")
-        x = conv_bn(inp, 64, 7, stride=2, name="stem")
+        if space_to_depth:
+            # MXU-dense stem (see S2DStemConv); identical output map
+            x = SpaceToDepth2D(2, name="stem_s2d")(inp)
+            x = S2DStemConv(64, name="stem")(x)
+            x = BatchNormalization(name="stem_bn")(x)
+            x = Activation("relu")(x)
+        else:
+            x = conv_bn(inp, 64, 7, stride=2, name="stem")
         x = MaxPooling2D(pool_size=3, strides=2, border_mode="same")(x)
         filters = 64
         for stage, n_blocks in enumerate(blocks):
@@ -78,5 +161,7 @@ class ResNet:
         return Model(inp, out, name=f"resnet{self.depth}")
 
 
-def resnet50(input_shape=(224, 224, 3), classes: int = 1000) -> Model:
-    return ResNet(50).build(input_shape, classes)
+def resnet50(input_shape=(224, 224, 3), classes: int = 1000,
+             space_to_depth: bool = False) -> Model:
+    return ResNet(50).build(input_shape, classes,
+                            space_to_depth=space_to_depth)

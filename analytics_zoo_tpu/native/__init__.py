@@ -231,6 +231,7 @@ class NativeHttpServer:
         if not self._handle:
             raise OSError(f"zoo_http_create({port}) failed")
         self._port = lib.zoo_http_port(self._handle)
+        self._tls = threading.local()  # per-thread request buffers
 
     @property
     def port(self) -> int:
@@ -243,12 +244,15 @@ class NativeHttpServer:
 
     def next_request(self, timeout_ms: int = -1):
         """Returns (req_id, path, body_bytes), or None on timeout, or
-        raises StopIteration after close(). Buffers are per-call —
-        multiple worker threads may pull concurrently."""
+        raises StopIteration after close(). Buffers are per-THREAD
+        (reused across polls — no 16MB alloc churn), so concurrent
+        worker pulls never share a buffer."""
         if not self._handle:
             raise StopIteration
-        buf = ctypes.create_string_buffer(self._max_body)
-        path = ctypes.create_string_buffer(1024)
+        if not hasattr(self._tls, "buf"):
+            self._tls.buf = ctypes.create_string_buffer(self._max_body)
+            self._tls.path = ctypes.create_string_buffer(1024)
+        buf, path = self._tls.buf, self._tls.path
         rid = ctypes.c_long()
         n = self._lib.zoo_http_next(
             self._handle, buf, len(buf), timeout_ms,

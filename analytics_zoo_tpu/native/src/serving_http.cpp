@@ -83,10 +83,15 @@ void send_response(int fd, int status, const std::string& body) {
 // read one HTTP request (headers + Content-Length body); false = drop
 bool read_request(Server* s, int fd, std::string* method,
                   std::string* path, std::string* body) {
+    // overall deadline: SO_RCVTIMEO only bounds each recv, not a
+    // slow-trickle client; destroy() relies on this hard cap
+    const auto deadline = std::chrono::steady_clock::now() +
+        std::chrono::seconds(60);
     std::string buf;
     char chunk[4096];
     size_t header_end = std::string::npos;
     while (header_end == std::string::npos) {
+        if (std::chrono::steady_clock::now() > deadline) return false;
         ssize_t r = ::recv(fd, chunk, sizeof(chunk), 0);
         if (r <= 0) return false;
         buf.append(chunk, static_cast<size_t>(r));
@@ -120,6 +125,7 @@ bool read_request(Server* s, int fd, std::string* method,
     }
     *body = buf.substr(header_end + 4);
     while (static_cast<long>(body->size()) < content_len) {
+        if (std::chrono::steady_clock::now() > deadline) return false;
         ssize_t r = ::recv(fd, chunk, sizeof(chunk), 0);
         if (r <= 0) return false;
         body->append(chunk, static_cast<size_t>(r));
@@ -171,6 +177,8 @@ void accept_loop(Server* s) {
                           reinterpret_cast<sockaddr*>(&peer), &len);
         if (fd < 0) {
             if (s->stop.load()) return;
+            // e.g. EMFILE under fd exhaustion: don't busy-spin a core
+            std::this_thread::sleep_for(std::chrono::milliseconds(10));
             continue;
         }
         int one = 1;
@@ -292,9 +300,9 @@ void zoo_http_destroy(void* h) {
     ::close(s->listen_fd);
     s->cv.notify_all();
     if (s->acceptor.joinable()) s->acceptor.join();
-    // connection threads are detached; wait (bounded by their socket
-    // timeouts) so none touches the Server after delete
-    for (int i = 0; i < 35000 && s->conn_threads.load() > 0; ++i)
+    // connection threads are detached; wait past read_request's 60s
+    // hard deadline so none touches the Server after delete
+    for (int i = 0; i < 70000 && s->conn_threads.load() > 0; ++i)
         std::this_thread::sleep_for(std::chrono::milliseconds(1));
     {
         std::lock_guard<std::mutex> g(s->mu);

@@ -23,6 +23,25 @@ from analytics_zoo_tpu.pipeline.inference.inference_model import (
     InferenceModel)
 
 
+def handle_predict(model: InferenceModel, body: bytes):
+    """The /predict contract, shared by the stdlib and native
+    front-ends: JSON body → (http_status, payload_dict)."""
+    try:
+        req = json.loads(body)
+        inputs = req["inputs"]
+        if isinstance(inputs, list) and inputs and \
+                isinstance(inputs[0], dict):
+            xs = [np.asarray(i["data"], np.float32) for i in inputs]
+        else:
+            xs = np.asarray(inputs, np.float32)
+        out = model.predict(xs)
+        if isinstance(out, list):
+            return 200, {"outputs": [o.tolist() for o in out]}
+        return 200, {"outputs": out.tolist()}
+    except Exception as e:  # serving boundary: report, not die
+        return 400, {"error": str(e)}
+
+
 class InferenceServer:
     def __init__(self, model: InferenceModel, host: str = "127.0.0.1",
                  port: int = 0):
@@ -54,24 +73,10 @@ class InferenceServer:
                 if self.path != "/predict":
                     self._reply(404, {"error": "not found"})
                     return
-                try:
-                    n = int(self.headers.get("Content-Length", 0))
-                    req = json.loads(self.rfile.read(n))
-                    inputs = req["inputs"]
-                    if isinstance(inputs, list) and inputs and \
-                            isinstance(inputs[0], dict):
-                        xs = [np.asarray(i["data"], np.float32)
-                              for i in inputs]
-                    else:
-                        xs = np.asarray(inputs, np.float32)
-                    out = server.model.predict(xs)
-                    if isinstance(out, list):
-                        payload = {"outputs": [o.tolist() for o in out]}
-                    else:
-                        payload = {"outputs": out.tolist()}
-                    self._reply(200, payload)
-                except Exception as e:  # serving boundary: report, not die
-                    self._reply(400, {"error": str(e)})
+                n = int(self.headers.get("Content-Length", 0))
+                status, payload = handle_predict(server.model,
+                                                 self.rfile.read(n))
+                self._reply(status, payload)
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._thread: Optional[threading.Thread] = None
@@ -126,26 +131,21 @@ class NativeInferenceServer:
                 self._srv.respond(rid, 404,
                                   b'{"error": "not found"}')
                 return
-            req = json.loads(body)
-            inputs = req["inputs"]
-            if isinstance(inputs, list) and inputs and \
-                    isinstance(inputs[0], dict):
-                xs = [np.asarray(i["data"], np.float32)
-                      for i in inputs]
-            else:
-                xs = np.asarray(inputs, np.float32)
-            out = self.model.predict(xs)
-            if isinstance(out, list):
-                payload = {"outputs": [o.tolist() for o in out]}
-            else:
-                payload = {"outputs": out.tolist()}
-            self._srv.respond(rid, 200, json.dumps(payload).encode())
-        except Exception as e:  # serving boundary: report, not die
+            status, payload = handle_predict(self.model, body)
+            self._srv.respond(rid, status,
+                              json.dumps(payload).encode())
+        except Exception as e:  # respond() itself failed
             try:
                 self._srv.respond(
                     rid, 400, json.dumps({"error": str(e)}).encode())
             except Exception:
                 pass
+        finally:
+            # refresh the C++-cached health AFTER the slot freed, so
+            # /health reflects post-request capacity
+            self._srv.set_health(json.dumps({
+                "status": "ok",
+                "free_slots": self.model.concurrent_slots_free}))
 
     def _loop(self):
         from analytics_zoo_tpu.common.nncontext import logger
@@ -161,9 +161,6 @@ class NativeInferenceServer:
                 continue
             if got is None:
                 continue
-            self._srv.set_health(json.dumps({
-                "status": "ok",
-                "free_slots": self.model.concurrent_slots_free}))
             self._serve_one(*got)
 
     def start(self, background: bool = True):
@@ -180,12 +177,12 @@ class NativeInferenceServer:
         return self
 
     def stop(self):
-        # workers drain first (they poll with a 200ms timeout), THEN
-        # the native handle is destroyed — never while a thread may be
-        # inside zoo_http_next
+        # workers drain FULLY first (they poll with a 200ms timeout;
+        # an in-flight predict finishes), THEN the native handle is
+        # destroyed — never while a thread may be inside zoo_http_*
         self._stopping = True
         for t in self._threads:
-            t.join(timeout=5)
+            t.join()
         self._srv.close()
 
 

@@ -75,3 +75,42 @@ def test_mobilenet_trains():
     y = rs.randint(0, 4, (16, 1)).astype(np.int32)
     res = ic.fit(x, y, batch_size=8, nb_epoch=1)
     assert len(res.history) == 1
+
+
+# -- space-to-depth stem (MLPerf-style MXU-dense stem) ------------------------
+
+class TestSpaceToDepthStem:
+    def test_stem_kernel_equivalence(self, rng):
+        """s2d(2)+4x4/s1 conv with the transformed kernel reproduces
+        the 7x7/s2 SAME stem exactly."""
+        import jax
+        import jax.numpy as jnp
+        from analytics_zoo_tpu.models.image.imageclassification.resnet \
+            import SpaceToDepth2D, s2d_stem_kernel
+        x = rng.randn(2, 32, 32, 3).astype(np.float32)
+        k7 = rng.randn(7, 7, 3, 8).astype(np.float32) * 0.1
+        want = jax.lax.conv_general_dilated(
+            jnp.asarray(x), jnp.asarray(k7), window_strides=(2, 2),
+            padding="SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        x2 = SpaceToDepth2D(2).call({}, jnp.asarray(x))
+        assert x2.shape == (2, 16, 16, 12)
+        k2d = s2d_stem_kernel(k7)
+        got = jax.lax.conv_general_dilated(
+            x2, jnp.asarray(k2d), window_strides=(1, 1),
+            padding=((1, 2), (1, 2)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5)
+
+    def test_resnet50_s2d_shapes_match(self, rng):
+        from analytics_zoo_tpu.models.image.imageclassification import \
+            resnet50
+        m1 = resnet50(input_shape=(64, 64, 3), classes=10)
+        m2 = resnet50(input_shape=(64, 64, 3), classes=10,
+                      space_to_depth=True)
+        x = rng.randn(2, 64, 64, 3).astype(np.float32)
+        p1 = m1.init_params()
+        p2 = m2.init_params()
+        o1 = m1.forward(p1, x, training=False)
+        o2 = m2.forward(p2, x, training=False)
+        assert o1.shape == o2.shape == (2, 10)
