@@ -16,4 +16,6 @@ EXAMPLES = [
     "onnx_import",
     "inference_serving",
     "distributed_training",
+    "rdd_ingest",
+    "quantized_serving",
 ]

@@ -300,10 +300,13 @@ void zoo_http_destroy(void* h) {
     ::close(s->listen_fd);
     s->cv.notify_all();
     if (s->acceptor.joinable()) s->acceptor.join();
-    // connection threads are detached; wait past read_request's 60s
-    // hard deadline so none touches the Server after delete
-    for (int i = 0; i < 70000 && s->conn_threads.load() > 0; ++i)
+    // connection threads are detached; worst-case lifetime is the 60s
+    // read deadline + one 30s SO_RCVTIMEO recv. Wait past that; if a
+    // thread is somehow still alive, deliberately LEAK the Server —
+    // a one-off leak at shutdown beats a use-after-free.
+    for (int i = 0; i < 95000 && s->conn_threads.load() > 0; ++i)
         std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    if (s->conn_threads.load() > 0) return;
     {
         std::lock_guard<std::mutex> g(s->mu);
         for (auto& kv : s->pending) ::close(kv.second);

@@ -13,6 +13,7 @@ GET  /health   →  {"status": "ok", "free_slots": N}
 from __future__ import annotations
 
 import json
+import time
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -73,9 +74,13 @@ class InferenceServer:
                 if self.path != "/predict":
                     self._reply(404, {"error": "not found"})
                     return
-                n = int(self.headers.get("Content-Length", 0))
-                status, payload = handle_predict(server.model,
-                                                 self.rfile.read(n))
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = self.rfile.read(n)
+                except Exception as e:  # bad header / client dropped
+                    self._reply(400, {"error": str(e)})
+                    return
+                status, payload = handle_predict(server.model, body)
                 self._reply(status, payload)
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
@@ -177,12 +182,22 @@ class NativeInferenceServer:
         return self
 
     def stop(self):
-        # workers drain FULLY first (they poll with a 200ms timeout;
-        # an in-flight predict finishes), THEN the native handle is
-        # destroyed — never while a thread may be inside zoo_http_*
+        # workers drain first (they poll with a 200ms timeout; an
+        # in-flight predict finishes), THEN the native handle is
+        # destroyed — never while a thread may be inside zoo_http_*.
+        # If a worker is wedged (hung predict), leak the native handle
+        # instead of freeing under it or hanging the caller forever.
         self._stopping = True
+        deadline = time.monotonic() + 60.0
         for t in self._threads:
-            t.join()
+            t.join(timeout=max(deadline - time.monotonic(), 0.1))
+        if any(t.is_alive() for t in self._threads):
+            from analytics_zoo_tpu.common.nncontext import logger
+            logger.warning(
+                "native serving: a worker is still busy after 60s; "
+                "leaking the native server handle instead of freeing "
+                "it underneath the worker")
+            return
         self._srv.close()
 
 

@@ -81,3 +81,14 @@ def test_inference_serving():
     results = _run("inference_serving", ["--concurrency", "2",
                                          "--requests", "4"])
     assert all(r is not None for r in results)
+
+def test_rdd_ingest():
+    metrics = _run("rdd_ingest", ["--n", "64", "--epochs", "1",
+                                  "--batch-size", "16"])
+    assert "loss" in metrics
+
+
+def test_quantized_serving():
+    result = _run("quantized_serving", ["--n", "128", "--epochs", "2"])
+    assert result["agreement"] >= 0.95
+    assert result["kernel_bytes_f32"] > 2 * result["kernel_bytes_int8"]
