@@ -9,9 +9,13 @@ implementations:
 - `parallel.ring_attention` — sequence-parallel ring attention over a
   mesh axis for long contexts (K/V blocks rotate over ICI while each
   device accumulates flash-style softmax statistics).
+- `parallel.ulysses` — all-to-all head-repartition sequence
+  parallelism (two large collectives instead of n ring rounds; needs
+  heads % axis == 0).
 
-Both share the same blockwise-softmax accumulation math, so ring == dense
-numerically (tested to 1e-5).
+Ring shares this module's blockwise-softmax accumulation math, so
+ring == dense numerically; ulysses runs ordinary dense attention
+locally after the head all-to-all (both tested to 1e-5 vs dense).
 """
 
 from __future__ import annotations

@@ -17,3 +17,18 @@ __all__ = [
     "FSDP_RULES",
     "TP_RULES",
 ]
+
+
+def get_sp_attention(mode: str):
+    """Resolve a sequence-parallel attention implementation by name —
+    the single validation/dispatch point for `sequence_parallel_mode`
+    ("ring" → ring_attention, "ulysses" → ulysses_attention)."""
+    if mode == "ring":
+        from analytics_zoo_tpu.parallel.ring_attention import \
+            ring_attention
+        return ring_attention
+    if mode == "ulysses":
+        from analytics_zoo_tpu.parallel.ulysses import ulysses_attention
+        return ulysses_attention
+    raise ValueError(
+        f"sequence_parallel_mode must be ring|ulysses, got {mode!r}")

@@ -1,0 +1,72 @@
+"""Ulysses-style sequence parallelism — all-to-all head repartition.
+
+The second canonical long-context strategy next to
+[`ring_attention`](ring_attention.py) (absent from the reference, whose
+max context is 512 — SURVEY.md §5; first-class here per the round
+goals). Where ring attention keeps Q resident and rotates K/V blocks
+around the ICI ring, Ulysses re-partitions ONE time: an all-to-all
+swaps the sharded axis from sequence to heads, every device then holds
+the FULL sequence for H/n heads and runs ordinary (flash) attention
+locally, and a second all-to-all swaps back.
+
+Trade-off vs ring: 2 all-to-alls of activation size instead of n
+ppermute rounds — fewer, larger collectives (better when n is small
+and heads are plentiful), but requires ``heads % axis_size == 0`` and
+holds the full sequence per device (memory O(S) vs ring's O(S/n) for
+K/V).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _ulysses_local(q, k, v, axis_name: str, causal: bool,
+                   scale: Optional[float]):
+    """Inside-shard_map body. q,k,v: (B, T_loc, H, D) local blocks."""
+    from analytics_zoo_tpu.ops.attention import dot_product_attention
+
+    # seq-sharded → head-sharded: (B, T_loc, H, D) → (B, T, H/n, D)
+    def to_heads(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=2,
+                                  concat_axis=1, tiled=True)
+
+    def to_seq(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=1,
+                                  concat_axis=2, tiled=True)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    out = dot_product_attention(qh, kh, vh, causal=causal, scale=scale)
+    return to_seq(out)
+
+
+def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      mesh: Mesh, axis: str = "seq",
+                      causal: bool = False,
+                      scale: Optional[float] = None) -> jnp.ndarray:
+    """Sequence-parallel attention via head all-to-all. q,k,v:
+    (B, T, H, D) with T sharded over ``axis``; returns the same
+    layout. Requires ``H % mesh.shape[axis] == 0``; falls back to a
+    plain single-block computation when the axis is absent or 1."""
+    if axis not in mesh.axis_names or mesh.shape[axis] == 1:
+        from analytics_zoo_tpu.ops.attention import dot_product_attention
+        return dot_product_attention(q, k, v, causal=causal, scale=scale)
+    n = mesh.shape[axis]
+    heads = q.shape[2]
+    if heads % n != 0:
+        raise ValueError(
+            f"ulysses attention needs heads ({heads}) divisible by the "
+            f"'{axis}' mesh axis size ({n}); use ring attention for "
+            "head-scarce models")
+    spec = P(None, axis, None, None)
+    fn = jax.shard_map(
+        functools.partial(_ulysses_local, axis_name=axis,
+                          causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)

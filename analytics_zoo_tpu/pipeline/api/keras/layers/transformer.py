@@ -59,10 +59,13 @@ class MultiHeadAttention(KerasLayer):
                  attn_p_drop: float = 0.1, resid_p_drop: float = 0.1,
                  causal: bool = False, initializer_range: float = 0.02,
                  sequence_parallel_axis: Optional[str] = None,
+                 sequence_parallel_mode: str = "ring",
                  input_shape=None, name=None, **kwargs):
         super().__init__(input_shape=input_shape, name=name, **kwargs)
         if hidden_size % n_head:
             raise ValueError("hidden_size must divide by n_head")
+        from analytics_zoo_tpu.parallel import get_sp_attention
+        get_sp_attention(sequence_parallel_mode)  # validate early
         self.hidden_size = int(hidden_size)
         self.n_head = int(n_head)
         self.attn_p_drop = float(attn_p_drop)
@@ -70,6 +73,7 @@ class MultiHeadAttention(KerasLayer):
         self.causal = causal
         self.initializer_range = float(initializer_range)
         self.sequence_parallel_axis = sequence_parallel_axis
+        self.sequence_parallel_mode = sequence_parallel_mode
 
     def build(self, rng, input_shape: Shape) -> dict:
         h = self.hidden_size
@@ -83,13 +87,17 @@ class MultiHeadAttention(KerasLayer):
 
     def _attend(self, q, k, v, mask):
         if self.sequence_parallel_axis:
+            if mask is not None:
+                raise NotImplementedError(
+                    "attention masks are not supported under sequence "
+                    "parallelism (causal masking is); drop padding or "
+                    "unset sequence_parallel_axis")
             from analytics_zoo_tpu.common.nncontext import get_nncontext
-            from analytics_zoo_tpu.parallel.ring_attention import \
-                ring_attention
-            mesh = get_nncontext().mesh
-            return ring_attention(q, k, v, mesh,
-                                  axis=self.sequence_parallel_axis,
-                                  causal=self.causal)
+            from analytics_zoo_tpu.parallel import get_sp_attention
+            sp = get_sp_attention(self.sequence_parallel_mode)
+            return sp(q, k, v, get_nncontext().mesh,
+                      axis=self.sequence_parallel_axis,
+                      causal=self.causal)
         return dot_product_attention(q, k, v, mask=mask,
                                      causal=self.causal)
 
@@ -131,11 +139,15 @@ class TransformerLayer(KerasLayer):
                  output_all_block: bool = False,
                  embed_p_drop: float = 0.1,
                  sequence_parallel_axis: Optional[str] = None,
+                 sequence_parallel_mode: str = "ring",
                  input_shape=None, name=None, **kwargs):
         super().__init__(input_shape=input_shape or (seq_len,),
                          name=name, **kwargs)
         if hidden_size % n_head:
             raise ValueError("hidden_size must divide by n_head")
+        from analytics_zoo_tpu.parallel import get_sp_attention
+        get_sp_attention(sequence_parallel_mode)  # validate early
+        self.sequence_parallel_mode = sequence_parallel_mode
         self.n_block = int(n_block)
         self.hidden_size = int(hidden_size)
         self.n_head = int(n_head)
@@ -217,12 +229,17 @@ class TransformerLayer(KerasLayer):
             k = k.reshape(b, t, nh, hd)
             v = v.reshape(b, t, nh, hd)
             if sp_axis:
+                if mask is not None:
+                    raise NotImplementedError(
+                        "attention masks are not supported under "
+                        "sequence parallelism (causal masking is); "
+                        "drop padding or unset sequence_parallel_axis")
                 from analytics_zoo_tpu.common.nncontext import \
                     get_nncontext
-                from analytics_zoo_tpu.parallel.ring_attention import \
-                    ring_attention
-                attn = ring_attention(q, k, v, get_nncontext().mesh,
-                                      axis=sp_axis, causal=causal)
+                from analytics_zoo_tpu.parallel import get_sp_attention
+                sp = get_sp_attention(self.sequence_parallel_mode)
+                attn = sp(q, k, v, get_nncontext().mesh,
+                          axis=sp_axis, causal=causal)
             else:
                 attn = dot_product_attention(q, k, v, mask=mask,
                                              causal=causal)
