@@ -1,12 +1,14 @@
 from analytics_zoo_tpu.feature.image.imageset import (
     ImageFeature, ImageSet, LocalImageSet)
 from analytics_zoo_tpu.feature.image.transforms import (
-    ImageBrightness, ImageCenterCrop, ImageChannelNormalize,
-    ImageContrast, ImageExpand, ImageFiller, ImageHFlip, ImageHue,
-    ImageMatToTensor, ImagePixelNormalizer, ImageRandomCrop,
-    ImageRandomPreprocessing, ImageResize, ImageSaturation,
-    ImageSetToSample, ImageAspectScale, ImageChannelScaledNormalizer,
-    ImageRandomAspectScale, ImageColorJitter)
+    ImageBrightness, ImageBytesToMat, ImageCenterCrop,
+    ImageChannelNormalize, ImageChannelOrder, ImageContrast,
+    ImageExpand, ImageFiller, ImageFixedCrop, ImageHFlip, ImageHue,
+    ImageMatToFloats, ImageMatToTensor, ImagePixelBytesToMat,
+    ImagePixelNormalizer, ImageRandomCrop, ImageRandomPreprocessing,
+    ImageResize, ImageSaturation, ImageSetToSample, ImageAspectScale,
+    ImageChannelScaledNormalizer, ImageRandomAspectScale,
+    ImageColorJitter)
 
 __all__ = [
     "ImageFeature", "ImageSet", "LocalImageSet",
@@ -16,5 +18,6 @@ __all__ = [
     "ImageSetToSample", "ImageExpand", "ImageFiller",
     "ImageRandomPreprocessing", "ImageAspectScale",
     "ImageRandomAspectScale", "ImageChannelScaledNormalizer",
-    "ImageColorJitter",
+    "ImageColorJitter", "ImageBytesToMat", "ImagePixelBytesToMat",
+    "ImageChannelOrder", "ImageFixedCrop", "ImageMatToFloats",
 ]

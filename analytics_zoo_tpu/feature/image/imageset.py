@@ -34,7 +34,9 @@ class ImageFeature(dict):
         super().__init__()
         if image is not None:
             self[self.IMAGE] = image
-            self[self.ORIGINAL_SIZE] = image.shape
+            # encoded bytes (ImageBytesToMat input) have no shape yet
+            if isinstance(image, np.ndarray) and image.ndim >= 2:
+                self[self.ORIGINAL_SIZE] = image.shape
         if label is not None:
             self[self.LABEL] = label
         if uri is not None:

@@ -354,3 +354,88 @@ class ImageRandomPreprocessing(ImagePreprocessing):
         if self.rng.rand() < self.prob:
             return self.inner.apply(feature)
         return feature
+
+
+class ImageBytesToMat(ImagePreprocessing):
+    """Decode encoded image bytes (JPEG/PNG) into an HWC uint8 array
+    (reference `ImageBytesToMat.scala` — there OpenCV imdecode; here
+    PIL). Reads the feature's `bytes` field when the image slot holds
+    raw bytes."""
+
+    def __init__(self, channel_order: str = "RGB"):
+        if channel_order not in ("RGB", "BGR"):
+            raise ValueError("channel_order must be RGB|BGR")
+        self.channel_order = channel_order
+
+    def apply(self, feature: ImageFeature) -> ImageFeature:
+        import io
+
+        from PIL import Image
+        raw = feature[ImageFeature.IMAGE]
+        if isinstance(raw, np.ndarray) and raw.ndim >= 2:
+            # already decoded — framework decoders produce RGB, so
+            # still honor a BGR request
+            if self.channel_order == "BGR":
+                feature[ImageFeature.IMAGE] = np.ascontiguousarray(
+                    raw[..., ::-1])
+            return feature
+        img = np.array(
+            Image.open(io.BytesIO(bytes(raw))).convert("RGB"))
+        if self.channel_order == "BGR":
+            img = img[..., ::-1]
+        feature[ImageFeature.IMAGE] = np.ascontiguousarray(img).copy()
+        return feature
+
+
+class ImagePixelBytesToMat(ImagePreprocessing):
+    """Raw pixel bytes + (h, w, c) shape → ndarray (reference
+    `ImagePixelBytesToMat.scala`)."""
+
+    def __init__(self, height: int, width: int, channels: int = 3):
+        self.shape = (int(height), int(width), int(channels))
+
+    def apply(self, feature: ImageFeature) -> ImageFeature:
+        raw = feature[ImageFeature.IMAGE]
+        arr = np.frombuffer(bytes(raw), np.uint8).reshape(self.shape)
+        # frombuffer views are read-only; own the memory
+        feature[ImageFeature.IMAGE] = arr.copy()
+        return feature
+
+
+class ImageChannelOrder(ImagePreprocessing):
+    """Swap RGB↔BGR (reference `ImageChannelOrder.scala`)."""
+
+    def apply_image(self, img, feature):
+        return np.ascontiguousarray(img[..., ::-1])
+
+
+class ImageFixedCrop(ImagePreprocessing):
+    """Crop a fixed region (reference `ImageFixedCrop.scala`):
+    (x1, y1, x2, y2), normalized [0, 1] when ``normalized=True`` else
+    absolute pixel coordinates."""
+
+    def __init__(self, x1: float, y1: float, x2: float, y2: float,
+                 normalized: bool = True):
+        self.box = (float(x1), float(y1), float(x2), float(y2))
+        self.normalized = normalized
+
+    def apply_image(self, img, feature):
+        h, w = img.shape[:2]
+        x1, y1, x2, y2 = self.box
+        if self.normalized:
+            x1, x2 = x1 * w, x2 * w
+            y1, y2 = y1 * h, y2 * h
+        x1 = int(np.clip(round(x1), 0, w - 1))
+        x2 = int(np.clip(round(x2), x1 + 1, w))
+        y1 = int(np.clip(round(y1), 0, h - 1))
+        y2 = int(np.clip(round(y2), y1 + 1, h))
+        return np.ascontiguousarray(img[y1:y2, x1:x2])
+
+
+class ImageMatToFloats(ImagePreprocessing):
+    """Flatten the image into a float32 vector (reference
+    `ImageMatToFloats.scala` — the raw-floats handoff used by the
+    serving path)."""
+
+    def apply_image(self, img, feature):
+        return np.asarray(img, np.float32).reshape(-1)

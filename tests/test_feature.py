@@ -294,3 +294,66 @@ class TestReferenceFixtures:
         assert all(f.get_sample() is not None for f in feats)
         assert all(f.get_sample().feature_arrays()[0].shape == (20,)
                    for f in feats)
+
+
+class TestNewImageTransforms:
+    def _feature(self, img):
+        from analytics_zoo_tpu.feature.image import ImageFeature
+        f = ImageFeature()
+        f[ImageFeature.IMAGE] = img
+        return f
+
+    def test_bytes_to_mat_png_roundtrip(self, rng):
+        import io
+        from PIL import Image
+        from analytics_zoo_tpu.feature.image import (ImageBytesToMat,
+                                                     ImageFeature)
+        img = (rng.rand(12, 10, 3) * 255).astype(np.uint8)
+        buf = io.BytesIO()
+        Image.fromarray(img).save(buf, format="PNG")  # lossless
+        f = self._feature(np.frombuffer(buf.getvalue(), np.uint8))
+        out = ImageBytesToMat().apply(f)
+        np.testing.assert_array_equal(out[ImageFeature.IMAGE], img)
+
+    def test_bytes_to_mat_bgr(self, rng):
+        import io
+        from PIL import Image
+        from analytics_zoo_tpu.feature.image import (ImageBytesToMat,
+                                                     ImageFeature)
+        img = (rng.rand(6, 5, 3) * 255).astype(np.uint8)
+        buf = io.BytesIO()
+        Image.fromarray(img).save(buf, format="PNG")
+        f = self._feature(buf.getvalue())
+        out = ImageBytesToMat(channel_order="BGR").apply(f)
+        np.testing.assert_array_equal(out[ImageFeature.IMAGE],
+                                      img[..., ::-1])
+
+    def test_pixel_bytes_to_mat(self, rng):
+        from analytics_zoo_tpu.feature.image import (
+            ImageFeature, ImagePixelBytesToMat)
+        img = (rng.rand(4, 5, 3) * 255).astype(np.uint8)
+        f = self._feature(img.tobytes())
+        out = ImagePixelBytesToMat(4, 5, 3).apply(f)
+        np.testing.assert_array_equal(out[ImageFeature.IMAGE], img)
+
+    def test_channel_order_and_fixed_crop(self, rng):
+        from analytics_zoo_tpu.feature.image import (
+            ImageChannelOrder, ImageFeature, ImageFixedCrop)
+        img = (rng.rand(10, 20, 3) * 255).astype(np.uint8)
+        swapped = ImageChannelOrder().apply(self._feature(img.copy()))
+        np.testing.assert_array_equal(swapped[ImageFeature.IMAGE],
+                                      img[..., ::-1])
+        crop = ImageFixedCrop(0.25, 0.2, 0.75, 0.8).apply(
+            self._feature(img.copy()))[ImageFeature.IMAGE]
+        assert crop.shape == (6, 10, 3)
+        crop_abs = ImageFixedCrop(2, 1, 12, 9, normalized=False).apply(
+            self._feature(img.copy()))[ImageFeature.IMAGE]
+        np.testing.assert_array_equal(crop_abs, img[1:9, 2:12])
+
+    def test_mat_to_floats(self, rng):
+        from analytics_zoo_tpu.feature.image import (ImageFeature,
+                                                     ImageMatToFloats)
+        img = (rng.rand(3, 4, 3) * 255).astype(np.uint8)
+        out = ImageMatToFloats().apply(self._feature(img))
+        flat = out[ImageFeature.IMAGE]
+        assert flat.dtype == np.float32 and flat.shape == (36,)
