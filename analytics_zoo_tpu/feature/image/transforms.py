@@ -376,14 +376,15 @@ class ImageBytesToMat(ImagePreprocessing):
             # already decoded — framework decoders produce RGB, so
             # still honor a BGR request
             if self.channel_order == "BGR":
-                feature[ImageFeature.IMAGE] = np.ascontiguousarray(
-                    raw[..., ::-1])
+                feature[ImageFeature.IMAGE] = \
+                    ImageChannelOrder().apply_image(raw, feature)
             return feature
+        # np.array(PIL) is already a fresh contiguous writable array
         img = np.array(
             Image.open(io.BytesIO(bytes(raw))).convert("RGB"))
         if self.channel_order == "BGR":
-            img = img[..., ::-1]
-        feature[ImageFeature.IMAGE] = np.ascontiguousarray(img).copy()
+            img = np.ascontiguousarray(img[..., ::-1])
+        feature[ImageFeature.IMAGE] = img
         return feature
 
 
@@ -403,9 +404,13 @@ class ImagePixelBytesToMat(ImagePreprocessing):
 
 
 class ImageChannelOrder(ImagePreprocessing):
-    """Swap RGB↔BGR (reference `ImageChannelOrder.scala`)."""
+    """Swap RGB↔BGR (reference `ImageChannelOrder.scala`). No-op for
+    grayscale (a channel swap is identity without channels — guarding
+    keeps 2-D images from being mirrored along width)."""
 
     def apply_image(self, img, feature):
+        if img.ndim < 3 or img.shape[-1] not in (3, 4):
+            return img
         return np.ascontiguousarray(img[..., ::-1])
 
 

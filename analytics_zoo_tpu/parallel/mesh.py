@@ -140,3 +140,28 @@ def shard_params(params: Any, mesh: Mesh,
         lambda x, ax: jax.device_put(
             x, logical_sharding(mesh, rules, ax)),
         params, logical_axes)
+
+
+def auto_tp_sharding(mesh: Mesh, x, axis: str = "model",
+                     min_elems: int = 2 ** 8) -> NamedSharding:
+    """Pick a tensor-parallel sharding for one param leaf: shard the
+    LAST dim (output features of Dense/conv kernels — the Megatron
+    column split) over the model axis when divisible; replicate biases
+    and small leaves. GSPMD's sharding propagation then derives the
+    activation shardings and inserts the all-reduces — the
+    compiler-native form of Megatron TP (scaling-book recipe)."""
+    if axis not in mesh.axis_names:
+        return NamedSharding(mesh, P())
+    n = mesh.shape[axis]
+    if n == 1 or x.ndim < 2 or x.size < min_elems or \
+            x.shape[-1] % n != 0:
+        return NamedSharding(mesh, P())
+    spec = [None] * x.ndim
+    spec[-1] = axis
+    return NamedSharding(mesh, P(*spec))
+
+
+def shard_params_tp(params: Any, mesh: Mesh, axis: str = "model") -> Any:
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, auto_tp_sharding(mesh, x, axis)),
+        params)

@@ -186,8 +186,8 @@ class Estimator:
                  ctx: Optional[NNContext] = None,
                  parallel_mode: str = "dp",
                  dtype_policy: Optional[str] = None):
-        if parallel_mode not in ("dp", "fsdp"):
-            raise ValueError("parallel_mode must be dp|fsdp")
+        if parallel_mode not in ("dp", "fsdp", "tp"):
+            raise ValueError("parallel_mode must be dp|fsdp|tp")
         dtype_policy = dtype_policy or os.environ.get(
             "ZOO_TPU_DTYPE_POLICY", "float32")
         if dtype_policy not in ("float32", "mixed_bfloat16"):
@@ -298,10 +298,15 @@ class Estimator:
 
     def _place_params(self, params):
         """DP: replicate (the reference's broadcast-weights semantics);
-        FSDP: ZeRO-shard over the 'fsdp' mesh axis."""
+        FSDP: ZeRO-shard over the 'fsdp' mesh axis; TP: Megatron-style
+        output-dim kernel sharding over 'model' (GSPMD propagates the
+        activation shardings and inserts the collectives)."""
         if self.parallel_mode == "fsdp":
             from analytics_zoo_tpu.parallel.mesh import shard_params_fsdp
             return shard_params_fsdp(params, self.ctx.mesh)
+        if self.parallel_mode == "tp":
+            from analytics_zoo_tpu.parallel.mesh import shard_params_tp
+            return shard_params_tp(params, self.ctx.mesh)
         return shard_params(params, self.ctx.mesh)
 
     # -- compiled steps -----------------------------------------------------
