@@ -411,6 +411,9 @@ class ImageChannelOrder(ImagePreprocessing):
     def apply_image(self, img, feature):
         if img.ndim < 3 or img.shape[-1] not in (3, 4):
             return img
+        if img.shape[-1] == 4:  # RGBA: swap color planes, keep alpha
+            return np.ascontiguousarray(np.concatenate(
+                [img[..., 2::-1], img[..., 3:]], axis=-1))
         return np.ascontiguousarray(img[..., ::-1])
 
 

@@ -117,10 +117,15 @@ def auto_fsdp_sharding(mesh: Mesh, x, axis: str = "fsdp",
     return NamedSharding(mesh, P())
 
 
-def shard_params_fsdp(params: Any, mesh: Mesh, axis: str = "fsdp") -> Any:
+def shard_params_with(params: Any, mesh: Mesh, chooser, axis: str) -> Any:
+    """Place every leaf per a (mesh, leaf, axis) -> NamedSharding
+    chooser — the shared body of the parallel-mode placements."""
     return jax.tree_util.tree_map(
-        lambda x: jax.device_put(x, auto_fsdp_sharding(mesh, x, axis)),
-        params)
+        lambda x: jax.device_put(x, chooser(mesh, x, axis)), params)
+
+
+def shard_params_fsdp(params: Any, mesh: Mesh, axis: str = "fsdp") -> Any:
+    return shard_params_with(params, mesh, auto_fsdp_sharding, axis)
 
 
 def shard_params(params: Any, mesh: Mesh,
@@ -162,6 +167,36 @@ def auto_tp_sharding(mesh: Mesh, x, axis: str = "model",
 
 
 def shard_params_tp(params: Any, mesh: Mesh, axis: str = "model") -> Any:
-    return jax.tree_util.tree_map(
-        lambda x: jax.device_put(x, auto_tp_sharding(mesh, x, axis)),
-        params)
+    return shard_params_with(params, mesh, auto_tp_sharding, axis)
+
+
+def auto_ep_sharding(mesh: Mesh, x, axis: str = "expert") -> \
+        NamedSharding:
+    """Expert-parallel placement for one expert-stacked leaf: shard
+    the LEADING (expert) dim over the expert mesh axis when
+    divisible."""
+    if axis not in mesh.axis_names:
+        return NamedSharding(mesh, P())
+    n = mesh.shape[axis]
+    if n == 1 or x.ndim < 1 or x.shape[0] % n != 0:
+        return NamedSharding(mesh, P())
+    spec = [None] * x.ndim
+    spec[0] = axis
+    return NamedSharding(mesh, P(*spec))
+
+
+# param keys that carry a stacked leading expert axis (MoE layers);
+# everything else (routers, embeddings, heads) replicates under EP
+_EP_PARAM_KEYS = frozenset({"w_in", "b_in", "w_out", "b_out"})
+
+
+def shard_params_ep(params: Any, mesh: Mesh, axis: str = "expert") -> Any:
+    repl = NamedSharding(mesh, P())
+
+    def place(path, x):
+        last = getattr(path[-1], "key", None) if path else None
+        if last in _EP_PARAM_KEYS:
+            return jax.device_put(x, auto_ep_sharding(mesh, x, axis))
+        return jax.device_put(x, repl)
+
+    return jax.tree_util.tree_map_with_path(place, params)

@@ -29,6 +29,7 @@ from analytics_zoo_tpu.pipeline.api.keras.layers.advanced_activations \
 from analytics_zoo_tpu.pipeline.api.keras.layers.noise import (
     GaussianNoise, GaussianDropout, SpatialDropout1D, SpatialDropout2D,
     SpatialDropout3D)
+from analytics_zoo_tpu.pipeline.api.keras.layers.moe import MoE
 from analytics_zoo_tpu.pipeline.api.keras.layers.transformer import (
     MultiHeadAttention, TransformerLayer, BERT)
 from analytics_zoo_tpu.pipeline.api.keras.layers.elementwise import (
@@ -77,7 +78,7 @@ __all__ = [
     "GaussianNoise", "GaussianDropout", "SpatialDropout1D",
     "SpatialDropout2D", "SpatialDropout3D",
     # transformer
-    "MultiHeadAttention", "TransformerLayer", "BERT",
+    "MultiHeadAttention", "TransformerLayer", "MoE", "BERT",
     # elementwise / tensor utilities
     "AddConstant", "MulConstant", "CAdd", "CMul", "Mul", "Scale", "Power",
     "Negative", "Exp", "Log", "Sqrt", "Square", "Identity",

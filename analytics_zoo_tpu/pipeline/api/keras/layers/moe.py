@@ -1,0 +1,143 @@
+"""Mixture-of-Experts FFN with expert parallelism.
+
+Absent from the reference (like ring/Ulysses sequence parallelism —
+SURVEY.md §2.10 lists EP as "NO"); first-class here because expert
+parallelism is one of the shardings a TPU-native framework must scale
+(round goals: dp/tp/sp/ep). Design is the XLA-friendly Switch
+Transformer formulation:
+
+- router: tokens → softmax over n_experts, top-1 gate;
+- capacity: each expert takes at most ``capacity_factor · T/E`` tokens
+  (overflow dropped — keeps every shape static for the compiler);
+- dispatch/combine are one-hot einsums, NOT gathers — under a mesh
+  with an ``expert`` axis and expert-stacked params sharded on it,
+  GSPMD lowers them to all-to-alls over ICI;
+- expert FFNs are ONE stacked einsum (E, d, h): no per-expert Python
+  loop, one MXU-dense contraction.
+
+Aux load-balancing loss (Switch eq. 4) is exposed via
+``regularization_loss`` so the Estimator adds it automatically.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.ops import activations, initializers
+from analytics_zoo_tpu.pipeline.api.keras.engine import KerasLayer, Shape
+
+
+class MoE(KerasLayer):
+    """Switch-style top-1 MoE FFN over (B, T, d) inputs.
+
+    Params carry a leading expert axis; pass ``expert_axis="expert"``
+    (with that axis in the mesh) to shard experts across devices —
+    dispatch/combine become all-to-alls (expert parallelism).
+    """
+
+    def __init__(self, n_experts: int, hidden_dim: int,
+                 capacity_factor: float = 1.25,
+                 activation="gelu", aux_loss_weight: float = 0.01,
+                 init="glorot_uniform",
+                 expert_axis: Optional[str] = None,
+                 input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.n_experts = int(n_experts)
+        self.hidden_dim = int(hidden_dim)
+        self.capacity_factor = float(capacity_factor)
+        self.activation = activations.get(activation)
+        self.aux_loss_weight = float(aux_loss_weight)
+        self.kernel_init = initializers.get(init)
+        self.expert_axis = expert_axis
+        self._last_aux = None
+
+    def build(self, rng, input_shape: Shape) -> dict:
+        d = input_shape[-1]
+        e, h = self.n_experts, self.hidden_dim
+        k1, k2, k3 = jax.random.split(rng, 3)
+        return {
+            "router_kernel": self.kernel_init(k1, (d, e)),
+            "w_in": self.kernel_init(k2, (e, d, h)),
+            "b_in": jnp.zeros((e, h), jnp.float32),
+            "w_out": self.kernel_init(k3, (e, h, d)),
+            "b_out": jnp.zeros((e, d), jnp.float32),
+        }
+
+    def _maybe_shard(self, x, spec_axes):
+        """Annotate expert-stacked intermediates so GSPMD keeps the
+        expert dim on the expert axis (all-to-all at the boundaries)."""
+        if not self.expert_axis:
+            return x
+        from analytics_zoo_tpu.common.nncontext import get_nncontext
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh = get_nncontext().mesh
+        if self.expert_axis not in mesh.axis_names:
+            return x
+        spec = [self.expert_axis if a == "E" else None
+                for a in spec_axes]
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(*spec)))
+
+    def call(self, params, x, *, training=False, rng=None):
+        b, t, d = x.shape
+        e = self.n_experts
+        cap = max(int(self.capacity_factor * t / e), 1)
+
+        logits = x @ params["router_kernel"].astype(x.dtype)  # (B,T,E)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        gate = jnp.max(probs, axis=-1)                        # (B,T)
+        expert_idx = jnp.argmax(probs, axis=-1)               # (B,T)
+
+        # position of each token within its expert's queue
+        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)
+        pos = jnp.cumsum(onehot, axis=1) * onehot              # (B,T,E)
+        within_cap = (pos <= cap) & (onehot > 0)
+        # dispatch tensor (B, T, E, C): token t → slot pos-1 of expert
+        slot = jax.nn.one_hot(
+            (pos - 1).astype(jnp.int32), cap, dtype=jnp.float32)
+        dispatch = within_cap[..., None].astype(jnp.float32) * slot
+
+        # (B,T,E,C) × (B,T,d) → (E, B, C, d): the all-to-all boundary.
+        # Routing stats stay f32; the expert FFN — the layer's dominant
+        # FLOPs — runs in the compute dtype (bf16 under the mixed
+        # policy) so EP keeps the MXU 2x rate.
+        cdt = x.dtype
+        xe = jnp.einsum("btec,btd->ebcd", dispatch.astype(cdt), x)
+        xe = self._maybe_shard(xe, "E***")
+        h = jnp.einsum("ebcd,edh->ebch", xe,
+                       params["w_in"].astype(cdt)) + \
+            params["b_in"].astype(cdt)[:, None, None, :]
+        h = self.activation(h) if self.activation else h
+        ye = jnp.einsum("ebch,ehd->ebcd", h,
+                        params["w_out"].astype(cdt)) + \
+            params["b_out"].astype(cdt)[:, None, None, :]
+        ye = self._maybe_shard(ye, "E***")
+
+        combine = (dispatch * gate[..., None, None]).astype(cdt)
+        y = jnp.einsum("btec,ebcd->btd", combine, ye)
+
+        # Switch aux loss: E · Σ_e fraction_tokens_e · mean_prob_e
+        frac = jnp.mean(onehot, axis=(0, 1))
+        mean_p = jnp.mean(probs, axis=(0, 1))
+        self._last_aux = e * jnp.sum(frac * mean_p)
+        return y.astype(x.dtype)
+
+    def regularization_loss(self, params) -> jnp.ndarray:
+        # consume-once: the aux value is a tracer from the forward
+        # trace; the Estimator reads it inside the SAME trace right
+        # after apply(). An eager/out-of-trace read (leaked tracer)
+        # falls back to 0 instead of crashing.
+        aux, self._last_aux = self._last_aux, None
+        if aux is None or self.aux_loss_weight == 0.0:
+            return jnp.zeros((), jnp.float32)
+        try:
+            return self.aux_loss_weight * aux
+        except Exception:
+            return jnp.zeros((), jnp.float32)
+
+    def compute_output_shape(self, input_shape: Shape) -> Shape:
+        return input_shape

@@ -186,8 +186,8 @@ class Estimator:
                  ctx: Optional[NNContext] = None,
                  parallel_mode: str = "dp",
                  dtype_policy: Optional[str] = None):
-        if parallel_mode not in ("dp", "fsdp", "tp"):
-            raise ValueError("parallel_mode must be dp|fsdp|tp")
+        if parallel_mode not in ("dp", "fsdp", "tp", "ep"):
+            raise ValueError("parallel_mode must be dp|fsdp|tp|ep")
         dtype_policy = dtype_policy or os.environ.get(
             "ZOO_TPU_DTYPE_POLICY", "float32")
         if dtype_policy not in ("float32", "mixed_bfloat16"):
@@ -307,6 +307,9 @@ class Estimator:
         if self.parallel_mode == "tp":
             from analytics_zoo_tpu.parallel.mesh import shard_params_tp
             return shard_params_tp(params, self.ctx.mesh)
+        if self.parallel_mode == "ep":
+            from analytics_zoo_tpu.parallel.mesh import shard_params_ep
+            return shard_params_ep(params, self.ctx.mesh)
         return shard_params(params, self.ctx.mesh)
 
     # -- compiled steps -----------------------------------------------------
