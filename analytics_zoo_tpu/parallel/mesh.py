@@ -185,18 +185,29 @@ def auto_ep_sharding(mesh: Mesh, x, axis: str = "expert") -> \
     return NamedSharding(mesh, P(*spec))
 
 
-# param keys that carry a stacked leading expert axis (MoE layers);
-# everything else (routers, embeddings, heads) replicates under EP
-_EP_PARAM_KEYS = frozenset({"w_in", "b_in", "w_out", "b_out"})
-
-
-def shard_params_ep(params: Any, mesh: Mesh, axis: str = "expert") -> Any:
+def shard_params_ep(params: Any, mesh: Mesh, axis: str = "expert",
+                    ep_paths: "Optional[set]" = None) -> Any:
+    """EP placement: only leaves named in ``ep_paths`` — a set of
+    (layer_name, param_key) pairs collected from layers that declare
+    ``expert_stacked_params`` — are expert-sharded; everything else
+    (routers, embeddings, heads) replicates."""
     repl = NamedSharding(mesh, P())
+    ep_paths = ep_paths or set()
 
     def place(path, x):
-        last = getattr(path[-1], "key", None) if path else None
-        if last in _EP_PARAM_KEYS:
+        keys = tuple(getattr(e, "key", None) for e in path)
+        if len(keys) >= 2 and (keys[-2], keys[-1]) in ep_paths:
             return jax.device_put(x, auto_ep_sharding(mesh, x, axis))
         return jax.device_put(x, repl)
 
     return jax.tree_util.tree_map_with_path(place, params)
+
+
+def collect_ep_paths(model) -> set:
+    """(layer_name, param_key) pairs of expert-stacked params, from
+    each layer's ``expert_stacked_params`` declaration."""
+    out = set()
+    for lyr in getattr(model, "layers", []):
+        for k in getattr(lyr, "expert_stacked_params", ()):
+            out.add((lyr.name, k))
+    return out

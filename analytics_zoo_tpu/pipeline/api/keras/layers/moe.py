@@ -39,6 +39,10 @@ class MoE(KerasLayer):
     dispatch/combine become all-to-alls (expert parallelism).
     """
 
+    # consumed by shard_params_ep: these params have a stacked leading
+    # expert dim (routers and other layers replicate under EP)
+    expert_stacked_params = ("w_in", "b_in", "w_out", "b_out")
+
     def __init__(self, n_experts: int, hidden_dim: int,
                  capacity_factor: float = 1.25,
                  activation="gelu", aux_loss_weight: float = 0.01,
@@ -137,6 +141,11 @@ class MoE(KerasLayer):
         try:
             return self.aux_loss_weight * aux
         except Exception:
+            from analytics_zoo_tpu.common.nncontext import logger
+            logger.warning(
+                "MoE aux loss dropped: regularization_loss was called "
+                "outside the trace that ran forward (custom training "
+                "loops must compute it in the same jit as apply)")
             return jnp.zeros((), jnp.float32)
 
     def compute_output_shape(self, input_shape: Shape) -> Shape:

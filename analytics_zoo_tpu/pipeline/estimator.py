@@ -300,7 +300,9 @@ class Estimator:
         """DP: replicate (the reference's broadcast-weights semantics);
         FSDP: ZeRO-shard over the 'fsdp' mesh axis; TP: Megatron-style
         output-dim kernel sharding over 'model' (GSPMD propagates the
-        activation shardings and inserts the collectives)."""
+        activation shardings and inserts the collectives); EP: shard
+        layer-declared expert-stacked params over 'expert', replicate
+        the rest."""
         if self.parallel_mode == "fsdp":
             from analytics_zoo_tpu.parallel.mesh import shard_params_fsdp
             return shard_params_fsdp(params, self.ctx.mesh)
@@ -308,8 +310,11 @@ class Estimator:
             from analytics_zoo_tpu.parallel.mesh import shard_params_tp
             return shard_params_tp(params, self.ctx.mesh)
         if self.parallel_mode == "ep":
-            from analytics_zoo_tpu.parallel.mesh import shard_params_ep
-            return shard_params_ep(params, self.ctx.mesh)
+            from analytics_zoo_tpu.parallel.mesh import (
+                collect_ep_paths, shard_params_ep)
+            return shard_params_ep(
+                params, self.ctx.mesh,
+                ep_paths=collect_ep_paths(self.model))
         return shard_params(params, self.ctx.mesh)
 
     # -- compiled steps -----------------------------------------------------
