@@ -5,7 +5,10 @@ The reference's longest context is BERT-512 with dense attention inside
 absent"). Here attention is a first-class op with two interchangeable
 implementations:
 
-- :func:`dot_product_attention` — plain XLA (fused by the compiler);
+- :func:`dot_product_attention` — plain XLA (fused by the compiler),
+  or the Pallas flash kernel (`impl="flash"` / ``ZOO_TPU_ATTENTION``
+  env, `ops.flash_attention`) which keeps softmax statistics in VMEM
+  instead of materialising the (B, H, Tq, Tk) logits in HBM;
 - `parallel.ring_attention` — sequence-parallel ring attention over a
   mesh axis for long contexts (K/V blocks rotate over ICI while each
   device accumulates flash-style softmax statistics).
@@ -20,6 +23,7 @@ locally after the head all-to-all (both tested to 1e-5 vs dense).
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 import jax
@@ -29,12 +33,32 @@ import jax.numpy as jnp
 def dot_product_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                           mask: Optional[jnp.ndarray] = None,
                           causal: bool = False,
-                          scale: Optional[float] = None) -> jnp.ndarray:
+                          scale: Optional[float] = None,
+                          impl: Optional[str] = None) -> jnp.ndarray:
     """Standard attention. q,k,v: (B, T, H, D) → (B, T, H, D).
 
     `mask`: broadcastable to (B, H, Tq, Tk), 1 = attend. Softmax in f32
     regardless of input dtype (bf16-safe).
+
+    `impl`: "xla" (default), "flash" (Pallas VMEM-resident kernel), or
+    "auto" (flash when the problem qualifies — no arbitrary mask,
+    128-divisible sequence lengths). ``ZOO_TPU_ATTENTION`` sets the
+    default process-wide.
     """
+    impl = impl or os.environ.get("ZOO_TPU_ATTENTION", "xla")
+    if impl not in ("xla", "flash", "auto"):
+        raise ValueError(f"unknown attention impl {impl!r}")
+    if impl != "xla":
+        from analytics_zoo_tpu.ops import flash_attention as fa
+        if fa.supports(q.shape[1], k.shape[1], q.shape[-1], mask):
+            return fa.flash_attention(q, k, v, causal=causal,
+                                      scale=scale)
+        if impl == "flash":
+            raise ValueError(
+                f"impl='flash' unsupported for Tq={q.shape[1]} "
+                f"Tk={k.shape[1]} mask={mask is not None} (need "
+                f"128-divisible T, no arbitrary mask); use 'auto' to "
+                f"fall back silently")
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     # (B, H, Tq, Tk)

@@ -1,0 +1,208 @@
+"""Pallas TPU flash-attention kernel.
+
+The native-kernel tier for the attention hot path (SURVEY.md §2.11:
+the reference's per-layer perf tier is MKL/MKL-DNN JNI kernels, e.g.
+`TransformerLayer.scala`/`BERT.scala` bottoming out in BigDL MKL; the
+TPU analog is XLA + Pallas). XLA already fuses the dense O(T²)
+attention well, but it materialises the (B, H, Tq, Tk) logits in HBM;
+this kernel keeps the running softmax statistics in VMEM so HBM
+traffic stays O(T·D) — the flash-attention recipe tiled for the MXU
+(128-lane blocks, f32 accumulators, bf16 matmul inputs).
+
+Forward is the Pallas kernel; backward (`jax.custom_vjp`) recomputes
+the dense gradient with XLA from the saved q/k/v — O(T²) memory at
+grad time only, which is the right trade at the reference's sequence
+lengths (BERT-512; `parallel.ring_attention` owns the truly-long-T
+training regime).
+
+On non-TPU backends the same kernel runs under `interpret=True`
+(numerics identical, speed irrelevant) so the CPU test mesh exercises
+the exact kernel code path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                scale: float, causal: bool, block_q: int, block_k: int,
+                causal_offset: int):
+    """One (batch, head, q-block, k-block) grid step.
+
+    Scratch (VMEM, persistent across the innermost `k` grid dim):
+      acc_ref (block_q, D) f32   un-normalised output accumulator
+      m_ref   (block_q, 128) f32 running row max (lanes replicated)
+      l_ref   (block_q, 128) f32 running softmax denominator
+    """
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    qi = pl.program_id(2)
+    # causal (end-aligned like the dense reference's tril(k=Tk-Tq):
+    # query i sees keys <= i + causal_offset): the whole k-block is
+    # masked iff its first key position exceeds the q-block's last
+    # query position — skip it entirely
+    run = (ki * block_k <=
+           qi * block_q + (block_q - 1) + causal_offset) if causal \
+        else (ki >= 0)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0, 0]                      # (block_q, D)
+        k = k_ref[0, 0]                      # (block_k, D)
+        v = v_ref[0, 0]                      # (block_k, D)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos + causal_offset >= k_pos, s, _NEG_INF)
+
+        m_prev = m_ref[:, :1]                # (block_q, 1)
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)      # rescale old accumulator
+        p = jnp.exp(s - m_new)               # (block_q, block_k) f32
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_ref[:] = acc_ref[:] * alpha + pv
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == nk - 1)
+    def _final():
+        l = l_ref[:, :1]
+        o_ref[0, 0] = (acc_ref[:] /
+                       jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    """q,k,v: (B, H, T, D) — head-major layout for contiguous blocks."""
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    nq, nk = tq // block_q, tk // block_k
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k,
+                               causal_offset=tk - tq)
+    blk = lambda bs, im: pl.BlockSpec((1, 1, bs, d), im)
+    return pl.pallas_call(
+        kernel,
+        grid=(b, h, nq, nk),
+        in_specs=[
+            blk(block_q, lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            blk(block_k, lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+            blk(block_k, lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+        ],
+        out_specs=blk(block_q, lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, tq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
+    return _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+                      interpret)
+
+
+def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    out = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+                     interpret)
+    return out, (q, k, v)
+
+
+def _flash_vjp_bwd(scale, causal, block_q, block_k, interpret, res, g):
+    # dense-recompute backward: O(T²) memory only at grad time
+    q, k, v = res
+
+    def dense(q, k, v):
+        s = jax.lax.dot_general(
+            q, k, (((3,), (3,)), ((0, 1), (0, 1))),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            tq, tk = s.shape[-2], s.shape[-1]
+            cm = jnp.tril(jnp.ones((tq, tk), jnp.bool_), k=tk - tq)
+            s = jnp.where(cm, s, _NEG_INF)
+        p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+        return jax.lax.dot_general(
+            p, v, (((3,), (2,)), ((0, 1), (0, 1)))).astype(q.dtype)
+
+    _, vjp = jax.vjp(dense, q, k, v)
+    return vjp(g.astype(q.dtype))
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def supports(tq: int, tk: int, d: int,
+             mask: Optional[jnp.ndarray]) -> bool:
+    """Whether the kernel handles this problem (else caller falls back
+    to the XLA path): block-divisible sequence lengths, a head dim that
+    fits VMEM tiles, and no arbitrary mask (causal is native)."""
+    bq, bk = _pick_blocks(tq, tk)
+    return (mask is None and bq is not None and bk is not None
+            and d <= 256)
+
+
+def _pick_blocks(tq: int, tk: int):
+    # biggest wins on v5e (measured: [1024,1024] beats [256,512] by
+    # 1.2-2.2x at T=2k-8k; VMEM footprint ~6MB at D<=128)
+    bq = next((b for b in (1024, 512, 256, 128) if tq % b == 0), None)
+    bk = next((b for b in (1024, 512, 256, 128) if tk % b == 0), None)
+    return bq, bk
+
+
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                    causal: bool = False,
+                    scale: Optional[float] = None,
+                    interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Flash attention. q,k,v: (B, T, H, D) → (B, T, H, D).
+
+    Same contract as :func:`ops.attention.dot_product_attention`
+    (f32 softmax, bf16-safe); Tq/Tk must be multiples of 128.
+    `interpret=None` auto-selects the Pallas interpreter off-TPU.
+    """
+    d = q.shape[-1]
+    scale = float(scale if scale is not None else 1.0 / (d ** 0.5))
+    tq, tk = q.shape[1], k.shape[1]
+    bq, bk = _pick_blocks(tq, tk)
+    if bq is None or bk is None:
+        raise ValueError(
+            f"flash_attention needs Tq/Tk divisible by 128; got "
+            f"Tq={tq} Tk={tk} (use dot_product_attention)")
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    qt = jnp.transpose(q, (0, 2, 1, 3))      # (B, H, T, D)
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    out = _flash(qt, kt, vt, scale, causal, bq, bk, bool(interpret))
+    return jnp.transpose(out, (0, 2, 1, 3))

@@ -205,9 +205,13 @@ def shard_params_ep(params: Any, mesh: Mesh, axis: str = "expert",
 
 def collect_ep_paths(model) -> set:
     """(layer_name, param_key) pairs of expert-stacked params, from
-    each layer's ``expert_stacked_params`` declaration."""
+    each layer's ``expert_stacked_params`` declaration. Recurses into
+    nested nets (a Sequential inside a Model etc.) — the params tree
+    nests by layer name, so a leaf's path still ends with
+    (layer_name, param_key) at any depth (`models.py:93-97`)."""
     out = set()
     for lyr in getattr(model, "layers", []):
         for k in getattr(lyr, "expert_stacked_params", ()):
             out.add((lyr.name, k))
+        out |= collect_ep_paths(lyr)
     return out

@@ -60,12 +60,19 @@ class MultiHeadAttention(KerasLayer):
                  causal: bool = False, initializer_range: float = 0.02,
                  sequence_parallel_axis: Optional[str] = None,
                  sequence_parallel_mode: str = "ring",
+                 attention_impl: Optional[str] = None,
                  input_shape=None, name=None, **kwargs):
         super().__init__(input_shape=input_shape, name=name, **kwargs)
         if hidden_size % n_head:
             raise ValueError("hidden_size must divide by n_head")
         from analytics_zoo_tpu.parallel import get_sp_attention
         get_sp_attention(sequence_parallel_mode)  # validate early
+        # None → ZOO_TPU_ATTENTION env (default "xla"); "auto"/"flash"
+        # select the Pallas flash kernel (ops/flash_attention.py)
+        if attention_impl not in (None, "xla", "flash", "auto"):
+            raise ValueError(
+                f"unknown attention impl {attention_impl!r}")
+        self.attention_impl = attention_impl
         self.hidden_size = int(hidden_size)
         self.n_head = int(n_head)
         self.attn_p_drop = float(attn_p_drop)
@@ -99,7 +106,8 @@ class MultiHeadAttention(KerasLayer):
                       axis=self.sequence_parallel_axis,
                       causal=self.causal)
         return dot_product_attention(q, k, v, mask=mask,
-                                     causal=self.causal)
+                                     causal=self.causal,
+                                     impl=self.attention_impl)
 
     def call(self, params, x, *, training=False, rng=None, mask=None):
         b, t, h = x.shape
@@ -140,6 +148,7 @@ class TransformerLayer(KerasLayer):
                  embed_p_drop: float = 0.1,
                  sequence_parallel_axis: Optional[str] = None,
                  sequence_parallel_mode: str = "ring",
+                 attention_impl: Optional[str] = None,
                  input_shape=None, name=None, **kwargs):
         super().__init__(input_shape=input_shape or (seq_len,),
                          name=name, **kwargs)
@@ -148,6 +157,10 @@ class TransformerLayer(KerasLayer):
         from analytics_zoo_tpu.parallel import get_sp_attention
         get_sp_attention(sequence_parallel_mode)  # validate early
         self.sequence_parallel_mode = sequence_parallel_mode
+        if attention_impl not in (None, "xla", "flash", "auto"):
+            raise ValueError(
+                f"unknown attention impl {attention_impl!r}")
+        self.attention_impl = attention_impl
         self.n_block = int(n_block)
         self.hidden_size = int(hidden_size)
         self.n_head = int(n_head)
@@ -242,7 +255,8 @@ class TransformerLayer(KerasLayer):
                           axis=sp_axis, causal=causal)
             else:
                 attn = dot_product_attention(q, k, v, mask=mask,
-                                             causal=causal)
+                                             causal=causal,
+                                             impl=self.attention_impl)
             attn = attn.reshape(b, t, hsz)
             attn = attn @ p["attn_out_kernel"].astype(x.dtype) + \
                 p["attn_out_bias"].astype(x.dtype)

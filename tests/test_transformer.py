@@ -196,3 +196,19 @@ class TestMoE:
         # expert-stacked kernels sharded over the expert axis
         spec = est.params["moe"]["w_in"].sharding.spec
         assert "expert" in str(spec), spec
+
+    def test_ep_paths_found_in_nested_net(self):
+        # a MoE inside a nested Sequential must still be expert-sharded
+        # (collect_ep_paths recurses; regression for the review finding
+        # where nesting silently replicated the experts)
+        from analytics_zoo_tpu.parallel.mesh import collect_ep_paths
+        from analytics_zoo_tpu.pipeline.api.keras import Sequential, \
+            layers as L
+        inner = Sequential(name="inner")
+        inner.add(L.MoE(n_experts=2, hidden_dim=8, input_shape=(4, 16),
+                        name="moe_nested"))
+        m = Sequential()
+        m.add(L.Embedding(16, 16, input_shape=(4,)))
+        m.add(inner)
+        paths = collect_ep_paths(m)
+        assert ("moe_nested", "w_in") in paths, paths
