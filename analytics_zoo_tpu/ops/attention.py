@@ -30,6 +30,17 @@ import jax
 import jax.numpy as jnp
 
 
+def resolve_attention_impl(impl: Optional[str]) -> str:
+    """Resolve an attention-impl selector: None → ``ZOO_TPU_ATTENTION``
+    env (default "xla"); validates against the known impls. The single
+    copy of this policy — used by dot_product_attention, the
+    sequence-parallel attentions, and the transformer layers."""
+    impl = impl or os.environ.get("ZOO_TPU_ATTENTION", "xla")
+    if impl not in ("xla", "flash", "auto"):
+        raise ValueError(f"unknown attention impl {impl!r}")
+    return impl
+
+
 def dot_product_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                           mask: Optional[jnp.ndarray] = None,
                           causal: bool = False,
@@ -45,9 +56,7 @@ def dot_product_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     128-divisible sequence lengths). ``ZOO_TPU_ATTENTION`` sets the
     default process-wide.
     """
-    impl = impl or os.environ.get("ZOO_TPU_ATTENTION", "xla")
-    if impl not in ("xla", "flash", "auto"):
-        raise ValueError(f"unknown attention impl {impl!r}")
+    impl = resolve_attention_impl(impl)
     if impl != "xla":
         from analytics_zoo_tpu.ops import flash_attention as fa
         if fa.supports(q.shape[1], k.shape[1], q.shape[-1], mask):

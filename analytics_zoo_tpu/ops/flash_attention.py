@@ -33,10 +33,16 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                scale: float, causal: bool, block_q: int, block_k: int,
-                causal_offset: int):
-    """One (batch, head, q-block, k-block) grid step.
+def _attn_body(off, q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref, *,
+               scale: float, causal: bool, block_q: int, block_k: int):
+    """Shared init + blockwise-softmax accumulation for one
+    (batch, head, q-block, k-block) grid step — the single copy of the
+    flash recursion used by both `_fwd_kernel` and `_block_kernel`
+    (they differ only in how `off` is sourced and what the last k step
+    writes).
+
+    `off`: causal offset (int, static or traced) — end-aligned like
+    the dense reference's tril(k=Tk-Tq): query i sees keys <= i + off.
 
     Scratch (VMEM, persistent across the innermost `k` grid dim):
       acc_ref (block_q, D) f32   un-normalised output accumulator
@@ -44,7 +50,6 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
       l_ref   (block_q, 128) f32 running softmax denominator
     """
     ki = pl.program_id(3)
-    nk = pl.num_programs(3)
 
     @pl.when(ki == 0)
     def _init():
@@ -53,13 +58,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         l_ref[:] = jnp.zeros_like(l_ref)
 
     qi = pl.program_id(2)
-    # causal (end-aligned like the dense reference's tril(k=Tk-Tq):
-    # query i sees keys <= i + causal_offset): the whole k-block is
-    # masked iff its first key position exceeds the q-block's last
-    # query position — skip it entirely
+    # the whole k-block is masked iff its first key position exceeds
+    # the q-block's last query position — skip it entirely
     run = (ki * block_k <=
-           qi * block_q + (block_q - 1) + causal_offset) if causal \
-        else (ki >= 0)
+           qi * block_q + (block_q - 1) + off) if causal else (ki >= 0)
 
     @pl.when(run)
     def _step():
@@ -74,7 +76,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
                 jnp.int32, (block_q, block_k), 0)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos + causal_offset >= k_pos, s, _NEG_INF)
+            s = jnp.where(q_pos + off >= k_pos, s, _NEG_INF)
 
         m_prev = m_ref[:, :1]                # (block_q, 1)
         l_prev = l_ref[:, :1]
@@ -90,7 +92,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
 
-    @pl.when(ki == nk - 1)
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                scale: float, causal: bool, block_q: int, block_k: int,
+                causal_offset: int):
+    """Self-contained flash forward: normalised output, static offset."""
+    _attn_body(causal_offset, q_ref, k_ref, v_ref, acc_ref, m_ref,
+               l_ref, scale=scale, causal=causal, block_q=block_q,
+               block_k=block_k)
+
+    @pl.when(pl.program_id(3) == pl.num_programs(3) - 1)
     def _final():
         l = l_ref[:, :1]
         o_ref[0, 0] = (acc_ref[:] /
@@ -161,6 +172,86 @@ def _flash_vjp_bwd(scale, causal, block_q, block_k, interpret, res, g):
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def _block_kernel(off_ref, q_ref, k_ref, v_ref,
+                  o_ref, m_out_ref, l_out_ref,
+                  acc_ref, m_ref, l_ref, *,
+                  scale: float, causal: bool,
+                  block_q: int, block_k: int):
+    """Partial-softmax block attention: same recursion as
+    `_fwd_kernel` (via `_attn_body`) but emits the UNNORMALISED
+    accumulator plus running (m, l) statistics, so a caller (ring
+    attention) can merge several K/V blocks' partials.
+    `off_ref` (SMEM, (1,1) int32) holds the global causal offset
+    q_global_start - k_global_start, which is traced (it depends on
+    `lax.axis_index` inside shard_map) and therefore can't be a Python
+    static like `_fwd_kernel`'s causal_offset."""
+    _attn_body(off_ref[0, 0], q_ref, k_ref, v_ref, acc_ref, m_ref,
+               l_ref, scale=scale, causal=causal, block_q=block_q,
+               block_k=block_k)
+
+    @pl.when(pl.program_id(3) == pl.num_programs(3) - 1)
+    def _final():
+        o_ref[0, 0] = acc_ref[:]
+        m_out_ref[0, 0] = m_ref[:, 0]
+        l_out_ref[0, 0] = l_ref[:, 0]
+
+
+def flash_block_partial(q, k, v, qk_offset, causal: bool, scale: float,
+                        interpret: Optional[bool] = None):
+    """One flash pass over a K/V block, returning partials for
+    cross-block merging (the ring-attention inner op).
+
+    q, k, v: (B, Tq, H, D) / (B, Tk, H, D); `qk_offset` a traced int32
+    scalar = q_global_start - k_global_start (causal only). Returns
+    (acc (B, Tq, H, D) f32 unnormalised, m (B, H, Tq) f32,
+    l (B, H, Tq) f32) with softmax base `m`.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    bq, bk = _pick_blocks(tq, tk)
+    qt = jnp.transpose(q, (0, 2, 1, 3))
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    off = jnp.asarray(qk_offset, jnp.int32).reshape(1, 1)
+    kernel = functools.partial(_block_kernel, scale=scale,
+                               causal=causal, block_q=bq, block_k=bk)
+    blk = lambda bs, im: pl.BlockSpec((1, 1, bs, d), im)
+    acc, m, l = pl.pallas_call(
+        kernel,
+        grid=(b, h, tq // bq, tk // bk),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            blk(bq, lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            blk(bk, lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+            blk(bk, lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+        ],
+        out_specs=[
+            blk(bq, lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq),
+                         lambda bi, hi, qi, ki: (bi, hi, qi)),
+            pl.BlockSpec((1, 1, bq),
+                         lambda bi, hi, qi, ki: (bi, hi, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, tq, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, tq), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, tq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(off, qt, kt, vt)
+    return jnp.transpose(acc, (0, 2, 1, 3)), m, l
 
 
 def supports(tq: int, tk: int, d: int,

@@ -25,8 +25,16 @@ from analytics_zoo_tpu.ops.attention import _flash_block_update
 
 
 def _ring_attention_local(q, k, v, axis_name: str, causal: bool,
-                          scale: Optional[float]):
-    """Inside-shard_map body. q,k,v: (B, T_loc, H, D) local blocks."""
+                          scale: Optional[float],
+                          use_flash: bool = False):
+    """Inside-shard_map body. q,k,v: (B, T_loc, H, D) local blocks.
+
+    ``use_flash``: compute each ring step's block with the Pallas
+    partial-softmax kernel (`ops.flash_attention.flash_block_partial`)
+    — the O(T_loc²) logits stay in VMEM — and merge the returned
+    (acc, m, l) partials into the running statistics. Numerically the
+    same blockwise-softmax recursion as the jnp path.
+    """
     n = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     b, t_loc, h, d = q.shape
@@ -38,13 +46,27 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool,
     def step(t, carry):
         o_acc, m, l, k_blk, v_blk = carry
         src = (my_idx - t) % n                           # block origin
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk) \
-            .astype(jnp.float32) * scale
-        if causal:
-            k_pos = src * t_loc + local_pos
-            mask = q_pos[:, None] >= k_pos[None, :]      # (Tq, Tk)
-            s = jnp.where(mask[None, None], s, -1e30)
-        o_acc, m, l = _flash_block_update((o_acc, m, l), s, v_blk)
+        if use_flash:
+            from analytics_zoo_tpu.ops.flash_attention import \
+                flash_block_partial
+            acc_b, m_b, l_b = flash_block_partial(
+                q, k_blk, v_blk, (my_idx - src) * t_loc,
+                causal=causal, scale=scale)
+            m_new = jnp.maximum(m, m_b)
+            a1 = jnp.exp(m - m_new)
+            a2 = jnp.exp(m_b - m_new)
+            l = l * a1 + l_b * a2
+            o_acc = o_acc * a1.transpose(0, 2, 1)[..., None] + \
+                acc_b * a2.transpose(0, 2, 1)[..., None]
+            m = m_new
+        else:
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk) \
+                .astype(jnp.float32) * scale
+            if causal:
+                k_pos = src * t_loc + local_pos
+                mask = q_pos[:, None] >= k_pos[None, :]  # (Tq, Tk)
+                s = jnp.where(mask[None, None], s, -1e30)
+            o_acc, m, l = _flash_block_update((o_acc, m, l), s, v_blk)
         # rotate K/V to the next device on the ring (skip after last)
         perm = [(j, (j + 1) % n) for j in range(n)]
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
@@ -59,20 +81,72 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool,
     return (o / jnp.maximum(denom, 1e-30)).astype(q.dtype)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_local_flash(q, k, v, axis_name, causal, scale):
+    return _ring_attention_local(q, k, v, axis_name, causal, scale,
+                                 use_flash=True)
+
+
+def _ring_local_flash_fwd(q, k, v, axis_name, causal, scale):
+    return _ring_local_flash(q, k, v, axis_name, causal, scale), \
+        (q, k, v)
+
+
+def _ring_local_flash_bwd(axis_name, causal, scale, res, g):
+    # backward recomputes via the differentiable jnp ring path (the
+    # Pallas block kernel has no VJP); same recursion ⇒ same gradient.
+    # NOTE: the replayed forward repeats the ring's ppermute rotations,
+    # so grad steps pay the ICI communication twice; saving (m, l) as
+    # residuals to skip the replay's softmax passes is a known lever
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: _ring_attention_local(
+            q, k, v, axis_name, causal, scale, use_flash=False),
+        q, k, v)
+    return vjp(g)
+
+
+_ring_local_flash.defvjp(_ring_local_flash_fwd, _ring_local_flash_bwd)
+
+
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    mesh: Mesh, axis: str = "seq",
                    causal: bool = False,
-                   scale: Optional[float] = None) -> jnp.ndarray:
+                   scale: Optional[float] = None,
+                   impl: Optional[str] = None) -> jnp.ndarray:
     """Sequence-parallel attention. q,k,v: (B, T, H, D) with T sharded
     over `axis`; returns (B, T, H, D) sharded the same way. Falls back
-    to a single-block computation when the axis is absent or size 1."""
+    to a single-block computation when the axis is absent or size 1.
+
+    `impl`: "xla" (jnp blockwise softmax, default), "flash" (Pallas
+    partial-softmax kernel per ring step; needs 128-divisible local T),
+    or "auto"; default from ``ZOO_TPU_ATTENTION`` like
+    `ops.attention.dot_product_attention`.
+    """
+    from analytics_zoo_tpu.ops.attention import resolve_attention_impl
+    impl = resolve_attention_impl(impl)
     if axis not in mesh.axis_names or mesh.shape[axis] == 1:
         from analytics_zoo_tpu.ops.attention import dot_product_attention
-        return dot_product_attention(q, k, v, causal=causal, scale=scale)
+        return dot_product_attention(q, k, v, causal=causal, scale=scale,
+                                     impl=impl)
+    n = mesh.shape[axis]
+    t_loc = q.shape[1] // n
+    use_flash = impl != "xla" and t_loc % 128 == 0 and \
+        q.shape[-1] <= 256
+    if impl == "flash" and not use_flash:
+        raise ValueError(
+            f"impl='flash' needs local T (={t_loc}) divisible by 128 "
+            f"and head dim <= 256")
+    scale_v = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
     spec = P(None, axis, None, None)
-    fn = jax.shard_map(
-        functools.partial(_ring_attention_local, axis_name=axis,
-                          causal=causal, scale=scale),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
+    if use_flash:
+        # positional call: custom_vjp nondiff_argnums are positional
+        def local(q, k, v):
+            return _ring_local_flash(q, k, v, axis, causal,
+                                     float(scale_v))
+    else:
+        local = functools.partial(_ring_attention_local, axis_name=axis,
+                                  causal=causal, scale=scale)
+    fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
     return fn(q, k, v)

@@ -27,7 +27,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 
 def _ulysses_local(q, k, v, axis_name: str, causal: bool,
-                   scale: Optional[float]):
+                   scale: Optional[float], impl: str):
     """Inside-shard_map body. q,k,v: (B, T_loc, H, D) local blocks."""
     from analytics_zoo_tpu.ops.attention import dot_product_attention
 
@@ -41,21 +41,31 @@ def _ulysses_local(q, k, v, axis_name: str, causal: bool,
                                   concat_axis=2, tiled=True)
 
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
-    out = dot_product_attention(qh, kh, vh, causal=causal, scale=scale)
+    out = dot_product_attention(qh, kh, vh, causal=causal, scale=scale,
+                                impl=impl)
     return to_seq(out)
 
 
 def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                       mesh: Mesh, axis: str = "seq",
                       causal: bool = False,
-                      scale: Optional[float] = None) -> jnp.ndarray:
+                      scale: Optional[float] = None,
+                      impl: Optional[str] = None) -> jnp.ndarray:
     """Sequence-parallel attention via head all-to-all. q,k,v:
     (B, T, H, D) with T sharded over ``axis``; returns the same
     layout. Requires ``H % mesh.shape[axis] == 0``; falls back to a
-    plain single-block computation when the axis is absent or 1."""
+    plain single-block computation when the axis is absent or 1.
+
+    `impl`: passed through to the local per-device
+    `dot_product_attention` after the head all-to-all ("flash" runs
+    the Pallas kernel over the full sequence).
+    """
+    from analytics_zoo_tpu.ops.attention import resolve_attention_impl
+    impl = resolve_attention_impl(impl)
     if axis not in mesh.axis_names or mesh.shape[axis] == 1:
         from analytics_zoo_tpu.ops.attention import dot_product_attention
-        return dot_product_attention(q, k, v, causal=causal, scale=scale)
+        return dot_product_attention(q, k, v, causal=causal, scale=scale,
+                                     impl=impl)
     n = mesh.shape[axis]
     heads = q.shape[2]
     if heads % n != 0:
@@ -66,7 +76,7 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     spec = P(None, axis, None, None)
     fn = jax.shard_map(
         functools.partial(_ulysses_local, axis_name=axis,
-                          causal=causal, scale=scale),
+                          causal=causal, scale=scale, impl=impl),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
     return fn(q, k, v)

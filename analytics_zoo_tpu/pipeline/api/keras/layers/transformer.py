@@ -27,7 +27,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from analytics_zoo_tpu.ops.attention import dot_product_attention
+from analytics_zoo_tpu.ops.attention import (dot_product_attention,
+                                             resolve_attention_impl)
 from analytics_zoo_tpu.pipeline.api.keras.engine import (
     KerasLayer, Shape, ShapeLike)
 
@@ -69,9 +70,8 @@ class MultiHeadAttention(KerasLayer):
         get_sp_attention(sequence_parallel_mode)  # validate early
         # None → ZOO_TPU_ATTENTION env (default "xla"); "auto"/"flash"
         # select the Pallas flash kernel (ops/flash_attention.py)
-        if attention_impl not in (None, "xla", "flash", "auto"):
-            raise ValueError(
-                f"unknown attention impl {attention_impl!r}")
+        if attention_impl is not None:
+            resolve_attention_impl(attention_impl)  # validate early
         self.attention_impl = attention_impl
         self.hidden_size = int(hidden_size)
         self.n_head = int(n_head)
@@ -104,7 +104,7 @@ class MultiHeadAttention(KerasLayer):
             sp = get_sp_attention(self.sequence_parallel_mode)
             return sp(q, k, v, get_nncontext().mesh,
                       axis=self.sequence_parallel_axis,
-                      causal=self.causal)
+                      causal=self.causal, impl=self.attention_impl)
         return dot_product_attention(q, k, v, mask=mask,
                                      causal=self.causal,
                                      impl=self.attention_impl)
@@ -157,9 +157,8 @@ class TransformerLayer(KerasLayer):
         from analytics_zoo_tpu.parallel import get_sp_attention
         get_sp_attention(sequence_parallel_mode)  # validate early
         self.sequence_parallel_mode = sequence_parallel_mode
-        if attention_impl not in (None, "xla", "flash", "auto"):
-            raise ValueError(
-                f"unknown attention impl {attention_impl!r}")
+        if attention_impl is not None:
+            resolve_attention_impl(attention_impl)  # validate early
         self.attention_impl = attention_impl
         self.n_block = int(n_block)
         self.hidden_size = int(hidden_size)
@@ -252,7 +251,8 @@ class TransformerLayer(KerasLayer):
                 from analytics_zoo_tpu.parallel import get_sp_attention
                 sp = get_sp_attention(self.sequence_parallel_mode)
                 attn = sp(q, k, v, get_nncontext().mesh,
-                          axis=sp_axis, causal=causal)
+                          axis=sp_axis, causal=causal,
+                          impl=self.attention_impl)
             else:
                 attn = dot_product_attention(q, k, v, mask=mask,
                                              causal=causal,
