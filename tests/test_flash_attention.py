@@ -113,3 +113,58 @@ def test_under_jit_and_vmapless_batch():
     ref = dot_product_attention(q, k, v, causal=True, impl='xla')
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
+
+
+def test_grad_causal_cross_attention():
+    # Pallas backward must respect the end-aligned causal offset too
+    rs = np.random.RandomState(5)
+    q = jnp.asarray(rs.randn(1, 128, 2, 32) * 0.5, jnp.float32)
+    k = jnp.asarray(rs.randn(1, 384, 2, 32) * 0.5, jnp.float32)
+    v = jnp.asarray(rs.randn(1, 384, 2, 32) * 0.5, jnp.float32)
+
+    def loss(att):
+        return lambda q, k, v: jnp.sum(att(q, k, v) ** 2)
+
+    gf = jax.grad(loss(lambda q, k, v: flash_attention(
+        q, k, v, causal=True)), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss(lambda q, k, v: dot_product_attention(
+        q, k, v, causal=True, impl='xla')), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=2e-4)
+
+
+def test_grad_bf16():
+    q, k, v = _qkv(t=128, dtype=jnp.bfloat16)
+    g = jax.grad(lambda q, k, v: jnp.sum(
+        flash_attention(q, k, v, causal=True).astype(jnp.float32) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: jnp.sum(
+        dot_product_attention(q, k, v, causal=True,
+                              impl='xla').astype(jnp.float32) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        assert a.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            atol=0.15, rtol=0.15)
+
+
+def test_grad_causal_tq_gt_tk_masked_rows():
+    # Tq > Tk causal: queries 0..Tq-Tk-1 are fully masked. Their
+    # recomputed p must be the forward's uniform 1/l, not 1 — the
+    # fused lse = m + log(l) absorbed log(l) at m=-1e30 and overscaled
+    # dv by Tk (review-confirmed, dv err up to 56 before the fix)
+    rs = np.random.RandomState(7)
+    q = jnp.asarray(rs.randn(1, 1024, 2, 32) * 0.5, jnp.float32)
+    k = jnp.asarray(rs.randn(1, 512, 2, 32) * 0.5, jnp.float32)
+    v = jnp.asarray(rs.randn(1, 512, 2, 32) * 0.5, jnp.float32)
+
+    gf = jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+        q, k, v, causal=True) ** 2), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: jnp.sum(dot_product_attention(
+        q, k, v, causal=True, impl='xla') ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4)
