@@ -18,4 +18,5 @@ EXAMPLES = [
     "distributed_training",
     "rdd_ingest",
     "quantized_serving",
+    "long_context",
 ]

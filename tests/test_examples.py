@@ -92,3 +92,8 @@ def test_quantized_serving():
     result = _run("quantized_serving", ["--n", "128", "--epochs", "2"])
     assert result["agreement"] >= 0.95
     assert result["kernel_bytes_f32"] > 2 * result["kernel_bytes_int8"]
+
+
+def test_long_context():
+    # small T so the Pallas-interpret flash path stays fast on CPU
+    _run("long_context", ["--seq-len", "1024"])
