@@ -65,10 +65,14 @@ class InferenceModel:
 
     def load_keras_net(self, net, params=None,
                        example_inputs: Optional[Sequence] = None,
-                       quantize: bool = False):
-        """Serve an in-memory KerasNet; ``quantize=True`` swaps
-        Dense/Conv kernels for int8 (MXU 8-bit path) calibrated on
-        ``example_inputs``."""
+                       quantize: bool = False,
+                       quantize_types: Optional[Sequence[str]] = None):
+        """Serve an in-memory KerasNet; ``quantize=True`` swaps Dense
+        kernels for int8 (MXU 8-bit path) calibrated on
+        ``example_inputs``. ``quantize_types`` widens the layer set
+        (e.g. ``("Dense", "Convolution2D")`` — conv int8 is measured
+        slower than bf16 on v5e but 4x smaller; see
+        `inference/quantize.py`)."""
         if params is None:
             est = net.estimator
             if est.params is None:
@@ -82,8 +86,10 @@ class InferenceModel:
                     "activation-scale calibration")
             from analytics_zoo_tpu.pipeline.inference.quantize import \
                 QuantizedModel
+            kw = {} if quantize_types is None else \
+                {"quantize_types": tuple(quantize_types)}
             qm = QuantizedModel(net, params,
-                                np.asarray(example_inputs[0]))
+                                np.asarray(example_inputs[0]), **kw)
             self.quantized = qm
 
             def predict_fn(*xs):
