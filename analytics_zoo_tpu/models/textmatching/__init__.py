@@ -1,3 +1,5 @@
 from analytics_zoo_tpu.models.textmatching.knrm import KNRM
+from analytics_zoo_tpu.models.textmatching.text_matcher import \
+    TextMatcher
 
-__all__ = ["KNRM"]
+__all__ = ["KNRM", "TextMatcher"]

@@ -17,7 +17,7 @@ from typing import Optional
 
 import numpy as np
 
-from analytics_zoo_tpu.models.common import Ranker, ZooModel
+from analytics_zoo_tpu.models.textmatching.text_matcher import TextMatcher
 from analytics_zoo_tpu.pipeline.api import autograd as A
 from analytics_zoo_tpu.pipeline.api.keras.engine import Input
 from analytics_zoo_tpu.pipeline.api.keras.models import Model
@@ -25,28 +25,24 @@ from analytics_zoo_tpu.pipeline.api.keras.layers import (
     Dense, Embedding, WordEmbedding)
 
 
-class KNRM(ZooModel, Ranker):
+class KNRM(TextMatcher):
     def __init__(self, text1_length: int, text2_length: int,
                  vocab_size: int, embed_size: int = 300,
                  embed_weights: Optional[np.ndarray] = None,
                  train_embed: bool = True, kernel_num: int = 21,
                  sigma: float = 0.1, exact_sigma: float = 0.001,
                  target_mode: str = "ranking"):
-        super().__init__()
+        super().__init__(text1_length, vocab_size,
+                         embed_size=embed_size,
+                         embed_weights=embed_weights,
+                         train_embed=train_embed,
+                         target_mode=target_mode)
         if kernel_num <= 1:
             raise ValueError("kernel_num must be > 1")
-        if target_mode not in ("ranking", "classification"):
-            raise ValueError("target_mode must be ranking|classification")
-        self.text1_length = int(text1_length)
         self.text2_length = int(text2_length)
-        self.vocab_size = int(vocab_size)
-        self.embed_size = int(embed_size)
-        self.embed_weights = embed_weights
-        self.train_embed = bool(train_embed)
         self.kernel_num = int(kernel_num)
         self.sigma = float(sigma)
         self.exact_sigma = float(exact_sigma)
-        self.target_mode = target_mode
 
     def hyper_parameters(self):
         return {"text1_length": self.text1_length,

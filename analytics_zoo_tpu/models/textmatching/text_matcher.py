@@ -1,0 +1,42 @@
+"""TextMatcher — base class for text-matching models (reference
+`P/models/textmatching/text_matcher.py:24-47`,
+`Z/models/textmatching/TextMatcher.scala`).
+
+Holds the shared text-matching hyperparameters (query length, vocab,
+embedding config, ranking-vs-classification target) and the Ranker
+NDCG/MAP evaluation; concrete models (KNRM) build their graph on top.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from analytics_zoo_tpu.models.common import Ranker, ZooModel
+
+
+class TextMatcher(ZooModel, Ranker):
+    """Base for text matchers scoring (text1, text2) pairs.
+
+    ``target_mode``: "ranking" (pairwise rank-hinge training over
+    alternating positive/negative rows) or "classification" (sigmoid
+    relevance probability) — the reference's two training regimes.
+    """
+
+    def __init__(self, text1_length: int, vocab_size: int,
+                 embed_size: int = 300,
+                 embed_weights: Optional[np.ndarray] = None,
+                 train_embed: bool = True,
+                 target_mode: str = "ranking"):
+        super().__init__()
+        if target_mode not in ("ranking", "classification"):
+            raise ValueError(
+                "target_mode must be ranking|classification, got "
+                f"{target_mode!r}")
+        self.text1_length = int(text1_length)
+        self.vocab_size = int(vocab_size)
+        self.embed_size = int(embed_size)
+        self.embed_weights = embed_weights
+        self.train_embed = bool(train_embed)
+        self.target_mode = target_mode

@@ -1,0 +1,24 @@
+"""Keras-style dataset loaders (reference:
+`pyzoo/zoo/pipeline/api/keras/datasets/{mnist,imdb,reuters,
+boston_housing}.py`).
+
+TPU-first redesign: the reference's loaders download from public
+mirrors via `bigdl.dataset.base.maybe_download`. TPU pods commonly run
+with no egress, so each loader here resolves in order:
+
+1. a local cache file in ``dest_dir`` (the SAME on-disk formats the
+   reference caches: MNIST idx-gzip, ``boston_housing.npz``,
+   pickled/npz index sequences) — drop files in place and they are
+   used;
+2. otherwise a small deterministic synthetic dataset with the real
+   shapes/dtypes/label ranges (seeded; clearly logged) so examples and
+   tests run offline.
+
+Every ``load_data`` returns ``(x_train, y_train), (x_test, y_test)``
+with the reference's dtypes.
+"""
+
+from analytics_zoo_tpu.pipeline.api.keras.datasets import (  # noqa: F401
+    boston_housing, imdb, mnist, reuters)
+
+__all__ = ["mnist", "imdb", "reuters", "boston_housing"]

@@ -1,0 +1,45 @@
+"""Reuters newswire topic loader (reference
+`P/pipeline/api/keras/datasets/reuters.py`).
+
+Reads a cached ``reuters.npz``/``reuters.pkl`` when present, else a
+seeded synthetic stand-in with the dataset's 46 topic classes.
+``test_split`` partitions the training set like the reference
+(`reuters.py:40-78`).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from analytics_zoo_tpu.common.safe_pickle import CheckedUnpickler
+from analytics_zoo_tpu.pipeline.api.keras.datasets._base import (
+    DEFAULT_DIR, apply_nb_words, cache_path, synthetic_notice,
+    synthetic_sequences)
+
+_VOCAB = 30980
+_CLASSES = 46
+
+
+def load_data(dest_dir=DEFAULT_DIR, nb_words=None, oov_char=2,
+              test_split=0.2):
+    npz = cache_path(dest_dir, "reuters.npz")
+    pkl = cache_path(dest_dir, "reuters.pkl")
+    if os.path.exists(npz):
+        with np.load(npz, allow_pickle=True) as f:
+            xs, ys = list(f["x"]), list(f["y"])
+    elif os.path.exists(pkl):
+        with open(pkl, "rb") as f:
+            xs, ys = CheckedUnpickler(f).load()
+    else:
+        synthetic_notice("reuters", f"no cache at {npz}")
+        xs = synthetic_sequences(640, _VOCAB, seed=20, mean_len=80)
+        ys = list(np.random.RandomState(21).randint(
+            0, _CLASSES, size=len(xs)))
+    xs = apply_nb_words(xs, nb_words, oov_char)
+    n_test = int(len(xs) * test_split)
+    x_train, y_train = xs[n_test:], ys[n_test:]
+    x_test, y_test = xs[:n_test], ys[:n_test]
+    return (x_train, y_train), (x_test, y_test)

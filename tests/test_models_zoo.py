@@ -285,3 +285,56 @@ class TestPretrainedRegistry:
         assert len(names) >= 1
         m = ObjectDetectionConfig.create(names[0])
         assert m.model_name == names[0]
+
+
+def test_text_matcher_base():
+    # TextMatcher base (reference P/models/textmatching/text_matcher.py)
+    from analytics_zoo_tpu.models.textmatching import KNRM, TextMatcher
+    m = KNRM(text1_length=4, text2_length=6, vocab_size=50,
+             embed_size=8)
+    assert isinstance(m, TextMatcher)
+    import pytest
+    with pytest.raises(ValueError):
+        TextMatcher(4, 50, target_mode="regression")
+
+
+def test_keras_datasets_offline():
+    # offline synthetic fallbacks keep the reference load_data contract
+    from analytics_zoo_tpu.pipeline.api.keras.datasets import (
+        boston_housing, imdb, mnist, reuters)
+    (xm, ym), (xmt, ymt) = mnist.load_data("/nonexistent/mnist")
+    assert xm.dtype == np.uint8 and xm.shape[1:] == (28, 28, 1)
+    assert ym.ndim == 1 and ym.max() <= 9
+    (xi, yi), _ = imdb.load_data("/nonexistent", nb_words=100,
+                                 oov_char=2)
+    assert max(max(s) for s in xi) < 100
+    assert set(yi) <= {0, 1}
+    (xr, yr), (xrt, yrt) = reuters.load_data("/nonexistent",
+                                             test_split=0.25)
+    assert len(xrt) == int((len(xr) + len(xrt)) * 0.25)
+    assert 0 <= min(yr) and max(yr) < 46
+    (xb, yb), (xbt, ybt) = boston_housing.load_data(
+        dest_dir="/nonexistent")
+    assert xb.shape[1] == 13 and len(xbt) == int(506 * 0.2)
+    # deterministic across calls
+    (xb2, _), _ = boston_housing.load_data(dest_dir="/nonexistent")
+    np.testing.assert_array_equal(xb, xb2)
+
+
+def test_mnist_idx_roundtrip(tmp_path):
+    # loader reads the REAL idx-gzip format when cache files exist
+    import gzip
+    import struct
+    from analytics_zoo_tpu.pipeline.api.keras.datasets import mnist
+    rs = np.random.RandomState(0)
+    imgs = rs.randint(0, 255, size=(4, 28, 28, 1)).astype(np.uint8)
+    lbls = np.arange(4).astype(np.uint8)
+    with gzip.open(tmp_path / "train-images-idx3-ubyte.gz", "wb") as f:
+        f.write(struct.pack(">IIII", 2051, 4, 28, 28))
+        f.write(imgs.tobytes())
+    with gzip.open(tmp_path / "train-labels-idx1-ubyte.gz", "wb") as f:
+        f.write(struct.pack(">II", 2049, 4))
+        f.write(lbls.tobytes())
+    x, y = mnist.read_data_sets(str(tmp_path), "train")
+    np.testing.assert_array_equal(x, imgs)
+    np.testing.assert_array_equal(y, lbls)
