@@ -19,4 +19,6 @@ EXAMPLES = [
     "rdd_ingest",
     "quantized_serving",
     "long_context",
+    "autograd_custom",
+    "qa_ranker",
 ]

@@ -1,0 +1,104 @@
+"""QA ranking example (reference `P/examples/qaranker/qa_ranker.py`):
+question/answer corpora flow through the TextSet pipeline
+(tokenize → normalize → word2idx → shape_sequence), relations become
+alternating positive/negative training pairs, KNRM trains with
+`rank_hinge`, and NDCG@3/5 + MAP are evaluated on relation lists.
+
+Runs on a tiny synthetic QA corpus by default; pass ``--data-path``
+with ``question_corpus.csv`` / ``answer_corpus.csv`` /
+``relation_train.csv`` / ``relation_valid.csv`` (the reference's
+layout) to use real data.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+
+def _synthetic_corpus(tmpdir):
+    """WikiQA-shaped toy data: each question has one on-topic answer
+    (shared keyword) and off-topic distractors."""
+    topics = ["rain", "sun", "moon", "wind", "snow", "fire", "tree",
+              "fish"]
+    qs, ans, rel_train, rel_valid = [], [], [], []
+    for i, t in enumerate(topics):
+        qs.append((f"q{i}", f"what causes {t} to appear"))
+        ans.append((f"a{i}p", f"the {t} appears because of {t} physics"))
+        ans.append((f"a{i}n", "unrelated text about something else"))
+        dst = rel_train if i < 6 else rel_valid
+        dst.append((f"q{i}", f"a{i}p", 1))
+        dst.append((f"q{i}", f"a{i}n", 0))
+    def write(name, rows, header):
+        path = os.path.join(tmpdir, name)
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(header + "\n")
+            for r in rows:
+                f.write(",".join(str(c) for c in r) + "\n")
+        return path
+    write("question_corpus.csv", qs, "id,text")
+    write("answer_corpus.csv", ans, "id,text")
+    write("relation_train.csv", rel_train, "id1,id2,label")
+    write("relation_valid.csv", rel_valid, "id1,id2,label")
+    return tmpdir
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--data-path", default=None)
+    p.add_argument("--question-length", type=int, default=10)
+    p.add_argument("--answer-length", type=int, default=40)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--nb-epoch", type=int, default=3)
+    p.add_argument("--learning-rate", type=float, default=1e-2)
+    args = p.parse_args(argv)
+
+    from analytics_zoo_tpu import init_nncontext
+    from analytics_zoo_tpu.feature.text import Relations, TextSet
+    from analytics_zoo_tpu.models.textmatching import KNRM
+    from analytics_zoo_tpu.ops.optimizers import Adam
+
+    init_nncontext()
+    data = args.data_path
+    if data is None:
+        import tempfile
+        data = _synthetic_corpus(tempfile.mkdtemp(prefix="qaranker_"))
+
+    q_set = TextSet.read_csv(os.path.join(data, "question_corpus.csv")) \
+        .tokenize().normalize().word2idx(min_freq=1) \
+        .shape_sequence(args.question_length)
+    a_set = TextSet.read_csv(os.path.join(data, "answer_corpus.csv")) \
+        .tokenize().normalize() \
+        .word2idx(min_freq=1, existing_map=q_set.get_word_index()) \
+        .shape_sequence(args.answer_length)
+    vocab = max(a_set.get_word_index().values()) + 1
+
+    train_rel = Relations.read(os.path.join(data, "relation_train.csv"))
+    x1, x2 = TextSet.from_relation_pairs(train_rel, q_set, a_set, seed=0)
+    x = np.concatenate([x1, x2], axis=1).astype(np.float32)
+    y = np.zeros((x.shape[0], 1), np.float32)  # ignored by rank_hinge
+
+    knrm = KNRM(args.question_length, args.answer_length, vocab,
+                embed_size=16, kernel_num=5)
+    knrm.compile(optimizer=Adam(lr=args.learning_rate),
+                 loss="rank_hinge")
+    knrm.fit(x, y, batch_size=args.batch_size, nb_epoch=args.nb_epoch)
+
+    valid_rel = Relations.read(os.path.join(data, "relation_valid.csv"))
+    l1, l2, labels, gids = TextSet.from_relation_lists(
+        valid_rel, q_set, a_set)
+    xv = np.concatenate([l1, l2], axis=1).astype(np.float32)
+    scores = knrm.predict(xv, batch_size=args.batch_size).reshape(-1)
+    metrics = {
+        "ndcg@3": knrm.evaluate_ndcg(scores, labels, gids, k=3),
+        "ndcg@5": knrm.evaluate_ndcg(scores, labels, gids, k=5),
+        "map": knrm.evaluate_map(scores, labels, gids),
+    }
+    print("qa_ranker:", {k: round(v, 4) for k, v in metrics.items()})
+    return metrics
+
+
+if __name__ == "__main__":
+    main()

@@ -244,6 +244,39 @@ class KerasNet(KerasLayer):
         est._train_step = None
         return self
 
+    def get_weights(self) -> "list[np.ndarray]":
+        """Flat list of weight arrays in deterministic (sorted-path)
+        order — the reference's `getWeights` (`Topology.scala`/
+        `KerasNet.get_weights`). Pair with :meth:`set_weights`."""
+        est = self.estimator
+        if est.params is None:
+            est._ensure_initialized()
+        return [np.asarray(leaf)
+                for _, leaf in jax.tree_util.tree_leaves_with_path(
+                    est.params)]
+
+    def set_weights(self, weights: "list[np.ndarray]"):
+        """Inverse of :meth:`get_weights` (shape-checked)."""
+        import jax.tree_util as jtu
+        est = self.estimator
+        if est.params is None:
+            est._ensure_initialized()
+        leaves = jtu.tree_leaves(est.params)
+        if len(weights) != len(leaves):
+            raise ValueError(
+                f"expected {len(leaves)} arrays, got {len(weights)}")
+        new = []
+        for cur, w in zip(leaves, weights):
+            w = np.asarray(w)
+            if tuple(w.shape) != tuple(cur.shape):
+                raise ValueError(
+                    f"shape mismatch: model {cur.shape} vs {w.shape}")
+            new.append(w.astype(cur.dtype))
+        est.params = jax.device_put(jtu.tree_unflatten(
+            jtu.tree_structure(est.params), new))
+        est._train_step = None
+        return self
+
     # -- introspection ------------------------------------------------------
     def summary(self, params: Optional[dict] = None,
                 line_length: int = 76) -> str:

@@ -97,3 +97,16 @@ def test_quantized_serving():
 def test_long_context():
     # small T so the Pallas-interpret flash path stays fast on CPU
     _run("long_context", ["--seq-len", "1024"])
+
+
+def test_autograd_custom():
+    result = _run("autograd_custom", ["--n", "256", "--epochs", "40"])
+    # mae shrinks and weights head toward [2, 2]
+    assert result["mae"] < 0.2, result
+
+
+def test_qa_ranker():
+    metrics = _run("qa_ranker", ["--nb-epoch", "2",
+                                 "--answer-length", "12"])
+    for k in ("ndcg@3", "ndcg@5", "map"):
+        assert 0.0 <= metrics[k] <= 1.0

@@ -366,3 +366,25 @@ class TestTensorParallel:
         m.add(L.Dense(2, input_shape=(4,)))
         with pytest.raises(ValueError):
             Estimator(m, parallel_mode="pp")
+
+
+def test_get_set_weights_roundtrip(rng):
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential, \
+        layers as L
+    m = Sequential()
+    m.add(L.Dense(4, input_shape=(3,)))
+    m.add(L.Dense(2))
+    m.compile(optimizer="sgd", loss="mse")
+    x = rng.randn(8, 3).astype(np.float32)
+    ref = m.predict(x)
+    ws = m.get_weights()
+    assert all(isinstance(w, np.ndarray) for w in ws)
+    m2 = Sequential()
+    m2.add(L.Dense(4, input_shape=(3,)))
+    m2.add(L.Dense(2))
+    m2.compile(optimizer="sgd", loss="mse")
+    m2.set_weights(ws)
+    np.testing.assert_allclose(m2.predict(x), ref, atol=1e-6)
+    import pytest
+    with pytest.raises(ValueError):
+        m2.set_weights(ws[:-1])
