@@ -21,4 +21,6 @@ EXAMPLES = [
     "long_context",
     "autograd_custom",
     "qa_ranker",
+    "transformer_sentiment",
+    "image_classification",
 ]

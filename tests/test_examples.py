@@ -110,3 +110,21 @@ def test_qa_ranker():
                                  "--answer-length", "12"])
     for k in ("ndcg@3", "ndcg@5", "map"):
         assert 0.0 <= metrics[k] <= 1.0
+
+
+def test_transformer_sentiment():
+    metrics = _run("transformer_sentiment",
+                   ["--max-len", "16", "--n-train", "64",
+                    "--hidden-size", "16", "--n-head", "2",
+                    "--max-features", "500"])
+    assert "loss" in metrics
+
+
+def test_image_classification_predict():
+    results = _run("image_classification",
+                   ["--image-size", "32", "--classes", "5",
+                    "--model", "squeezenet", "--top-n", "2"])
+    assert len(results) == 4
+    for uri, top in results:
+        assert len(top) == 2
+        assert all(0 <= c < 5 for c, _ in top)
