@@ -23,4 +23,5 @@ EXAMPLES = [
     "qa_ranker",
     "transformer_sentiment",
     "image_classification",
+    "vae_mnist",
 ]

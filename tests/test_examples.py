@@ -5,6 +5,7 @@ import importlib.util
 import os
 import sys
 
+import numpy as np
 import pytest
 
 EXAMPLES = os.path.join(os.path.dirname(__file__), "..",
@@ -128,3 +129,12 @@ def test_image_classification_predict():
     for uri, top in results:
         assert len(top) == 2
         assert all(0 <= c < 5 for c, _ in top)
+
+
+def test_vae_mnist():
+    result = _run("vae_mnist", ["--n-train", "128", "--epochs", "1",
+                                "--hidden", "32"])
+    assert np.isfinite(result["loss"])
+    assert result["samples"].shape == (4, 784)
+    assert 0.0 <= result["samples"].min() and \
+        result["samples"].max() <= 1.0
