@@ -24,4 +24,5 @@ EXAMPLES = [
     "transformer_sentiment",
     "image_classification",
     "vae_mnist",
+    "transfer_learning",
 ]

@@ -138,3 +138,9 @@ def test_vae_mnist():
     assert result["samples"].shape == (4, 784)
     assert 0.0 <= result["samples"].min() and \
         result["samples"].max() <= 1.0
+
+
+def test_transfer_learning():
+    metrics = _run("transfer_learning", ["--n", "64", "--epochs", "1",
+                                         "--image-size", "16"])
+    assert "loss" in metrics
