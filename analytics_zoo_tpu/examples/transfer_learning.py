@@ -65,19 +65,14 @@ def main(argv=None):
     tuned.compile(optimizer="adam",
                   loss="sparse_categorical_crossentropy",
                   metrics=["accuracy"])
-    # carry the pretrained weights over by layer name
-    tuned.estimator._ensure_initialized()
-    src = backbone.estimator.params
-    tuned.estimator.params = {
-        name: (src[name] if name in src else sub)
-        for name, sub in tuned.estimator.params.items()}
-    tuned.estimator._train_step = None
+    tuned.copy_weights_from(backbone)  # by layer name
 
     # separable synthetic cats-vs-dogs: class shifts the channel mix
     y = rs.randint(0, 2, (args.n, 1)).astype(np.int32)
     x = rs.rand(args.n, size, size, 3).astype(np.float32)
     x[:, :, :, 0] += 0.8 * y.reshape(-1, 1, 1)
-    before = np.asarray(src["conv1"]["kernel"])
+    before = np.asarray(
+        backbone.estimator.params["conv1"]["kernel"])
     tuned.fit(x, y, batch_size=32, nb_epoch=args.epochs)
     after = np.asarray(tuned.estimator.params["conv1"]["kernel"])
     assert np.array_equal(before, after), "frozen conv1 must not move"

@@ -77,11 +77,7 @@ def main(argv=None):
     z_in = Input((args.latent,), name="z")
     gen = Model(z_in, dec_out(dec_h(z_in)), name="generator")
     gen.compile(optimizer="sgd", loss="mse")
-    gen.estimator._ensure_initialized()
-    trained = vae.estimator.params
-    gen.estimator.params = {
-        name: (trained[name] if name in trained else sub)
-        for name, sub in gen.estimator.params.items()}
+    gen.copy_weights_from(vae)  # decoder layers matched by name
     samples = gen.predict(
         rs.randn(4, args.latent).astype(np.float32), batch_size=4)
     print(f"generated {samples.shape[0]} digits, pixel range "

@@ -52,22 +52,27 @@ def dot_product_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     regardless of input dtype (bf16-safe).
 
     `impl`: "xla" (default), "flash" (Pallas VMEM-resident kernel), or
-    "auto" (flash when the problem qualifies — no arbitrary mask,
-    128-divisible sequence lengths). ``ZOO_TPU_ATTENTION`` sets the
-    default process-wide.
+    "auto" (flash when the problem qualifies — 128-divisible sequence
+    lengths and a mask that is absent or a pure key-padding mask like
+    BERT's (B, 1, 1, Tk)). ``ZOO_TPU_ATTENTION`` sets the default
+    process-wide.
     """
     impl = resolve_attention_impl(impl)
     if impl != "xla":
         from analytics_zoo_tpu.ops import flash_attention as fa
-        if fa.supports(q.shape[1], k.shape[1], q.shape[-1], mask):
+        # single routing decision: shapes kernel-compatible AND the
+        # mask (if any) reduces to the kernel's key-padding form
+        km = fa.as_key_mask(mask, q.shape[0], k.shape[1])
+        if fa.supports(q.shape[1], k.shape[1], q.shape[-1], None) \
+                and (mask is None or km is not None):
             return fa.flash_attention(q, k, v, causal=causal,
-                                      scale=scale)
+                                      scale=scale, key_mask=km)
         if impl == "flash":
             raise ValueError(
                 f"impl='flash' unsupported for Tq={q.shape[1]} "
                 f"Tk={k.shape[1]} mask={mask is not None} (need "
-                f"128-divisible T, no arbitrary mask); use 'auto' to "
-                f"fall back silently")
+                f"128-divisible T and a key-padding-only mask); use "
+                f"'auto' to fall back silently")
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     # (B, H, Tq, Tk)

@@ -50,7 +50,8 @@ def _apply_causal_mask(s, qi, ki, off, block_q, block_k,
 
 
 def _attn_body(off, q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref, *,
-               scale: float, causal: bool, block_q: int, block_k: int):
+               scale: float, causal: bool, block_q: int, block_k: int,
+               kmask_ref=None):
     """Shared init + blockwise-softmax accumulation for one
     (batch, head, q-block, k-block) grid step — the single copy of the
     flash recursion used by both `_fwd_kernel` and `_block_kernel`
@@ -59,6 +60,10 @@ def _attn_body(off, q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref, *,
 
     `off`: causal offset (int, static or traced) — end-aligned like
     the dense reference's tril(k=Tk-Tq): query i sees keys <= i + off.
+    `kmask_ref`: optional key-validity block ref, (1, 8, block_k) f32
+    0/1 replicated over the sublane dim (TPU tiling needs the
+    second-to-last block dim divisible by 8) — keys with 0 are masked
+    for every query row (the BERT padding-mask shape (B, 1, 1, Tk)).
 
     Scratch (VMEM, persistent across the innermost `k` grid dim):
       acc_ref (block_q, D) f32   un-normalised output accumulator
@@ -89,6 +94,8 @@ def _attn_body(off, q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref, *,
             preferred_element_type=jnp.float32) * scale
         if causal:
             s = _apply_causal_mask(s, qi, ki, off, block_q, block_k)
+        if kmask_ref is not None:
+            s = jnp.where(kmask_ref[0][:1, :] > 0, s, _NEG_INF)
 
         m_prev = m_ref[:, :1]                # (block_q, 1)
         l_prev = l_ref[:, :1]
@@ -105,14 +112,7 @@ def _attn_body(off, q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref, *,
         l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                scale: float, causal: bool, block_q: int, block_k: int,
-                causal_offset: int):
-    """Self-contained flash forward: normalised output, static offset."""
-    _attn_body(causal_offset, q_ref, k_ref, v_ref, acc_ref, m_ref,
-               l_ref, scale=scale, causal=causal, block_q=block_q,
-               block_k=block_k)
-
+def _fwd_finalize(o_ref, acc_ref, l_ref):
     @pl.when(pl.program_id(3) == pl.num_programs(3) - 1)
     def _final():
         l = l_ref[:, :1]
@@ -120,23 +120,60 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
                        jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
-    """q,k,v: (B, H, T, D) — head-major layout for contiguous blocks."""
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                scale: float, causal: bool, block_q: int, block_k: int,
+                causal_offset: int):
+    """Self-contained flash forward: normalised output, static offset."""
+    _attn_body(causal_offset, q_ref, k_ref, v_ref, acc_ref, m_ref,
+               l_ref, scale=scale, causal=causal, block_q=block_q,
+               block_k=block_k)
+    _fwd_finalize(o_ref, acc_ref, l_ref)
+
+
+def _fwd_kernel_masked(q_ref, k_ref, v_ref, km_ref, o_ref,
+                       acc_ref, m_ref, l_ref, *,
+                       scale: float, causal: bool, block_q: int,
+                       block_k: int, causal_offset: int):
+    """`_fwd_kernel` + key-validity mask input."""
+    _attn_body(causal_offset, q_ref, k_ref, v_ref, acc_ref, m_ref,
+               l_ref, scale=scale, causal=causal, block_q=block_q,
+               block_k=block_k, kmask_ref=km_ref)
+    _fwd_finalize(o_ref, acc_ref, l_ref)
+
+
+def _kmask8(key_mask, tk):
+    """(B, Tk) 0/1 → (B, 8, Tk) f32, sublane-replicated for tiling."""
+    km = jnp.asarray(key_mask).astype(jnp.float32)
+    return jnp.broadcast_to(km[:, None, :], (km.shape[0], 8, tk))
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
+               key_mask=None):
+    """q,k,v: (B, H, T, D) — head-major layout for contiguous blocks.
+    `key_mask`: optional (B, Tk) 0/1 key-validity mask."""
     b, h, tq, d = q.shape
     tk = k.shape[2]
     nq, nk = tq // block_q, tk // block_k
-    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               block_q=block_q, block_k=block_k,
-                               causal_offset=tk - tq)
+    cfg = dict(scale=scale, causal=causal, block_q=block_q,
+               block_k=block_k, causal_offset=tk - tq)
     blk = lambda bs, im: pl.BlockSpec((1, 1, bs, d), im)
+    in_specs = [
+        blk(block_q, lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        blk(block_k, lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+        blk(block_k, lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+    ]
+    args = [q, k, v]
+    if key_mask is None:
+        kernel = functools.partial(_fwd_kernel, **cfg)
+    else:
+        kernel = functools.partial(_fwd_kernel_masked, **cfg)
+        in_specs.append(pl.BlockSpec(
+            (1, 8, block_k), lambda bi, hi, qi, ki: (bi, 0, ki)))
+        args.append(_kmask8(key_mask, tk))
     return pl.pallas_call(
         kernel,
         grid=(b, h, nq, nk),
-        in_specs=[
-            blk(block_q, lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            blk(block_k, lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
-            blk(block_k, lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=blk(block_q, lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b, h, tq, d), q.dtype),
         scratch_shapes=[
@@ -148,11 +185,11 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
-    )(q, k, v)
+    )(*args)
 
 
 def _recompute_p(q_blk, k_blk, m_col, l_col, qi, ki, off, scale,
-                 causal, block_q, block_k):
+                 causal, block_q, block_k, km_ref=None):
     """Recompute the softmax probabilities of one (q-block, k-block)
     tile from the saved row statistics — shared by both backward
     kernels. p = exp(s - m)/l, NOT exp(s - (m + log l)): the fused
@@ -165,13 +202,27 @@ def _recompute_p(q_blk, k_blk, m_col, l_col, qi, ki, off, scale,
         preferred_element_type=jnp.float32) * scale
     if causal:
         s = _apply_causal_mask(s, qi, ki, off, block_q, block_k)
+    if km_ref is not None:
+        s = jnp.where(km_ref[0][:1, :] > 0, s, _NEG_INF)
     return jnp.exp(s - m_col) / jnp.maximum(l_col, 1e-30)
 
 
-def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, m_in_ref, l_in_ref,
-                     delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
-                     scale: float, causal: bool, block_q: int,
-                     block_k: int, causal_offset: int):
+def _mask_ds(ds, qi, ki, off, causal, block_q, block_k, km_ref):
+    """Zero ds at masked positions: the dense reference's where-mask
+    passes no gradient there; fully-masked rows have NONZERO uniform p
+    (it feeds dv like the dense path) but must not leak into dq/dk."""
+    if causal:
+        ds = _apply_causal_mask(ds, qi, ki, off, block_q, block_k,
+                                fill=0.0)
+    if km_ref is not None:
+        ds = jnp.where(km_ref[0][:1, :] > 0, ds, 0.0)
+    return ds
+
+
+def _bwd_dkdv_impl(q_ref, k_ref, v_ref, do_ref, m_in_ref, l_in_ref,
+                   delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                   scale: float, causal: bool, block_q: int,
+                   block_k: int, causal_offset: int, km_ref=None):
     """Grid (B, H, nk, nq): each k-block accumulates dk/dv over all
     q-blocks. delta = rowsum(do ⊙ o) (precomputed outside)."""
     qi = pl.program_id(3)
@@ -195,7 +246,7 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, m_in_ref, l_in_ref,
         p = _recompute_p(q, k, m_in_ref[0, 0][:, :1],
                          l_in_ref[0, 0][:, :1], qi, ki,
                          causal_offset, scale, causal, block_q,
-                         block_k)
+                         block_k, km_ref=km_ref)
         # dv += pᵀ·do ; dp = do·vᵀ ; ds = p⊙(dp − Δ)·scale ; dk += dsᵀ·q
         dv_acc[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -204,13 +255,8 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, m_in_ref, l_in_ref,
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta_ref[0, 0][:, :1]) * scale
-        if causal:
-            # the dense reference's where-mask passes no gradient at
-            # masked positions; fully-masked rows have NONZERO uniform
-            # p (it feeds dv like the dense path) but must not leak
-            # into dq/dk
-            ds = _apply_causal_mask(ds, qi, ki, causal_offset,
-                                    block_q, block_k, fill=0.0)
+        ds = _mask_ds(ds, qi, ki, causal_offset, causal, block_q,
+                      block_k, km_ref)
         dk_acc[:] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -221,10 +267,26 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, m_in_ref, l_in_ref,
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, m_in_ref, l_in_ref,
-                   delta_ref, dq_ref, dq_acc, *,
-                   scale: float, causal: bool,
-                   block_q: int, block_k: int, causal_offset: int):
+def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, m_in_ref, l_in_ref,
+                     delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+                     **cfg):
+    _bwd_dkdv_impl(q_ref, k_ref, v_ref, do_ref, m_in_ref, l_in_ref,
+                   delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, **cfg)
+
+
+def _bwd_dkdv_kernel_masked(q_ref, k_ref, v_ref, do_ref, km_ref,
+                            m_in_ref, l_in_ref, delta_ref,
+                            dk_ref, dv_ref, dk_acc, dv_acc, **cfg):
+    _bwd_dkdv_impl(q_ref, k_ref, v_ref, do_ref, m_in_ref, l_in_ref,
+                   delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+                   km_ref=km_ref, **cfg)
+
+
+def _bwd_dq_impl(q_ref, k_ref, v_ref, do_ref, m_in_ref, l_in_ref,
+                 delta_ref, dq_ref, dq_acc, *,
+                 scale: float, causal: bool,
+                 block_q: int, block_k: int, causal_offset: int,
+                 km_ref=None):
     """Grid (B, H, nq, nk): each q-block accumulates dq over k-blocks."""
     ki = pl.program_id(3)
     nk = pl.num_programs(3)
@@ -247,18 +309,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, m_in_ref, l_in_ref,
         p = _recompute_p(q, k, m_in_ref[0, 0][:, :1],
                          l_in_ref[0, 0][:, :1], qi, ki,
                          causal_offset, scale, causal, block_q,
-                         block_k)
+                         block_k, km_ref=km_ref)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta_ref[0, 0][:, :1]) * scale
-        if causal:
-            # the dense reference's where-mask passes no gradient at
-            # masked positions; fully-masked rows have NONZERO uniform
-            # p (it feeds dv like the dense path) but must not leak
-            # into dq/dk
-            ds = _apply_causal_mask(ds, qi, ki, causal_offset,
-                                    block_q, block_k, fill=0.0)
+        ds = _mask_ds(ds, qi, ki, causal_offset, causal, block_q,
+                      block_k, km_ref)
         dq_acc[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -268,31 +325,53 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, m_in_ref, l_in_ref,
         dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, m_in_ref, l_in_ref,
+                   delta_ref, dq_ref, dq_acc, **cfg):
+    _bwd_dq_impl(q_ref, k_ref, v_ref, do_ref, m_in_ref, l_in_ref,
+                 delta_ref, dq_ref, dq_acc, **cfg)
+
+
+def _bwd_dq_kernel_masked(q_ref, k_ref, v_ref, do_ref, km_ref,
+                          m_in_ref, l_in_ref, delta_ref, dq_ref,
+                          dq_acc, **cfg):
+    _bwd_dq_impl(q_ref, k_ref, v_ref, do_ref, m_in_ref, l_in_ref,
+                 delta_ref, dq_ref, dq_acc, km_ref=km_ref, **cfg)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, key_mask, scale, causal, block_q, block_k,
+           interpret):
+    """`key_mask`: (B, Tk) 0/1 f32 or an all-ones dummy when the
+    static `masked` bit of the caller is off (it is a diff arg so it
+    can be traced; its gradient is defined as zeros)."""
     return _flash_fwd(q, k, v, scale, causal, block_q, block_k,
-                      interpret)
+                      interpret,
+                      key_mask=key_mask if key_mask.ndim == 2 else None)
 
 
-def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+def _flash_vjp_fwd(q, k, v, key_mask, scale, causal, block_q, block_k,
+                   interpret):
     # run the partials kernel (unnormalised acc + m/l) so the row
-    # logsumexp needed by the Pallas backward comes out of the same
+    # statistics needed by the Pallas backward come out of the same
     # pass; normalise outside — same math as _fwd_kernel's in-kernel
     # divide, one extra O(T·D) HBM round-trip at trace-under-grad only
     tk, tq = k.shape[2], q.shape[2]
+    km = key_mask if key_mask.ndim == 2 else None
     acc, m, l = _block_partials(q, k, v, tk - tq, causal, scale,
-                                block_q, block_k, interpret)
+                                block_q, block_k, interpret,
+                                key_mask=km)
     out = (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
-    return out, (q, k, v, out, m, l)
+    return out, (q, k, v, key_mask, out, m, l)
 
 
 def _flash_vjp_bwd(scale, causal, block_q, block_k, interpret, res, g):
     """FlashAttention-2 backward as two Pallas kernels (dk/dv then dq);
-    probabilities are recomputed blockwise from the saved logsumexp, so
-    grad-time memory stays O(T·D) like the forward."""
-    q, k, v, out, m, l = res
+    probabilities are recomputed blockwise from the saved row
+    statistics, so grad-time memory stays O(T·D) like the forward."""
+    q, k, v, key_mask, out, m, l = res
     b, h, tq, d = q.shape
     tk = k.shape[2]
+    masked = key_mask.ndim == 2
     do = g.astype(q.dtype)
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)                 # (B, H, Tq)
@@ -309,19 +388,30 @@ def _flash_vjp_bwd(scale, causal, block_q, block_k, interpret, res, g):
     params = pltpu.CompilerParams(
         dimension_semantics=("parallel", "parallel", "parallel",
                              "arbitrary"))
+    km8 = _kmask8(key_mask, tk) if masked else None
+    km_spec_kv = pl.BlockSpec((1, 8, block_k),
+                              lambda bi, hi, ki, qi: (bi, 0, ki))
+    km_spec_q = pl.BlockSpec((1, 8, block_k),
+                             lambda bi, hi, qi, ki: (bi, 0, ki))
 
+    in_specs_kv = [
+        blk(block_q, lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+        blk(block_k, lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+        blk(block_k, lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+        blk(block_q, lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+    ] + ([km_spec_kv] if masked else []) + [
+        row(block_q, lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+        row(block_q, lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+        row(block_q, lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+    ]
+    args_kv = [q, k, v, do] + ([km8] if masked else []) + \
+        [m_r, l_r, delta_r]
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkdv_kernel, **common),
+        functools.partial(
+            _bwd_dkdv_kernel_masked if masked else _bwd_dkdv_kernel,
+            **common),
         grid=(b, h, tk // block_k, tq // block_q),
-        in_specs=[
-            blk(block_q, lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
-            blk(block_k, lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
-            blk(block_k, lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
-            blk(block_q, lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
-            row(block_q, lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
-            row(block_q, lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
-            row(block_q, lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
-        ],
+        in_specs=in_specs_kv,
         out_specs=[
             blk(block_k, lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
             blk(block_k, lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
@@ -336,50 +426,41 @@ def _flash_vjp_bwd(scale, causal, block_q, block_k, interpret, res, g):
         ],
         compiler_params=params,
         interpret=interpret,
-    )(q, k, v, do, m_r, l_r, delta_r)
+    )(*args_kv)
 
+    in_specs_q = [
+        blk(block_q, lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        blk(block_k, lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+        blk(block_k, lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+        blk(block_q, lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+    ] + ([km_spec_q] if masked else []) + [
+        row(block_q, lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        row(block_q, lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        row(block_q, lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+    ]
+    args_q = [q, k, v, do] + ([km8] if masked else []) + \
+        [m_r, l_r, delta_r]
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, **common),
+        functools.partial(
+            _bwd_dq_kernel_masked if masked else _bwd_dq_kernel,
+            **common),
         grid=(b, h, tq // block_q, tk // block_k),
-        in_specs=[
-            blk(block_q, lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            blk(block_k, lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
-            blk(block_k, lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
-            blk(block_q, lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            row(block_q, lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            row(block_q, lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            row(block_q, lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-        ],
+        in_specs=in_specs_q,
         out_specs=blk(block_q, lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=params,
         interpret=interpret,
-    )(q, k, v, do, m_r, l_r, delta_r)
+    )(*args_q)
 
-    return dq, dk, dv
+    return dq, dk, dv, jnp.zeros_like(key_mask)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
-def _block_kernel(off_ref, q_ref, k_ref, v_ref,
-                  o_ref, m_out_ref, l_out_ref,
-                  acc_ref, m_ref, l_ref, *,
-                  scale: float, causal: bool,
-                  block_q: int, block_k: int):
-    """Partial-softmax block attention: same recursion as
-    `_fwd_kernel` (via `_attn_body`) but emits the UNNORMALISED
-    accumulator plus running (m, l) statistics, so a caller (ring
-    attention) can merge several K/V blocks' partials.
-    `off_ref` (SMEM, (1,1) int32) holds the global causal offset
-    q_global_start - k_global_start, which is traced (it depends on
-    `lax.axis_index` inside shard_map) and therefore can't be a Python
-    static like `_fwd_kernel`'s causal_offset."""
-    _attn_body(off_ref[0, 0], q_ref, k_ref, v_ref, acc_ref, m_ref,
-               l_ref, scale=scale, causal=causal, block_q=block_q,
-               block_k=block_k)
-
+def _block_finalize(o_ref, m_out_ref, l_out_ref, acc_ref, m_ref,
+                    l_ref):
     @pl.when(pl.program_id(3) == pl.num_programs(3) - 1)
     def _final():
         o_ref[0, 0] = acc_ref[:]
@@ -391,28 +472,67 @@ def _block_kernel(off_ref, q_ref, k_ref, v_ref,
         l_out_ref[0, 0] = l_ref[:]
 
 
+def _block_kernel(off_ref, q_ref, k_ref, v_ref,
+                  o_ref, m_out_ref, l_out_ref,
+                  acc_ref, m_ref, l_ref, *,
+                  scale: float, causal: bool,
+                  block_q: int, block_k: int):
+    """Partial-softmax block attention: same recursion as
+    `_fwd_kernel` (via `_attn_body`) but emits the UNNORMALISED
+    accumulator plus running (m, l) statistics, so a caller (ring
+    attention, the custom VJP forward) can merge partials or build the
+    backward's row statistics.
+    `off_ref` (SMEM, (1,1) int32) holds the global causal offset
+    q_global_start - k_global_start, which is traced (it depends on
+    `lax.axis_index` inside shard_map) and therefore can't be a Python
+    static like `_fwd_kernel`'s causal_offset."""
+    _attn_body(off_ref[0, 0], q_ref, k_ref, v_ref, acc_ref, m_ref,
+               l_ref, scale=scale, causal=causal, block_q=block_q,
+               block_k=block_k)
+    _block_finalize(o_ref, m_out_ref, l_out_ref, acc_ref, m_ref, l_ref)
+
+
+def _block_kernel_masked(off_ref, q_ref, k_ref, v_ref, km_ref,
+                         o_ref, m_out_ref, l_out_ref,
+                         acc_ref, m_ref, l_ref, *,
+                         scale: float, causal: bool,
+                         block_q: int, block_k: int):
+    """`_block_kernel` + key-validity mask input."""
+    _attn_body(off_ref[0, 0], q_ref, k_ref, v_ref, acc_ref, m_ref,
+               l_ref, scale=scale, causal=causal, block_q=block_q,
+               block_k=block_k, kmask_ref=km_ref)
+    _block_finalize(o_ref, m_out_ref, l_out_ref, acc_ref, m_ref, l_ref)
+
+
 def _block_partials(qt, kt, vt, qk_offset, causal, scale,
-                    block_q, block_k, interpret):
+                    block_q, block_k, interpret, key_mask=None):
     """Head-major core of `flash_block_partial` (also the forward of
-    the custom VJP, which needs the logsumexp). qt/kt/vt:
+    the custom VJP, which needs the row statistics). qt/kt/vt:
     (B, H, T, D); returns (acc (B, H, Tq, D) f32 unnormalised,
     m (B, H, Tq) f32, l (B, H, Tq) f32)."""
     b, h, tq, d = qt.shape
     tk = kt.shape[2]
     off = jnp.asarray(qk_offset, jnp.int32).reshape(1, 1)
-    kernel = functools.partial(_block_kernel, scale=scale,
-                               causal=causal, block_q=block_q,
-                               block_k=block_k)
+    masked = key_mask is not None
+    kernel = functools.partial(
+        _block_kernel_masked if masked else _block_kernel,
+        scale=scale, causal=causal, block_q=block_q, block_k=block_k)
     blk = lambda bs, im: pl.BlockSpec((1, 1, bs, d), im)
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        blk(block_q, lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        blk(block_k, lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+        blk(block_k, lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+    ]
+    args = [off, qt, kt, vt]
+    if masked:
+        in_specs.append(pl.BlockSpec(
+            (1, 8, block_k), lambda bi, hi, qi, ki: (bi, 0, ki)))
+        args.append(_kmask8(key_mask, tk))
     acc, m, l = pl.pallas_call(
         kernel,
         grid=(b, h, tq // block_q, tk // block_k),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            blk(block_q, lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            blk(block_k, lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
-            blk(block_k, lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             blk(block_q, lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
             pl.BlockSpec((1, 1, block_q, 128),
@@ -434,7 +554,7 @@ def _block_partials(qt, kt, vt, qk_offset, causal, scale,
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
-    )(off, qt, kt, vt)
+    )(*args)
     return acc, m[..., 0], l[..., 0]
 
 
@@ -461,14 +581,37 @@ def flash_block_partial(q, k, v, qk_offset, causal: bool, scale: float,
     return jnp.transpose(acc, (0, 2, 1, 3)), m, l
 
 
+def as_key_mask(mask, b: int, tk: int):
+    """Reduce an attention mask (broadcastable to (B, H, Tq, Tk)) to
+    the kernel-native (B, Tk) key-validity form, or None if it varies
+    per query/head (detected STATICALLY from the shape: dims 1 and 2
+    must be broadcast dims). Only the explicit 4-D (B|1, 1, 1, Tk)
+    form qualifies — exactly BERT's padding mask (`layers/BERT.scala`
+    extended attention mask); a 2-D mask is NOT accepted because the
+    dense path broadcasts 2-D as (Tq, Tk), a different meaning."""
+    if mask is None:
+        return None
+    shp = tuple(mask.shape)
+    if mask.ndim == 4 and shp[1] == 1 and shp[2] == 1 and \
+            shp[3] == tk and shp[0] in (1, b):
+        km = mask[:, 0, 0, :]
+        return jnp.broadcast_to(km, (b, tk))
+    return None
+
+
 def supports(tq: int, tk: int, d: int,
-             mask: Optional[jnp.ndarray]) -> bool:
+             mask: Optional[jnp.ndarray], b: Optional[int] = None
+             ) -> bool:
     """Whether the kernel handles this problem (else caller falls back
-    to the XLA path): block-divisible sequence lengths, a head dim that
-    fits VMEM tiles, and no arbitrary mask (causal is native)."""
+    to the XLA path): block-divisible sequence lengths, a head dim
+    that fits VMEM tiles, and a mask that is either absent or a pure
+    key-padding mask (causal is native)."""
     bq, bk = _pick_blocks(tq, tk)
-    return (mask is None and bq is not None and bk is not None
-            and d <= 256)
+    if bq is None or bk is None or d > 256:
+        return False
+    if mask is None:
+        return True
+    return b is not None and as_key_mask(mask, b, tk) is not None
 
 
 def _pick_blocks(tq: int, tk: int):
@@ -482,16 +625,19 @@ def _pick_blocks(tq: int, tk: int):
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     causal: bool = False,
                     scale: Optional[float] = None,
+                    key_mask: Optional[jnp.ndarray] = None,
                     interpret: Optional[bool] = None) -> jnp.ndarray:
     """Flash attention. q,k,v: (B, T, H, D) → (B, T, H, D).
 
     Same contract as :func:`ops.attention.dot_product_attention`
     (f32 softmax, bf16-safe); Tq/Tk must be multiples of 128.
+    `key_mask`: optional (B, Tk) 0/1 key-validity (padding) mask,
+    applied natively in the kernel (fwd AND bwd).
     `interpret=None` auto-selects the Pallas interpreter off-TPU.
     """
     d = q.shape[-1]
     scale = float(scale if scale is not None else 1.0 / (d ** 0.5))
-    tq, tk = q.shape[1], k.shape[1]
+    b, tq, tk = q.shape[0], q.shape[1], k.shape[1]
     bq, bk = _pick_blocks(tq, tk)
     if bq is None or bk is None:
         raise ValueError(
@@ -502,5 +648,16 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     qt = jnp.transpose(q, (0, 2, 1, 3))      # (B, H, T, D)
     kt = jnp.transpose(k, (0, 2, 1, 3))
     vt = jnp.transpose(v, (0, 2, 1, 3))
-    out = _flash(qt, kt, vt, scale, causal, bq, bk, bool(interpret))
+    if key_mask is None:
+        # scalar dummy: ndim != 2 is the static "no mask" bit of the
+        # custom_vjp (the mask must be a diff arg because it is traced)
+        km = jnp.zeros((), jnp.float32)
+    else:
+        if tuple(key_mask.shape) != (b, tk):
+            raise ValueError(
+                f"key_mask must be (B, Tk)=({b}, {tk}); got "
+                f"{tuple(key_mask.shape)}")
+        km = key_mask.astype(jnp.float32)
+    out = _flash(qt, kt, vt, km, scale, causal, bq, bk,
+                 bool(interpret))
     return jnp.transpose(out, (0, 2, 1, 3))

@@ -255,6 +255,28 @@ class KerasNet(KerasLayer):
                 for _, leaf in jax.tree_util.tree_leaves_with_path(
                     est.params)]
 
+    def copy_weights_from(self, other: "KerasNet",
+                          strict: bool = False) -> "KerasNet":
+        """Copy weights from another net BY LAYER NAME (the
+        transfer-learning carry-over of the reference's
+        `NetUtils.scala:47-140` surgery): layers present in both nets
+        take `other`'s weights, the rest keep their own.
+        ``strict=True`` requires every layer of this net to match."""
+        src_est, dst_est = other.estimator, self.estimator
+        if src_est.params is None:
+            src_est._ensure_initialized()
+        if dst_est.params is None:
+            dst_est._ensure_initialized()
+        src = src_est.params
+        missing = [n for n in dst_est.params if n not in src]
+        if strict and missing:
+            raise KeyError(f"layers missing from source: {missing}")
+        dst_est.params = {
+            name: (src[name] if name in src else sub)
+            for name, sub in dst_est.params.items()}
+        dst_est._train_step = None           # invalidate compiled step
+        return self
+
     def set_weights(self, weights: "list[np.ndarray]"):
         """Inverse of :meth:`get_weights` (shape-checked)."""
         import jax.tree_util as jtu

@@ -168,3 +168,89 @@ def test_grad_causal_tq_gt_tk_masked_rows():
     for a, b in zip(gf, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=5e-4, rtol=5e-4)
+
+
+def _padding_mask(b, tk, lengths):
+    m = np.zeros((b, tk), np.float32)
+    for i, ln in enumerate(lengths):
+        m[i, :ln] = 1.0
+    return m
+
+
+def test_key_mask_matches_dense():
+    q, k, v = _qkv(b=2, t=256)
+    km = _padding_mask(2, 256, [256, 100])
+    mask4 = km[:, None, None, :]              # BERT (B, 1, 1, Tk)
+    ref = dot_product_attention(q, k, v, mask=mask4, impl='xla')
+    out = flash_attention(q, k, v, key_mask=jnp.asarray(km))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    # auto-routing: the (B,1,1,Tk) mask is detected as key-padding
+    out_auto = dot_product_attention(q, k, v, mask=jnp.asarray(mask4),
+                                     impl='auto')
+    np.testing.assert_allclose(np.asarray(out_auto), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    assert supports(256, 256, 64, jnp.asarray(mask4), b=2)
+    # per-query masks still fall back
+    assert not supports(256, 256, 64, jnp.ones((2, 1, 256, 256)), b=2)
+    # 2-D masks mean (Tq, Tk) in the dense path — never kernel-routed
+    from analytics_zoo_tpu.ops.flash_attention import as_key_mask
+    assert as_key_mask(jnp.ones((2, 256)), 2, 256) is None
+    mask2d = jnp.asarray(np.tril(np.ones((256, 256), np.float32)))
+    out2d = dot_product_attention(q, k, v, mask=mask2d, impl='auto')
+    ref2d = dot_product_attention(q, k, v, mask=mask2d, impl='xla')
+    np.testing.assert_allclose(np.asarray(out2d), np.asarray(ref2d),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_key_mask_with_causal_and_grad():
+    q, k, v = _qkv(b=2, t=128, h=2, d=32, seed=9)
+    km = jnp.asarray(_padding_mask(2, 128, [128, 77]))
+    mask4 = km[:, None, None, :]
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True,
+                                       key_mask=km) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(dot_product_attention(
+            q, k, v, mask=mask4, causal=True, impl='xla') ** 2)
+
+    np.testing.assert_allclose(
+        np.asarray(flash_attention(q, k, v, causal=True, key_mask=km)),
+        np.asarray(dot_product_attention(q, k, v, mask=mask4,
+                                         causal=True, impl='xla')),
+        atol=2e-5, rtol=2e-5)
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4)
+
+
+def test_bert_padding_mask_flash_path():
+    # BERT's (B, 1, 1, T) padding mask routes to the Pallas kernel
+    # under attention_impl='auto' and matches the XLA path
+    from analytics_zoo_tpu.pipeline.api.keras.layers.transformer import \
+        BERT
+    t, vocab = 128, 64
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, vocab, (2, t)).astype(np.int32)
+    types = np.zeros((2, t), np.int32)
+    pos = np.tile(np.arange(t), (2, 1)).astype(np.int32)
+    mask = np.ones((2, t), np.float32)
+    mask[1, 90:] = 0.0
+    inputs = [ids, types, pos, mask]
+
+    def run(impl):
+        lay = BERT(vocab=vocab, hidden_size=32, n_block=1, n_head=2,
+                   seq_len=t, intermediate_size=64,
+                   output_all_block=False, attention_impl=impl)
+        params = lay.init(jax.random.PRNGKey(0), None)
+        outs = lay.call(params, [jnp.asarray(a) for a in inputs])
+        return [np.asarray(o) for o in outs]
+
+    ref = run("xla")
+    out = run("auto")
+    for a, b in zip(out, ref):
+        np.testing.assert_allclose(a, b, atol=5e-5, rtol=5e-5)
