@@ -212,3 +212,61 @@ class TestMoE:
         m.add(inner)
         paths = collect_ep_paths(m)
         assert ("moe_nested", "w_in") in paths, paths
+
+
+class TestRemat:
+    def test_remat_same_results_and_grads(self, rng):
+        import jax
+        import jax.numpy as jnp
+        from analytics_zoo_tpu.pipeline.api.keras import layers as L
+
+        ids = rng.randint(0, 50, (2, 16)).astype(np.int32)
+
+        def build(remat):
+            lay = L.TransformerLayer(n_block=2, hidden_size=16,
+                                     n_head=2, seq_len=16, vocab=50,
+                                     remat=remat)
+            params = lay.init(jax.random.PRNGKey(0), None)
+            return lay, params
+
+        lay0, p0 = build(False)
+        lay1, p1 = build(True)
+
+        def loss(lay):
+            def f(p, x):
+                return jnp.sum(lay.call(p, x) ** 2)
+            return f
+
+        out0 = lay0.call(p0, ids)
+        out1 = lay1.call(p1, ids)
+        np.testing.assert_allclose(np.asarray(out0), np.asarray(out1),
+                                   atol=1e-6)
+        g0 = jax.grad(loss(lay0))(p0, ids)
+        g1 = jax.grad(loss(lay1))(p1, ids)
+        for (k0, a), (k1, b) in zip(
+                sorted(jax.tree_util.tree_leaves_with_path(g0),
+                       key=str),
+                sorted(jax.tree_util.tree_leaves_with_path(g1),
+                       key=str)):
+            # remat recomputes activations; f32 rounding may differ
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-5, rtol=5e-3,
+                                       err_msg=str(k0))
+
+    def test_remat_trains_in_estimator(self, rng):
+        from analytics_zoo_tpu import init_nncontext
+        from analytics_zoo_tpu.pipeline.api.keras import Sequential, \
+            layers as L
+        from analytics_zoo_tpu.pipeline.estimator import Estimator
+        init_nncontext(tpu_mesh={"data": -1})
+        m = Sequential()
+        m.add(L.TransformerLayer(n_block=2, hidden_size=16, n_head=2,
+                                 seq_len=8, vocab=32, remat=True))
+        m.add(L.Select(1, -1))
+        m.add(L.Dense(4))
+        est = Estimator(m, optimizer="adam",
+                        loss="softmax_cross_entropy")
+        x = rng.randint(0, 32, (16, 8)).astype(np.int32)
+        y = rng.randint(0, 4, (16, 1)).astype(np.int32)
+        res = est.train(x, y, batch_size=16, nb_epoch=1)
+        assert np.isfinite(res.history[-1]["loss"])
