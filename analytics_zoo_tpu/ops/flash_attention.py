@@ -572,7 +572,7 @@ def flash_block_partial(q, k, v, qk_offset, causal: bool, scale: float,
         interpret = jax.default_backend() not in ("tpu", "axon")
     b, tq, h, d = q.shape
     tk = k.shape[1]
-    bq, bk = _pick_blocks(tq, tk)
+    bq, bk = _pick_blocks(tq, tk, jnp.dtype(q.dtype).itemsize)
     acc, m, l = _block_partials(
         jnp.transpose(q, (0, 2, 1, 3)),
         jnp.transpose(k, (0, 2, 1, 3)),
@@ -614,11 +614,19 @@ def supports(tq: int, tk: int, d: int,
     return b is not None and as_key_mask(mask, b, tk) is not None
 
 
-def _pick_blocks(tq: int, tk: int):
+def _pick_blocks(tq: int, tk: int, itemsize: int = 2):
     # biggest wins on v5e (measured: [1024,1024] beats [256,512] by
-    # 1.2-2.2x at T=2k-8k; VMEM footprint ~6MB at D<=128)
-    bq = next((b for b in (1024, 512, 256, 128) if tq % b == 0), None)
-    bk = next((b for b in (1024, 512, 256, 128) if tk % b == 0), None)
+    # 1.2-2.2x at T=2k-8k), but the BACKWARD holds ~4 f32
+    # (block_q, block_k) tiles in VMEM at once, which at f32 operands
+    # with 1024-blocks exceeds the 16MB scoped-VMEM budget (measured
+    # 17.05M) — cap f32 at 512. Forward and backward MUST share the
+    # blocks: the causal whole-block skip decides which fully-masked
+    # query rows participate, and a fwd/bwd mismatch desyncs their
+    # gradients.
+    cap = 512 if itemsize >= 4 else 1024
+    sizes = tuple(b for b in (1024, 512, 256, 128) if b <= cap)
+    bq = next((b for b in sizes if tq % b == 0), None)
+    bk = next((b for b in sizes if tk % b == 0), None)
     return bq, bk
 
 
@@ -638,7 +646,7 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     d = q.shape[-1]
     scale = float(scale if scale is not None else 1.0 / (d ** 0.5))
     b, tq, tk = q.shape[0], q.shape[1], k.shape[1]
-    bq, bk = _pick_blocks(tq, tk)
+    bq, bk = _pick_blocks(tq, tk, jnp.dtype(q.dtype).itemsize)
     if bq is None or bk is None:
         raise ValueError(
             f"flash_attention needs Tq/Tk divisible by 128; got "
