@@ -270,3 +270,22 @@ class TestRemat:
         y = rng.randint(0, 4, (16, 1)).astype(np.int32)
         res = est.train(x, y, batch_size=16, nb_epoch=1)
         assert np.isfinite(res.history[-1]["loss"])
+
+
+def test_estimator_trains_with_flash_attention(rng, monkeypatch):
+    # ZOO_TPU_ATTENTION=auto routes the training loop's attention
+    # through the Pallas kernel (interpret mode on CPU) end to end
+    monkeypatch.setenv("ZOO_TPU_ATTENTION", "auto")
+    from analytics_zoo_tpu import init_nncontext
+    from analytics_zoo_tpu.pipeline.estimator import Estimator
+    init_nncontext(tpu_mesh={"data": -1})
+    m = Sequential()
+    m.add(L.TransformerLayer(n_block=1, hidden_size=16, n_head=2,
+                             seq_len=128, vocab=32))
+    m.add(L.Select(1, -1))
+    m.add(L.Dense(4))
+    est = Estimator(m, optimizer="adam", loss="softmax_cross_entropy")
+    x = rng.randint(0, 32, (8, 128)).astype(np.int32)
+    y = rng.randint(0, 4, (8, 1)).astype(np.int32)
+    res = est.train(x, y, batch_size=8, nb_epoch=1)
+    assert np.isfinite(res.history[-1]["loss"])
