@@ -49,9 +49,16 @@ def load_native() -> Optional[ctypes.CDLL]:
             return _lib
         if _build_failed:
             return None
-        if not os.path.exists(_SO_PATH) and not _build():
-            _build_failed = True
-            return None
+        srcs = [os.path.join(_NATIVE_DIR, f)
+                for f in ("host_arena.cpp", "serving_queue.cpp",
+                          "serving_http.cpp")]
+        stale = os.path.exists(_SO_PATH) and any(
+            os.path.getmtime(s) > os.path.getmtime(_SO_PATH)
+            for s in srcs if os.path.exists(s))
+        if (not os.path.exists(_SO_PATH) or stale) and not _build():
+            if not os.path.exists(_SO_PATH):   # stale-but-present is usable
+                _build_failed = True
+                return None
         try:
             lib = ctypes.CDLL(_SO_PATH)
         except OSError:
