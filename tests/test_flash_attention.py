@@ -294,3 +294,39 @@ def test_bert_padding_mask_flash_path():
     out = run("auto")
     for a, b in zip(out, ref):
         np.testing.assert_allclose(a, b, atol=5e-5, rtol=5e-5)
+
+
+@pytest.mark.parametrize("tq,tk", [(128, 128), (256, 128), (128, 384)])
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("masked", [False, True])
+def test_conformance_sweep(tq, tk, causal, masked):
+    # fwd+grad conformance vs dense across the shape/mask grid (live
+    # rows only where end-aligned causal creates none here: tk >= tq
+    # or equal, so every row attends to something)
+    rs = np.random.RandomState(tq + tk + causal + masked)
+    q = jnp.asarray(rs.randn(2, tq, 2, 32) * 0.5, jnp.float32)
+    k = jnp.asarray(rs.randn(2, tk, 2, 32) * 0.5, jnp.float32)
+    v = jnp.asarray(rs.randn(2, tk, 2, 32) * 0.5, jnp.float32)
+    km = None
+    mask4 = None
+    if masked:
+        m = np.ones((2, tk), np.float32)
+        m[1, tk // 2:] = 0.0
+        km = jnp.asarray(m)
+        mask4 = km[:, None, None, :]
+
+    out = flash_attention(q, k, v, causal=causal, key_mask=km)
+    ref = dot_product_attention(q, k, v, mask=mask4, causal=causal,
+                                impl='xla')
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=3e-5, rtol=3e-5)
+
+    gf = jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+        q, k, v, causal=causal, key_mask=km) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: jnp.sum(dot_product_attention(
+        q, k, v, mask=mask4, causal=causal, impl='xla') ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4)
