@@ -44,8 +44,13 @@ from analytics_zoo_tpu.parallel.mesh import shard_batch, shard_params
 # ---------------------------------------------------------------------------
 
 class Trigger:
+    """Training-control predicate (reference: BigDL `Trigger` algebra —
+    everyEpoch/severalIteration/maxEpoch/maxIteration/minLoss/maxScore
+    plus and/or composition). ``**state`` carries the current epoch
+    loss and validation metrics at epoch-end evaluations."""
+
     def __call__(self, epoch: int, iteration: int,
-                 epoch_end: bool) -> bool:
+                 epoch_end: bool, **state) -> bool:
         raise NotImplementedError
 
     @staticmethod
@@ -64,9 +69,25 @@ class Trigger:
     def max_iteration(n: int) -> "Trigger":
         return MaxIteration(n)
 
+    @staticmethod
+    def min_loss(v: float) -> "Trigger":
+        return MinLoss(v)
+
+    @staticmethod
+    def max_score(v: float, metric: "Optional[str]" = None) -> "Trigger":
+        return MaxScore(v, metric)
+
+    @staticmethod
+    def and_(*triggers: "Trigger") -> "Trigger":
+        return TriggerAnd(*triggers)
+
+    @staticmethod
+    def or_(*triggers: "Trigger") -> "Trigger":
+        return TriggerOr(*triggers)
+
 
 class EveryEpoch(Trigger):
-    def __call__(self, epoch, iteration, epoch_end):
+    def __call__(self, epoch, iteration, epoch_end, **state):
         return epoch_end
 
 
@@ -74,7 +95,7 @@ class SeveralIteration(Trigger):
     def __init__(self, n: int):
         self.n = int(n)
 
-    def __call__(self, epoch, iteration, epoch_end):
+    def __call__(self, epoch, iteration, epoch_end, **state):
         return iteration > 0 and iteration % self.n == 0
 
 
@@ -82,7 +103,7 @@ class MaxEpoch(Trigger):
     def __init__(self, n: int):
         self.n = int(n)
 
-    def __call__(self, epoch, iteration, epoch_end):
+    def __call__(self, epoch, iteration, epoch_end, **state):
         return epoch >= self.n
 
 
@@ -90,8 +111,56 @@ class MaxIteration(Trigger):
     def __init__(self, n: int):
         self.n = int(n)
 
-    def __call__(self, epoch, iteration, epoch_end):
+    def __call__(self, epoch, iteration, epoch_end, **state):
         return iteration >= self.n
+
+
+class MinLoss(Trigger):
+    """Stop once the epoch training loss drops to ``v`` (BigDL
+    `Trigger.minLoss`); evaluated at epoch end."""
+
+    def __init__(self, v: float):
+        self.v = float(v)
+
+    def __call__(self, epoch, iteration, epoch_end, **state):
+        loss = state.get("loss")
+        return epoch_end and loss is not None and loss <= self.v
+
+
+class MaxScore(Trigger):
+    """Stop once a validation metric reaches ``v`` (BigDL
+    `Trigger.maxScore`); uses ``metric`` or the first validation
+    metric reported."""
+
+    def __init__(self, v: float, metric: "Optional[str]" = None):
+        self.v = float(v)
+        self.metric = metric
+
+    def __call__(self, epoch, iteration, epoch_end, **state):
+        metrics = state.get("val_metrics") or {}
+        if not (epoch_end and metrics):
+            return False
+        if self.metric is not None:
+            score = metrics.get(self.metric)
+        else:
+            score = next(iter(metrics.values()), None)
+        return score is not None and score >= self.v
+
+
+class TriggerAnd(Trigger):
+    def __init__(self, *triggers: Trigger):
+        self.triggers = triggers
+
+    def __call__(self, *a, **state):
+        return all(t(*a, **state) for t in self.triggers)
+
+
+class TriggerOr(Trigger):
+    def __init__(self, *triggers: Trigger):
+        self.triggers = triggers
+
+    def __call__(self, *a, **state):
+        return any(t(*a, **state) for t in self.triggers)
 
 
 # ---------------------------------------------------------------------------
@@ -537,8 +606,11 @@ class Estimator:
                 self.save_checkpoint()
             history.append(entry)
             logger.info("epoch %d: %s", epoch, entry)
-            if stop or (end_trigger is not None and
-                        end_trigger(epoch, self.step, True)):
+            if stop or (end_trigger is not None and end_trigger(
+                    epoch, self.step, True,
+                    loss=entry.get("loss"),
+                    val_metrics={k[4:]: v for k, v in entry.items()
+                                 if k.startswith("val_")})):
                 break
         if self._profiling:  # short run ended inside the trace window
             jax.profiler.stop_trace()

@@ -388,3 +388,29 @@ def test_get_set_weights_roundtrip(rng):
     import pytest
     with pytest.raises(ValueError):
         m2.set_weights(ws[:-1])
+
+
+def test_min_loss_max_score_triggers(rng):
+    from analytics_zoo_tpu.pipeline.estimator import (
+        Estimator, MaxEpoch, MinLoss, Trigger, TriggerOr)
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential, \
+        layers as L
+    # trivially learnable: loss collapses fast → MinLoss fires early
+    x = rng.rand(64, 4).astype(np.float32)
+    y = (x @ np.ones((4, 1), np.float32)).astype(np.float32)
+    m = Sequential()
+    m.add(L.Dense(1, input_shape=(4,)))
+    est = Estimator(m, optimizer="adam", loss="mse")
+    res = est.train(x, y, batch_size=32, nb_epoch=50,
+                    end_trigger=TriggerOr(MinLoss(10.0), MaxEpoch(50)))
+    assert len(res.history) < 50     # stopped early on loss
+
+    # trigger algebra + state plumbing
+    t = Trigger.and_(Trigger.every_epoch(), Trigger.min_loss(0.5))
+    assert t(1, 10, True, loss=0.4)
+    assert not t(1, 10, True, loss=0.9)
+    assert not t(1, 10, False, loss=0.4)
+    s = Trigger.max_score(0.9, metric="accuracy")
+    assert s(1, 10, True, val_metrics={"accuracy": 0.95})
+    assert not s(1, 10, True, val_metrics={"accuracy": 0.5})
+    assert not s(1, 10, True)
