@@ -138,6 +138,21 @@ class ImageSet:
     def get_label(self) -> "list":
         return [f.label for f in self.features]
 
+    def to_arrays(self) -> "tuple[np.ndarray, Optional[np.ndarray]]":
+        """Stacked (images, labels-or-None) — lets an ImageSet be
+        passed straight to `fit`/`evaluate`/`predict` like the
+        reference's `model.fit(image_set, ...)` (TextSet has the same
+        contract)."""
+        xs = np.stack([np.asarray(f.image, np.float32)
+                       for f in self.features])
+        labels = [f.label for f in self.features]
+        if any(lb is not None for lb in labels):
+            ys = np.asarray([np.asarray(lb) for lb in labels])
+            if ys.ndim == 1:
+                ys = ys[:, None]
+            return xs, ys
+        return xs, None
+
     def __len__(self):
         return len(self.features)
 

@@ -211,6 +211,12 @@ class ArrayDataset:
 def to_dataset(data, y=None):
     if hasattr(data, "iter_batches"):
         return data
+    if hasattr(data, "to_arrays"):
+        # TextSet / ImageSet passed straight to fit/evaluate/predict
+        # (reference `model.fit(train_set, ...)` over TextSet,
+        # `qa_ranker.py`; ImageSet via `ImageSet.toDataSet`)
+        xs, ys = data.to_arrays()
+        return ArrayDataset(xs, ys if y is None else y)
     from analytics_zoo_tpu.feature.rdd import is_rdd_like, \
         is_spark_dataframe
     if is_rdd_like(data) or is_spark_dataframe(data):

@@ -357,3 +357,36 @@ class TestNewImageTransforms:
         out = ImageMatToFloats().apply(self._feature(img))
         flat = out[ImageFeature.IMAGE]
         assert flat.dtype == np.float32 and flat.shape == (36,)
+
+
+def test_fit_accepts_textset_and_imageset_directly():
+    # reference API shape: model.fit(train_set, ...) over TextSet
+    # (qa_ranker.py) and ImageSet
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential, \
+        layers as L
+    rs = np.random.RandomState(0)
+
+    texts = [f"word{i} word{(i * 7) % 5} filler" for i in range(16)]
+    ts = TextSet.from_texts(texts, labels=list(rs.randint(0, 2, 16)))
+    ts.tokenize().normalize().word2idx().shape_sequence(6)
+    m = Sequential()
+    m.add(L.Embedding(40, 8, input_shape=(6,)))
+    m.add(L.GlobalAveragePooling1D())
+    m.add(L.Dense(2))
+    m.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    res = m.fit(ts, batch_size=8, nb_epoch=1)
+    assert np.isfinite(res.history[-1]["loss"])
+    assert m.predict(ts, batch_size=8).shape == (16, 2)
+
+    from analytics_zoo_tpu.feature.image import ImageSet
+    imgs = rs.rand(16, 8, 8, 3).astype(np.float32)
+    iset = ImageSet.from_arrays(imgs, labels=rs.randint(0, 3, 16))
+    mi = Sequential()
+    mi.add(L.Convolution2D(4, 3, border_mode="same",
+                           activation="relu", input_shape=(8, 8, 3)))
+    mi.add(L.GlobalAveragePooling2D())
+    mi.add(L.Dense(3))
+    mi.compile(optimizer="adam",
+               loss="sparse_categorical_crossentropy")
+    res = mi.fit(iset, batch_size=8, nb_epoch=1)
+    assert np.isfinite(res.history[-1]["loss"])
