@@ -59,6 +59,10 @@ def load_native() -> Optional[ctypes.CDLL]:
             if not os.path.exists(_SO_PATH):   # stale-but-present is usable
                 _build_failed = True
                 return None
+            import logging
+            logging.getLogger("analytics_zoo_tpu").warning(
+                "native: rebuild failed; loading STALE %s (sources are "
+                "newer than the binary)", _SO_PATH)
         try:
             lib = ctypes.CDLL(_SO_PATH)
         except OSError:

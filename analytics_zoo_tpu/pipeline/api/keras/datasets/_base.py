@@ -8,7 +8,7 @@ import numpy as np
 
 from analytics_zoo_tpu.common.nncontext import logger
 
-DEFAULT_DIR = "/tmp/.zoo/dataset"
+DEFAULT_DIR = os.path.join(os.path.expanduser("~"), ".zoo", "dataset")
 
 
 def cache_path(dest_dir: str, name: str) -> str:

@@ -14,7 +14,7 @@ import os
 import numpy as np
 
 from analytics_zoo_tpu.pipeline.api.keras.datasets._base import (
-    cache_path, synthetic_notice)
+    DEFAULT_DIR, cache_path, synthetic_notice)
 
 TRAIN_MEAN = 0.13066047740239506 * 255
 TRAIN_STD = 0.3081078 * 255
@@ -78,7 +78,7 @@ def read_data_sets(train_dir, data_type="train"):
                       else 1)
 
 
-def load_data(location="/tmp/.zoo/dataset/mnist"):
+def load_data(location=os.path.join(DEFAULT_DIR, "mnist")):
     x_train, y_train = read_data_sets(location, "train")
     x_test, y_test = read_data_sets(location, "test")
     return (x_train, y_train), (x_test, y_test)

@@ -27,14 +27,37 @@ def load_data(dest_dir=DEFAULT_DIR, nb_words=None, oov_char=2,
               test_split=0.2):
     npz = cache_path(dest_dir, "reuters.npz")
     pkl = cache_path(dest_dir, "reuters.pkl")
+    xs = None
+    bad_npz = False
     if os.path.exists(npz):
-        with np.load(npz, allow_pickle=True) as f:
-            xs, ys = list(f["x"]), list(f["y"])
-    elif os.path.exists(pkl):
+        # Ragged sequences are stored flat (x_flat) + offsets (x_off)
+        # so the npz never contains object arrays and loads with
+        # allow_pickle=False — object-array caches would need
+        # unrestricted pickle, which the repo's CheckedUnpickler
+        # policy forbids.
+        try:
+            with np.load(npz, allow_pickle=False) as f:
+                flat, off = f["x_flat"], f["x_off"]
+                xs = [list(flat[off[i]:off[i + 1]])
+                      for i in range(len(off) - 1)]
+                ys = list(f["y"])
+        except (KeyError, ValueError):
+            bad_npz = True
+            xs = None
+    if bad_npz:
+        from analytics_zoo_tpu.common.nncontext import logger
+        logger.warning(
+            "datasets.reuters: cache %s is not in the flat+offsets "
+            "format and was ignored; re-save it with "
+            "x_flat=concat(seqs), x_off=cumsum([0]+lengths), y=labels "
+            "(legacy object-array caches can be converted from the "
+            "reuters.pkl via CheckedUnpickler)", npz)
+    if xs is None and os.path.exists(pkl):
         with open(pkl, "rb") as f:
             xs, ys = CheckedUnpickler(f).load()
-    else:
-        synthetic_notice("reuters", f"no cache at {npz}")
+    if xs is None:
+        if not bad_npz:
+            synthetic_notice("reuters", f"no cache at {npz}")
         xs = synthetic_sequences(640, _VOCAB, seed=20, mean_len=80)
         ys = list(np.random.RandomState(21).randint(
             0, _CLASSES, size=len(xs)))

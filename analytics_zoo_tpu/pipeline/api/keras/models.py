@@ -271,9 +271,35 @@ class KerasNet(KerasLayer):
         missing = [n for n in dst_est.params if n not in src]
         if strict and missing:
             raise KeyError(f"layers missing from source: {missing}")
-        dst_est.params = {
-            name: (src[name] if name in src else sub)
-            for name, sub in dst_est.params.items()}
+
+        from analytics_zoo_tpu.common.nncontext import logger
+
+        def _shapes(tree):
+            return [(p, tuple(leaf.shape)) for p, leaf in
+                    jax.tree_util.tree_leaves_with_path(tree)]
+
+        new_params = {}
+        for name, sub in dst_est.params.items():
+            if name not in src:
+                new_params[name] = sub
+                continue
+            if _shapes(src[name]) != _shapes(sub):
+                if strict:
+                    raise ValueError(
+                        f"layer {name!r}: source weights "
+                        f"{_shapes(src[name])} incompatible with "
+                        f"{_shapes(sub)}")
+                logger.warning(
+                    "copy_weights_from: skipping layer %r — source "
+                    "shapes %s != destination %s", name,
+                    _shapes(src[name]), _shapes(sub))
+                new_params[name] = sub
+                continue
+            # dtype differences (e.g. f32 backbone -> bf16 model) cast
+            # to the destination's dtype rather than skipping
+            new_params[name] = jax.tree_util.tree_map(
+                lambda s, d: jnp.asarray(s, d.dtype), src[name], sub)
+        dst_est.params = new_params
         dst_est._train_step = None           # invalidate compiled step
         return self
 

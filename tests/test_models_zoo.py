@@ -321,6 +321,50 @@ def test_keras_datasets_offline():
     np.testing.assert_array_equal(xb, xb2)
 
 
+def test_reuters_npz_flat_offsets(tmp_path):
+    # the npz cache stores ragged sequences as flat ints + offsets so
+    # it loads with allow_pickle=False (no pickle execution surface)
+    from analytics_zoo_tpu.pipeline.api.keras.datasets import reuters
+    seqs = [[4, 5, 6], [7, 8], [9, 10, 11, 12]]
+    flat = np.concatenate([np.asarray(s) for s in seqs])
+    off = np.cumsum([0] + [len(s) for s in seqs])
+    np.savez(tmp_path / "reuters.npz", x_flat=flat, x_off=off,
+             y=np.array([1, 2, 3]))
+    (xr, yr), (xrt, yrt) = reuters.load_data(str(tmp_path),
+                                             test_split=1 / 3)
+    got = [list(s) for s in (xrt + xr)]
+    assert got == seqs
+    assert list(yrt) + list(yr) == [1, 2, 3]
+    # an object-array npz (the unsafe layout) is rejected, not unpickled
+    np.savez(tmp_path / "reuters.npz",
+             x=np.array([[1], [2, 3]], dtype=object),
+             y=np.array([0, 1]))
+    (xr, yr), _ = reuters.load_data(str(tmp_path))  # falls to synthetic
+    assert len(xr) > 0
+
+
+def test_copy_weights_from_shape_mismatch():
+    # same-named layer with different dims is skipped (non-strict) or
+    # raises (strict) instead of silently installing mismatched params
+    import jax
+    import pytest
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    from analytics_zoo_tpu.pipeline.api.keras.models import Sequential
+    a = Sequential([Dense(4, input_shape=(3,), name="d")])
+    b = Sequential([Dense(5, input_shape=(3,), name="d")])
+    a.compile(optimizer="sgd", loss="mse")
+    b.compile(optimizer="sgd", loss="mse")
+    a.estimator._ensure_initialized()
+    b.estimator._ensure_initialized()
+    before = jax.tree_util.tree_leaves(b.estimator.params)
+    b.copy_weights_from(a)                    # skipped with a warning
+    after = jax.tree_util.tree_leaves(b.estimator.params)
+    for x, y in zip(before, after):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    with pytest.raises(ValueError):
+        b.copy_weights_from(a, strict=True)
+
+
 def test_mnist_idx_roundtrip(tmp_path):
     # loader reads the REAL idx-gzip format when cache files exist
     import gzip
