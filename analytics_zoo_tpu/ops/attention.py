@@ -32,13 +32,33 @@ import jax.numpy as jnp
 
 def resolve_attention_impl(impl: Optional[str]) -> str:
     """Resolve an attention-impl selector: None → ``ZOO_TPU_ATTENTION``
-    env (default "xla"); validates against the known impls. The single
-    copy of this policy — used by dot_product_attention, the
-    sequence-parallel attentions, and the transformer layers."""
-    impl = impl or os.environ.get("ZOO_TPU_ATTENTION", "xla")
+    env (default "auto" — the Pallas flash kernel whenever it wins);
+    validates against the known impls. The single copy of this policy —
+    used by dot_product_attention, the sequence-parallel attentions,
+    and the transformer layers."""
+    impl = impl or os.environ.get("ZOO_TPU_ATTENTION", "auto")
     if impl not in ("xla", "flash", "auto"):
         raise ValueError(f"unknown attention impl {impl!r}")
     return impl
+
+
+def flash_backend_ok() -> bool:
+    """Whether "auto" may route to the Pallas kernel on this backend:
+    real TPU, or anywhere when ``ZOO_TPU_FLASH_FORCE_INTERPRET=1``
+    (CPU kernel-coverage tests). Explicit ``impl="flash"`` ignores
+    this and runs the interpreter off-TPU."""
+    if os.environ.get("ZOO_TPU_FLASH_FORCE_INTERPRET") == "1":
+        return True
+    return jax.default_backend() in ("tpu", "axon")
+
+
+def flash_profitable(tk: int) -> bool:
+    """Whether flash beats XLA dense at this key length. Measured on
+    the v5e (fwd+bwd, B=4 H=16 D=64 bf16, causal): dense wins at
+    Tk ≤ 512 (0.48x/0.13x at 256/512), flash wins from 1024 up
+    (1.82x/2.47x/3.7x at 1024/2048/4096 — PERF.md). Crossover is
+    overridable via ``ZOO_TPU_FLASH_MIN_T``."""
+    return tk >= int(os.environ.get("ZOO_TPU_FLASH_MIN_T", "1024"))
 
 
 def dot_product_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
@@ -51,20 +71,26 @@ def dot_product_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     `mask`: broadcastable to (B, H, Tq, Tk), 1 = attend. Softmax in f32
     regardless of input dtype (bf16-safe).
 
-    `impl`: "xla" (default), "flash" (Pallas VMEM-resident kernel), or
-    "auto" (flash when the problem qualifies — 128-divisible sequence
-    lengths and a mask that is absent or a pure key-padding mask like
-    BERT's (B, 1, 1, Tk)). ``ZOO_TPU_ATTENTION`` sets the default
+    `impl`: "auto" (the default: Pallas flash kernel when the problem
+    qualifies — 128-divisible sequence lengths, a mask that is absent
+    or a pure key-padding mask like BERT's (B, 1, 1, Tk), a TPU
+    backend, and Tk past the measured dense/flash crossover — else
+    XLA dense), "flash" (force the kernel; interpret mode off-TPU),
+    or "xla" (force dense). ``ZOO_TPU_ATTENTION`` sets the default
     process-wide.
     """
     impl = resolve_attention_impl(impl)
-    if impl != "xla":
+    # cheap gates first so the default ("auto") path off-TPU / below
+    # the crossover never imports pallas or inspects the mask
+    if impl == "flash" or (impl == "auto" and flash_backend_ok()
+                           and flash_profitable(k.shape[1])):
         from analytics_zoo_tpu.ops import flash_attention as fa
         # single routing decision: shapes kernel-compatible AND the
         # mask (if any) reduces to the kernel's key-padding form
         km = fa.as_key_mask(mask, q.shape[0], k.shape[1])
-        if fa.supports(q.shape[1], k.shape[1], q.shape[-1], None) \
-                and (mask is None or km is not None):
+        supported = fa.supports(q.shape[1], k.shape[1], q.shape[-1],
+                                None) and (mask is None or km is not None)
+        if supported:
             return fa.flash_attention(q, k, v, causal=causal,
                                       scale=scale, key_mask=km)
         if impl == "flash":

@@ -34,6 +34,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
 
+# Incremented (at trace time) on every flash_attention /
+# flash_block_partial entry, so tests can assert that a given API
+# call actually routed to the Pallas kernel.
+invocations = 0
+
 
 def _apply_causal_mask(s, qi, ki, off, block_q, block_k,
                        fill=_NEG_INF):
@@ -568,6 +573,8 @@ def flash_block_partial(q, k, v, qk_offset, causal: bool, scale: float,
     (acc (B, Tq, H, D) f32 unnormalised, m (B, H, Tq) f32,
     l (B, H, Tq) f32) with softmax base `m`.
     """
+    global invocations
+    invocations += 1
     if interpret is None:
         interpret = jax.default_backend() not in ("tpu", "axon")
     b, tq, h, d = q.shape
@@ -643,6 +650,8 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     applied natively in the kernel (fwd AND bwd).
     `interpret=None` auto-selects the Pallas interpreter off-TPU.
     """
+    global invocations
+    invocations += 1
     d = q.shape[-1]
     scale = float(scale if scale is not None else 1.0 / (d ** 0.5))
     b, tq, tk = q.shape[0], q.shape[1], k.shape[1]

@@ -118,12 +118,14 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     over `axis`; returns (B, T, H, D) sharded the same way. Falls back
     to a single-block computation when the axis is absent or size 1.
 
-    `impl`: "xla" (jnp blockwise softmax, default), "flash" (Pallas
-    partial-softmax kernel per ring step; needs 128-divisible local T),
-    or "auto"; default from ``ZOO_TPU_ATTENTION`` like
-    `ops.attention.dot_product_attention`.
+    `impl`: "auto" (the default: Pallas partial-softmax kernel per
+    ring step on TPU when local T is 128-divisible and past the
+    dense/flash crossover, else jnp blockwise softmax), "flash"
+    (force the kernel), or "xla" (force jnp); default from
+    ``ZOO_TPU_ATTENTION`` like `ops.attention.dot_product_attention`.
     """
-    from analytics_zoo_tpu.ops.attention import resolve_attention_impl
+    from analytics_zoo_tpu.ops.attention import (
+        flash_backend_ok, flash_profitable, resolve_attention_impl)
     impl = resolve_attention_impl(impl)
     if axis not in mesh.axis_names or mesh.shape[axis] == 1:
         from analytics_zoo_tpu.ops.attention import dot_product_attention
@@ -131,8 +133,10 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                                      impl=impl)
     n = mesh.shape[axis]
     t_loc = q.shape[1] // n
-    use_flash = impl != "xla" and t_loc % 128 == 0 and \
-        q.shape[-1] <= 256
+    compatible = t_loc % 128 == 0 and q.shape[-1] <= 256
+    use_flash = compatible and (impl == "flash" or (
+        impl == "auto" and flash_backend_ok()
+        and flash_profitable(t_loc)))
     if impl == "flash" and not use_flash:
         raise ValueError(
             f"impl='flash' needs local T (={t_loc}) divisible by 128 "

@@ -15,11 +15,22 @@ def cache_path(dest_dir: str, name: str) -> str:
     return os.path.join(os.path.expanduser(dest_dir), name)
 
 
+_LEGACY_DIR = "/tmp/.zoo/dataset"
+
+
 def synthetic_notice(dataset: str, why: str) -> None:
+    legacy = ""
+    # never READ the world-writable legacy location (ADVICE r2), but
+    # do tell users their old cache needs moving to the per-user dir
+    if os.path.isdir(_LEGACY_DIR):
+        legacy = (f" NOTE: a legacy cache dir exists at {_LEGACY_DIR}; "
+                  f"it is no longer read — move your files to "
+                  f"{DEFAULT_DIR} (after verifying you created them).")
     logger.warning(
         "datasets.%s: %s — generating a deterministic SYNTHETIC "
         "stand-in (real shapes/dtypes, fake content). Place the "
-        "reference cache file locally to use real data.", dataset, why)
+        "reference cache file locally to use real data.%s",
+        dataset, why, legacy)
 
 
 def synthetic_sequences(n, vocab, seed, mean_len=120, max_len=400):

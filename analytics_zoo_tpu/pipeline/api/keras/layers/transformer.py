@@ -68,8 +68,9 @@ class MultiHeadAttention(KerasLayer):
             raise ValueError("hidden_size must divide by n_head")
         from analytics_zoo_tpu.parallel import get_sp_attention
         get_sp_attention(sequence_parallel_mode)  # validate early
-        # None → ZOO_TPU_ATTENTION env (default "xla"); "auto"/"flash"
-        # select the Pallas flash kernel (ops/flash_attention.py)
+        # None → ZOO_TPU_ATTENTION env (default "auto": the Pallas
+        # flash kernel on TPU past the crossover, else XLA dense);
+        # "flash"/"xla" force one path (ops/flash_attention.py)
         if attention_impl is not None:
             resolve_attention_impl(attention_impl)  # validate early
         self.attention_impl = attention_impl

@@ -273,12 +273,16 @@ class TestRemat:
 
 
 def test_estimator_trains_with_flash_attention(rng, monkeypatch):
-    # ZOO_TPU_ATTENTION=auto routes the training loop's attention
-    # through the Pallas kernel (interpret mode on CPU) end to end
-    monkeypatch.setenv("ZOO_TPU_ATTENTION", "auto")
+    # the default impl ("auto") routes the training loop's attention
+    # through the Pallas kernel end to end once the backend/crossover
+    # gates pass (forced here: interpret mode on CPU, crossover at 128)
+    monkeypatch.setenv("ZOO_TPU_FLASH_FORCE_INTERPRET", "1")
+    monkeypatch.setenv("ZOO_TPU_FLASH_MIN_T", "128")
     from analytics_zoo_tpu import init_nncontext
+    from analytics_zoo_tpu.ops import flash_attention as fa
     from analytics_zoo_tpu.pipeline.estimator import Estimator
     init_nncontext(tpu_mesh={"data": -1})
+    calls_before = fa.invocations
     m = Sequential()
     m.add(L.TransformerLayer(n_block=1, hidden_size=16, n_head=2,
                              seq_len=128, vocab=32))
@@ -289,3 +293,4 @@ def test_estimator_trains_with_flash_attention(rng, monkeypatch):
     y = rng.randint(0, 4, (8, 1)).astype(np.int32)
     res = est.train(x, y, batch_size=8, nb_epoch=1)
     assert np.isfinite(res.history[-1]["loss"])
+    assert fa.invocations > calls_before  # kernel was actually hit
