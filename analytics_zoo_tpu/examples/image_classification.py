@@ -41,7 +41,8 @@ def main(argv=None):
     size = args.image_size
     imc = ImageClassifier.load_model(
         args.model, weights_path=args.weights,
-        input_shape=(size, size, 3), classes=args.classes)
+        input_shape=(size, size, 3), classes=args.classes,
+        allow_random=args.weights is None)
     if args.weights is None:
         imc.compile()  # random weights: demonstrates the pipeline
 

@@ -68,17 +68,19 @@ class ImageClassifier(ZooModel):
 
     @classmethod
     def load_model(cls, path_or_name: str, weights_path=None,
-                   input_shape=(224, 224, 3), classes: int = 1000):
+                   input_shape=(224, 224, 3), classes: int = 1000,
+                   allow_random: bool = False):
         """Registry-aware load (reference
         `ImageClassifier.loadModel` by published name): a known
         architecture name (e.g. ``"resnet-50"``) builds it and loads
         shape-validated weights from ``weights_path`` /
-        ``$ZOO_TPU_PRETRAINED_DIR``; anything else is a
+        ``$ZOO_TPU_PRETRAINED_DIR`` (raising when no artifact is
+        found unless ``allow_random=True``); anything else is a
         ``save_model`` file path."""
         from analytics_zoo_tpu.models.config import (
             ImageClassificationConfig, _strip_published_name)
         if _strip_published_name(path_or_name).lower() in _builders():
             return ImageClassificationConfig.create(
                 path_or_name, input_shape=input_shape, classes=classes,
-                weights_path=weights_path)
+                weights_path=weights_path, allow_random=allow_random)
         return super().load_model(path_or_name)

@@ -79,17 +79,19 @@ class ObjectDetector(ZooModel):
 
     @classmethod
     def load_model(cls, path_or_name: str, weights_path=None,
-                   n_classes=None, img_size=None):
+                   n_classes=None, img_size=None,
+                   allow_random: bool = False):
         """Registry-aware load (reference
         `ObjectDetector.load(name)` via `ObjectDetectionConfig`):
-        known variant names build + load local weights; other strings
-        are ``save_model`` file paths."""
+        known variant names build + load local weights (raising when
+        no artifact is found unless ``allow_random=True``); other
+        strings are ``save_model`` file paths."""
         from analytics_zoo_tpu.models.config import (
             ObjectDetectionConfig, _strip_published_name)
         if _strip_published_name(path_or_name).lower() in CONFIGS:
             return ObjectDetectionConfig.create(
                 path_or_name, n_classes=n_classes, img_size=img_size,
-                weights_path=weights_path)
+                weights_path=weights_path, allow_random=allow_random)
         return super().load_model(path_or_name)
 
     # -- training -----------------------------------------------------------
