@@ -39,10 +39,14 @@ def main(argv=None):
 
     init_nncontext()
     size = args.image_size
+    # random-weights demo only when NO weight source is configured at
+    # all — if a pretrained dir is set but resolution fails, raise
+    # rather than silently predict with random weights
     imc = ImageClassifier.load_model(
         args.model, weights_path=args.weights,
         input_shape=(size, size, 3), classes=args.classes,
-        allow_random=args.weights is None)
+        allow_random=(args.weights is None
+                      and not os.environ.get("ZOO_TPU_PRETRAINED_DIR")))
     if args.weights is None:
         imc.compile()  # random weights: demonstrates the pipeline
 

@@ -182,6 +182,30 @@ class ZooModel:
         return self
 
 
+class ImportedZooModel(ZooModel):
+    """ZooModel surface over a net imported from an external artifact
+    (reference `ZooModel.loadModel`: the artifact defines the
+    architecture). `build_model` re-imports from `artifact`, so
+    ``save_model``/``load_model`` round-trips work as long as the
+    artifact file stays in place (saved fine-tuned weights are
+    shape-validated over the re-imported net)."""
+
+    def __init__(self, artifact: str, model_name: str = "imported",
+                 net: Optional[KerasNet] = None):
+        super().__init__()
+        self.artifact = str(artifact)
+        self.model_name = str(model_name)
+        self._model = net
+
+    def build_model(self) -> KerasNet:
+        from analytics_zoo_tpu.pipeline.api.net_load import Net
+        return Net.load_bigdl(self.artifact)
+
+    def hyper_parameters(self) -> dict:
+        return {"artifact": self.artifact,
+                "model_name": self.model_name}
+
+
 class Ranker:
     """Ranking evaluation mixin (reference `models/common/Ranker.scala:33`):
     NDCG@k (`:112`) and MAP (`:147`) over grouped (query, candidates)

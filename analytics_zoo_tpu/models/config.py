@@ -45,8 +45,10 @@ def _resolve_weights(name: str, arch: str,
         return weights_path
     root = os.environ.get("ZOO_TPU_PRETRAINED_DIR")
     if root:
-        for stem in dict.fromkeys((name, arch)):        # ordered, deduped
-            for ext in (".npz", ".model"):
+        # .npz (shape-validated into the built arch) under either stem
+        # beats any .model (artifact-defined arch)
+        for ext in (".npz", ".model"):
+            for stem in dict.fromkeys((name, arch)):    # ordered, deduped
                 cand = os.path.join(root, stem + ext)
                 if os.path.exists(cand):
                     return cand
@@ -62,12 +64,14 @@ def _missing_weights_error(kind: str, name: str) -> FileNotFoundError:
 
 
 def _load_bigdl_artifact(kind: str, arch: str, path: str,
-                         ignored_args: dict):
+                         ignored_args: dict, wrapper=None):
     """A reference ``.model`` artifact defines the model
-    (`ZooModel.loadModel`): import it whole via the BigDL codec.
-    Returns the imported `Sequential` — NOT an
-    ImageClassifier/ObjectDetector wrapper — because the artifact's
-    own architecture wins."""
+    (`ZooModel.loadModel`): import it whole via the BigDL codec. The
+    imported net is adopted into `wrapper` (an
+    ImageClassifier/ObjectDetector built for a known arch, keeping
+    detect()/save_weights/the full ZooModel surface) or, for archs
+    outside the wrapper registries, returned as an
+    `ImportedZooModel`."""
     from analytics_zoo_tpu.pipeline.api.net_load import Net
     dropped = {k: v for k, v in ignored_args.items() if v is not None}
     if dropped:
@@ -77,7 +81,12 @@ def _load_bigdl_artifact(kind: str, arch: str, path: str,
             dropped)
     logger.info("%s: %s loaded from reference artifact %s",
                 kind, arch, path)
-    return Net.load_bigdl(path)
+    net = Net.load_bigdl(path)
+    if wrapper is not None:
+        wrapper._model = net
+        return wrapper
+    from analytics_zoo_tpu.models.common import ImportedZooModel
+    return ImportedZooModel(path, model_name=arch, net=net)
 
 
 def _strip_published_name(name: str) -> str:
@@ -112,11 +121,17 @@ class ImageClassificationConfig:
             raise _missing_weights_error("ImageClassificationConfig",
                                          name)
         if wp is not None and wp.endswith(".model"):
+            wrapper = None
+            if arch in ImageClassifier.ARCHS:
+                wrapper = ImageClassifier(model_name=arch,
+                                          input_shape=input_shape,
+                                          classes=classes)
             return _load_bigdl_artifact(
                 "ImageClassificationConfig", arch, wp,
                 {"input_shape": (None if input_shape == (224, 224, 3)
                                  else input_shape),
-                 "classes": None if classes == 1000 else classes})
+                 "classes": None if classes == 1000 else classes},
+                wrapper=wrapper)
         model = ImageClassifier(model_name=arch,
                                 input_shape=input_shape,
                                 classes=classes)
@@ -153,9 +168,15 @@ class ObjectDetectionConfig:
         if wp is None and not allow_random:
             raise _missing_weights_error("ObjectDetectionConfig", name)
         if wp is not None and wp.endswith(".model"):
+            wrapper = None
+            if arch in ObjectDetectionConfig.names():
+                wrapper = ObjectDetector(model_name=arch,
+                                         n_classes=n_classes,
+                                         img_size=img_size)
             return _load_bigdl_artifact(
                 "ObjectDetectionConfig", arch, wp,
-                {"n_classes": n_classes, "img_size": img_size})
+                {"n_classes": n_classes, "img_size": img_size},
+                wrapper=wrapper)
         model = ObjectDetector(model_name=arch, n_classes=n_classes,
                                img_size=img_size)
         model.compile()

@@ -78,8 +78,14 @@ class ImageClassifier(ZooModel):
         found unless ``allow_random=True``); anything else is a
         ``save_model`` file path."""
         from analytics_zoo_tpu.models.config import (
-            ImageClassificationConfig, _strip_published_name)
-        if _strip_published_name(path_or_name).lower() in _builders():
+            ImageClassificationConfig, _resolve_weights,
+            _strip_published_name)
+        arch = _strip_published_name(path_or_name).lower()
+        # registry route: known arch, OR an artifact for this published
+        # name sits in $ZOO_TPU_PRETRAINED_DIR (e.g. a .model whose
+        # arch has no built-in builder)
+        if arch in _builders() or _resolve_weights(
+                path_or_name, arch, None) is not None:
             return ImageClassificationConfig.create(
                 path_or_name, input_shape=input_shape, classes=classes,
                 weights_path=weights_path, allow_random=allow_random)

@@ -87,8 +87,11 @@ class ObjectDetector(ZooModel):
         no artifact is found unless ``allow_random=True``); other
         strings are ``save_model`` file paths."""
         from analytics_zoo_tpu.models.config import (
-            ObjectDetectionConfig, _strip_published_name)
-        if _strip_published_name(path_or_name).lower() in CONFIGS:
+            ObjectDetectionConfig, _resolve_weights,
+            _strip_published_name)
+        arch = _strip_published_name(path_or_name).lower()
+        if arch in CONFIGS or _resolve_weights(
+                path_or_name, arch, None) is not None:
             return ObjectDetectionConfig.create(
                 path_or_name, n_classes=n_classes, img_size=img_size,
                 weights_path=weights_path, allow_random=allow_random)

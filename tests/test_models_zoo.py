@@ -311,16 +311,28 @@ class TestPretrainedRegistry:
                    "bigdl/bigdl_lenet.model")
         if not os.path.exists(fixture):
             pytest.skip("reference fixture not present")
+        from analytics_zoo_tpu.models.common import ImportedZooModel
         from analytics_zoo_tpu.models.config import \
             ImageClassificationConfig
+        from analytics_zoo_tpu.models.image.imageclassification import \
+            ImageClassifier
         name = "analytics-zoo_lenet_mnist_0.1.0"
         shutil.copy(fixture, tmp_path / f"{name}.model")
         monkeypatch.setenv("ZOO_TPU_PRETRAINED_DIR", str(tmp_path))
         net = ImageClassificationConfig.create(name)
+        # arch "lenet" has no built-in builder → ZooModel surface via
+        # ImportedZooModel (the artifact defines the architecture)
+        assert isinstance(net, ImportedZooModel)
+        assert net.model_name == "lenet"
         x = np.random.RandomState(0).randn(2, 784).astype(np.float32)
         out = net.predict(x)
         assert out.shape == (2, 5)      # the fixture's logSoftMax head
         np.testing.assert_allclose(np.exp(out).sum(-1), 1.0, atol=1e-4)
+        # the documented entry point resolves the same artifact even
+        # though "lenet" is outside the builder registry
+        m2 = ImageClassifier.load_model(name)
+        assert isinstance(m2, ImportedZooModel)
+        np.testing.assert_allclose(m2.predict(x), out, atol=1e-6)
 
 
 def test_text_matcher_base():
