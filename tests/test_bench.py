@@ -1,0 +1,29 @@
+"""Smoke test for the secondary NCF benchmark: the script must always
+print one well-formed JSON line (the driver-contract shared with
+bench.py). Runs on CPU with tiny sizes; the measured TPU number lives
+in PERF.md."""
+
+import json
+import os
+import subprocess
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_ncf_emits_json_line():
+    env = dict(os.environ,
+               ZOO_TPU_BENCH_PLATFORM="cpu",
+               ZOO_TPU_BENCH_NCF_BATCH="64",
+               ZOO_TPU_BENCH_STEPS="2")
+    out = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "bench_ncf.py")],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [l for l in out.stdout.splitlines() if l.startswith("{")]
+    assert len(lines) == 1
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "ncf_train_samples_per_sec_per_chip"
+    assert rec["unit"] == "samples/sec"
+    assert rec["value"] > 0
+    assert rec["vs_baseline"] is None
