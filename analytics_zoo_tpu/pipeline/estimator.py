@@ -407,7 +407,16 @@ class Estimator:
 
     @staticmethod
     def _merge_updates(params, updates):
-        """Recursively fold BatchNorm-style state updates into params."""
+        """Recursively fold BatchNorm-style state updates into params.
+        Lists merge element-wise with ``None`` meaning "unchanged"
+        (the tfpark bridge's sparse weight-list updates)."""
+        if updates is None:
+            return params
+        if isinstance(updates, (list, tuple)) and \
+                isinstance(params, (list, tuple)):
+            return type(params)(
+                Estimator._merge_updates(p, u)
+                for p, u in zip(params, updates))
         if not isinstance(updates, dict) or not isinstance(params, dict):
             return updates
         out = dict(params)

@@ -9,8 +9,13 @@ weights are assigned back into the live tf.keras model — preserving the
 reference's weights→session contract (`net.py:703-714`), so
 `model.save(...)`/`get_weights()` see the trained values.
 
-Known limitation (documented in `tf_graph`): BatchNorm moving averages
-do not update through the bridge (update side effects are stripped).
+BatchNorm moving averages DO update through the bridge (round 3): the
+stripped `AssignSubVariableOp` values come back as extra outputs of
+the training function and are folded into the tracked variables after
+each step (`Estimator._merge_updates`), matching the reference's
+all-variables round-trip (`TFTrainingHelper.scala:83-136`). The one
+remaining gap is the `call_tf` fallback path (unsupported ops), where
+updates are dropped with a warning.
 """
 
 from __future__ import annotations
@@ -39,7 +44,8 @@ class _TFKerasNet:
     rejects int inputs and they are never trainable."""
 
     def __init__(self, train_fn, infer_fn, weight_values: List,
-                 trainable_flags: List[bool], infer_perm: List[int]):
+                 trainable_flags: List[bool], infer_perm: List[int],
+                 update_spec: Optional[List] = None):
         from analytics_zoo_tpu.tfpark.tf_graph import split_float_weights
         self._train_fn = train_fn
         self._infer_fn = infer_fn
@@ -50,6 +56,12 @@ class _TFKerasNet:
         self._trainable = [bool(trainable_flags[i])
                            for i in self._float_idx]
         self._infer_perm = infer_perm
+        # variable updates (BN moving stats): map each extra train_fn
+        # output to its position in the FLOAT weight list (update
+        # targets are always float — the rewrite only tracks float
+        # variables as weights)
+        self._update_spec = [(self._float_idx.index(vi), kind)
+                             for vi, kind in (update_spec or [])]
         self.name = "tf_keras_net"
         self.layers: list = []
 
@@ -65,10 +77,28 @@ class _TFKerasNet:
                                 self._n)
 
     def apply(self, params, x, *, training=False, rng=None):
+        import jax
         xs = list(x) if isinstance(x, (list, tuple)) else [x]
         full = self._assemble(params["weights"])
         if training:
-            return self._train_fn(*full, *xs, rng=rng), {}
+            out, upd_vals = self._train_fn(*full, *xs, rng=rng)
+            if not self._update_spec:
+                return out, {}
+            # fold Assign{,Add,Sub} values into a sparse weight-list
+            # update (None = unchanged); grads must not flow into the
+            # moving statistics. Sequential assigns to one variable
+            # compose in graph order (`cur` tracks the running value).
+            new_ws: List = [None] * len(self._float_idx)
+            for (fi, kind), val in zip(self._update_spec, upd_vals):
+                cur = new_ws[fi] if new_ws[fi] is not None \
+                    else params["weights"][fi]
+                val = jax.lax.stop_gradient(val).astype(cur.dtype)
+                if kind == "add":
+                    val = cur + val
+                elif kind == "sub":
+                    val = cur - val
+                new_ws[fi] = val
+            return out, {"weights": new_ws}
         wi = [full[i] for i in self._infer_perm]
         return self._infer_fn(*wi, *xs), {}
 
@@ -104,8 +134,9 @@ class KerasModel:
         def call_infer(*xs):
             return model(xs if n_in > 1 else xs[0], training=False)
 
-        train_fn, train_vars = to_jax_fn(call_train, sig,
-                                         variables=model.variables)
+        train_fn, train_vars, update_spec = to_jax_fn(
+            call_train, sig, variables=model.variables,
+            with_updates=True)
         infer_fn, infer_vars = to_jax_fn(call_infer, sig,
                                          variables=model.variables)
         # second trace may order/use variables differently; permute
@@ -124,7 +155,7 @@ class KerasModel:
             train_fn, infer_fn,
             [v.numpy() for v in train_vars],
             [id(v) in trainable_ids for v in train_vars],
-            perm)
+            perm, update_spec=update_spec)
 
         opt = optimizer if optimizer is not None else \
             keras_optimizer_to_zoo(getattr(model, "optimizer", None))

@@ -16,9 +16,12 @@ Rewrite steps (see `make_explicit_fn`):
    and drop the resource placeholders;
 4. strip the control edges TF adds from reads to the output NoOp
    (they would force the now-unfed placeholders to execute);
-5. drop moving-stat update side effects (`AssignVariableOp` etc.) —
-   documented limitation: BatchNorm moving averages do not update
-   through this bridge;
+5. strip update side effects (`AssignVariableOp` etc.) — but capture
+   each plain Assign{,Add,Sub}VariableOp's VALUE tensor targeting a
+   tracked variable, so callers can request them as extra outputs
+   (`to_jax_fn(with_updates=True)`) and fold BatchNorm moving
+   averages back after each step, matching the reference's
+   all-variables round-trip (`TFTrainingHelper.scala:83-136`);
 6. re-wrap with `tf.compat.v1.wrap_function`, feeding reads via
    `input_map`, with signature `(*weights, *inputs)`.
 """
@@ -37,6 +40,16 @@ _SIDE_EFFECT_OPS = {
     "ResourceApplyMomentum",
 }
 
+# plain variable assigns whose VALUE can be captured as an extra
+# function output and folded back by the caller (optimizer
+# ResourceApply* ops are not: training state belongs to the zoo
+# optimizer, not the bridged graph)
+_ASSIGN_KINDS = {
+    "AssignVariableOp": "assign",
+    "AssignAddVariableOp": "add",
+    "AssignSubVariableOp": "sub",
+}
+
 
 def _tf():
     import tensorflow as tf
@@ -47,7 +60,8 @@ class _Rewritten:
     """Products of the variable-to-input graph rewrite."""
 
     def __init__(self, gd, read_map, const_reads, const_feeds,
-                 input_names, output_names, used_vars, input_specs):
+                 input_names, output_names, used_vars, input_specs,
+                 update_map=None):
         self.gd = gd
         self.read_map = read_map          # read tensor -> weight index
         self.const_reads = const_reads    # read tensor -> const value
@@ -56,6 +70,11 @@ class _Rewritten:
         self.output_names = output_names
         self.used_vars = used_vars
         self.input_specs = input_specs
+        # captured variable-update side effects: the value tensor fed
+        # to a stripped Assign{,Add,Sub}VariableOp targeting a tracked
+        # variable — [(value_tensor_name, var_index, kind)] with kind
+        # in {"assign", "add", "sub"}
+        self.update_map = update_map or []
 
 
 def _rewrite(fn: Callable, input_signature: Sequence,
@@ -135,6 +154,7 @@ def _rewrite(fn: Callable, input_signature: Sequence,
     # -- 3. swap ReadVariableOps for Placeholders; drop resource phs ------
     read_map: dict = {}     # read output tensor name -> weight index
     const_reads: dict = {}  # read output tensor name -> constant value
+    update_map: list = []   # (value tensor, var index, assign kind)
     swapped = set()
     new_nodes = []
 
@@ -212,6 +232,19 @@ def _rewrite(fn: Callable, input_signature: Sequence,
                                            node.name in ph_to_const):
             continue
         elif node.op in _SIDE_EFFECT_OPS:
+            # the op itself is stripped (no resources at run time), but
+            # a plain Assign* targeting a TRACKED variable is a state
+            # update the caller can fold back (BatchNorm moving stats,
+            # reference TFTrainingHelper.scala:83-136 round-trips ALL
+            # variables): capture its value tensor as an extra output
+            kind = _ASSIGN_KINDS.get(node.op)
+            if kind is not None and node.input:
+                res = _resolve_src(node.input[0].split(":")[0])
+                if res in ph_to_var:
+                    val = node.input[1]
+                    if ":" not in val:
+                        val = val + ":0"
+                    update_map.append((val, ph_to_var[res], kind))
             swapped.add(node.name)  # strip, and strip control refs to it
             continue
         else:
@@ -253,7 +286,8 @@ def _rewrite(fn: Callable, input_signature: Sequence,
     input_specs = [(tuple(t.shape), t.dtype) for t in graph.inputs
                    if t.op.name not in captured]
     return _Rewritten(gd2, read_map, const_reads, const_feeds,
-                      input_names, output_names, used_vars, input_specs)
+                      input_names, output_names, used_vars, input_specs,
+                      update_map=update_map)
 
 
 def make_explicit_fn(fn: Callable, input_signature: Sequence,
@@ -295,7 +329,8 @@ def make_explicit_fn(fn: Callable, input_signature: Sequence,
 
 def to_jax_fn(fn: Callable, input_signature: Sequence,
               variables: Optional[Sequence] = None,
-              prefer_native: bool = True):
+              prefer_native: bool = True,
+              with_updates: bool = False):
     """TF function → JAX function ``(jax_fn(*weights, *inputs), vars)``.
 
     Preferred path: the GraphDef→jnp interpreter (`graphdef_jax`) — the
@@ -303,8 +338,20 @@ def to_jax_fn(fn: Callable, input_signature: Sequence,
     differentiates with `jax.grad` directly. Fallback (unsupported ops,
     e.g. `While` from keras LSTM): `jax2tf.call_tf`, which requires TF
     kernels for the backend (CPU-only in this image).
+
+    ``with_updates=True`` returns ``(jax_fn, vars, update_spec)``:
+    the stripped variable-update side effects (BatchNorm moving
+    averages — Assign{,Add,Sub}VariableOp on tracked variables) become
+    extra outputs, ``jax_fn`` returns ``(outputs, update_values)`` and
+    ``update_spec`` is ``[(var_index, kind)]`` aligned with
+    ``update_values`` (kind in {"assign", "add", "sub"}; "add"/"sub"
+    values are deltas to apply to the variable). On the call_tf
+    fallback the spec is empty — updates stay a documented limitation
+    there.
     """
     rw = _rewrite(fn, input_signature, variables)
+    upd_tensors = [t for t, _, _ in rw.update_map]
+    upd_spec = [(vi, kind) for _, vi, kind in rw.update_map]
     if prefer_native:
         from analytics_zoo_tpu.tfpark.graphdef_jax import \
             GraphDefFunction
@@ -313,16 +360,41 @@ def to_jax_fn(fn: Callable, input_signature: Sequence,
         feeds = dict(rw.const_reads)
         feeds.update(rw.const_feeds)
         gfn = GraphDefFunction(
-            rw.gd, read_names + rw.input_names, rw.output_names,
+            rw.gd, read_names + rw.input_names, list(rw.output_names),
             const_feeds=feeds)
         missing = gfn.unsupported_ops()
+        if not missing and with_updates and upd_tensors:
+            # updates ride along only if THEIR subgraph also
+            # interprets — never degrade the main function to the
+            # call_tf fallback because of an assign-value op
+            gfn_full = GraphDefFunction(
+                rw.gd, read_names + rw.input_names,
+                list(rw.output_names) + upd_tensors, const_feeds=feeds)
+            if gfn_full.unsupported_ops():
+                logger.warning(
+                    "to_jax_fn: ops %s in the variable-update subgraph "
+                    "are not interpreted; dropping %d updates (moving "
+                    "statistics will not update)",
+                    gfn_full.unsupported_ops(), len(upd_tensors))
+                upd_tensors, upd_spec = [], []
+            else:
+                gfn = gfn_full
         if not missing:
             n_w = len(rw.used_vars)
+            n_out = len(rw.output_names)
 
             def jax_fn(*args, rng=None):
                 ws, xs = args[:n_w], args[n_w:]
-                return gfn(*[ws[vi] for vi in read_idx], *xs, rng=rng)
+                res = gfn(*[ws[vi] for vi in read_idx], *xs, rng=rng)
+                if not with_updates:
+                    return res
+                res = res if isinstance(res, (list, tuple)) else [res]
+                main = res[:n_out]
+                main = main[0] if n_out == 1 else tuple(main)
+                return main, list(res[n_out:])
 
+            if with_updates:
+                return jax_fn, rw.used_vars, upd_spec
             return jax_fn, rw.used_vars
         logger.warning(
             "graphdef_jax: ops %s not interpreted; falling back to "
@@ -334,8 +406,16 @@ def to_jax_fn(fn: Callable, input_signature: Sequence,
 
     def jax_fn(*args, rng=None):
         del rng  # call_tf path: graph randomness stays baked
-        return ctf(*args)
+        out = ctf(*args)
+        return (out, []) if with_updates else out
 
+    if with_updates:
+        if rw.update_map:
+            logger.warning(
+                "to_jax_fn: %d variable updates dropped on the "
+                "call_tf fallback path (moving statistics will not "
+                "update)", len(rw.update_map))
+        return jax_fn, used_vars, []
     return jax_fn, used_vars
 
 
