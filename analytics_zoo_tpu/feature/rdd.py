@@ -73,21 +73,39 @@ def _partition_filter(shard_index: int, num_shards: int) -> Callable:
     return keep
 
 
-def collect_shard(rdd: Any, shard_index: Optional[int] = None,
-                  num_shards: Optional[int] = None) -> "list":
-    """Collect this host's round-robin share of an RDD-like's records."""
+def iter_shard(rdd: Any, shard_index: Optional[int] = None,
+               num_shards: Optional[int] = None) -> Iterator:
+    """Stream this host's round-robin share of an RDD-like's records.
+
+    Uses ``toLocalIterator()`` when the RDD provides it (pyspark does:
+    one partition resident at a time on the driver, reference
+    NNEstimator.scala:571-674 streams partitions through executors the
+    same way) and falls back to ``collect()`` otherwise."""
     if shard_index is None or num_shards is None:
         shard_index, num_shards = process_shard_spec()
     if num_shards == 1:
-        return list(rdd.collect())
-    n_parts = rdd.getNumPartitions()
-    if n_parts < num_shards:
-        logger.warning(
-            "RDD has %d partitions < %d ingest hosts; repartition the "
-            "RDD for balanced multi-host ingest", n_parts, num_shards)
-    owned = rdd.mapPartitionsWithIndex(
-        _partition_filter(shard_index, num_shards))
-    return list(owned.collect())
+        owned = rdd
+    else:
+        n_parts = rdd.getNumPartitions()
+        if n_parts < num_shards:
+            logger.warning(
+                "RDD has %d partitions < %d ingest hosts; repartition "
+                "the RDD for balanced multi-host ingest", n_parts,
+                num_shards)
+        owned = rdd.mapPartitionsWithIndex(
+            _partition_filter(shard_index, num_shards))
+    tli = getattr(owned, "toLocalIterator", None)
+    if callable(tli):
+        yield from tli()
+    else:
+        yield from owned.collect()
+
+
+def collect_shard(rdd: Any, shard_index: Optional[int] = None,
+                  num_shards: Optional[int] = None) -> "list":
+    """Collect this host's round-robin share of an RDD-like's records
+    (materialised; prefer :func:`iter_shard` for streaming)."""
+    return list(iter_shard(rdd, shard_index, num_shards))
 
 
 class LocalRdd:
@@ -140,6 +158,15 @@ class LocalRdd:
 
     def collect(self) -> "list":
         return list(itertools.chain.from_iterable(self._parts))
+
+    def toLocalIterator(self) -> Iterator:
+        """Stream records one partition at a time (pyspark parity);
+        `partitions_fetched` counts entered partitions so tests can
+        assert laziness."""
+        for p in self._parts:
+            self.partitions_fetched = getattr(
+                self, "partitions_fetched", 0) + 1
+            yield from p
 
     def count(self) -> int:
         return sum(len(p) for p in self._parts)

@@ -136,8 +136,8 @@ class NNEstimator(_Params):
         executor-side, and each JAX process collects only its partition
         share (reference NNEstimator.scala:361-390 maps df.rdd the same
         way; here multi-host replaces multi-executor)."""
-        from analytics_zoo_tpu.feature.rdd import collect_shard, \
-            is_rdd_like, is_spark_dataframe
+        from analytics_zoo_tpu.feature.rdd import is_rdd_like, \
+            is_spark_dataframe, iter_shard
         if isinstance(df, pd.DataFrame):
             has_label = with_label and self.label_col in df.columns
             for _, row in df.iterrows():
@@ -149,11 +149,11 @@ class NNEstimator(_Params):
             cols = [self.features_col] + \
                 ([self.label_col] if has_label else [])
             rdd = df.select(*cols).rdd
-            for row in collect_shard(rdd):
+            for row in iter_shard(rdd):
                 yield row[0], (row[1] if has_label else None)
             return
         if is_rdd_like(df):
-            for rec in collect_shard(df):
+            for rec in iter_shard(df):
                 if isinstance(rec, Sample):
                     yield rec, None
                 elif isinstance(rec, tuple) and len(rec) == 2:
@@ -282,24 +282,80 @@ class NNModel(_Params):
         return self.estimator.predict(x, batch_size=self.batch_size)
 
     def transform(self, df):
-        """Append the prediction column. Spark DataFrames round-trip
-        through pandas on this host (driver-side inference on the TPU
-        slice; the reference's executor-side broadcast-predict has no
-        analog when the accelerator lives with the driver)."""
+        """Append the prediction column. Spark DataFrames stream
+        through the driver in bounded chunks (``toLocalIterator`` →
+        predict → per-chunk ``createDataFrame`` → union), so the
+        resident feature set is one chunk, not the whole DataFrame —
+        the driver-side analog of the reference's batched
+        executor-side predict (NNEstimator.scala:571-674). Chunk rows:
+        ``ZOO_TPU_TRANSFORM_CHUNK`` (default 1024, floored at
+        batch_size)."""
         from analytics_zoo_tpu.feature.rdd import is_spark_dataframe
         if is_spark_dataframe(df):
-            pdf = df.toPandas()
-            out = self.transform(pdf)
-            out[self.prediction_col] = [
-                [float(v) for v in np.asarray(p).reshape(-1)]
-                for p in out[self.prediction_col]]
-            return self._spark_session_of(df).createDataFrame(
-                self._spark_safe(out))
+            return self._stream_spark_transform(
+                df, lambda col: [[float(v)
+                                  for v in np.asarray(p).reshape(-1)]
+                                 for p in col])
         preds = self._raw_predict(df)
         out = df.copy()
         out[self.prediction_col] = [np.asarray(p).reshape(-1)
                                     for p in preds]
         return out
+
+    def _stream_spark_transform(self, df, finalize: Callable):
+        """Chunked Spark-DataFrame transform: toLocalIterator →
+        (subclass) pandas transform per chunk → per-chunk
+        createDataFrame → tree-reduced union (O(log n) plan depth).
+        The Python-resident feature chunk is bounded; the output
+        schema is inferred once on the first chunk and pinned for the
+        rest (an all-None nullable column in a later chunk must not
+        re-infer differently). `finalize` serialises the prediction
+        column for Spark rows."""
+        import itertools
+        spark = self._spark_session_of(df)
+        chunk_rows = max(self.batch_size, int(os.environ.get(
+            "ZOO_TPU_TRANSFORM_CHUNK", "1024")))
+        cols = list(df.columns)
+        schema = None
+
+        def flush(buf):
+            nonlocal schema
+            out = self.transform(pd.DataFrame(buf, columns=cols))
+            out[self.prediction_col] = finalize(
+                out[self.prediction_col])
+            safe = self._spark_safe(out)
+            part = spark.createDataFrame(safe) if schema is None \
+                else spark.createDataFrame(safe, schema=schema)
+            if schema is None:
+                schema = getattr(part, "schema", None)
+            return part
+
+        # tree-reduce the unions: stack of (level, df), equal levels
+        # merge — keeps both plan depth and union count logarithmic
+        stack: "list" = []
+
+        def push(part):
+            level = 0
+            while stack and stack[-1][0] == level:
+                _, prev = stack.pop()
+                part = prev.unionAll(part)
+                level += 1
+            stack.append((level, part))
+
+        it = df.toLocalIterator()
+        chunks = iter(
+            lambda: [tuple(r) for r in itertools.islice(it, chunk_rows)],
+            [])
+        n = 0
+        for buf in chunks:
+            push(flush(buf))
+            n += 1
+        if n == 0:          # empty input: same error surface as pandas
+            push(flush([]))
+        result = None
+        for _, part in stack:
+            result = part if result is None else result.unionAll(part)
+        return result
 
     # -- persistence (MLWritable/MLReadable analog) -------------------------
     def save(self, path: str, over_write: bool = False):
@@ -355,11 +411,8 @@ class NNClassifierModel(NNModel):
     def transform(self, df):
         from analytics_zoo_tpu.feature.rdd import is_spark_dataframe
         if is_spark_dataframe(df):
-            out = self.transform(df.toPandas())
-            out[self.prediction_col] = [float(v) for v in
-                                        out[self.prediction_col]]
-            return self._spark_session_of(df).createDataFrame(
-                self._spark_safe(out))
+            return self._stream_spark_transform(
+                df, lambda col: [float(v) for v in col])
         preds = self._raw_predict(df)
         out = df.copy()
         if preds.ndim > 1 and preds.shape[-1] > 1:
