@@ -84,6 +84,13 @@ def save_bytes(data: bytes, path: str,
         f.write(data)
 
 
+def _requalify(scheme: str, names) -> List[str]:
+    """fsspec strips the scheme from listing results; restore it so
+    results round-trip through read_bytes etc."""
+    return sorted(p if "://" in str(p) else f"{scheme}://{p}"
+                  for p in names)
+
+
 def list_files(pattern: str) -> List[str]:
     """Glob helper used by readers (reference `Utils.listPaths`)."""
     scheme, local = _split_scheme(pattern)
@@ -102,9 +109,42 @@ def list_files(pattern: str) -> List[str]:
                if e.get("type") == "file"]
     else:
         out = list(fs.glob(pattern))
-    # fsspec strips the scheme from results; restore for round-trips
-    return sorted(p if "://" in str(p) else f"{scheme}://{p}"
-                  for p in out)
+    return _requalify(scheme, out)
+
+
+def is_dir(path: str) -> bool:
+    """Directory test across local and fsspec schemes."""
+    scheme, local = _split_scheme(path)
+    if scheme is None:
+        return os.path.isdir(local)
+    return bool(_fs_for(scheme).isdir(local))
+
+
+def list_dirs(path: str) -> List[str]:
+    """Immediate subdirectories of `path` (local or fsspec scheme),
+    scheme-qualified like :func:`list_files`."""
+    scheme, local = _split_scheme(path)
+    if scheme is None:
+        return sorted(
+            os.path.join(local, d) for d in os.listdir(local)
+            if os.path.isdir(os.path.join(local, d)))
+    fs = _fs_for(scheme)
+    out = [e["name"] for e in fs.ls(local, detail=True)
+           if e.get("type") == "directory"]
+    return _requalify(scheme, out)
+
+
+def walk_files(path: str) -> List[str]:
+    """All files under `path` recursively (reference
+    `NNImageReader.scala:144-182` reads whole HDFS trees this way)."""
+    scheme, local = _split_scheme(path)
+    if scheme is None:
+        return sorted(
+            f for f in _glob.glob(os.path.join(local, "**", "*"),
+                                  recursive=True)
+            if os.path.isfile(f))
+    fs = _fs_for(scheme)
+    return _requalify(scheme, fs.find(local))
 
 
 def mkdirs(path: str) -> None:

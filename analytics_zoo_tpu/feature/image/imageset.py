@@ -9,12 +9,13 @@ layout (divergence from BigDL's CHW float means no transpose on device).
 
 from __future__ import annotations
 
-import glob
+import io
 import os
 from typing import Any, Callable, Iterable, Iterator, List, Optional
 
 import numpy as np
 
+from analytics_zoo_tpu.common import utils as zutils
 from analytics_zoo_tpu.feature.common import Preprocessing, Sample
 from analytics_zoo_tpu.feature.feature_set import FeatureSet
 
@@ -56,8 +57,11 @@ class ImageFeature(dict):
 
 
 def _decode(path: str) -> np.ndarray:
+    """Decode one image from a local path or any fsspec scheme
+    (``gs://``/``s3://``/``memory://`` — reference `ImageSet.read`
+    reads straight off HDFS the same way)."""
     from PIL import Image
-    with Image.open(path) as im:
+    with Image.open(io.BytesIO(zutils.read_bytes(path))) as im:
         return np.asarray(im.convert("RGB"), np.uint8)
 
 
@@ -76,28 +80,21 @@ class ImageSet:
     @staticmethod
     def read(path: str, with_label_from_dirs: bool = False,
              max_images: Optional[int] = None) -> "ImageSet":
-        if os.path.isdir(path):
+        if zutils.is_dir(path):
             if with_label_from_dirs:
-                classes = sorted(
-                    d for d in os.listdir(path)
-                    if os.path.isdir(os.path.join(path, d)))
-                label_map = {c: i for i, c in enumerate(classes)}
+                class_dirs = zutils.list_dirs(path)
+                label_map = {d: i for i, d in enumerate(class_dirs)}
                 feats = []
-                for c in classes:
-                    for f in sorted(glob.glob(
-                            os.path.join(path, c, "*"))):
+                for d in class_dirs:
+                    for f in zutils.list_files(d):
                         feats.append(ImageFeature(
                             _decode(f),
-                            label=np.asarray([label_map[c]], np.int32),
+                            label=np.asarray([label_map[d]], np.int32),
                             uri=f))
                         if max_images and len(feats) >= max_images:
                             return ImageSet(feats)
                 return ImageSet(feats)
-            files = sorted(
-                f for f in glob.glob(os.path.join(path, "*"))
-                if os.path.isfile(f))
-        else:
-            files = sorted(glob.glob(path))
+        files = zutils.list_files(path)
         if max_images:
             files = files[:max_images]
         return ImageSet([ImageFeature(_decode(f), uri=f) for f in files])

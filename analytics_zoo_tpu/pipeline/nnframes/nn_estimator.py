@@ -295,28 +295,52 @@ class NNModel(_Params):
             return self._stream_spark_transform(
                 df, lambda col: [[float(v)
                                   for v in np.asarray(p).reshape(-1)]
-                                 for p in col])
+                                 for p in col],
+                scalar_pred=False)
         preds = self._raw_predict(df)
         out = df.copy()
         out[self.prediction_col] = [np.asarray(p).reshape(-1)
                                     for p in preds]
         return out
 
-    def _stream_spark_transform(self, df, finalize: Callable):
+    def _output_schema(self, df, scalar_pred: bool):
+        """Input schema + the prediction field, so every chunk's
+        createDataFrame uses ONE schema regardless of what the chunk's
+        values would infer (an all-None nullable column in some chunk
+        must not change types). None when pyspark types are
+        unavailable (duck-typed test doubles) — falls back to
+        first-chunk inference."""
+        base = getattr(df, "schema", None)
+        if base is None:
+            return None
+        try:
+            from pyspark.sql.types import (ArrayType, DoubleType,
+                                           StructField, StructType)
+        except ImportError:
+            return None
+        pred_t = DoubleType() if scalar_pred \
+            else ArrayType(DoubleType())
+        fields = [f for f in base.fields
+                  if f.name != self.prediction_col]
+        return StructType(
+            fields + [StructField(self.prediction_col, pred_t, True)])
+
+    def _stream_spark_transform(self, df, finalize: Callable,
+                                scalar_pred: bool = False):
         """Chunked Spark-DataFrame transform: toLocalIterator →
         (subclass) pandas transform per chunk → per-chunk
         createDataFrame → tree-reduced union (O(log n) plan depth).
-        The Python-resident feature chunk is bounded; the output
-        schema is inferred once on the first chunk and pinned for the
-        rest (an all-None nullable column in a later chunk must not
-        re-infer differently). `finalize` serialises the prediction
-        column for Spark rows."""
+        The Python-resident feature chunk is bounded; every chunk uses
+        ONE output schema — built from ``df.schema`` + the prediction
+        field when pyspark is importable, else pinned from the first
+        chunk's inference. `finalize` serialises the prediction column
+        for Spark rows."""
         import itertools
         spark = self._spark_session_of(df)
         chunk_rows = max(self.batch_size, int(os.environ.get(
             "ZOO_TPU_TRANSFORM_CHUNK", "1024")))
         cols = list(df.columns)
-        schema = None
+        schema = self._output_schema(df, scalar_pred)
 
         def flush(buf):
             nonlocal schema
@@ -412,7 +436,8 @@ class NNClassifierModel(NNModel):
         from analytics_zoo_tpu.feature.rdd import is_spark_dataframe
         if is_spark_dataframe(df):
             return self._stream_spark_transform(
-                df, lambda col: [float(v) for v in col])
+                df, lambda col: [float(v) for v in col],
+                scalar_pred=True)
         preds = self._raw_predict(df)
         out = df.copy()
         if preds.ndim > 1 and preds.shape[-1] > 1:

@@ -1,16 +1,19 @@
 """NNImageReader / NNImageSchema (reference
 `Z/pipeline/nnframes/NNImageReader.scala:144-182`): read images into a
 DataFrame with the image-schema struct columns
-(origin, height, width, nChannels, mode, data)."""
+(origin, height, width, nChannels, mode, data). Paths resolve through
+`common.utils`' fsspec helpers, so ``gs://``/``s3://``/``hdfs://``
+trees read end-to-end like the reference's HDFS reads."""
 
 from __future__ import annotations
 
-import glob
-import os
+import io
 from typing import List, Optional
 
 import numpy as np
 import pandas as pd
+
+from analytics_zoo_tpu.common import utils as zutils
 
 
 class NNImageSchema:
@@ -43,17 +46,15 @@ class NNImageReader:
         `image_codec` kept for signature parity.)"""
         from PIL import Image
         del min_partitions, image_codec
-        if os.path.isdir(path):
-            files = sorted(
-                f for f in glob.glob(os.path.join(path, "**", "*"),
-                                     recursive=True)
-                if os.path.isfile(f))
+        if zutils.is_dir(path):
+            files = zutils.walk_files(path)
         else:
-            files = sorted(glob.glob(path))
+            files = zutils.list_files(path)
         rows = []
         for f in files:
             try:
-                with Image.open(f) as im:
+                data = zutils.read_bytes(f)
+                with Image.open(io.BytesIO(data)) as im:
                     rgb = im.convert("RGB")
                     if resize_h > 0 and resize_w > 0:
                         rgb = rgb.resize((resize_w, resize_h),
