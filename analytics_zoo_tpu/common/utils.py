@@ -64,6 +64,38 @@ def read_bytes(path: str) -> bytes:
         return f.read()
 
 
+def read_bytes_many(paths) -> "dict":
+    """``{path: bytes}`` for a batch of paths. Remote schemes fetch in
+    ONE ``fs.cat`` call (concurrent under the hood) instead of a
+    blocking round-trip per file — the difference between seconds and
+    tens of minutes for a 10k-image ``gs://`` tree."""
+    out: dict = {}
+    by_scheme: dict = {}
+    for p in paths:
+        scheme, local = _split_scheme(p)
+        if scheme is None:
+            with open(local, "rb") as f:
+                out[p] = f.read()
+        else:
+            by_scheme.setdefault(scheme, []).append((p, local))
+    for scheme, items in by_scheme.items():
+        fs = _fs_for(scheme)
+        try:
+            got = fs.cat([local for _, local in items])
+        except Exception:
+            got = None  # fall back to per-file reads below
+        if isinstance(got, (bytes, bytearray)) and len(items) == 1:
+            got = {fs._strip_protocol(items[0][1]): bytes(got)}
+        for orig, local in items:
+            key = fs._strip_protocol(local)
+            if isinstance(got, dict) and key in got:
+                out[orig] = got[key]
+            else:
+                with fs.open(local, "rb") as f:
+                    out[orig] = f.read()
+    return out
+
+
 def save_bytes(data: bytes, path: str,
                is_overwrite: bool = False) -> None:
     """(reference `Utils.saveBytes`)"""

@@ -60,8 +60,12 @@ def _decode(path: str) -> np.ndarray:
     """Decode one image from a local path or any fsspec scheme
     (``gs://``/``s3://``/``memory://`` — reference `ImageSet.read`
     reads straight off HDFS the same way)."""
+    return _decode_bytes(zutils.read_bytes(path))
+
+
+def _decode_bytes(data: bytes) -> np.ndarray:
     from PIL import Image
-    with Image.open(io.BytesIO(zutils.read_bytes(path))) as im:
+    with Image.open(io.BytesIO(data)) as im:
         return np.asarray(im.convert("RGB"), np.uint8)
 
 
@@ -84,20 +88,26 @@ class ImageSet:
             if with_label_from_dirs:
                 class_dirs = zutils.list_dirs(path)
                 label_map = {d: i for i, d in enumerate(class_dirs)}
-                feats = []
+                labelled = []          # (path, label) before decode
                 for d in class_dirs:
                     for f in zutils.list_files(d):
-                        feats.append(ImageFeature(
-                            _decode(f),
-                            label=np.asarray([label_map[d]], np.int32),
-                            uri=f))
-                        if max_images and len(feats) >= max_images:
-                            return ImageSet(feats)
-                return ImageSet(feats)
+                        labelled.append((f, label_map[d]))
+                        if max_images and len(labelled) >= max_images:
+                            break
+                    if max_images and len(labelled) >= max_images:
+                        break
+                blobs = zutils.read_bytes_many([f for f, _ in labelled])
+                return ImageSet([
+                    ImageFeature(_decode_bytes(blobs[f]),
+                                 label=np.asarray([lbl], np.int32),
+                                 uri=f)
+                    for f, lbl in labelled])
         files = zutils.list_files(path)
         if max_images:
             files = files[:max_images]
-        return ImageSet([ImageFeature(_decode(f), uri=f) for f in files])
+        blobs = zutils.read_bytes_many(files)
+        return ImageSet([ImageFeature(_decode_bytes(blobs[f]), uri=f)
+                         for f in files])
 
     @staticmethod
     def from_arrays(images: np.ndarray,

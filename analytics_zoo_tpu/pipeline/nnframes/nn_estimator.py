@@ -313,6 +313,12 @@ class NNModel(_Params):
         base = getattr(df, "schema", None)
         if base is None:
             return None
+        if self.prediction_col in df.columns:
+            # re-scoring: the pandas transform overwrites the column
+            # IN PLACE, so positions differ from base-fields-then-
+            # prediction — let first-chunk inference (which matches
+            # the pandas order by construction) pin the schema
+            return None
         try:
             from pyspark.sql.types import (ArrayType, DoubleType,
                                            StructField, StructType)

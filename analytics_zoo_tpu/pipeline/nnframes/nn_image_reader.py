@@ -50,11 +50,13 @@ class NNImageReader:
             files = zutils.walk_files(path)
         else:
             files = zutils.list_files(path)
+        # one batched fetch (fs.cat) for remote schemes; IO errors
+        # propagate — only DECODE failures mark a file as non-image
+        blobs = zutils.read_bytes_many(files)
         rows = []
         for f in files:
             try:
-                data = zutils.read_bytes(f)
-                with Image.open(io.BytesIO(data)) as im:
+                with Image.open(io.BytesIO(blobs[f])) as im:
                     rgb = im.convert("RGB")
                     if resize_h > 0 and resize_w > 0:
                         rgb = rgb.resize((resize_w, resize_h),
