@@ -35,8 +35,10 @@ def main(argv=None):
     ncf = NeuralCF(user_count=args.users, item_count=args.items,
                    num_classes=5, user_embed=16, item_embed=16,
                    hidden_layers=(32, 16, 8), mf_embed=16)
-    ncf.compile(optimizer="adam",
-                loss="sparse_categorical_crossentropy",
+    # class_nll pairs with NeuralCF's log-softmax head (the
+    # reference's LogSoftMax + ClassNLLCriterion); a probability-space
+    # CE here would clip the log-probs and learn nothing
+    ncf.compile(optimizer="adam", loss="class_nll",
                 metrics=["accuracy"])
     x = np.stack([users, items], axis=1).astype(np.int32)
     y = (ratings - 1).reshape(-1, 1)

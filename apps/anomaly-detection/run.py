@@ -1,0 +1,75 @@
+"""Anomaly-detection app (reference `apps/anomaly-detection`): see
+README.md alongside this file for the narrated walkthrough."""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def load_series(csv: "str | None", points: int, rng) -> np.ndarray:
+    if csv:
+        import pandas as pd
+
+        from analytics_zoo_tpu.common.utils import read_bytes
+        import io
+        df = pd.read_csv(io.BytesIO(read_bytes(csv)))
+        col = "value" if "value" in df.columns else df.columns[-1]
+        return df[col].to_numpy(np.float32)
+    # taxi-shaped synthetic: daily + weekly seasonality + noise + spikes
+    t = np.arange(points)
+    series = (10.0 + 2.0 * np.sin(t / 48 * 2 * np.pi)
+              + 1.0 * np.sin(t / (48 * 7) * 2 * np.pi)
+              + 0.2 * rng.randn(points)).astype(np.float32)
+    spikes = rng.choice(points, 5, replace=False)
+    series[spikes] += 6.0
+    return series
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--csv", default=None,
+                   help="CSV with a 'value' column (local or fsspec "
+                        "scheme); omit for synthetic data")
+    p.add_argument("--points", type=int, default=2000)
+    p.add_argument("--unroll", type=int, default=24)
+    p.add_argument("--epochs", type=int, default=5)
+    p.add_argument("--batch-size", type=int, default=256)
+    p.add_argument("--anomalies", type=int, default=5)
+    args = p.parse_args(argv)
+
+    from analytics_zoo_tpu import init_nncontext
+    from analytics_zoo_tpu.models.anomalydetection import AnomalyDetector
+
+    init_nncontext()
+    rng = np.random.RandomState(0)
+    series = load_series(args.csv, args.points, rng)
+    # standardise like the reference notebook
+    series = (series - series.mean()) / (series.std() + 1e-8)
+
+    indexed = AnomalyDetector.unroll(series[:, None], args.unroll)
+    x, y = AnomalyDetector.to_arrays(indexed)
+    split = int(len(x) * 0.8)
+    x_train, y_train, x_test, y_test = (x[:split], y[:split],
+                                        x[split:], y[split:])
+
+    ad = AnomalyDetector(feature_shape=(args.unroll, 1),
+                         hidden_layers=(8, 32, 15),
+                         dropouts=(0.2, 0.2, 0.2))
+    ad.compile(optimizer="adam", loss="mse")
+    ad.fit(x_train, y_train, batch_size=args.batch_size,
+           nb_epoch=args.epochs)
+
+    y_pred = ad.predict(x_test, batch_size=args.batch_size).reshape(-1)
+    mse = float(np.mean((y_pred - y_test.reshape(-1)) ** 2))
+    flagged, threshold = AnomalyDetector.detect_anomalies(
+        y_test.reshape(-1), y_pred, anomaly_size=args.anomalies)
+    print(f"test mse={mse:.4f}; flagged {len(flagged)} anomalies "
+          f"(|error| > {threshold:.3f}) at test indices "
+          f"{sorted(flagged.tolist())}")
+    return flagged
+
+
+if __name__ == "__main__":
+    main()

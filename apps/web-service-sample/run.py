@@ -1,0 +1,80 @@
+"""Web-service app (reference `apps/web-service-sample`): see
+README.md alongside this file for the narrated walkthrough."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import urllib.request
+
+import numpy as np
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--concurrency", type=int, default=4)
+    p.add_argument("--requests", type=int, default=16)
+    args = p.parse_args(argv)
+
+    from analytics_zoo_tpu import init_nncontext
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential, \
+        layers as L
+    from analytics_zoo_tpu.pipeline.inference import InferenceModel
+    from analytics_zoo_tpu.pipeline.inference.serving import \
+        make_inference_server
+
+    init_nncontext()
+    net = Sequential()
+    net.add(L.Dense(32, input_shape=(8,), activation="relu"))
+    net.add(L.Dense(3, activation="softmax"))
+    net.compile(optimizer="adam",
+                loss="sparse_categorical_crossentropy")
+
+    model = InferenceModel(supported_concurrent_num=args.concurrency)
+    model.load_keras_net(net)
+    server = make_inference_server(model)    # native C++ when built
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    print(f"serving on {base} via {type(server).__name__}")
+
+    with urllib.request.urlopen(f"{base}/health", timeout=10) as r:
+        print("health:", json.loads(r.read()))
+
+    # payloads generated up front: RandomState is not thread-safe
+    rng = np.random.RandomState(0)
+    payloads = [rng.rand(2, 8).astype(np.float32).tolist()
+                for _ in range(args.requests)]
+    errors: "list[str]" = []
+
+    def client(i: int):
+        x = payloads[i]
+        req = urllib.request.Request(
+            f"{base}/predict",
+            data=json.dumps({"inputs": x}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                preds = json.loads(r.read())["outputs"]
+            rows = np.asarray(preds, np.float32)
+            if rows.shape != (2, 3) or not np.allclose(
+                    rows.sum(-1), 1.0, atol=1e-3):
+                errors.append(f"request {i}: bad payload {rows!r}")
+        except Exception as e:
+            errors.append(f"request {i}: {e}")
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(args.requests)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    server.stop()
+    if errors:
+        raise SystemExit("FAILED:\n" + "\n".join(errors[:5]))
+    print(f"{args.requests} concurrent requests served OK "
+          f"({args.concurrency}-way pool)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,38 @@
+"""Smoke tests for the apps/ tutorial tier (reference `apps/`):
+each run.py must work end-to-end offline at toy scale."""
+
+import os
+import runpy
+import sys
+
+import pytest
+
+_APPS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "apps")
+
+
+def _run(app, argv):
+    old = sys.argv
+    sys.argv = ["run.py"] + argv
+    try:
+        runpy.run_path(os.path.join(_APPS, app, "run.py"),
+                       run_name="__main__")
+    except SystemExit as e:       # argparse/app exits: 0/None only
+        assert not e.code, f"{app} exited {e.code}"
+    finally:
+        sys.argv = old
+
+
+def test_app_anomaly_detection():
+    _run("anomaly-detection",
+         ["--points", "400", "--epochs", "1", "--batch-size", "64"])
+
+
+def test_app_recommendation_ncf():
+    _run("recommendation-ncf",
+         ["--users", "50", "--items", "40", "--samples", "2000",
+          "--epochs", "1", "--batch-size", "256"])
+
+
+def test_app_web_service():
+    _run("web-service-sample", ["--requests", "4", "--concurrency", "2"])
