@@ -123,6 +123,163 @@ def s2d_stem_kernel(k7: np.ndarray) -> np.ndarray:
     return np.ascontiguousarray(k2d.reshape(4, 4, 4 * c, f))
 
 
+class FusedBottleneck(KerasLayer):
+    """v1.5 bottleneck with the Pallas fused matmul+BN kernel
+    (`ops.conv_bn.matmul_bn`) on the 1×1 convs.
+
+    Same math as the `_bottleneck` subgraph (conv → BatchNorm with
+    moving-mean-shifted single-pass batch statistics → ReLU, residual
+    add), restructured for HBM traffic: the 1×1 convs run as matmuls
+    whose prologue applies the previous BN+ReLU in VMEM and whose
+    epilogue accumulates this BN's Σy/Σy² while writing the output —
+    per fused conv the activation tensor is written once instead of
+    written + read (stats) + read/written (apply). The 3×3 stays an
+    XLA conv (its input must materialise anyway); its BN statistics
+    use the same single-pass jnp reduction as `BatchNormalization`.
+
+    Params: ``c1/c2/c3[/down]`` HWIO kernels + ``bn1/bn2/bn3[/bnd]``
+    groups each ``{gamma, beta, _state:{moving_mean, moving_var}}`` —
+    the per-layer content of the unfused block, so weights can be
+    copied across layouts.
+
+    Eval mode: the 3×3's jnp statistics reduction is skipped (moving
+    stats are used); the matmul kernels' stats epilogue still runs but
+    costs no HBM traffic — it reduces the f32 accumulator already in
+    VMEM.
+    """
+
+    def __init__(self, filters: int, stride: int = 1,
+                 downsample: bool = False, epsilon: float = 1e-3,
+                 momentum: float = 0.99, init="glorot_uniform",
+                 input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.filters = int(filters)
+        self.stride = int(stride)
+        self.downsample = bool(downsample)
+        self.epsilon = float(epsilon)
+        self.momentum = float(momentum)
+        self.kernel_init = initializers.get(init)
+
+    def _bn_init(self, n):
+        return {"gamma": jnp.ones((n,), jnp.float32),
+                "beta": jnp.zeros((n,), jnp.float32),
+                "_state": {"moving_mean": jnp.zeros((n,), jnp.float32),
+                           "moving_var": jnp.ones((n,), jnp.float32)}}
+
+    def build(self, rng, input_shape):
+        c = input_shape[-1]
+        f = self.filters
+        ks = jax.random.split(rng, 4)
+        params = {
+            "c1": self.kernel_init(ks[0], (1, 1, c, f)),
+            "c2": self.kernel_init(ks[1], (3, 3, f, f)),
+            "c3": self.kernel_init(ks[2], (1, 1, f, 4 * f)),
+            "bn1": self._bn_init(f),
+            "bn2": self._bn_init(f),
+            "bn3": self._bn_init(4 * f),
+        }
+        if self.downsample:
+            params["down"] = self.kernel_init(ks[3], (1, 1, c, 4 * f))
+            params["bnd"] = self._bn_init(4 * f)
+        return params
+
+    def _bn_vectors(self, bn, ssum, ssq, count, training):
+        """(scale, shift, updates) via the SHARED BatchNorm scheme
+        (`normalization.bn_batch_stats`/`bn_fold` — the same code the
+        unfused layer runs, so the two layouts cannot drift)."""
+        from analytics_zoo_tpu.pipeline.api.keras.layers \
+            .normalization import bn_batch_stats, bn_fold
+        state = bn["_state"]
+        if training:
+            mean, var, upd = bn_batch_stats(ssum, ssq, count, state,
+                                            self.momentum)
+        else:
+            mean, var = state["moving_mean"], state["moving_var"]
+            upd = {}
+        scale, shift = bn_fold(mean, var, bn["gamma"], bn["beta"],
+                               self.epsilon)
+        return scale, shift, upd
+
+    def _jnp_stats(self, y, mm):
+        """Single-pass shifted statistics for the XLA 3×3 conv output
+        (the reduction `BatchNormalization.apply` runs in training)."""
+        axes = tuple(range(y.ndim - 1))
+        yf = y.astype(jnp.float32) - jax.lax.stop_gradient(mm)
+        count = float(np.prod([y.shape[a] for a in axes]))
+        return (jnp.sum(yf, axis=axes), jnp.sum(jnp.square(yf), axes),
+                count)
+
+    def apply(self, params, x, *, training=False, rng=None):
+        from analytics_zoo_tpu.ops.conv_bn import conv1x1_bn
+        updates = {}
+        mm = lambda bn: jax.lax.stop_gradient(
+            params[bn]["_state"]["moving_mean"])
+
+        # c1: 1×1 matmul + bn1 stats epilogue
+        y1, s1, q1 = conv1x1_bn(x, params["c1"], stat_shift=mm("bn1"))
+        n1 = float(np.prod(y1.shape[:-1]))
+        scale1, shift1, upd1 = self._bn_vectors(
+            params["bn1"], s1, q1, n1, training)
+        if upd1:
+            updates["bn1"] = upd1
+        # bn1 apply + relu materialises ONCE as the 3×3 conv's input
+        z1 = jnp.maximum(
+            y1 * scale1.astype(y1.dtype) + shift1.astype(y1.dtype), 0)
+
+        # c2: XLA 3×3 (stride lives here, v1.5), jnp single-pass stats
+        y2 = jax.lax.conv_general_dilated(
+            z1, params["c2"].astype(z1.dtype),
+            window_strides=(self.stride, self.stride),
+            padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if training:     # eval uses moving stats: skip the reduction
+            s2, q2, n2 = self._jnp_stats(y2, mm("bn2"))
+        else:
+            s2 = q2 = n2 = None
+        scale2, shift2, upd2 = self._bn_vectors(
+            params["bn2"], s2, q2, n2, training)
+        if upd2:
+            updates["bn2"] = upd2
+
+        # c3: bn2-apply+relu prologue, 1×1 matmul, bn3 stats epilogue
+        y3, s3, q3 = conv1x1_bn(
+            y2, params["c3"], in_scale=scale2, in_shift=shift2,
+            relu_in=True, stat_shift=mm("bn3"))
+        n3 = float(np.prod(y3.shape[:-1]))
+        scale3, shift3, upd3 = self._bn_vectors(
+            params["bn3"], s3, q3, n3, training)
+        if upd3:
+            updates["bn3"] = upd3
+
+        if self.downsample:
+            ysc, sd, qd = conv1x1_bn(x, params["down"],
+                                     stride=self.stride,
+                                     stat_shift=mm("bnd"))
+            nd = float(np.prod(ysc.shape[:-1]))
+            scaled, shiftd, updd = self._bn_vectors(
+                params["bnd"], sd, qd, nd, training)
+            if updd:
+                updates["bnd"] = updd
+            shortcut = ysc * scaled.astype(ysc.dtype) + \
+                shiftd.astype(ysc.dtype)
+        else:
+            shortcut = x
+        # bn3 apply + residual add + relu: one elementwise pass
+        out = jnp.maximum(
+            y3 * scale3.astype(y3.dtype) + shift3.astype(y3.dtype) +
+            shortcut.astype(y3.dtype), 0)
+        return out, updates
+
+    def call(self, params, x, *, training=False, rng=None):
+        y, _ = self.apply(params, x, training=training, rng=rng)
+        return y
+
+    def compute_output_shape(self, input_shape):
+        h, w, _ = input_shape
+        s = self.stride
+        return ((h + s - 1) // s, (w + s - 1) // s, 4 * self.filters)
+
+
 class ResNet:
     """Builder; `ResNet(depth).build(input_shape, classes)` → keras Model."""
 
@@ -136,7 +293,11 @@ class ResNet:
         self.depth = depth
 
     def build(self, input_shape=(224, 224, 3), classes: int = 1000,
-              space_to_depth: bool = False) -> Model:
+              space_to_depth: bool = False,
+              fused: bool = False) -> Model:
+        """``fused=True`` uses :class:`FusedBottleneck` (the Pallas
+        matmul+BN kernel on the 1×1 convs) — same math, less HBM
+        traffic; weights are per-conv/per-BN either way."""
         blocks = self.DEPTH_BLOCKS[self.depth]
         inp = Input(input_shape, name="image")
         if space_to_depth:
@@ -152,9 +313,14 @@ class ResNet:
         for stage, n_blocks in enumerate(blocks):
             for b in range(n_blocks):
                 stride = 2 if (b == 0 and stage > 0) else 1
-                x = _bottleneck(x, filters, stride=stride,
-                                downsample=(b == 0),
-                                name=f"s{stage}b{b}")
+                if fused:
+                    x = FusedBottleneck(filters, stride=stride,
+                                        downsample=(b == 0),
+                                        name=f"s{stage}b{b}")(x)
+                else:
+                    x = _bottleneck(x, filters, stride=stride,
+                                    downsample=(b == 0),
+                                    name=f"s{stage}b{b}")
             filters *= 2
         x = GlobalAveragePooling2D()(x)
         out = Dense(classes, name="fc")(x)
@@ -162,6 +328,7 @@ class ResNet:
 
 
 def resnet50(input_shape=(224, 224, 3), classes: int = 1000,
-             space_to_depth: bool = False) -> Model:
+             space_to_depth: bool = False,
+             fused: bool = False) -> Model:
     return ResNet(50).build(input_shape, classes,
-                            space_to_depth=space_to_depth)
+                            space_to_depth=space_to_depth, fused=fused)

@@ -1,0 +1,266 @@
+"""Fused 1×1-conv (matmul) + BatchNorm Pallas kernel.
+
+The ResNet-50 training step is HBM-bound on BatchNorm traffic, not
+MXU-bound (PERF.md profile: BN statistics reductions ≈33% and BN
+apply/FMA fusions ≈24% of device time vs ≈25% for the convs). The
+reference hits the same wall differently — its MKL-DNN engine fuses
+conv+BN+ReLU into one primitive (`zoo/.../IRconvertor` lowers
+conv_bn chains to fused MKL ops); this module is the TPU analog for
+the 1×1 convs that dominate a bottleneck block, where a 1×1 NHWC conv
+IS a matmul over (N·H·W, Cin):
+
+- **prologue**: the PREVIOUS BN's folded apply (``x·scale+shift``)
+  and ReLU run on the input tile in VMEM while it feeds the MXU — the
+  normalized activation never exists in HBM;
+- **matmul**: (M, K) @ (K, N) in bf16 on the MXU, f32 accumulator;
+- **epilogue**: per-channel ``Σy`` and ``Σy²`` (f32, shifted by the
+  moving mean for cancellation safety — same scheme as
+  `keras.layers.BatchNormalization`) accumulate while the output tile
+  is written — THIS layer's BN statistics cost no extra HBM pass.
+
+Per conv+BN+ReLU the activation traffic drops from
+write + stats-read + apply-read + apply-write (4 passes) to a single
+write, and the input-side apply pass of the previous layer disappears.
+
+The backward is a `jax.custom_vjp` expressed in JAX: the statistics
+cotangents fold into ONE augmented cotangent
+``g = dy + dΣ + 2(y−shift)·dΣ²`` feeding both backward matmuls, and
+the prologue's VJP (ReLU mask × scale, plus the reductions giving
+d(scale)/d(shift)) fuses into the dx pass — fewer reduction passes
+than autodiff of the unfused graph.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# test observability, like ops.flash_attention.invocations
+invocations = 0
+
+
+def _pick_blocks(m: int, k: int, n: int) -> Tuple[int, int]:
+    """(block_m, block_k); N is never tiled (ResNet channel counts are
+    ≤2048 and 128-multiples, so the whole (bm, N) f32 accumulator and
+    the (bk, N) weight tile fit VMEM comfortably)."""
+    # any admitted k is a 64-multiple, so 64 terminates the search
+    bk = next(b for b in (512, 384, 256, 128, 64) if k % b == 0) \
+        if k > 512 else k
+    # VMEM budget ~ acc(bm·n·4) + x(bm·bk·2) + w(bk·n·2): keep ≲6MB
+    bm = 512
+    while bm > 128 and bm * n * 4 + bm * bk * 2 > 5 * 2 ** 20:
+        bm //= 2
+    return max(bm, 128), bk
+
+
+def _kernel(x_ref, w_ref, s_ref, t_ref, sh_ref,
+            y_ref, sum_ref, sq_ref, acc_ref, *,
+            n_k: int, relu_in: bool, affine_in: bool, out_dtype):
+    """One (mi, ki) grid step. Refs:
+    x (bm, bk) input tile; w (bk, N); s/t (1, K-slice? no — (1, bk))
+    prologue scale/shift; sh (1, N) stats shift; outputs y (bm, N),
+    sum/sq (1, N) f32 accumulated across mi; acc (bm, N) f32 scratch.
+    Grid order (mi, ki): ki innermost."""
+    mi = pl.program_id(0)
+    ki = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init_acc():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    x = x_ref[...]
+    if affine_in:
+        x = x.astype(jnp.float32) * s_ref[0, :][None, :] + \
+            t_ref[0, :][None, :]
+    if relu_in:
+        x = jnp.maximum(x, 0.0)
+    x = x.astype(w_ref.dtype)
+    acc_ref[...] += jax.lax.dot_general(
+        x, w_ref[...], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        acc = acc_ref[...]
+        y_ref[...] = acc.astype(out_dtype)
+        d = acc - sh_ref[0, :][None, :]      # shifted for stability
+
+        @pl.when(mi == 0)
+        def _first():
+            sum_ref[...] = jnp.sum(d, axis=0, keepdims=True)
+            sq_ref[...] = jnp.sum(d * d, axis=0, keepdims=True)
+
+        @pl.when(mi != 0)
+        def _rest():
+            sum_ref[...] += jnp.sum(d, axis=0, keepdims=True)
+            sq_ref[...] += jnp.sum(d * d, axis=0, keepdims=True)
+
+
+def _matmul_bn_fwd_pallas(x, w, s, t, sh, relu_in, affine_in,
+                          interpret):
+    m, k = x.shape
+    n = w.shape[1]
+    bm, bk = _pick_blocks(m, k, n)
+    if m % bm:                       # pad rows to a block multiple
+        pad = bm - m % bm
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+        mp = m + pad
+    else:
+        mp = m
+    n_m, n_k = mp // bm, k // bk
+    kernel = functools.partial(
+        _kernel, n_k=n_k, relu_in=relu_in, affine_in=affine_in,
+        out_dtype=jnp.dtype(x.dtype))
+    y, ssum, ssq = pl.pallas_call(
+        kernel,
+        grid=(n_m, n_k),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda mi, ki: (mi, ki)),
+            pl.BlockSpec((bk, n), lambda mi, ki: (ki, 0)),
+            pl.BlockSpec((1, bk), lambda mi, ki: (0, ki)),
+            pl.BlockSpec((1, bk), lambda mi, ki: (0, ki)),
+            pl.BlockSpec((1, n), lambda mi, ki: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, n), lambda mi, ki: (mi, 0)),
+            pl.BlockSpec((1, n), lambda mi, ki: (0, 0)),
+            pl.BlockSpec((1, n), lambda mi, ki: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((mp, n), x.dtype),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((bm, n), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(x, w, s, t, sh)
+    if mp != m:
+        # padded (all-zero) input rows still produce a nonzero output
+        # row when the prologue has a shift/ReLU: y0 = prologue(0) @ w.
+        # Subtract their exact statistics contribution.
+        extra = jnp.float32(mp - m)
+        if affine_in:
+            row0 = t[0, :]
+            if relu_in:
+                row0 = jnp.maximum(row0, 0.0)
+            y0 = row0 @ w.astype(jnp.float32)
+        else:
+            y0 = jnp.zeros((n,), jnp.float32)
+        d0 = y0 - sh[0, :]
+        ssum = ssum - extra * d0[None, :]
+        ssq = ssq - extra * (d0 ** 2)[None, :]
+        y = y[:m]
+    return y, ssum[0], ssq[0]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _matmul_bn(x, w, s, t, sh, relu_in, affine_in, interpret):
+    return _matmul_bn_fwd_pallas(x, w, s, t, sh, relu_in, affine_in,
+                                 interpret)
+
+
+def _matmul_bn_vjp_fwd(x, w, s, t, sh, relu_in, affine_in, interpret):
+    out = _matmul_bn_fwd_pallas(x, w, s, t, sh, relu_in, affine_in,
+                                interpret)
+    y, _, _ = out
+    return out, (x, w, s, t, sh, y)
+
+
+def _matmul_bn_vjp_bwd(relu_in, affine_in, interpret, res, cots):
+    x, w, s, t, sh, y = res
+    dy, dsum, dsq = cots
+    f32 = jnp.float32
+    # stats cotangents fold into one augmented output cotangent:
+    # y feeds (y, Σ(y-sh), Σ(y-sh)²) so g = dy + dΣ + 2(y-sh)·dΣ²
+    g = dy.astype(f32) + dsum[None, :] + \
+        2.0 * (y.astype(f32) - sh[0, :][None, :]) * dsq[None, :]
+    # recompute the prologue (cheaper than saving x' — one read of x
+    # instead of a second M×K tensor in HBM)
+    if affine_in:
+        xa = x.astype(f32) * s[0, :][None, :] + t[0, :][None, :]
+    else:
+        xa = x.astype(f32)
+    xp = jnp.maximum(xa, 0.0) if relu_in else xa
+    dw = jax.lax.dot_general(xp, g, (((0,), (0,)), ((), ())),
+                             preferred_element_type=f32)
+    dxp = jax.lax.dot_general(g, w.astype(f32),
+                              (((1,), (1,)), ((), ())),
+                              preferred_element_type=f32)
+    if relu_in:
+        dxp = jnp.where(xa > 0.0, dxp, 0.0)
+    if affine_in:
+        dx = dxp * s[0, :][None, :]
+        ds = jnp.sum(dxp * x.astype(f32), axis=0, keepdims=True)
+        dt = jnp.sum(dxp, axis=0, keepdims=True)
+    else:
+        dx = dxp
+        ds = jnp.zeros_like(s)
+        dt = jnp.zeros_like(t)
+    return (dx.astype(x.dtype), dw.astype(w.dtype),
+            ds.astype(s.dtype), dt.astype(t.dtype),
+            jnp.zeros_like(sh))
+
+
+_matmul_bn.defvjp(_matmul_bn_vjp_fwd, _matmul_bn_vjp_bwd)
+
+
+def matmul_bn(x: jnp.ndarray, w: jnp.ndarray,
+              in_scale: Optional[jnp.ndarray] = None,
+              in_shift: Optional[jnp.ndarray] = None,
+              relu_in: bool = False,
+              stat_shift: Optional[jnp.ndarray] = None,
+              interpret: Optional[bool] = None):
+    """Fused ``relu(x·in_scale+in_shift) @ w`` with BN-statistics
+    epilogue.
+
+    x: (M, K); w: (K, N) — K, N must be 128-multiples (ResNet channel
+    counts are). Returns ``(y (M, N), sum (N,), sumsq (N,))`` where
+    the statistics are over ``y - stat_shift`` in f32 (pass the BN's
+    moving mean, stop-gradded, as ``stat_shift``; see
+    `BatchNormalization.apply` for the scheme).
+
+    `in_scale`/`in_shift` (K,): previous-BN folded apply on the input,
+    in VMEM (skip both for a raw matmul); ``relu_in`` applies ReLU
+    after the affine. Differentiable in x, w, in_scale, in_shift.
+    """
+    global invocations
+    invocations += 1
+    m, k = x.shape
+    n = w.shape[1]
+    if k % 64 or n % 64:
+        # 128 is the native lane width; 64 still compiles (Mosaic pads
+        # lanes) and covers ResNet's stage-0 64-channel convs
+        raise ValueError(f"K={k} and N={n} must be 64-multiples")
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    affine_in = in_scale is not None
+    f32 = jnp.float32
+    s = (in_scale.astype(f32) if affine_in else
+         jnp.ones((k,), f32)).reshape(1, k)
+    t = (in_shift.astype(f32) if in_shift is not None else
+         jnp.zeros((k,), f32)).reshape(1, k)
+    sh = (stat_shift.astype(f32) if stat_shift is not None else
+          jnp.zeros((n,), f32)).reshape(1, n)
+    return _matmul_bn(x, w.astype(x.dtype), s, t, sh,
+                      relu_in, affine_in, bool(interpret))
+
+
+def conv1x1_bn(x: jnp.ndarray, w: jnp.ndarray, stride: int = 1,
+               **kwargs):
+    """NHWC 1×1 conv + BN statistics via :func:`matmul_bn`.
+    x: (N, H, W, C); w: (1, 1, C, F) or (C, F). Returns
+    ``(y (N, H', W', F), sum (F,), sumsq (F,))``."""
+    if w.ndim == 4:
+        w = w[0, 0]
+    if stride != 1:
+        x = x[:, ::stride, ::stride, :]
+    b, h, wd, c = x.shape
+    y2, ssum, ssq = matmul_bn(x.reshape(b * h * wd, c), w, **kwargs)
+    return y2.reshape(b, h, wd, w.shape[-1]), ssum, ssq

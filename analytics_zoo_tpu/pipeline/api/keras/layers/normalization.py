@@ -19,8 +19,40 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from analytics_zoo_tpu.pipeline.api.keras.engine import KerasLayer, Shape
+
+
+def bn_batch_stats(ssum, ssq, count, state, momentum):
+    """Batch mean/var from moving-mean-SHIFTED sums ``Σ(x−mm)`` /
+    ``Σ(x−mm)²`` plus the moving-average update — the single copy of
+    the scheme, shared by :class:`BatchNormalization` and the fused
+    ResNet bottleneck (`models/.../resnet.py`). The shift keeps
+    E[x²]−E[x]² from cancelling when |mean| ≫ std; the moving mean is
+    stop-gradded (it is frozen state, not a differentiable input)."""
+    mm = jax.lax.stop_gradient(state["moving_mean"])
+    d_mean = ssum / count
+    d_sq = ssq / count
+    mean = d_mean + mm
+    var = jnp.maximum(d_sq - jnp.square(d_mean), 0.0)
+    m = momentum
+    updates = {"_state": {
+        "moving_mean": m * state["moving_mean"] + (1 - m) * mean,
+        "moving_var": m * state["moving_var"] + (1 - m) * var,
+    }}
+    return mean, var, updates
+
+
+def bn_fold(mean, var, gamma, beta, epsilon):
+    """Fold ``(x−mean)·rsqrt(var+eps)·γ+β`` into per-channel
+    ``(scale, shift)`` for a single FMA apply (γ/β may be None)."""
+    inv = jax.lax.rsqrt(var + epsilon)
+    scale = inv * gamma if gamma is not None else inv
+    shift = -mean * scale
+    if beta is not None:
+        shift = shift + beta
+    return scale, shift
 
 
 class BatchNormalization(KerasLayer):
@@ -69,33 +101,24 @@ class BatchNormalization(KerasLayer):
         if training:
             # single pass over x: both reductions fuse into one
             # multi-output kernel reading x once (profiling showed BN
-            # reductions, not convs, dominate the ResNet-50 step).
-            # Shifting by the (non-differentiated) moving mean keeps
-            # E[x²]-E[x]² from cancelling when |mean| >> std — strictly
-            # more stable than the plain single-pass form.
+            # reductions, not convs, dominate the ResNet-50 step)
             shift0 = self._reshape_stat(
                 jax.lax.stop_gradient(state["moving_mean"]), x)
             xf = x.astype(jnp.float32) - shift0
-            d_mean = jnp.mean(xf, axis=reduce_axes)
-            d_sq = jnp.mean(jnp.square(xf), axis=reduce_axes)
-            mean = d_mean + state["moving_mean"]
-            var = jnp.maximum(d_sq - jnp.square(d_mean), 0.0)
-            m = self.momentum
-            updates = {"_state": {
-                "moving_mean": m * state["moving_mean"] + (1 - m) * mean,
-                "moving_var": m * state["moving_var"] + (1 - m) * var,
-            }}
+            count = float(np.prod([x.shape[a] for a in reduce_axes]))
+            mean, var, updates = bn_batch_stats(
+                jnp.sum(xf, axis=reduce_axes),
+                jnp.sum(jnp.square(xf), axis=reduce_axes),
+                count, state, self.momentum)
         else:
             mean, var = state["moving_mean"], state["moving_var"]
             updates = {}
         # fold (x-mean)*inv*gamma+beta into one per-element FMA: the
         # per-channel scale/shift vectors are computed in f32 off the
         # hot path, so the activation tensor is read once, written once
-        inv = jax.lax.rsqrt(var + self.epsilon)
-        scale = inv * params["gamma"] if self.scale else inv
-        shift = -mean * scale
-        if self.center:
-            shift = shift + params["beta"]
+        scale, shift = bn_fold(
+            mean, var, params["gamma"] if self.scale else None,
+            params["beta"] if self.center else None, self.epsilon)
         y = x * self._reshape_stat(scale, x).astype(x.dtype) + \
             self._reshape_stat(shift, x).astype(x.dtype)
         return y, updates

@@ -95,15 +95,23 @@ class KerasNet(KerasLayer):
                 return {inner.name: mask_layer(inner,
                                                sub.get(inner.name, {}))
                         for inner in lyr.layers if inner.name in sub}
-            def leaf_mask(path_leaf):
-                return lyr.trainable
+            def mask_sub(node):
+                # "_state" subtrees are non-trainable at ANY nesting
+                # depth (composite layers like FusedBottleneck keep
+                # per-BN state under params["bn1"]["_state"], ...)
+                if isinstance(node, dict):
+                    return {k: (jax.tree_util.tree_map(
+                                    lambda _: False, v)
+                                if k == "_state" else mask_sub(v))
+                            for k, v in node.items()}
+                return jax.tree_util.tree_map(
+                    lambda _: bool(lyr.trainable), node)
             out = {}
             for k, v in sub.items():
                 if k == "_state":
                     out[k] = jax.tree_util.tree_map(lambda _: False, v)
                 else:
-                    out[k] = jax.tree_util.tree_map(
-                        lambda _: bool(lyr.trainable), v)
+                    out[k] = mask_sub(v)
             return out
         return {lyr.name: mask_layer(lyr, params.get(lyr.name, {}))
                 for lyr in self.layers if lyr.name in params}

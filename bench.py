@@ -126,10 +126,14 @@ def main():
                    log_level="WARNING")
     # MLPerf-style space-to-depth stem (same map, MXU-dense; see
     # models/.../resnet.py S2DStemConv) — measured +1.5% img/s on
-    # v5e; ZOO_TPU_BENCH_S2D=0 reverts to the plain 7x7/s2 stem
+    # v5e; ZOO_TPU_BENCH_S2D=0 reverts to the plain 7x7/s2 stem.
+    # ZOO_TPU_BENCH_FUSED=1 (default) uses the Pallas fused
+    # matmul+BN bottleneck (ops/conv_bn.py) on the 1x1 convs.
     model = resnet50(input_shape=(image, image, 3), classes=1000,
                      space_to_depth=os.environ.get(
-                         "ZOO_TPU_BENCH_S2D", "1") == "1")
+                         "ZOO_TPU_BENCH_S2D", "1") == "1",
+                     fused=os.environ.get(
+                         "ZOO_TPU_BENCH_FUSED", "1") == "1")
     params = model.init_params()
     loss_fn = losses.softmax_cross_entropy
     tx = optimizers.SGD(lr=0.1, momentum=0.9).to_optax()

@@ -1,0 +1,233 @@
+"""Fused matmul+BN Pallas kernel vs the unfused XLA graph.
+
+Like the flash-attention conformance suite, the REAL kernel runs
+under the Pallas interpreter on the CPU mesh, so the exact kernel
+code path is what's verified — values, statistics, gradients, moving-
+state updates, across the block variants ResNet-50 uses."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.ops.conv_bn import conv1x1_bn, matmul_bn
+
+
+def _ref_matmul_bn(x, w, s=None, t=None, relu_in=False, sh=None):
+    xf = x.astype(jnp.float32)
+    if s is not None:
+        xf = xf * s[None, :] + t[None, :]
+    if relu_in:
+        xf = jnp.maximum(xf, 0.0)
+    y = (xf.astype(x.dtype) @ w.astype(x.dtype)).astype(jnp.float32)
+    d = y - (0.0 if sh is None else sh[None, :])
+    return y.astype(x.dtype), jnp.sum(d, 0), jnp.sum(d * d, 0)
+
+
+@pytest.mark.parametrize("m,k,n", [(512, 128, 256), (300, 256, 128),
+                                   (784, 640, 128), (49 * 8, 512, 1024)])
+def test_matmul_bn_matches_reference(m, k, n, rng):
+    x = jnp.asarray(rng.randn(m, k), jnp.float32)
+    w = jnp.asarray(rng.randn(k, n) * 0.1, jnp.float32)
+    s = jnp.asarray(rng.rand(k) + 0.5, jnp.float32)
+    t = jnp.asarray(rng.randn(k), jnp.float32)
+    sh = jnp.asarray(rng.randn(n), jnp.float32)
+    y, ssum, ssq = matmul_bn(x, w, in_scale=s, in_shift=t,
+                             relu_in=True, stat_shift=sh)
+    ry, rsum, rsq = _ref_matmul_bn(x, w, s, t, True, sh)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ry),
+                               rtol=1e-5, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(ssum), np.asarray(rsum),
+                               rtol=1e-4, atol=1e-2)
+    np.testing.assert_allclose(np.asarray(ssq), np.asarray(rsq),
+                               rtol=1e-4, atol=1e-2)
+
+
+def test_matmul_bn_plain_and_bf16(rng):
+    x = jnp.asarray(rng.randn(384, 128), jnp.bfloat16)
+    w = jnp.asarray(rng.randn(128, 128) * 0.1, jnp.float32)
+    y, ssum, ssq = matmul_bn(x, w)
+    ry, rsum, rsq = _ref_matmul_bn(x, w)
+    assert y.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(y, np.float32),
+                               np.asarray(ry, np.float32),
+                               rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(np.asarray(ssum), np.asarray(rsum),
+                               rtol=2e-2, atol=2.0)
+    np.testing.assert_allclose(np.asarray(ssq), np.asarray(rsq),
+                               rtol=2e-2, atol=2.0)
+
+
+def test_matmul_bn_grads_match(rng):
+    m, k, n = 384, 128, 256
+    x = jnp.asarray(rng.randn(m, k), jnp.float32)
+    w = jnp.asarray(rng.randn(k, n) * 0.1, jnp.float32)
+    s = jnp.asarray(rng.rand(k) + 0.5, jnp.float32)
+    t = jnp.asarray(rng.randn(k), jnp.float32)
+    sh = jnp.asarray(rng.randn(n), jnp.float32)
+
+    def loss_fused(x, w, s, t):
+        y, sm, sq = matmul_bn(x, w, in_scale=s, in_shift=t,
+                              relu_in=True, stat_shift=sh)
+        return (jnp.sum(y.astype(jnp.float32) * 0.3) +
+                jnp.sum(jnp.sin(sm)) + jnp.sum(jnp.sqrt(sq + 1.0)))
+
+    def loss_ref(x, w, s, t):
+        xp = jnp.maximum(x * s[None, :] + t[None, :], 0.0)
+        y = xp @ w
+        d = y - sh[None, :]
+        return (jnp.sum(y * 0.3) + jnp.sum(jnp.sin(jnp.sum(d, 0))) +
+                jnp.sum(jnp.sqrt(jnp.sum(d * d, 0) + 1.0)))
+
+    g1 = jax.grad(loss_fused, argnums=(0, 1, 2, 3))(x, w, s, t)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(x, w, s, t)
+    for name, a, b in zip("x w s t".split(), g1, g2):
+        a, b = np.asarray(a), np.asarray(b)
+        # scale-aware: f32 matmul reduction order makes tiny entries
+        # noisy relative to themselves, not to the tensor scale
+        tol = 2e-3 * max(float(np.abs(b).max()), 1.0)
+        np.testing.assert_allclose(a, b, rtol=2e-3, atol=tol,
+                                   err_msg=f"d{name}")
+
+
+def test_conv1x1_bn_stride(rng):
+    x = jnp.asarray(rng.randn(2, 8, 8, 128), jnp.float32)
+    w = jnp.asarray(rng.randn(1, 1, 128, 256) * 0.1, jnp.float32)
+    y, ssum, ssq = conv1x1_bn(x, w, stride=2)
+    ref = jax.lax.conv_general_dilated(
+        x, w, window_strides=(2, 2), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               rtol=1e-5, atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(ssum),
+        np.asarray(ref.astype(jnp.float32).sum((0, 1, 2))),
+        rtol=1e-4, atol=1e-2)
+
+
+# ---------------------------------------------------------------------------
+# FusedBottleneck vs the unfused keras subgraph, identical weights
+# ---------------------------------------------------------------------------
+
+def _unfused_block_model(c, filters, stride, downsample, h=8, w=8):
+    from analytics_zoo_tpu.models.image.imageclassification.resnet \
+        import _bottleneck
+    from analytics_zoo_tpu.pipeline.api.keras.engine import Input
+    from analytics_zoo_tpu.pipeline.api.keras.models import Model
+    inp = Input((h, w, c), name="x")
+    out = _bottleneck(inp, filters, stride=stride,
+                      downsample=downsample, name="blk")
+    return Model(inp, out, name="unfused_block")
+
+
+def _copy_weights(fused_params, model_params):
+    """unfused per-layer params → the FusedBottleneck layout."""
+    fp = dict(fused_params)
+    fp["c1"] = model_params["blk_c1"]["kernel"]
+    fp["c2"] = model_params["blk_c2"]["kernel"]
+    fp["c3"] = model_params["blk_c3"]["kernel"]
+    fp["bn1"] = model_params["blk_c1_bn"]
+    fp["bn2"] = model_params["blk_c2_bn"]
+    fp["bn3"] = model_params["blk_c3_bn"]
+    if "blk_down" in model_params:
+        fp["down"] = model_params["blk_down"]["kernel"]
+        fp["bnd"] = model_params["blk_down_bn"]
+    return fp
+
+
+@pytest.mark.parametrize("stride,downsample", [(1, False), (1, True),
+                                               (2, True)])
+def test_fused_bottleneck_matches_unfused(stride, downsample, rng):
+    from analytics_zoo_tpu.models.image.imageclassification.resnet \
+        import FusedBottleneck
+    c, filters = 128, 64    # ResNet stage-0 shapes (64-lane tiles)
+    # non-downsample blocks need matching in/out channels (residual)
+    if not downsample:
+        c = 4 * filters
+    model = _unfused_block_model(c, filters, stride, downsample)
+    mparams = model.init_params()
+    blk = FusedBottleneck(filters, stride=stride, downsample=downsample,
+                          input_shape=(8, 8, c), name="blk")
+    fparams = _copy_weights(blk.init(jax.random.PRNGKey(0)), mparams)
+    # randomize the BN params/state so the comparison is not at the
+    # init fixed point
+    for bn in ("blk_c1_bn", "blk_c2_bn", "blk_c3_bn", "blk_down_bn"):
+        if bn not in mparams:
+            continue
+        n = mparams[bn]["gamma"].shape[0]
+        mparams[bn]["gamma"] = jnp.asarray(rng.rand(n) + 0.5,
+                                           jnp.float32)
+        mparams[bn]["beta"] = jnp.asarray(rng.randn(n) * 0.1,
+                                          jnp.float32)
+        mparams[bn]["_state"]["moving_mean"] = jnp.asarray(
+            rng.randn(n) * 0.1, jnp.float32)
+        mparams[bn]["_state"]["moving_var"] = jnp.asarray(
+            rng.rand(n) + 0.5, jnp.float32)
+    fparams = _copy_weights(fparams, mparams)
+
+    x = jnp.asarray(rng.randn(4, 8, 8, c), jnp.float32)
+
+    for training in (True, False):
+        ref_out, ref_upd = model.apply(mparams, x, training=training)
+        out, upd = blk.apply(fparams, x, training=training)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref_out), rtol=2e-4, atol=2e-4,
+            err_msg=f"training={training}")
+        if training:
+            pairs = [("bn1", "blk_c1_bn"), ("bn2", "blk_c2_bn"),
+                     ("bn3", "blk_c3_bn")]
+            if downsample:
+                pairs.append(("bnd", "blk_down_bn"))
+            for fk, mk in pairs:
+                for stat in ("moving_mean", "moving_var"):
+                    np.testing.assert_allclose(
+                        np.asarray(upd[fk]["_state"][stat]),
+                        np.asarray(ref_upd[mk]["_state"][stat]),
+                        rtol=1e-3, atol=1e-3,
+                        err_msg=f"{fk}.{stat}")
+        else:
+            assert upd == {}
+
+    # gradients agree: same scalar loss through both graphs
+    def loss_fused(p, x):
+        out, _ = blk.apply(p, x, training=True)
+        return jnp.mean(jnp.square(out.astype(jnp.float32)))
+
+    def loss_ref(p, x):
+        out, _ = model.apply(p, x, training=True)
+        return jnp.mean(jnp.square(out.astype(jnp.float32)))
+
+    gf = jax.grad(loss_fused)(fparams, x)
+    gm = jax.grad(loss_ref)(mparams, x)
+    checks = [("c1", gm["blk_c1"]["kernel"], gf["c1"]),
+              ("c2", gm["blk_c2"]["kernel"], gf["c2"]),
+              ("c3", gm["blk_c3"]["kernel"], gf["c3"]),
+              ("bn1.gamma", gm["blk_c1_bn"]["gamma"],
+               gf["bn1"]["gamma"]),
+              ("bn2.gamma", gm["blk_c2_bn"]["gamma"],
+               gf["bn2"]["gamma"]),
+              ("bn3.beta", gm["blk_c3_bn"]["beta"],
+               gf["bn3"]["beta"])]
+    if downsample:
+        checks.append(("down", gm["blk_down"]["kernel"], gf["down"]))
+    for name, a, b in checks:
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), rtol=5e-3, atol=5e-4,
+            err_msg=f"grad {name}")
+
+
+def test_fused_resnet50_builds_and_trains(rng):
+    from analytics_zoo_tpu import init_nncontext
+    from analytics_zoo_tpu.models.image.imageclassification import \
+        resnet50
+    from analytics_zoo_tpu.ops import losses, optimizers
+    from analytics_zoo_tpu.pipeline.estimator import Estimator
+    init_nncontext(tpu_mesh={"data": 1},
+                   devices=jax.devices("cpu")[:1])
+    model = resnet50(input_shape=(32, 32, 3), classes=10, fused=True)
+    est = Estimator(model, optimizer="sgd",
+                    loss="softmax_cross_entropy")
+    x = rng.randn(4, 32, 32, 3).astype(np.float32)
+    y = rng.randint(0, 10, size=(4, 1)).astype(np.int32)
+    res = est.train(x, y, batch_size=4, nb_epoch=1)
+    assert np.isfinite(res.history[-1]["loss"])
