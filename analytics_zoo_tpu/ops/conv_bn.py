@@ -52,8 +52,10 @@ def _pick_blocks(m: int, k: int, n: int) -> Tuple[int, int]:
     bk = next(b for b in (512, 384, 256, 128, 64) if k % b == 0) \
         if k > 512 else k
     # VMEM budget ~ acc(bm·n·4) + x(bm·bk·2) + w(bk·n·2): keep ≲6MB
+    # (leaves headroom for Pallas double-buffering in 16MB VMEM)
     bm = 512
-    while bm > 128 and bm * n * 4 + bm * bk * 2 > 5 * 2 ** 20:
+    while bm > 128 and \
+            bm * n * 4 + bm * bk * 2 + bk * n * 2 > 6 * 2 ** 20:
         bm //= 2
     return max(bm, 128), bk
 
@@ -150,7 +152,12 @@ def _matmul_bn_fwd_pallas(x, w, s, t, sh, relu_in, affine_in,
             row0 = t[0, :]
             if relu_in:
                 row0 = jnp.maximum(row0, 0.0)
-            y0 = row0 @ w.astype(jnp.float32)
+            # match the kernel's compute path exactly: the prologue
+            # output is cast to the weight dtype before the MXU dot
+            y0 = jax.lax.dot_general(
+                row0.astype(w.dtype)[None, :], w,
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)[0]
         else:
             y0 = jnp.zeros((n,), jnp.float32)
         d0 = y0 - sh[0, :]
@@ -188,9 +195,15 @@ def _matmul_bn_vjp_bwd(relu_in, affine_in, interpret, res, cots):
     else:
         xa = x.astype(f32)
     xp = jnp.maximum(xa, 0.0) if relu_in else xa
-    dw = jax.lax.dot_general(xp, g, (((0,), (0,)), ((), ())),
+    # backward matmuls run in the forward's compute dtype (bf16 on the
+    # MXU) with f32 accumulation — mixed-precision standard; only the
+    # elementwise algebra stays f32
+    cd = x.dtype
+    gc = g.astype(cd)
+    dw = jax.lax.dot_general(xp.astype(cd), gc,
+                             (((0,), (0,)), ((), ())),
                              preferred_element_type=f32)
-    dxp = jax.lax.dot_general(g, w.astype(f32),
+    dxp = jax.lax.dot_general(gc, w.astype(cd),
                               (((1,), (1,)), ((), ())),
                               preferred_element_type=f32)
     if relu_in:
@@ -220,8 +233,9 @@ def matmul_bn(x: jnp.ndarray, w: jnp.ndarray,
     """Fused ``relu(x·in_scale+in_shift) @ w`` with BN-statistics
     epilogue.
 
-    x: (M, K); w: (K, N) — K, N must be 128-multiples (ResNet channel
-    counts are). Returns ``(y (M, N), sum (N,), sumsq (N,))`` where
+    x: (M, K); w: (K, N) — K, N must be 64-multiples (128 preferred:
+    the native lane width; 64 covers ResNet's stage-0 convs via lane
+    padding). Returns ``(y (M, N), sum (N,), sumsq (N,))`` where
     the statistics are over ``y - stat_shift`` in f32 (pass the BN's
     moving mean, stop-gradded, as ``stat_shift``; see
     `BatchNormalization.apply` for the scheme).
@@ -240,9 +254,10 @@ def matmul_bn(x: jnp.ndarray, w: jnp.ndarray,
         raise ValueError(f"K={k} and N={n} must be 64-multiples")
     if interpret is None:
         interpret = jax.default_backend() not in ("tpu", "axon")
-    affine_in = in_scale is not None
+    # shift-only callers get scale=1, not a silently dropped shift
+    affine_in = in_scale is not None or in_shift is not None
     f32 = jnp.float32
-    s = (in_scale.astype(f32) if affine_in else
+    s = (in_scale.astype(f32) if in_scale is not None else
          jnp.ones((k,), f32)).reshape(1, k)
     t = (in_shift.astype(f32) if in_shift is not None else
          jnp.zeros((k,), f32)).reshape(1, k)

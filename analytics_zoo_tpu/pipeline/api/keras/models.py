@@ -106,12 +106,7 @@ class KerasNet(KerasLayer):
                             for k, v in node.items()}
                 return jax.tree_util.tree_map(
                     lambda _: bool(lyr.trainable), node)
-            out = {}
-            for k, v in sub.items():
-                if k == "_state":
-                    out[k] = jax.tree_util.tree_map(lambda _: False, v)
-                else:
-                    out[k] = mask_sub(v)
+            out = mask_sub(sub)
             return out
         return {lyr.name: mask_layer(lyr, params.get(lyr.name, {}))
                 for lyr in self.layers if lyr.name in params}

@@ -1,0 +1,164 @@
+"""Chip-session measurement for the fused conv+BN work (round 3).
+
+Runs, in ONE process (one backend init, scan-chain timing per the
+axon recipe in PERF.md):
+  1. kernel microbench: matmul_bn vs the equivalent unfused XLA graph
+     (prologue-apply+relu, matmul, single-pass stats) on ResNet-50's
+     1x1 shapes, fwd and fwd+bwd;
+  2. full-model A/B: ResNet-50 train step fused=0 vs fused=1
+     (bench.py subprocesses).
+
+Usage:  python scripts/measure_fused.py [--skip-micro] [--skip-model]
+        [--steps 20] [--tiny]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+# (M, K, N): ResNet-50 1x1 conv shapes at batch 128
+_RESNET_SHAPES = [
+    (128 * 56 * 56, 64, 64),      # s0 c1
+    (128 * 56 * 56, 64, 256),     # s0 c3
+    (128 * 56 * 56, 256, 64),     # s0b1 c1
+    (128 * 28 * 28, 512, 128),    # s1 c1
+    (128 * 28 * 28, 128, 512),    # s1 c3
+    (128 * 14 * 14, 1024, 256),   # s2 c1
+    (128 * 14 * 14, 256, 1024),   # s2 c3
+    (128 * 7 * 7, 2048, 512),     # s3 c1
+    (128 * 7 * 7, 512, 2048),     # s3 c3
+]
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--skip-micro", action="store_true")
+    p.add_argument("--skip-model", action="store_true")
+    p.add_argument("--batch", type=int, default=128)
+    p.add_argument("--tiny", action="store_true",
+                   help="smoke-run mechanics on CPU-size shapes")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          "/tmp/zoo_tpu_xla_cache")
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 2.0)
+    except Exception:
+        pass
+    devices = jax.devices()
+    print(f"# backend={devices[0].platform}", flush=True)
+
+    steps = args.steps
+
+    def _t(f):
+        t0 = time.perf_counter()
+        f()
+        return time.perf_counter() - t0
+
+    def chain_time(fn, x, *consts):
+        """ms per call of fn(x, *consts): one jitted scan chain of
+        `steps` iterations feeding x -> x, min of 3 runs, dispatch
+        overhead subtracted."""
+        @jax.jit
+        def chain(x, *consts):
+            def body(c, _):
+                out = fn(c, *consts)
+                return out.astype(c.dtype), jnp.zeros(())
+            c, _ = jax.lax.scan(body, x, None, length=steps)
+            return jnp.sum(c.astype(jnp.float32))
+        float(np.asarray(chain(x, *consts)))            # compile+warm
+        tiny = jax.jit(lambda a: a + 1.0)
+        float(np.asarray(tiny(jnp.zeros(()))))
+        over = min(_t(lambda: float(np.asarray(tiny(jnp.zeros(())))))
+                   for _ in range(5))
+        best = min(_t(lambda: float(np.asarray(chain(x, *consts))))
+                   for _ in range(3))
+        return max(best - over, 1e-9) / steps * 1e3
+
+    if not args.skip_micro:
+        from analytics_zoo_tpu.ops.conv_bn import matmul_bn
+
+        shapes = [(512, 128, 256), (256, 256, 128)] if args.tiny \
+            else _RESNET_SHAPES
+        rs = np.random.RandomState(0)
+        print("# micro: fused kernel vs unfused XLA "
+              "(prologue-apply+relu, matmul, stats)", flush=True)
+        for m, k, n in shapes:
+            x = jnp.asarray(rs.randn(m, k), jnp.bfloat16)
+            w = jnp.asarray(rs.randn(k, n) * 0.05, jnp.bfloat16)
+            s = jnp.asarray(rs.rand(k) + 0.5, jnp.float32)
+            t = jnp.asarray(rs.randn(k) * 0.1, jnp.float32)
+            sh = jnp.asarray(rs.randn(n) * 0.1, jnp.float32)
+
+            def fused(x, w):
+                y, sm, sq = matmul_bn(x, w, in_scale=s, in_shift=t,
+                                      relu_in=True, stat_shift=sh)
+                # touch the stats so nothing is dead-code-eliminated;
+                # keep the carry shape (M, K) by projecting back
+                y = y + (sm + sq)[None, :].astype(y.dtype) * 0
+                return y[:, :x.shape[1]] if n >= x.shape[1] else \
+                    jnp.pad(y, ((0, 0), (0, x.shape[1] - n)))
+
+            def unfused(x, w):
+                xp = jnp.maximum(
+                    x * s[None, :].astype(x.dtype) +
+                    t[None, :].astype(x.dtype), 0)
+                y = xp @ w
+                d = y.astype(jnp.float32) - sh[None, :]
+                sm, sq = jnp.sum(d, 0), jnp.sum(d * d, 0)
+                y = y + (sm + sq)[None, :].astype(y.dtype) * 0
+                return y[:, :x.shape[1]] if n >= x.shape[1] else \
+                    jnp.pad(y, ((0, 0), (0, x.shape[1] - n)))
+
+            def grad_of(fn):
+                def loss(x, w):
+                    return jnp.sum(fn(x, w).astype(jnp.float32))
+                g = jax.grad(loss, argnums=0)
+                return lambda x, w: g(x, w)
+
+            tf_ = chain_time(fused, x, w)
+            tu = chain_time(unfused, x, w)
+            gtf = chain_time(grad_of(fused), x, w)
+            gtu = chain_time(grad_of(unfused), x, w)
+            print(f"M={m:9d} K={k:4d} N={n:4d}  "
+                  f"fwd {tu:7.3f}->{tf_:7.3f} ms ({tu / tf_:4.2f}x)  "
+                  f"fwd+bwd {gtu:7.3f}->{gtf:7.3f} ms "
+                  f"({gtu / gtf:4.2f}x)", flush=True)
+
+    if not args.skip_model:
+        print("# model A/B: ZOO_TPU_BENCH_FUSED 0 vs 1:", flush=True)
+        import subprocess
+        here = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))
+        for fused in ("0", "1"):
+            env = dict(os.environ, ZOO_TPU_BENCH_FUSED=fused,
+                       ZOO_TPU_BENCH_STEPS=str(steps),
+                       ZOO_TPU_BENCH_BATCH=str(args.batch))
+            if args.tiny:
+                env.update(ZOO_TPU_BENCH_BATCH="4",
+                           ZOO_TPU_BENCH_IMAGE="64",
+                           ZOO_TPU_BENCH_STEPS="2",
+                           ZOO_TPU_BENCH_PLATFORM=os.environ.get(
+                               "ZOO_TPU_BENCH_PLATFORM", "cpu"))
+            out = subprocess.run(
+                [sys.executable, os.path.join(here, "bench.py")],
+                capture_output=True, text=True, env=env, timeout=900)
+            line = next((l for l in out.stdout.splitlines()
+                         if l.startswith("{")), "<no json>")
+            diag = next((l for l in out.stderr.splitlines()
+                         if "step_time" in l), "")
+            print(f"fused={fused}: {line}\n  {diag}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

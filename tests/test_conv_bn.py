@@ -58,6 +58,18 @@ def test_matmul_bn_plain_and_bf16(rng):
                                rtol=2e-2, atol=2.0)
 
 
+def test_matmul_bn_shift_only(rng):
+    # in_shift without in_scale must apply the shift (scale=1), not
+    # silently drop it
+    x = jnp.asarray(rng.randn(256, 128), jnp.float32)
+    w = jnp.asarray(rng.randn(128, 128) * 0.1, jnp.float32)
+    t = jnp.asarray(rng.randn(128), jnp.float32)
+    y, _, _ = matmul_bn(x, w, in_shift=t)
+    ry, _, _ = _ref_matmul_bn(x, w, jnp.ones((128,), jnp.float32), t)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ry),
+                               rtol=1e-5, atol=1e-4)
+
+
 def test_matmul_bn_grads_match(rng):
     m, k, n = 384, 128, 256
     x = jnp.asarray(rng.randn(m, k), jnp.float32)
@@ -214,6 +226,36 @@ def test_fused_bottleneck_matches_unfused(stride, downsample, rng):
         np.testing.assert_allclose(
             np.asarray(b), np.asarray(a), rtol=5e-3, atol=5e-4,
             err_msg=f"grad {name}")
+
+
+def test_fused_block_dp_sharded_batch_matches_single(rng):
+    # GSPMD must not silently change the kernel's BN statistics when
+    # the batch is sharded over the mesh (global-batch syncBN parity)
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from analytics_zoo_tpu.models.image.imageclassification.resnet \
+        import FusedBottleneck
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the multi-device CPU mesh")
+    blk = FusedBottleneck(64, stride=1, downsample=True,
+                          input_shape=(8, 8, 128), name="blk")
+    params = blk.init(jax.random.PRNGKey(0))
+    x = jnp.asarray(rng.randn(16, 8, 8, 128), jnp.float32)
+
+    def step(p, x):
+        out, upd = blk.apply(p, x, training=True)
+        return (jnp.mean(out.astype(jnp.float32)),
+                upd["bn1"]["_state"]["moving_mean"])
+
+    l1, mm1 = jax.jit(step)(params, x)
+    n = len(jax.devices())
+    mesh = Mesh(np.array(jax.devices()).reshape(n), ("data",))
+    xs = jax.device_put(x, NamedSharding(mesh, P("data")))
+    ps = jax.device_put(params, NamedSharding(mesh, P()))
+    l2, mm2 = jax.jit(step)(ps, xs)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(mm1), np.asarray(mm2),
+                               atol=1e-5)
 
 
 def test_fused_resnet50_builds_and_trains(rng):
