@@ -33,6 +33,7 @@ than autodiff of the unfused graph.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -183,6 +184,17 @@ def _matmul_bn_vjp_fwd(x, w, s, t, sh, relu_in, affine_in, interpret):
 def _matmul_bn_vjp_bwd(relu_in, affine_in, interpret, res, cots):
     x, w, s, t, sh, y = res
     dy, dsum, dsq = cots
+    if os.environ.get("ZOO_TPU_CONV_BN_PALLAS_BWD", "1") == "1":
+        return _bwd_pallas(x, w, s, t, sh, y, dy, dsum, dsq,
+                           relu_in, affine_in, interpret)
+    return _bwd_jax(x, w, s, t, sh, y, dy, dsum, dsq,
+                    relu_in, affine_in)
+
+
+def _bwd_jax(x, w, s, t, sh, y, dy, dsum, dsq, relu_in, affine_in):
+    """XLA-expressed backward (the `ZOO_TPU_CONV_BN_PALLAS_BWD=0`
+    reference path, and the ground truth the Pallas backward is
+    conformance-tested against)."""
     f32 = jnp.float32
     # stats cotangents fold into one augmented output cotangent:
     # y feeds (y, Σ(y-sh), Σ(y-sh)²) so g = dy + dΣ + 2(y-sh)·dΣ²
@@ -219,6 +231,193 @@ def _matmul_bn_vjp_bwd(relu_in, affine_in, interpret, res, cots):
     return (dx.astype(x.dtype), dw.astype(w.dtype),
             ds.astype(s.dtype), dt.astype(t.dtype),
             jnp.zeros_like(sh))
+
+
+def _g_tile(dy, y, sh_row, dsum_row, dsq_row):
+    """The augmented cotangent on one tile, in f32 (single copy of the
+    formula shared by both backward kernels)."""
+    return (dy.astype(jnp.float32) + dsum_row +
+            2.0 * (y.astype(jnp.float32) - sh_row) * dsq_row)
+
+
+def _dx_kernel(dy_ref, y_ref, x_ref, w_ref, s_ref, t_ref, sh_ref,
+               dsum_ref, dsq_ref, dx_ref, ds_ref, dt_ref, *,
+               relu_in: bool, affine_in: bool, out_dtype):
+    """Grid (mi,): dx tile = prologue'(x) ⊙ (g @ Wᵀ); ds/dt accumulate
+    across mi. g is recomputed from dy/y in VMEM — it never exists in
+    HBM (the XLA path materialises it as both matmuls' operand)."""
+    mi = pl.program_id(0)
+    g = _g_tile(dy_ref[...], y_ref[...], sh_ref[0, :][None, :],
+                dsum_ref[0, :][None, :], dsq_ref[0, :][None, :])
+    dxp = jax.lax.dot_general(
+        g.astype(w_ref.dtype), w_ref[...],
+        (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+    xf = x_ref[...].astype(jnp.float32)
+    if affine_in:
+        xa = xf * s_ref[0, :][None, :] + t_ref[0, :][None, :]
+    else:
+        xa = xf
+    if relu_in:
+        dxp = jnp.where(xa > 0.0, dxp, 0.0)
+    if affine_in:
+        dx_ref[...] = (dxp * s_ref[0, :][None, :]).astype(out_dtype)
+        ds_new = jnp.sum(dxp * xf, axis=0, keepdims=True)
+        dt_new = jnp.sum(dxp, axis=0, keepdims=True)
+    else:
+        dx_ref[...] = dxp.astype(out_dtype)
+        ds_new = jnp.zeros_like(ds_ref)
+        dt_new = jnp.zeros_like(dt_ref)
+
+    @pl.when(mi == 0)
+    def _first():
+        ds_ref[...] = ds_new
+        dt_ref[...] = dt_new
+
+    @pl.when(mi != 0)
+    def _rest():
+        ds_ref[...] += ds_new
+        dt_ref[...] += dt_new
+
+
+def _dw_kernel(dy_ref, y_ref, x_ref, s_ref, t_ref, sh_ref,
+               dsum_ref, dsq_ref, dw_ref, acc_ref, *,
+               n_m: int, relu_in: bool, affine_in: bool):
+    """Grid (ni, mi): dW[:, ni] += prologue(x)ᵀ @ g, accumulated over
+    mi in a VMEM scratch, written at the last mi."""
+    mi = pl.program_id(1)
+
+    @pl.when(mi == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    g = _g_tile(dy_ref[...], y_ref[...], sh_ref[0, :][None, :],
+                dsum_ref[0, :][None, :], dsq_ref[0, :][None, :])
+    xf = x_ref[...].astype(jnp.float32)
+    if affine_in:
+        xf = xf * s_ref[0, :][None, :] + t_ref[0, :][None, :]
+    if relu_in:
+        xf = jnp.maximum(xf, 0.0)
+    cd = x_ref.dtype
+    acc_ref[...] += jax.lax.dot_general(
+        xf.astype(cd), g.astype(cd), (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(mi == n_m - 1)
+    def _write():
+        dw_ref[...] = acc_ref[...]
+
+
+def _bwd_pallas(x, w, s, t, sh, y, dy, dsum, dsq, relu_in, affine_in,
+                interpret):
+    m, k = x.shape
+    n = w.shape[1]
+    f32 = jnp.float32
+    dsum2 = dsum.astype(f32).reshape(1, n)
+    dsq2 = dsq.astype(f32).reshape(1, n)
+    # block rows: bound VMEM by the fattest resident set
+    bm = 512
+    while bm > 128 and bm * (2 * n + k) * 2 + bm * k * 4 > 6 * 2 ** 20:
+        bm //= 2
+    if m % bm:
+        pad = bm - m % bm
+        # zero-padded rows: g_pad = dsum (nonzero!) but relu'/affine
+        # masks make dx rows garbage we slice off; for ds/dt the
+        # padded rows contribute dxp_pad·0 (xf=0) to ds and dxp_pad to
+        # dt — correct dt exactly below. dW pads xp rows as
+        # prologue(0) like the forward — corrected below too.
+        x_p = jnp.pad(x, ((0, pad), (0, 0)))
+        dy_p = jnp.pad(dy, ((0, pad), (0, 0)))
+        y_p = jnp.pad(y, ((0, pad), (0, 0)))
+    else:
+        pad = 0
+        x_p, dy_p, y_p = x, dy, y
+    mp = m + pad
+    n_m = mp // bm
+
+    dx, ds, dt = pl.pallas_call(
+        functools.partial(_dx_kernel, relu_in=relu_in,
+                          affine_in=affine_in,
+                          out_dtype=jnp.dtype(x.dtype)),
+        grid=(n_m,),
+        in_specs=[
+            pl.BlockSpec((bm, n), lambda mi: (mi, 0)),    # dy
+            pl.BlockSpec((bm, n), lambda mi: (mi, 0)),    # y
+            pl.BlockSpec((bm, k), lambda mi: (mi, 0)),    # x
+            pl.BlockSpec((k, n), lambda mi: (0, 0)),      # w
+            pl.BlockSpec((1, k), lambda mi: (0, 0)),      # s
+            pl.BlockSpec((1, k), lambda mi: (0, 0)),      # t
+            pl.BlockSpec((1, n), lambda mi: (0, 0)),      # sh
+            pl.BlockSpec((1, n), lambda mi: (0, 0)),      # dsum
+            pl.BlockSpec((1, n), lambda mi: (0, 0)),      # dsq
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, k), lambda mi: (mi, 0)),
+            pl.BlockSpec((1, k), lambda mi: (0, 0)),
+            pl.BlockSpec((1, k), lambda mi: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((mp, k), x.dtype),
+            jax.ShapeDtypeStruct((1, k), f32),
+            jax.ShapeDtypeStruct((1, k), f32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(dy_p, y_p, x_p, w, s, t, sh, dsum2, dsq2)
+
+    bn_w = n if k * n * 4 <= 4 * 2 ** 20 else \
+        next(b for b in (1024, 512, 256, 128, 64) if n % b == 0)
+    dw = pl.pallas_call(
+        functools.partial(_dw_kernel, n_m=n_m, relu_in=relu_in,
+                          affine_in=affine_in),
+        grid=(n // bn_w, n_m),
+        in_specs=[
+            pl.BlockSpec((bm, bn_w), lambda ni, mi: (mi, ni)),  # dy
+            pl.BlockSpec((bm, bn_w), lambda ni, mi: (mi, ni)),  # y
+            pl.BlockSpec((bm, k), lambda ni, mi: (mi, 0)),      # x
+            pl.BlockSpec((1, k), lambda ni, mi: (0, 0)),        # s
+            pl.BlockSpec((1, k), lambda ni, mi: (0, 0)),        # t
+            pl.BlockSpec((1, bn_w), lambda ni, mi: (0, ni)),    # sh
+            pl.BlockSpec((1, bn_w), lambda ni, mi: (0, ni)),    # dsum
+            pl.BlockSpec((1, bn_w), lambda ni, mi: (0, ni)),    # dsq
+        ],
+        out_specs=pl.BlockSpec((k, bn_w), lambda ni, mi: (0, ni)),
+        out_shape=jax.ShapeDtypeStruct((k, n), f32),
+        scratch_shapes=[pltpu.VMEM((k, bn_w), f32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(dy_p, y_p, x_p, s, t, sh, dsum2, dsq2)
+
+    if pad:
+        dx = dx[:m]
+        if affine_in:
+            # padded-row corrections (exact; dy=y=x=0 on those rows):
+            # g_pad = dsum − 2·sh·dsq, xp_pad = prologue(0) = relu(t)
+            cd = x.dtype
+            g_pad = dsum2[0] - 2.0 * sh[0, :] * dsq2[0]     # (N,)
+            row0 = jnp.maximum(t[0, :], 0.0) if relu_in else t[0, :]
+            # dW accumulated pad·(xp_pad ⊗ g_pad) — subtract it
+            dw = dw - jnp.float32(pad) * jax.lax.dot_general(
+                row0.astype(cd)[:, None], g_pad.astype(cd)[None, :],
+                (((1,), (0,)), ((), ())), preferred_element_type=f32)
+            # dt accumulated pad·dxp_pad where dxp_pad is the masked
+            # backward of one padded row (ds got dxp_pad·x = 0: exact)
+            dxp_pad = jax.lax.dot_general(
+                g_pad.astype(cd)[None, :], w.astype(cd),
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=f32)[0]
+            if relu_in:
+                dxp_pad = jnp.where(t[0, :] > 0.0, dxp_pad, 0.0)
+            dt = dt - jnp.float32(pad) * dxp_pad[None, :]
+        # no affine: xp_pad = 0 (and relu mask kills dxp_pad), so dW
+        # needs no correction and ds/dt are zeroed below anyway
+
+    if not affine_in:
+        ds = jnp.zeros((1, k), f32)
+        dt = jnp.zeros((1, k), f32)
+    return (dx, dw.astype(w.dtype), ds.astype(s.dtype),
+            dt.astype(t.dtype), jnp.zeros_like(sh))
 
 
 _matmul_bn.defvjp(_matmul_bn_vjp_fwd, _matmul_bn_vjp_bwd)

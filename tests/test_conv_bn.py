@@ -102,6 +102,70 @@ def test_matmul_bn_grads_match(rng):
                                    err_msg=f"d{name}")
 
 
+@pytest.mark.parametrize("m,affine,relu", [
+    (512, True, True),      # block-aligned, single tile
+    (300, True, True),      # padded rows + affine/relu corrections
+    (300, True, False),     # padded + affine, no relu mask
+    (300, False, False),    # padded, raw matmul
+    (1100, True, True),     # multi-tile grid (n_m=3) + padding
+    (1100, False, True),    # multi-tile, relu without affine
+])
+def test_pallas_backward_matches_jax_backward(m, affine, relu, rng,
+                                              monkeypatch):
+    # the Pallas backward kernels (g recomputed in VMEM, fused mask +
+    # ds/dt epilogue) must agree with the XLA-expressed backward —
+    # including the cross-tile ds/dt and dW accumulation paths
+    k, n = 128, 256
+    x = jnp.asarray(rng.randn(m, k), jnp.float32)
+    w = jnp.asarray(rng.randn(k, n) * 0.1, jnp.float32)
+    s = jnp.asarray(rng.rand(k) + 0.5, jnp.float32) if affine else None
+    t = jnp.asarray(rng.randn(k), jnp.float32) if affine else None
+    sh = jnp.asarray(rng.randn(n), jnp.float32)
+
+    def loss(x, w, *aff):
+        kw = dict(relu_in=relu, stat_shift=sh)
+        if affine:
+            kw.update(in_scale=aff[0], in_shift=aff[1])
+        y, sm, sq = matmul_bn(x, w, **kw)
+        return (jnp.sum(y.astype(jnp.float32) * 0.3) +
+                jnp.sum(jnp.sin(sm)) + jnp.sum(jnp.sqrt(sq + 1.0)))
+
+    args = (x, w) + ((s, t) if affine else ())
+    argnums = tuple(range(len(args)))
+    monkeypatch.setenv("ZOO_TPU_CONV_BN_PALLAS_BWD", "1")
+    gp = jax.grad(loss, argnums=argnums)(*args)
+    monkeypatch.setenv("ZOO_TPU_CONV_BN_PALLAS_BWD", "0")
+    gj = jax.grad(loss, argnums=argnums)(*args)
+    for name, a, b in zip("x w s t".split(), gp, gj):
+        a, b = np.asarray(a), np.asarray(b)
+        tol = 2e-3 * max(float(np.abs(b).max()), 1.0)
+        np.testing.assert_allclose(a, b, rtol=2e-3, atol=tol,
+                                   err_msg=f"d{name} (m={m})")
+
+
+def test_pallas_backward_dw_column_tiling(rng, monkeypatch):
+    # K·N·4 > 4MB forces the dW kernel's bn_w column tiling
+    m, k, n = 256, 1024, 2048
+    x = jnp.asarray(rng.randn(m, k) * 0.3, jnp.float32)
+    w = jnp.asarray(rng.randn(k, n) * 0.05, jnp.float32)
+    sh = jnp.asarray(rng.randn(n) * 0.1, jnp.float32)
+
+    def loss(x, w):
+        y, sm, sq = matmul_bn(x, w, stat_shift=sh)
+        return (jnp.sum(y.astype(jnp.float32) * 0.1) +
+                jnp.sum(jnp.sin(sm * 0.01)))
+
+    monkeypatch.setenv("ZOO_TPU_CONV_BN_PALLAS_BWD", "1")
+    gp = jax.grad(loss, argnums=(0, 1))(x, w)
+    monkeypatch.setenv("ZOO_TPU_CONV_BN_PALLAS_BWD", "0")
+    gj = jax.grad(loss, argnums=(0, 1))(x, w)
+    for name, a, b in zip("x w".split(), gp, gj):
+        a, b = np.asarray(a), np.asarray(b)
+        tol = 2e-3 * max(float(np.abs(b).max()), 1.0)
+        np.testing.assert_allclose(a, b, rtol=2e-3, atol=tol,
+                                   err_msg=f"d{name}")
+
+
 def test_conv1x1_bn_stride(rng):
     x = jnp.asarray(rng.randn(2, 8, 8, 128), jnp.float32)
     w = jnp.asarray(rng.randn(1, 1, 128, 256) * 0.1, jnp.float32)
