@@ -312,11 +312,26 @@ def _bwd_pallas(x, w, s, t, sh, y, dy, dsum, dsq, relu_in, affine_in,
     m, k = x.shape
     n = w.shape[1]
     f32 = jnp.float32
+    if k * n * 2 > 8 * 2 ** 20:
+        # the dx kernel keeps the whole (K, N) weight resident; beyond
+        # ~8MB that cannot fit VMEM with the row tiles — use the XLA
+        # backward (ResNet's largest is 1024x2048 bf16 = 4MB)
+        return _bwd_jax(x, w, s, t, sh, y, dy, dsum, dsq,
+                        relu_in, affine_in)
+    # dW scratch + output block are (K, bn_w) f32: bound K·bn_w, not
+    # K·N; no qualifying column tile (extreme K) → XLA backward
+    bn_w = next((b for b in (2048, 1024, 512, 256, 128, 64)
+                 if n % b == 0 and k * b * 4 <= 4 * 2 ** 20), None)
+    if bn_w is None:
+        return _bwd_jax(x, w, s, t, sh, y, dy, dsum, dsq,
+                        relu_in, affine_in)
     dsum2 = dsum.astype(f32).reshape(1, n)
     dsq2 = dsq.astype(f32).reshape(1, n)
-    # block rows: bound VMEM by the fattest resident set
+    # block rows: bound VMEM by the fattest resident set, INCLUDING
+    # the (K, N) weight tile the dx kernel holds
     bm = 512
-    while bm > 128 and bm * (2 * n + k) * 2 + bm * k * 4 > 6 * 2 ** 20:
+    while bm > 128 and bm * (2 * n + k) * 2 + bm * k * 4 + \
+            k * n * 2 > 8 * 2 ** 20:
         bm //= 2
     if m % bm:
         pad = bm - m % bm
@@ -365,8 +380,6 @@ def _bwd_pallas(x, w, s, t, sh, y, dy, dsum, dsq, relu_in, affine_in,
         interpret=interpret,
     )(dy_p, y_p, x_p, w, s, t, sh, dsum2, dsq2)
 
-    bn_w = n if k * n * 4 <= 4 * 2 ** 20 else \
-        next(b for b in (1024, 512, 256, 128, 64) if n % b == 0)
     dw = pl.pallas_call(
         functools.partial(_dw_kernel, n_m=n_m, relu_in=relu_in,
                           affine_in=affine_in),

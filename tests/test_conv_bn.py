@@ -143,6 +143,36 @@ def test_pallas_backward_matches_jax_backward(m, affine, relu, rng,
                                    err_msg=f"d{name} (m={m})")
 
 
+def test_pallas_backward_bf16_padded(rng, monkeypatch):
+    # the production dtype: bf16 compute with padded rows exercises
+    # every .astype(cd) in the kernels and the pad corrections in the
+    # same rounding order the kernel accumulates
+    m, k, n = 700, 128, 256    # bm=512 → 2 tiles + 324 padded rows
+    x = jnp.asarray(rng.randn(m, k), jnp.bfloat16)
+    w = jnp.asarray(rng.randn(k, n) * 0.1, jnp.bfloat16)
+    s = jnp.asarray(rng.rand(k) + 0.5, jnp.float32)
+    t = jnp.asarray(rng.randn(k), jnp.float32)
+    sh = jnp.asarray(rng.randn(n) * 0.1, jnp.float32)
+
+    def loss(x, w, s, t):
+        y, sm, sq = matmul_bn(x, w, in_scale=s, in_shift=t,
+                              relu_in=True, stat_shift=sh)
+        return (jnp.sum(y.astype(jnp.float32) * 0.3) +
+                jnp.sum(jnp.sin(sm * 0.01)) +
+                jnp.sum(jnp.sqrt(sq * 1e-4 + 1.0)))
+
+    monkeypatch.setenv("ZOO_TPU_CONV_BN_PALLAS_BWD", "1")
+    gp = jax.grad(loss, argnums=(0, 1, 2, 3))(x, w, s, t)
+    monkeypatch.setenv("ZOO_TPU_CONV_BN_PALLAS_BWD", "0")
+    gj = jax.grad(loss, argnums=(0, 1, 2, 3))(x, w, s, t)
+    for name, a, b in zip("x w s t".split(), gp, gj):
+        a = np.asarray(a, np.float32)
+        b = np.asarray(b, np.float32)
+        tol = 2e-2 * max(float(np.abs(b).max()), 1.0)
+        np.testing.assert_allclose(a, b, rtol=2e-2, atol=tol,
+                                   err_msg=f"d{name}")
+
+
 def test_pallas_backward_dw_column_tiling(rng, monkeypatch):
     # K·N·4 > 4MB forces the dW kernel's bn_w column tiling
     m, k, n = 256, 1024, 2048
