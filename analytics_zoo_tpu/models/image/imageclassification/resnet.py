@@ -133,19 +133,19 @@ class FusedBottleneck(KerasLayer):
     whose prologue applies the previous BN+ReLU in VMEM and whose
     epilogue accumulates this BN's Σy/Σy² while writing the output —
     per fused conv the activation tensor is written once instead of
-    written + read (stats) + read/written (apply). The 3×3 stays an
-    XLA conv (its input must materialise anyway); its BN statistics
-    use the same single-pass jnp reduction as `BatchNormalization`.
+    written + read (stats) + read/written (apply). Stride-1 blocks
+    run the 3×3 through the fused `conv3x3_bn` Pallas kernel too
+    (bn1's normalized activation never exists in HBM); the strided
+    blocks' 3×3 stays an XLA conv with the single-pass jnp statistics
+    reduction (skipped in eval, when moving stats are used).
 
     Params: ``c1/c2/c3[/down]`` HWIO kernels + ``bn1/bn2/bn3[/bnd]``
     groups each ``{gamma, beta, _state:{moving_mean, moving_var}}`` —
     the per-layer content of the unfused block, so weights can be
     copied across layouts.
 
-    Eval mode: the 3×3's jnp statistics reduction is skipped (moving
-    stats are used); the matmul kernels' stats epilogue still runs but
-    costs no HBM traffic — it reduces the f32 accumulator already in
-    VMEM.
+    Eval mode: the Pallas kernels' stats epilogues still run but cost
+    no HBM traffic — they reduce the f32 accumulator already in VMEM.
     """
 
     def __init__(self, filters: int, stride: int = 1,
@@ -210,7 +210,7 @@ class FusedBottleneck(KerasLayer):
                 count)
 
     def apply(self, params, x, *, training=False, rng=None):
-        from analytics_zoo_tpu.ops.conv_bn import conv1x1_bn
+        from analytics_zoo_tpu.ops.conv_bn import conv1x1_bn, conv3x3_bn
         updates = {}
         mm = lambda bn: jax.lax.stop_gradient(
             params[bn]["_state"]["moving_mean"])
@@ -222,20 +222,30 @@ class FusedBottleneck(KerasLayer):
             params["bn1"], s1, q1, n1, training)
         if upd1:
             updates["bn1"] = upd1
-        # bn1 apply + relu materialises ONCE as the 3×3 conv's input
-        z1 = jnp.maximum(
-            y1 * scale1.astype(y1.dtype) + shift1.astype(y1.dtype), 0)
 
-        # c2: XLA 3×3 (stride lives here, v1.5), jnp single-pass stats
-        y2 = jax.lax.conv_general_dilated(
-            z1, params["c2"].astype(z1.dtype),
-            window_strides=(self.stride, self.stride),
-            padding="SAME",
-            dimension_numbers=("NHWC", "HWIO", "NHWC"))
-        if training:     # eval uses moving stats: skip the reduction
-            s2, q2, n2 = self._jnp_stats(y2, mm("bn2"))
+        if self.stride == 1:
+            # c2: fused Pallas 3×3 — bn1 apply+relu in the prologue
+            # (the normalized activation never exists in HBM), bn2
+            # stats in the epilogue
+            y2, s2, q2 = conv3x3_bn(
+                y1, params["c2"], in_scale=scale1, in_shift=shift1,
+                relu_in=True, stat_shift=mm("bn2"))
+            n2 = float(np.prod(y2.shape[:-1]))
         else:
-            s2 = q2 = n2 = None
+            # strided c2 stays an XLA conv: materialise bn1's apply
+            # once as its input, stats via the single-pass reduction
+            z1 = jnp.maximum(
+                y1 * scale1.astype(y1.dtype) +
+                shift1.astype(y1.dtype), 0)
+            y2 = jax.lax.conv_general_dilated(
+                z1, params["c2"].astype(z1.dtype),
+                window_strides=(self.stride, self.stride),
+                padding="SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            if training:  # eval uses moving stats: skip the reduction
+                s2, q2, n2 = self._jnp_stats(y2, mm("bn2"))
+            else:
+                s2 = q2 = n2 = None
         scale2, shift2, upd2 = self._bn_vectors(
             params["bn2"], s2, q2, n2, training)
         if upd2:

@@ -212,6 +212,67 @@ def test_conv1x1_bn_stride(rng):
 
 
 # ---------------------------------------------------------------------------
+# conv3x3_bn: the fused 3×3
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("scale,shift,relu", [
+    (True, True, True),     # full prologue
+    (False, False, False),  # raw conv + stats
+    (False, True, False),   # shift-only (scale defaults to ones)
+    (False, False, True),   # relu on raw x, no affine
+])
+def test_conv3x3_bn_matches_reference(scale, shift, relu, rng):
+    from analytics_zoo_tpu.ops.conv_bn import _conv3_ref, conv3x3_bn
+    b, h, w_, cin, cout = 3, 9, 9, 64, 128
+    x = jnp.asarray(rng.randn(b, h, w_, cin), jnp.float32)
+    w = jnp.asarray(rng.randn(3, 3, cin, cout) * 0.1, jnp.float32)
+    s = jnp.asarray(rng.rand(cin) + 0.5, jnp.float32) if scale else None
+    t = jnp.asarray(rng.randn(cin), jnp.float32) if shift else None
+    sh = jnp.asarray(rng.randn(cout), jnp.float32)
+    y, sm, sq = conv3x3_bn(x, w, in_scale=s, in_shift=t,
+                           relu_in=relu, stat_shift=sh)
+    ry, rsm, rsq = _conv3_ref(
+        x, w, s if scale else jnp.ones((cin,), jnp.float32),
+        t if shift else jnp.zeros((cin,), jnp.float32),
+        sh, relu, True)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ry),
+                               rtol=1e-4, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(sm), np.asarray(rsm),
+                               rtol=1e-4, atol=0.1)
+    np.testing.assert_allclose(np.asarray(sq), np.asarray(rsq),
+                               rtol=1e-4, atol=0.1)
+
+
+def test_conv3x3_bn_grads_match(rng):
+    from analytics_zoo_tpu.ops.conv_bn import _conv3_ref, conv3x3_bn
+    b, h, w_, cin, cout = 2, 6, 6, 64, 64
+    x = jnp.asarray(rng.randn(b, h, w_, cin), jnp.float32)
+    w = jnp.asarray(rng.randn(3, 3, cin, cout) * 0.1, jnp.float32)
+    s = jnp.asarray(rng.rand(cin) + 0.5, jnp.float32)
+    t = jnp.asarray(rng.randn(cin), jnp.float32)
+    sh = jnp.asarray(rng.randn(cout) * 0.1, jnp.float32)
+
+    def loss_fused(x, w, s, t):
+        y, sm, sq = conv3x3_bn(x, w, in_scale=s, in_shift=t,
+                               relu_in=True, stat_shift=sh)
+        return (jnp.sum(y.astype(jnp.float32) * 0.3) +
+                jnp.sum(jnp.sin(sm)) + jnp.sum(jnp.sqrt(sq + 1.0)))
+
+    def loss_ref(x, w, s, t):
+        y, sm, sq = _conv3_ref(x, w, s, t, sh, True, True)
+        return (jnp.sum(y.astype(jnp.float32) * 0.3) +
+                jnp.sum(jnp.sin(sm)) + jnp.sum(jnp.sqrt(sq + 1.0)))
+
+    g1 = jax.grad(loss_fused, argnums=(0, 1, 2, 3))(x, w, s, t)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(x, w, s, t)
+    for name, a, b_ in zip("x w s t".split(), g1, g2):
+        a, b_ = np.asarray(a), np.asarray(b_)
+        tol = 2e-3 * max(float(np.abs(b_).max()), 1.0)
+        np.testing.assert_allclose(a, b_, rtol=2e-3, atol=tol,
+                                   err_msg=f"d{name}")
+
+
+# ---------------------------------------------------------------------------
 # FusedBottleneck vs the unfused keras subgraph, identical weights
 # ---------------------------------------------------------------------------
 
