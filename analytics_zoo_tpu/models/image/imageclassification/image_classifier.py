@@ -9,19 +9,30 @@ from typing import Optional, Tuple
 from analytics_zoo_tpu.models.common import ZooModel
 
 
+def _fused_resnet() -> bool:
+    """ZOO_TPU_FUSED_RESNET=1 builds registry ResNets with the fused
+    Pallas conv+BN bottlenecks (`ops/conv_bn.py`) by default."""
+    import os
+    return os.environ.get("ZOO_TPU_FUSED_RESNET", "0") == "1"
+
+
+def _build_resnet(depth, s, c, fused=False):
+    from analytics_zoo_tpu.models.image.imageclassification.resnet \
+        import ResNet
+    return ResNet(depth).build(s, c, fused=fused)
+
+
 def _builders():
     """Single name→builder registry; ARCHS derives from its keys so the
-    validation tuple and the dispatch can never drift."""
+    validation tuple and the dispatch can never drift. ResNet builders
+    accept ``fused=`` (the rest are fixed-layout)."""
+    import functools
+
     from analytics_zoo_tpu.models.image.imageclassification import archs
     from analytics_zoo_tpu.models.image.imageclassification.lenet import \
         lenet5
-    from analytics_zoo_tpu.models.image.imageclassification.resnet import \
-        ResNet
-    return {
+    reg = {
         "lenet-5": lenet5,
-        "resnet-50": lambda s, c: ResNet(50).build(s, c),
-        "resnet-101": lambda s, c: ResNet(101).build(s, c),
-        "resnet-152": lambda s, c: ResNet(152).build(s, c),
         "vgg-16": archs.vgg16,
         "vgg-19": archs.vgg19,
         "inception-v1": archs.inception_v1,
@@ -30,6 +41,9 @@ def _builders():
         "densenet-121": archs.densenet121,
         "squeezenet": archs.squeezenet,
     }
+    for d in (50, 101, 152):
+        reg[f"resnet-{d}"] = functools.partial(_build_resnet, d)
+    return reg
 
 
 class ImageClassifier(ZooModel):
@@ -48,7 +62,13 @@ class ImageClassifier(ZooModel):
 
     def __init__(self, model_name: str = "resnet-50",
                  input_shape: Tuple[int, int, int] = (224, 224, 3),
-                 classes: int = 1000):
+                 classes: int = 1000,
+                 fused: Optional[bool] = None):
+        """``fused``: ResNets only — build with the fused Pallas
+        conv+BN bottlenecks. None resolves the ``ZOO_TPU_FUSED_RESNET``
+        env default AT CONSTRUCTION and the resolved value persists in
+        ``hyper_parameters`` (a checkpoint reloads the architecture it
+        was saved with, regardless of the loading process's env)."""
         super().__init__()
         name = model_name.lower()
         if name not in _builders():
@@ -57,14 +77,24 @@ class ImageClassifier(ZooModel):
         self.model_name = name
         self.input_shape = tuple(input_shape)
         self.classes = int(classes)
+        if fused is None:
+            fused = name.startswith("resnet-") and _fused_resnet()
+        self.fused = bool(fused)
+        if self.fused and not name.startswith("resnet-"):
+            raise ValueError(f"fused=True is ResNet-only, not {name}")
 
     def hyper_parameters(self):
         return {"model_name": self.model_name,
                 "input_shape": self.input_shape,
-                "classes": self.classes}
+                "classes": self.classes,
+                "fused": self.fused}
 
     def build_model(self):
-        return _builders()[self.model_name](self.input_shape, self.classes)
+        builder = _builders()[self.model_name]
+        if self.model_name.startswith("resnet-"):
+            return builder(self.input_shape, self.classes,
+                           fused=self.fused)
+        return builder(self.input_shape, self.classes)
 
     @classmethod
     def load_model(cls, path_or_name: str, weights_path=None,

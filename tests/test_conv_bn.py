@@ -413,6 +413,41 @@ def test_fused_block_dp_sharded_batch_matches_single(rng):
                                atol=1e-5)
 
 
+def test_registry_resnet_fused_env(monkeypatch, tmp_path):
+    # ZOO_TPU_FUSED_RESNET=1 routes the ImageClassifier registry
+    # builders through FusedBottleneck, and the resolved choice
+    # persists through save_model/load_model regardless of the
+    # loading process's env
+    from analytics_zoo_tpu.models.image.imageclassification import \
+        ImageClassifier
+    from analytics_zoo_tpu.models.image.imageclassification.resnet \
+        import FusedBottleneck
+
+    def is_fused(m):
+        return any(isinstance(l, FusedBottleneck)
+                   for l in m.model.layers)
+
+    monkeypatch.setenv("ZOO_TPU_FUSED_RESNET", "1")
+    m = ImageClassifier("resnet-50", input_shape=(32, 32, 3),
+                        classes=10)
+    assert is_fused(m) and m.fused
+    monkeypatch.delenv("ZOO_TPU_FUSED_RESNET")
+    assert not is_fused(ImageClassifier("resnet-50",
+                                        input_shape=(32, 32, 3),
+                                        classes=10))
+    # explicit arg beats env; identity survives the checkpoint
+    m3 = ImageClassifier("resnet-50", input_shape=(32, 32, 3),
+                         classes=10, fused=True)
+    m3.compile()
+    m3.model.estimator._ensure_initialized()
+    path = str(tmp_path / "fused.model")
+    m3.save_model(path)
+    loaded = ImageClassifier.load_model(path)
+    assert loaded.fused and is_fused(loaded)
+    with pytest.raises(ValueError):
+        ImageClassifier("vgg-16", fused=True)
+
+
 def test_fused_resnet50_builds_and_trains(rng):
     from analytics_zoo_tpu import init_nncontext
     from analytics_zoo_tpu.models.image.imageclassification import \
