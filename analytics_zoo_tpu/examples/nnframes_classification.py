@@ -25,7 +25,9 @@ def main(argv=None):
     init_nncontext()
     rng = np.random.RandomState(0)
     feats = rng.randn(args.samples, 6).astype(np.float32)
-    labels = (feats.sum(axis=1) > 0).astype(np.int64) + 1  # 1-based
+    # 0-based class ids — the TPU losses and argmax predictions are
+    # 0-based (divergence from BigDL's 1-based ClassNLL convention)
+    labels = (feats.sum(axis=1) > 0).astype(np.int64)
     df = pd.DataFrame({"features": list(feats), "label": labels})
 
     net = Sequential()

@@ -199,6 +199,14 @@ class NNEstimator(_Params):
         fs = self._df_to_feature_set(df)
         est = Estimator(self.model, optimizer=self._build_optimizer(),
                         loss=self.criterion, metrics=self.metrics)
+        # a model that already carries weights (pretrained backbone
+        # loaded via compile+load_weights, prior fit, ...) trains FROM
+        # them — re-initializing would silently discard the transfer-
+        # learning starting point (reference trains the model it was
+        # given, NNEstimator.scala:415)
+        prior = getattr(self.model, "_estimator", None)
+        if prior is not None and prior.params is not None:
+            est.params = prior.params
         if self.clip_l2 is not None:
             est.set_gradient_clipping_by_l2_norm(self.clip_l2)
         if self.clip_const is not None:

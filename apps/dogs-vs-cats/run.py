@@ -1,0 +1,113 @@
+"""Dogs-vs-cats transfer-learning app (reference `apps/dogs-vs-cats`,
+BASELINE config #2): see README.md alongside this file."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import tempfile
+
+import numpy as np
+import pandas as pd
+
+
+def synth_folder(root: str, per_class: int, size: int, rng) -> None:
+    """cats/dogs-shaped folder: brightness-biased classes so a frozen
+    random backbone + linear head can still learn offline."""
+    from PIL import Image
+    for cls, lo, hi in (("cat", 0, 128), ("dog", 128, 255)):
+        os.makedirs(os.path.join(root, cls), exist_ok=True)
+        for i in range(per_class):
+            img = rng.randint(lo, hi, (size, size, 3)).astype(np.uint8)
+            Image.fromarray(img).save(
+                os.path.join(root, cls, f"{i}.png"))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--folder", default=None,
+                   help="cat/... dog/... image folder (local or "
+                        "fsspec scheme); omit for synthetic data")
+    p.add_argument("--arch", default="lenet-5",
+                   help="backbone architecture. The reference app "
+                        "uses inception-v1 WITH pretrained weights "
+                        "(--weights); without weights a deep "
+                        "backbone's random features vanish (or its "
+                        "BatchNorm train/eval stats mismatch), so "
+                        "the offline demo defaults to the shallow "
+                        "BN-free lenet-5")
+    p.add_argument("--weights", default=None,
+                   help="backbone weights (.npz) for real transfer "
+                        "learning")
+    p.add_argument("--image-size", type=int, default=28)
+    p.add_argument("--per-class", type=int, default=32)
+    p.add_argument("--epochs", type=int, default=20)
+    p.add_argument("--batch-size", type=int, default=32)
+    args = p.parse_args(argv)
+
+    from analytics_zoo_tpu import init_nncontext
+    from analytics_zoo_tpu.feature.common import SeqToTensor
+    from analytics_zoo_tpu.feature.image import ImageSet
+    from analytics_zoo_tpu.models.image.imageclassification import \
+        ImageClassifier
+    from analytics_zoo_tpu.ops.optimizers import Adam
+    from analytics_zoo_tpu.pipeline.nnframes import NNClassifier
+
+    init_nncontext()
+    rng = np.random.RandomState(0)
+    folder = args.folder
+    if folder is None:
+        folder = tempfile.mkdtemp(prefix="dogs_cats_")
+        synth_folder(folder, args.per_class, args.image_size, rng)
+
+    # 1. images + labels from the class-dir layout
+    iset = ImageSet.read(folder, with_label_from_dirs=True)
+    size = args.image_size
+    channels = 1 if args.arch == "lenet-5" else 3
+    feats, labels = [], []
+    for f in iset.features:
+        from PIL import Image
+        arr = np.asarray(Image.fromarray(f.image).resize((size, size)),
+                         np.float32) / 255.0
+        if channels == 1:
+            arr = arr.mean(axis=-1, keepdims=True)
+        feats.append(arr)
+        # 0-based class ids: the TPU losses/argmax are 0-based
+        # (divergence from BigDL's 1-based ClassNLL convention)
+        labels.append(float(f.label[0]))
+    df = pd.DataFrame({"features": feats, "label": labels})
+
+    # 2. backbone + freeze (the reference's freezeUpTo): everything
+    # but the classification head stays fixed
+    backbone = ImageClassifier(args.arch,
+                               input_shape=(size, size, channels),
+                               classes=2)
+    backbone.compile()            # builds params so weights can load
+    if args.weights:
+        backbone.load_weights(args.weights)
+    net = backbone.model
+    net.freeze(*[l.name for l in net.layers[:-1]])
+    n_frozen = sum(1 for l in net.layers if not l.trainable)
+    print(f"backbone {args.arch}: {len(net.layers)} layers, "
+          f"{n_frozen} frozen, head trains")
+
+    # 3. Spark-ML-style training + scoring. The loss must match the
+    # head: lenet-5 ends in softmax (probability-space loss), the
+    # other registry backbones end in raw logits (softmax CE) — the
+    # wrong pairing clips/squashes gradients and learns nothing
+    loss = ("sparse_categorical_crossentropy"
+            if args.arch == "lenet-5" else "softmax_cross_entropy")
+    clf = (NNClassifier(net, loss,
+                        SeqToTensor((size, size, channels)))
+           .set_batch_size(args.batch_size)
+           .set_max_epoch(args.epochs)
+           .set_optim_method(Adam(lr=1e-2)))
+    model = clf.fit(df)
+    out = model.transform(df)
+    acc = float((out["prediction"] == out["label"]).mean())
+    print(f"train accuracy: {acc:.3f} over {len(df)} images")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

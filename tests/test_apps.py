@@ -36,3 +36,8 @@ def test_app_recommendation_ncf():
 
 def test_app_web_service():
     _run("web-service-sample", ["--requests", "4", "--concurrency", "2"])
+
+
+def test_app_dogs_vs_cats():
+    _run("dogs-vs-cats",
+         ["--per-class", "16", "--epochs", "10", "--batch-size", "16"])

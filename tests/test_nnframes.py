@@ -153,6 +153,40 @@ def test_nnimage_reader_fsspec_scheme():
         fs.rm("/nnimg", recursive=True)
 
 
+def test_nnestimator_trains_from_existing_weights(rng):
+    # a model carrying weights (pretrained backbone, prior fit) must
+    # train FROM them, not silently re-initialize — the transfer-
+    # learning contract (reference NNEstimator.scala:415)
+    import jax
+
+    net = Sequential()
+    net.add(L.Dense(8, input_shape=(4,), activation="relu",
+                    name="backbone"))
+    net.add(L.Dense(2, name="head"))
+    net.compile("adam", "softmax_cross_entropy")
+    net.estimator._ensure_initialized()
+    # distinctive backbone weights, then freeze the backbone
+    marked = jax.tree_util.tree_map(
+        lambda a: a * 0 + 0.125, net.estimator.params["backbone"])
+    net.estimator.params = dict(net.estimator.params,
+                                backbone=marked)
+    net.freeze("backbone")
+
+    df = pd.DataFrame({
+        "features": [rng.randn(4).astype(np.float32)
+                     for _ in range(16)],
+        "label": [float(i % 2) for i in range(16)]})
+    clf = (NNClassifier(net, "softmax_cross_entropy",
+                        SeqToTensor((4,)))
+           .set_batch_size(8).set_max_epoch(1))
+    model = clf.fit(df)
+    after = jax.device_get(model.estimator.params)["backbone"]
+    for leaf in jax.tree_util.tree_leaves(after):
+        np.testing.assert_allclose(np.asarray(leaf), 0.125,
+                                   err_msg="frozen pretrained "
+                                           "backbone was discarded")
+
+
 def test_nnframes_image_pipeline_end_to_end(tmp_path):
     """The dogs-vs-cats transfer-learning shape (BASELINE config #2) at
     toy scale: images → DataFrame → NNClassifier."""
