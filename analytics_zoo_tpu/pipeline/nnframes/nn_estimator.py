@@ -203,10 +203,21 @@ class NNEstimator(_Params):
         # loaded via compile+load_weights, prior fit, ...) trains FROM
         # them — re-initializing would silently discard the transfer-
         # learning starting point (reference trains the model it was
-        # given, NNEstimator.scala:415)
+        # given, NNEstimator.scala:415). _place_params COPIES onto the
+        # mesh: the jitted step donates its params, and sharing
+        # buffers with the model's own estimator would invalidate them
         prior = getattr(self.model, "_estimator", None)
         if prior is not None and prior.params is not None:
-            est.params = prior.params
+            from analytics_zoo_tpu.pipeline.estimator import \
+                _check_params_compatible
+            try:
+                _check_params_compatible(self.model, prior.params)
+                est.params = est._place_params(prior.params)
+            except (KeyError, ValueError):
+                from analytics_zoo_tpu.common.nncontext import logger
+                logger.warning(
+                    "NNEstimator.fit: existing params no longer match "
+                    "the model topology; re-initializing")
         if self.clip_l2 is not None:
             est.set_gradient_clipping_by_l2_norm(self.clip_l2)
         if self.clip_const is not None:
@@ -222,6 +233,13 @@ class NNEstimator(_Params):
         est.train(fs, batch_size=self.batch_size,
                   nb_epoch=self.max_epoch, validation_data=val,
                   validation_trigger=self.validation_trigger)
+        if prior is not None:
+            # reference semantics: fit mutates the given model — a
+            # second fit (or model.predict) continues from the trained
+            # weights, not the pre-fit ones
+            prior.params = est.params
+            prior.opt_state = None        # moments belong to est
+            prior._train_step = None
         return self._wrap_model(est)
 
     def _wrap_model(self, est: Estimator) -> "NNModel":

@@ -66,7 +66,8 @@ class _TFEstimatorNet:
     (inputs = [features..., labels]); inference forward returns
     predictions (inputs = [features...])."""
 
-    def __init__(self, loss_fn, pred_fn, weights, pred_perm):
+    def __init__(self, loss_fn, pred_fn, weights, pred_perm,
+                 update_spec=None):
         from analytics_zoo_tpu.tfpark.tf_graph import split_float_weights
         self._loss_fn = loss_fn
         self._pred_fn = pred_fn
@@ -75,6 +76,9 @@ class _TFEstimatorNet:
         self._float_values = [np.asarray(weights[i])
                               for i in self._float_idx]
         self._pred_perm = pred_perm
+        # BN moving stats etc.: extra train_fn outputs → float index
+        self._update_spec = [(self._float_idx.index(vi), kind)
+                             for vi, kind in (update_spec or [])]
         self.name = "tf_estimator_net"
         self.layers: list = []
 
@@ -90,10 +94,15 @@ class _TFEstimatorNet:
                                 self._n)
 
     def apply(self, params, x, *, training=False, rng=None):
+        from analytics_zoo_tpu.tfpark.tf_graph import fold_weight_updates
         xs = list(x) if isinstance(x, (list, tuple)) else [x]
         full = self._assemble(params["weights"])
         if training:
-            return self._loss_fn(*full, *xs, rng=rng), {}
+            loss, upd_vals = self._loss_fn(*full, *xs, rng=rng)
+            if not self._update_spec:
+                return loss, {}
+            return loss, {"weights": fold_weight_updates(
+                self._update_spec, params["weights"], upd_vals)}
         if self._pred_fn is None:
             raise RuntimeError("model_fn returned no predictions")
         wp = [full[i] for i in self._pred_perm]
@@ -169,8 +178,9 @@ class TFEstimator:
 
         sig = fspec + ([lspec] if lspec is not None else [])
         with tf.variable_creator_scope(self._store.creator):
-            loss_fn, train_vars = to_jax_fn(
-                train_trace, sig, variables=self._store.variables)
+            loss_fn, train_vars, update_spec = to_jax_fn(
+                train_trace, sig, variables=self._store.variables,
+                with_updates=True)
 
         def pred_trace(*args):
             spec = self.model_fn(
@@ -233,14 +243,15 @@ class TFEstimator:
                     "TFEstimator: no eval-mode graph (%s); evaluate() "
                     "will use the training graph", e)
 
+        self._train_vars = train_vars   # introspection/assign-back
         self._net = _TFEstimatorNet(
-            loss_fn, pred_fn, [v.numpy() for v in train_vars], perm)
+            loss_fn, pred_fn, [v.numpy() for v in train_vars], perm,
+            update_spec=update_spec)
         from analytics_zoo_tpu.pipeline.estimator import Estimator
         import jax.numpy as jnp
         self._estimator = Estimator(
             self._net, optimizer=self.optimizer,
             loss=lambda y_true, y_pred: jnp.mean(y_pred))
-        self._train_vars = train_vars
         if self.model_dir:
             self._estimator.set_checkpoint(self.model_dir)
 

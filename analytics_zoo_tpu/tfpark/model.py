@@ -77,28 +77,15 @@ class _TFKerasNet:
                                 self._n)
 
     def apply(self, params, x, *, training=False, rng=None):
-        import jax
+        from analytics_zoo_tpu.tfpark.tf_graph import fold_weight_updates
         xs = list(x) if isinstance(x, (list, tuple)) else [x]
         full = self._assemble(params["weights"])
         if training:
             out, upd_vals = self._train_fn(*full, *xs, rng=rng)
             if not self._update_spec:
                 return out, {}
-            # fold Assign{,Add,Sub} values into a sparse weight-list
-            # update (None = unchanged); grads must not flow into the
-            # moving statistics. Sequential assigns to one variable
-            # compose in graph order (`cur` tracks the running value).
-            new_ws: List = [None] * len(self._float_idx)
-            for (fi, kind), val in zip(self._update_spec, upd_vals):
-                cur = new_ws[fi] if new_ws[fi] is not None \
-                    else params["weights"][fi]
-                val = jax.lax.stop_gradient(val).astype(cur.dtype)
-                if kind == "add":
-                    val = cur + val
-                elif kind == "sub":
-                    val = cur - val
-                new_ws[fi] = val
-            return out, {"weights": new_ws}
+            return out, {"weights": fold_weight_updates(
+                self._update_spec, params["weights"], upd_vals)}
         wi = [full[i] for i in self._infer_perm]
         return self._infer_fn(*wi, *xs), {}
 

@@ -445,6 +445,25 @@ def assemble_weights(float_ws: Sequence, float_idx: Sequence[int],
     return full
 
 
+def fold_weight_updates(spec, weights, upd_vals):
+    """Captured Assign{,Add,Sub} values → a sparse float-weight-list
+    update (None = unchanged), stop-gradded, with sequential assigns
+    to one variable composing in graph order. ``spec``:
+    ``[(float_index, kind)]`` aligned with ``upd_vals`` (the single
+    copy of the fold used by tfpark KerasModel and TFEstimator)."""
+    import jax
+    new_ws: list = [None] * len(weights)
+    for (fi, kind), val in zip(spec, upd_vals):
+        cur = new_ws[fi] if new_ws[fi] is not None else weights[fi]
+        val = jax.lax.stop_gradient(val).astype(cur.dtype)
+        if kind == "add":
+            val = cur + val
+        elif kind == "sub":
+            val = cur - val
+        new_ws[fi] = val
+    return new_ws
+
+
 def keras_optimizer_to_zoo(optimizer):
     """tf.keras optimizer → zoo optimizer (reference analog:
     `to_bigdl_optim_method`, `net.py:592-688`)."""

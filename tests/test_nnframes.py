@@ -185,6 +185,13 @@ def test_nnestimator_trains_from_existing_weights(rng):
         np.testing.assert_allclose(np.asarray(leaf), 0.125,
                                    err_msg="frozen pretrained "
                                            "backbone was discarded")
+    # fit wrote the trained weights back into the model (reference
+    # semantics: a refit continues, model.predict sees the training)
+    head_model = jax.device_get(net.estimator.params)["head"]
+    head_fit = jax.device_get(model.estimator.params)["head"]
+    for a, b in zip(jax.tree_util.tree_leaves(head_model),
+                    jax.tree_util.tree_leaves(head_fit)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
 def test_nnframes_image_pipeline_end_to_end(tmp_path):

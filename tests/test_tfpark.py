@@ -286,6 +286,45 @@ def test_keras_model_embedding_resource_gather(rng):
     km.fit(x, y, batch_size=8, epochs=2)  # embedding weights trainable
 
 
+def test_tf_estimator_batchnorm_moving_stats_update(rng):
+    # the estimator path folds BN moving-average updates back too
+    # (parity with KerasModel — TFTrainingHelper.scala:83-136)
+    from analytics_zoo_tpu import init_nncontext
+    from analytics_zoo_tpu.pipeline.api.net import TFDataset
+    from analytics_zoo_tpu.tfpark import TFEstimator, TFEstimatorSpec
+    init_nncontext(tpu_mesh={"data": 1}, devices=jax.devices("cpu")[:1])
+
+    def model_fn(features, labels, mode):
+        bn = tf.keras.layers.BatchNormalization(momentum=0.9,
+                                                name="bn")
+        dense = tf.keras.layers.Dense(1, name="out")
+        h = bn(features, training=(mode == "train"))
+        pred = dense(h)
+        if mode in ("train", "eval"):
+            loss = tf.reduce_mean((pred - labels) ** 2)
+            return TFEstimatorSpec(mode, predictions=pred, loss=loss)
+        return TFEstimatorSpec(mode, predictions=pred)
+
+    x = (rng.randn(64, 4) * 2 + 3).astype(np.float32)
+    y = rng.randn(64, 1).astype(np.float32)
+    est = TFEstimator(model_fn, optimizer="adam")
+
+    def input_fn():
+        return TFDataset.from_ndarrays(x, y, batch_size=32)
+
+    est.train(input_fn, nb_epoch=2)
+    # the trained weight state carries UPDATED moving statistics
+    floats = [np.asarray(w) for w in
+              jax.device_get(est._estimator.params)["weights"]]
+    weights = est._net._assemble(floats)
+    by_name = {v.name: np.asarray(weights[i])
+               for i, v in enumerate(est._train_vars)}
+    mm = next(v for k, v in by_name.items() if "moving_mean" in k)
+    mv = next(v for k, v in by_name.items() if "moving_variance" in k)
+    assert not np.allclose(mm, 0.0), "moving_mean did not update"
+    assert not np.allclose(mv, 1.0), "moving_variance did not update"
+
+
 def test_keras_optimizer_schedule_freezes_lr():
     from analytics_zoo_tpu.tfpark.tf_graph import keras_optimizer_to_zoo
     sched = tf.keras.optimizers.schedules.ExponentialDecay(0.01, 100,
