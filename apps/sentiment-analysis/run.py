@@ -1,0 +1,118 @@
+"""Sentiment-analysis app (reference `apps/sentiment-analysis`): see
+README.md alongside this file."""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--csv", default=None,
+                   help="CSV with text,label columns — runs the raw "
+                        "TextSet pipeline on your data")
+    p.add_argument("--imdb", action="store_true",
+                   help="use the keras.datasets.imdb loader (real "
+                        "reviews when ~/.zoo/dataset/imdb_full.pkl "
+                        "is present; its offline stand-in has RANDOM "
+                        "labels, so accuracy stays ~0.5 by design)")
+    p.add_argument("--encoder", default="cnn",
+                   choices=["cnn", "lstm", "gru"])
+    p.add_argument("--sequence-length", type=int, default=64)
+    p.add_argument("--token-length", type=int, default=32)
+    p.add_argument("--nb-words", type=int, default=4000)
+    p.add_argument("--samples", type=int, default=512)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--epochs", type=int, default=4)
+    args = p.parse_args(argv)
+
+    from analytics_zoo_tpu import init_nncontext
+    from analytics_zoo_tpu.models.textclassification import TextClassifier
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Embedding
+
+    init_nncontext()
+    seq = args.sequence_length
+
+    from analytics_zoo_tpu.feature.text import TextSet
+
+    def pipeline(texts, labels):
+        ts = TextSet.from_texts(texts, labels)
+        ts = (ts.tokenize().word2idx()
+              .shape_sequence(seq).generate_sample())
+        x, y = ts.to_arrays()
+        return x, y, int(x.max()) + 1
+
+    if args.csv:
+        import io
+
+        import pandas as pd
+
+        from analytics_zoo_tpu.common.utils import read_bytes
+        df = pd.read_csv(io.BytesIO(read_bytes(args.csv)))
+        # label-sorted exports are common: shuffle before the split;
+        # string labels ("pos"/"neg") map to 0-based ids
+        df = df.sample(frac=1, random_state=0).reset_index(drop=True)
+        labels = df["label"]
+        if not np.issubdtype(np.asarray(labels).dtype, np.number):
+            codes, classes = pd.factorize(labels)
+            print("label mapping:",
+                  {c: i for i, c in enumerate(classes)})
+            labels = codes
+        x, y, vocab = pipeline(list(df["text"]),
+                               [int(v) for v in labels])
+    elif args.imdb:
+        from analytics_zoo_tpu.pipeline.api.keras.datasets import imdb
+        (xs, ys), _ = imdb.load_data(nb_words=args.nb_words)
+        xs, ys = xs[:args.samples], ys[:args.samples]
+        x = np.zeros((len(xs), seq), np.int32)
+        for i, s in enumerate(xs):                 # pad/truncate
+            s = list(s)[:seq]
+            x[i, :len(s)] = s
+        y = np.asarray(ys, np.int32).reshape(-1, 1)
+        vocab = args.nb_words
+    else:
+        # offline demo: review-shaped synthetic corpus with real
+        # sentiment signal, through the FULL TextSet pipeline
+        rng = np.random.RandomState(0)
+        pos = ("great wonderful loved brilliant superb charming "
+               "delightful masterpiece moving excellent").split()
+        neg = ("awful boring terrible dull waste disappointing "
+               "mess lifeless tedious poor").split()
+        filler = ("movie film plot actor scene story the a was and "
+                  "it of with director ending music").split()
+        texts, labels = [], []
+        for i in range(args.samples):
+            lbl = i % 2
+            strong = pos if lbl else neg
+            n = rng.randint(10, seq)
+            words = [(rng.choice(strong) if rng.rand() < 0.3
+                      else rng.choice(filler)) for _ in range(n)]
+            texts.append(" ".join(words))
+            labels.append(lbl)
+        order = rng.permutation(len(texts))
+        x, y, vocab = pipeline([texts[i] for i in order],
+                               [labels[i] for i in order])
+
+    split = int(len(x) * 0.8)
+    clf = TextClassifier(
+        class_num=int(y.max()) + 1,
+        token_length=args.token_length, sequence_length=seq,
+        encoder=args.encoder, encoder_output_dim=64,
+        embedding=Embedding(vocab, args.token_length,
+                            input_shape=(seq,)))
+    # probability-space loss: TextClassifier ends in softmax
+    clf.compile(optimizer="adam",
+                loss="sparse_categorical_crossentropy",
+                metrics=["accuracy"])
+    clf.fit(x[:split], y[:split], batch_size=args.batch_size,
+            nb_epoch=args.epochs)
+    metrics = clf.evaluate(x[split:], y[split:],
+                           batch_size=args.batch_size)
+    print("test:", {k: round(float(v), 4) for k, v in metrics.items()})
+    return metrics
+
+
+if __name__ == "__main__":
+    main()

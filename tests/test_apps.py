@@ -41,3 +41,8 @@ def test_app_web_service():
 def test_app_dogs_vs_cats():
     _run("dogs-vs-cats",
          ["--per-class", "16", "--epochs", "10", "--batch-size", "16"])
+
+
+def test_app_sentiment_analysis():
+    _run("sentiment-analysis",
+         ["--samples", "128", "--epochs", "2", "--batch-size", "32"])
