@@ -10,6 +10,7 @@ layout (divergence from BigDL's CHW float means no transpose on device).
 from __future__ import annotations
 
 import io
+import logging
 import os
 from typing import Any, Callable, Iterable, Iterator, List, Optional
 
@@ -18,6 +19,8 @@ import numpy as np
 from analytics_zoo_tpu.common import utils as zutils
 from analytics_zoo_tpu.feature.common import Preprocessing, Sample
 from analytics_zoo_tpu.feature.feature_set import FeatureSet
+
+logger = logging.getLogger(__name__)
 
 
 class ImageFeature(dict):
@@ -69,6 +72,24 @@ def _decode_bytes(data: bytes) -> np.ndarray:
         return np.asarray(im.convert("RGB"), np.uint8)
 
 
+def _decode_many(blobs, keyed) -> "list":
+    """Decode `(key, extra)` pairs via ``blobs[key]``; undecodable
+    files are skipped with ONE summary warning (reference: Spark's
+    input machinery logs bad records rather than failing the job or
+    silently shrinking the dataset)."""
+    out, dropped = [], []
+    for key, extra in keyed:
+        try:
+            out.append((key, extra, _decode_bytes(blobs[key])))
+        except Exception:
+            dropped.append(key)
+    if dropped:
+        logger.warning(
+            "ImageSet.read: skipped %d of %d file(s) that failed to "
+            "decode (first: %s)", len(dropped), len(keyed), dropped[0])
+    return out
+
+
 class ImageSet:
     """Collection of ImageFeatures with a lazy transform pipeline.
 
@@ -98,16 +119,17 @@ class ImageSet:
                         break
                 blobs = zutils.read_bytes_many([f for f, _ in labelled])
                 return ImageSet([
-                    ImageFeature(_decode_bytes(blobs[f]),
-                                 label=np.asarray([lbl], np.int32),
+                    ImageFeature(img, label=np.asarray([lbl], np.int32),
                                  uri=f)
-                    for f, lbl in labelled])
+                    for f, lbl, img in _decode_many(blobs, labelled)])
         files = zutils.list_files(path)
         if max_images:
             files = files[:max_images]
         blobs = zutils.read_bytes_many(files)
-        return ImageSet([ImageFeature(_decode_bytes(blobs[f]), uri=f)
-                         for f in files])
+        return ImageSet([
+            ImageFeature(img, uri=f)
+            for f, _, img in _decode_many(blobs,
+                                          [(f, None) for f in files])])
 
     @staticmethod
     def from_arrays(images: np.ndarray,

@@ -45,18 +45,19 @@ from jax.experimental.pallas import tpu as pltpu
 invocations = 0
 
 
-def _pick_blocks(m: int, k: int, n: int) -> Tuple[int, int]:
+def _pick_blocks(m: int, k: int, n: int, itemsize: int = 2
+                 ) -> Tuple[int, int]:
     """(block_m, block_k); N is never tiled (ResNet channel counts are
     ≤2048 and 128-multiples, so the whole (bm, N) f32 accumulator and
     the (bk, N) weight tile fit VMEM comfortably)."""
     # any admitted k is a 64-multiple, so 64 terminates the search
     bk = next(b for b in (512, 384, 256, 128, 64) if k % b == 0) \
         if k > 512 else k
-    # VMEM budget ~ acc(bm·n·4) + x(bm·bk·2) + w(bk·n·2): keep ≲6MB
-    # (leaves headroom for Pallas double-buffering in 16MB VMEM)
+    # VMEM budget ~ acc(bm·n·4) + x(bm·bk·isz) + w(bk·n·isz): keep
+    # ≲6MB (leaves headroom for Pallas double-buffering in 16MB VMEM)
     bm = 512
     while bm > 128 and \
-            bm * n * 4 + bm * bk * 2 + bk * n * 2 > 6 * 2 ** 20:
+            bm * n * 4 + (bm * bk + bk * n) * itemsize > 6 * 2 ** 20:
         bm //= 2
     return max(bm, 128), bk
 
@@ -108,7 +109,9 @@ def _matmul_bn_fwd_pallas(x, w, s, t, sh, relu_in, affine_in,
                           interpret):
     m, k = x.shape
     n = w.shape[1]
-    bm, bk = _pick_blocks(m, k, n)
+    bm, bk = _pick_blocks(
+        m, k, n, max(jnp.dtype(x.dtype).itemsize,
+                     jnp.dtype(w.dtype).itemsize))
     if m % bm:                       # pad rows to a block multiple
         pad = bm - m % bm
         x = jnp.pad(x, ((0, pad), (0, 0)))
@@ -312,7 +315,9 @@ def _bwd_pallas(x, w, s, t, sh, y, dy, dsum, dsq, relu_in, affine_in,
     m, k = x.shape
     n = w.shape[1]
     f32 = jnp.float32
-    if k * n * 2 > 8 * 2 ** 20:
+    x_isz = jnp.dtype(x.dtype).itemsize
+    w_isz = jnp.dtype(w.dtype).itemsize
+    if k * n * w_isz >= 8 * 2 ** 20:
         # the dx kernel keeps the whole (K, N) weight resident; beyond
         # ~8MB that cannot fit VMEM with the row tiles — use the XLA
         # backward (ResNet's largest is 1024x2048 bf16 = 4MB)
@@ -329,10 +334,17 @@ def _bwd_pallas(x, w, s, t, sh, y, dy, dsum, dsq, relu_in, affine_in,
     dsq2 = dsq.astype(f32).reshape(1, n)
     # block rows: bound VMEM by the fattest resident set, INCLUDING
     # the (K, N) weight tile the dx kernel holds
+    def _resident(bm):
+        return bm * 2 * n * x_isz + bm * k * x_isz + \
+            bm * k * 4 + k * n * w_isz
     bm = 512
-    while bm > 128 and bm * (2 * n + k) * 2 + bm * k * 4 + \
-            k * n * 2 > 8 * 2 ** 20:
+    while bm > 128 and _resident(bm) > 8 * 2 ** 20:
         bm //= 2
+    if _resident(bm) > 8 * 2 ** 20:
+        # even the smallest row tile busts VMEM (f32 at large K·N):
+        # fall back rather than fail Mosaic allocation on chip
+        return _bwd_jax(x, w, s, t, sh, y, dy, dsum, dsq,
+                        relu_in, affine_in)
     if m % bm:
         pad = bm - m % bm
         # zero-padded rows: g_pad = dsum (nonzero!) but relu'/affine

@@ -9,18 +9,83 @@ seeded synthetic stand-in with the dataset's 46 topic classes.
 
 from __future__ import annotations
 
+import io
 import os
 import pickle
+import zipfile
 
 import numpy as np
 
-from analytics_zoo_tpu.common.safe_pickle import CheckedUnpickler
+from analytics_zoo_tpu.common.safe_pickle import (
+    CheckedUnpickler, UnsafePickleError)
 from analytics_zoo_tpu.pipeline.api.keras.datasets._base import (
     DEFAULT_DIR, apply_nb_words, cache_path, synthetic_notice,
     synthetic_sequences)
 
 _VOCAB = 30980
 _CLASSES = 46
+
+
+def _load_legacy_npz(path):
+    """One-time migration of a legacy object-array ``reuters.npz``
+    (the format this repo wrote before the flat+offsets scheme).
+
+    `np.load(allow_pickle=True)` would run unrestricted pickle; an
+    object-dtype ``.npy`` member is just a header followed by a pickle
+    stream, so the stream is fed through `CheckedUnpickler` instead —
+    same whitelist as every other cache this repo reads. Returns
+    ``(xs, ys)`` or None if the file is not a legacy cache."""
+    from numpy.lib import format as npy_format
+
+    def member(zf, name):
+        with zf.open(name) as f:
+            version = npy_format.read_magic(f)
+            read_header = {          # public per-version readers only
+                (1, 0): npy_format.read_array_header_1_0,
+                (2, 0): npy_format.read_array_header_2_0,
+            }.get(version)
+            if read_header is None:
+                raise ValueError(f"unsupported npy version {version}")
+            _, _, dtype = read_header(f)
+            if dtype.hasobject:
+                return CheckedUnpickler(f).load()
+            f2 = io.BytesIO(zf.read(name))
+            return np.lib.format.read_array(f2, allow_pickle=False)
+
+    try:
+        with zipfile.ZipFile(path) as zf:
+            names = set(zf.namelist())
+            if not {"x.npy", "y.npy"} <= names:
+                return None
+            xs = [list(map(int, seq)) for seq in member(zf, "x.npy")]
+            ys = [int(v) for v in np.asarray(member(zf, "y.npy"))]
+            return xs, ys
+    except UnsafePickleError:
+        # a security rejection must be distinguishable from a merely
+        # stale cache — surface it, don't fold into the format warning
+        from analytics_zoo_tpu.common.nncontext import logger
+        logger.error(
+            "datasets.reuters: legacy cache %s contains a pickle "
+            "payload outside the deserialization whitelist — "
+            "REFUSING to load it (tampered or foreign file?)", path)
+        return None
+    except (zipfile.BadZipFile, KeyError, ValueError, TypeError,
+            OSError):
+        return None
+
+
+def _save_flat_npz(path, xs, ys):
+    off = np.cumsum([0] + [len(s) for s in xs])
+    flat = np.concatenate([np.asarray(s, np.int64) for s in xs]) \
+        if off[-1] else np.zeros((0,), np.int64)
+    tmp = path + ".tmp.npz"  # .npz suffix stops np.savez renaming it
+    try:                     # atomic replace: a crash mid-write must
+        np.savez(tmp, x_flat=flat, x_off=off,   # not leave a
+                 y=np.asarray(ys, np.int64))    # truncated cache
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
 
 
 def load_data(dest_dir=DEFAULT_DIR, nb_words=None, oov_char=2,
@@ -41,17 +106,28 @@ def load_data(dest_dir=DEFAULT_DIR, nb_words=None, oov_char=2,
                 xs = [list(flat[off[i]:off[i + 1]])
                       for i in range(len(off) - 1)]
                 ys = list(f["y"])
-        except (KeyError, ValueError):
-            bad_npz = True
+        except (KeyError, ValueError, OSError,
+                zipfile.BadZipFile):  # truncated/foreign file →
+            bad_npz = True            # legacy probe, then synthetic
             xs = None
     if bad_npz:
         from analytics_zoo_tpu.common.nncontext import logger
-        logger.warning(
-            "datasets.reuters: cache %s is not in the flat+offsets "
-            "format and was ignored; re-save it with "
-            "x_flat=concat(seqs), x_off=cumsum([0]+lengths), y=labels "
-            "(legacy object-array caches can be converted from the "
-            "reuters.pkl via CheckedUnpickler)", npz)
+        legacy = _load_legacy_npz(npz)
+        if legacy is not None:
+            xs, ys = legacy
+            try:             # migrate in place to flat+offsets
+                _save_flat_npz(npz, xs, ys)
+                logger.info(
+                    "datasets.reuters: migrated legacy object-array "
+                    "cache %s to the flat+offsets format", npz)
+            except OSError:
+                pass         # read-only cache dir: converted in memory
+        else:
+            logger.warning(
+                "datasets.reuters: cache %s is not in the flat+offsets "
+                "format and was ignored; re-save it with "
+                "x_flat=concat(seqs), x_off=cumsum([0]+lengths), "
+                "y=labels", npz)
     if xs is None and os.path.exists(pkl):
         with open(pkl, "rb") as f:
             xs, ys = CheckedUnpickler(f).load()

@@ -8,12 +8,15 @@ trees read end-to-end like the reference's HDFS reads."""
 from __future__ import annotations
 
 import io
+import logging
 from typing import List, Optional
 
 import numpy as np
 import pandas as pd
 
 from analytics_zoo_tpu.common import utils as zutils
+
+logger = logging.getLogger(__name__)
 
 
 class NNImageSchema:
@@ -54,6 +57,7 @@ class NNImageReader:
         # propagate — only DECODE failures mark a file as non-image
         blobs = zutils.read_bytes_many(files)
         rows = []
+        dropped: List[str] = []
         for f in files:
             try:
                 with Image.open(io.BytesIO(blobs[f])) as im:
@@ -63,7 +67,8 @@ class NNImageReader:
                                          Image.BILINEAR)
                     arr = np.asarray(rgb, np.uint8)
             except Exception:
-                continue  # non-image files are skipped
+                dropped.append(f)
+                continue  # non-image files are skipped (with a warning)
             rows.append({
                 NNImageSchema.ORIGIN: f,
                 NNImageSchema.HEIGHT: arr.shape[0],
@@ -72,4 +77,9 @@ class NNImageReader:
                 NNImageSchema.MODE: 16,  # CV_8UC3 parity
                 NNImageSchema.DATA: arr.reshape(-1),
             })
+        if dropped:
+            logger.warning(
+                "NNImageReader: skipped %d of %d file(s) that failed "
+                "to decode (first: %s)", len(dropped), len(files),
+                dropped[0])
         return pd.DataFrame(rows, columns=NNImageSchema.COLUMNS)

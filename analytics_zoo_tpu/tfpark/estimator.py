@@ -77,8 +77,9 @@ class _TFEstimatorNet:
                               for i in self._float_idx]
         self._pred_perm = pred_perm
         # BN moving stats etc.: extra train_fn outputs → float index
-        self._update_spec = [(self._float_idx.index(vi), kind)
-                             for vi, kind in (update_spec or [])]
+        from analytics_zoo_tpu.tfpark.tf_graph import build_update_spec
+        self._update_spec = build_update_spec(self._float_idx,
+                                              update_spec)
         self.name = "tf_estimator_net"
         self.layers: list = []
 

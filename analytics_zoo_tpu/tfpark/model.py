@@ -56,12 +56,10 @@ class _TFKerasNet:
         self._trainable = [bool(trainable_flags[i])
                            for i in self._float_idx]
         self._infer_perm = infer_perm
-        # variable updates (BN moving stats): map each extra train_fn
-        # output to its position in the FLOAT weight list (update
-        # targets are always float — the rewrite only tracks float
-        # variables as weights)
-        self._update_spec = [(self._float_idx.index(vi), kind)
-                             for vi, kind in (update_spec or [])]
+        # variable updates (BN moving stats): see build_update_spec
+        from analytics_zoo_tpu.tfpark.tf_graph import build_update_spec
+        self._update_spec = build_update_spec(self._float_idx,
+                                              update_spec)
         self.name = "tf_keras_net"
         self.layers: list = []
 

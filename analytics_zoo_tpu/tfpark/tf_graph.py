@@ -445,6 +445,28 @@ def assemble_weights(float_ws: Sequence, float_idx: Sequence[int],
     return full
 
 
+def build_update_spec(float_idx, update_spec):
+    """Captured-update targets → FLOAT weight-list positions, aligned
+    slot-for-slot with the extra train_fn outputs. A target that is a
+    tracked NON-float variable (e.g. an int step counter assigned in
+    the traced graph) maps to None — it is baked as a constant, so
+    there is nothing to fold back; the slot stays so alignment with
+    ``upd_vals`` is preserved. One warning reports the dropped targets'
+    variable indices (single copy shared by tfpark KerasModel and
+    TFEstimator)."""
+    spec = [(float_idx.index(vi) if vi in float_idx else None, kind)
+            for vi, kind in (update_spec or [])]
+    dropped = [vi for vi, _ in (update_spec or [])
+               if vi not in float_idx]
+    if dropped:
+        logger.warning(
+            "tfpark: %d captured variable update(s) target non-float "
+            "variables baked as constants (indices %s); those "
+            "variables will NOT advance during training",
+            len(dropped), dropped)
+    return spec
+
+
 def fold_weight_updates(spec, weights, upd_vals):
     """Captured Assign{,Add,Sub} values → a sparse float-weight-list
     update (None = unchanged), stop-gradded, with sequential assigns
@@ -454,6 +476,8 @@ def fold_weight_updates(spec, weights, upd_vals):
     import jax
     new_ws: list = [None] * len(weights)
     for (fi, kind), val in zip(spec, upd_vals):
+        if fi is None:       # non-float target: baked const, no fold
+            continue
         cur = new_ws[fi] if new_ws[fi] is not None else weights[fi]
         val = jax.lax.stop_gradient(val).astype(cur.dtype)
         if kind == "add":

@@ -383,11 +383,20 @@ def test_reuters_npz_flat_offsets(tmp_path):
     got = [list(s) for s in (xrt + xr)]
     assert got == seqs
     assert list(yrt) + list(yr) == [1, 2, 3]
-    # an object-array npz (the unsafe layout) is rejected, not unpickled
-    np.savez(tmp_path / "reuters.npz",
-             x=np.array([[1], [2, 3]], dtype=object),
-             y=np.array([0, 1]))
-    (xr, yr), _ = reuters.load_data(str(tmp_path))  # falls to synthetic
+    # a legacy object-array npz (the layout this repo wrote before
+    # flat+offsets) is auto-migrated through CheckedUnpickler — NOT
+    # np.load(allow_pickle=True) — and rewritten in the safe format
+    legacy = np.empty(2, dtype=object)
+    legacy[0], legacy[1] = [1], [2, 3]
+    np.savez(tmp_path / "reuters.npz", x=legacy, y=np.array([0, 1]))
+    (xr, yr), (xrt, yrt) = reuters.load_data(str(tmp_path),
+                                             test_split=0.5)
+    assert [list(s) for s in (xrt + xr)] == [[1], [2, 3]]
+    with np.load(tmp_path / "reuters.npz", allow_pickle=False) as f:
+        assert sorted(f.files) == ["x_flat", "x_off", "y"]
+    # an npz that is neither format falls through to synthetic
+    np.savez(tmp_path / "reuters.npz", nonsense=np.array([1]))
+    (xr, yr), _ = reuters.load_data(str(tmp_path))
     assert len(xr) > 0
 
 
