@@ -9,7 +9,9 @@ line for the second:
 
 `vs_baseline` is null: the reference publishes no NCF throughput number
 (BASELINE.md lists the workload without a target), so there is nothing
-honest to normalise against. The measured number lives in PERF.md.
+honest to normalise against. The measured number lives in PERF.md, and
+`bench.py` embeds this metric in its own JSON line (`extra_metrics`) so
+the driver's BENCH artifact carries both workloads.
 
 Model/recipe: the reference NeuralCF ml-1m example
 (`examples/recommendation/NeuralCFexample.scala`: 6040 users, 3706
@@ -33,36 +35,19 @@ import numpy as np
 _t_start = time.perf_counter()
 
 
-def main():
-    batch = int(os.environ.get("ZOO_TPU_BENCH_NCF_BATCH", "8192"))
-    steps = int(os.environ.get("ZOO_TPU_BENCH_STEPS", "20"))
-
+def measure(batch: int = 8192, steps: int = 20,
+            metric: str = "ncf_train_samples_per_sec_per_chip") -> dict:
+    """Measure NCF training throughput on the ALREADY-initialized
+    backend; returns the metric record (callable in-process from
+    bench.py after its own backend init)."""
     import jax
     import jax.numpy as jnp
     import optax
 
-    try:
-        jax.config.update("jax_compilation_cache_dir",
-                          os.environ.get("ZOO_TPU_COMPILE_CACHE",
-                                         "/tmp/zoo_tpu_xla_cache"))
-        jax.config.update(
-            "jax_persistent_cache_min_compile_time_secs", 2.0)
-    except Exception:
-        pass
-    plat = os.environ.get("ZOO_TPU_BENCH_PLATFORM")
-    if plat:
-        jax.config.update("jax_platforms", plat)
-
-    t0 = time.perf_counter()
-    devices = jax.devices()
-    t_init = time.perf_counter() - t0
-    print(f"# backend={devices[0].platform} n_devices={len(devices)} "
-          f"init={t_init:.1f}s", file=sys.stderr, flush=True)
-
     from analytics_zoo_tpu import init_nncontext
     from analytics_zoo_tpu.models.recommendation import NeuralCF
 
-    init_nncontext(tpu_mesh={"data": 1}, devices=devices[:1],
+    init_nncontext(tpu_mesh={"data": 1}, devices=jax.devices()[:1],
                    log_level="WARNING")
     # ml-1m scale + the reference example's dims
     ncf = NeuralCF(user_count=6040, item_count=3706, num_classes=5,
@@ -105,7 +90,6 @@ def main():
     t0 = time.perf_counter()
     compiled = jax.jit(run).lower(params, opt_state, x, y).compile()
     t_compile = time.perf_counter() - t0
-    print(f"# compile={t_compile:.1f}s", file=sys.stderr, flush=True)
 
     tiny = jax.jit(lambda a: a + 1.0).lower(
         jnp.zeros((), jnp.float32)).compile()
@@ -130,16 +114,45 @@ def main():
 
     dt = max(best_dt - overhead, 1e-9)
     samples_per_sec = batch * steps / dt
-    print(json.dumps({
-        "metric": "ncf_train_samples_per_sec_per_chip",
+    print(f"# [ncf] batch={batch} steps={steps} "
+          f"step_time={dt / steps * 1e6:.0f}us loss={loss:.3f} "
+          f"overhead={overhead * 1000:.1f}ms compile={t_compile:.1f}s",
+          file=sys.stderr, flush=True)
+    return {
+        "metric": metric,
         "value": round(samples_per_sec, 1),
         "unit": "samples/sec",
         "vs_baseline": None,
-    }), flush=True)
-    print(f"# batch={batch} steps={steps} "
-          f"step_time={dt / steps * 1e6:.0f}us loss={loss:.3f} "
-          f"overhead={overhead * 1000:.1f}ms compile={t_compile:.1f}s "
-          f"total={time.perf_counter() - _t_start:.1f}s",
+    }
+
+
+def main():
+    batch = int(os.environ.get("ZOO_TPU_BENCH_NCF_BATCH", "8192"))
+    steps = int(os.environ.get("ZOO_TPU_BENCH_STEPS", "20"))
+
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ.get("ZOO_TPU_COMPILE_CACHE",
+                                         "/tmp/zoo_tpu_xla_cache"))
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 2.0)
+    except Exception:
+        pass
+    plat = os.environ.get("ZOO_TPU_BENCH_PLATFORM")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+
+    t0 = time.perf_counter()
+    devices = jax.devices()
+    t_init = time.perf_counter() - t0
+    print(f"# backend={devices[0].platform} n_devices={len(devices)} "
+          f"init={t_init:.1f}s", file=sys.stderr, flush=True)
+
+    rec = measure(batch=batch, steps=steps)
+    print(json.dumps(rec), flush=True)
+    print(f"# total={time.perf_counter() - _t_start:.1f}s",
           file=sys.stderr)
 
 
