@@ -1,0 +1,142 @@
+"""Wide&Deep recommendation example (reference
+`zoo/examples/recommendation/Ml1mWideAndDeep.scala:40-115` and
+`apps/recommendation-wide-n-deep/wide_n_deep.ipynb`): the ml-1m
+recipe — wide base (occupation, gender), wide cross (age×gender
+hash-bucketed to 100), indicators (genres, gender), userId/itemId
+embeddings, continuous age — trained with Adam on 5 rating classes,
+then `predict_user_item_pair` / `recommend_for_user` /
+`recommend_for_item`. Synthetic ml-1m-shaped data by default (the
+real ratings.dat/users.dat/movies.dat are a download away; this
+environment is offline)."""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+BUCKET = 100          # reference bucketSize for the age-gender cross
+N_OCC, N_GENDER, N_GENRES = 21, 3, 19
+
+
+def synth_ml1m(n, users, items, rng):
+    """Synthetic ratings joined with user/item profiles: rating
+    depends on user/item affinity + age, so the model has signal."""
+    uid = rng.randint(1, users + 1, n)
+    iid = rng.randint(1, items + 1, n)
+    gender = rng.randint(1, N_GENDER, n)           # 1..2 like M/F
+    age = rng.choice([18, 25, 35, 45, 50, 56], n)
+    occupation = rng.randint(0, N_OCC, n)
+    genres = rng.randint(0, N_GENRES, n)
+    affinity = ((uid * 7 + iid * 3) % 10) / 9.0
+    score = 2.5 * affinity + 1.2 * (age / 56.0) + \
+        0.3 * rng.randn(n)
+    rating = np.clip(np.round(score + 1.5), 1, 5).astype(np.int64)
+    return dict(uid=uid, iid=iid, gender=gender, age=age,
+                occupation=occupation, genres=genres, rating=rating)
+
+
+def assembly_feature(d, info):
+    """The reference `assemblyFeature` (Utils.scala): multi-hot wide
+    vector + [indicators | embed ids | continuous] deep vector."""
+    n = len(d["uid"])
+    x_wide = np.zeros((n, info.wide_dim), np.float32)
+    x_wide[np.arange(n), d["occupation"]] = 1.0          # base 0..20
+    x_wide[np.arange(n), N_OCC + d["gender"]] = 1.0      # base gender
+    cross = (d["age"] * 3 + d["gender"]) % BUCKET        # hash cross
+    x_wide[np.arange(n), N_OCC + N_GENDER + cross] = 1.0
+
+    ind_genres = np.eye(N_GENRES, dtype=np.float32)[d["genres"]]
+    ind_gender = np.eye(N_GENDER, dtype=np.float32)[d["gender"]]
+    x_deep = np.concatenate([
+        ind_genres, ind_gender,
+        (d["uid"] - 1)[:, None].astype(np.float32),
+        (d["iid"] - 1)[:, None].astype(np.float32),
+        (d["age"][:, None] / 56.0).astype(np.float32),
+    ], axis=1)
+    return x_wide, x_deep
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model-type", default="wide_n_deep",
+                   choices=["wide", "deep", "wide_n_deep"])
+    p.add_argument("--users", type=int, default=200)
+    p.add_argument("--items", type=int, default=100)
+    p.add_argument("--samples", type=int, default=4096)
+    p.add_argument("--batch-size", type=int, default=512)
+    p.add_argument("--epochs", type=int, default=4)
+    args = p.parse_args(argv)
+
+    from analytics_zoo_tpu import init_nncontext
+    from analytics_zoo_tpu.models.recommendation import (
+        ColumnFeatureInfo, UserItemFeature, WideAndDeep)
+    from analytics_zoo_tpu.ops.optimizers import Adam
+
+    init_nncontext(seed=0)
+    rng = np.random.RandomState(0)
+    d = synth_ml1m(args.samples, args.users, args.items, rng)
+
+    # the reference Ml1mWideAndDeep localColumnInfo, verbatim
+    info = ColumnFeatureInfo(
+        wide_base_cols=["occupation", "gender"],
+        wide_base_dims=[N_OCC, N_GENDER],
+        wide_cross_cols=["age-gender"],
+        wide_cross_dims=[BUCKET],
+        indicator_cols=["genres", "gender"],
+        indicator_dims=[N_GENRES, N_GENDER],
+        embed_cols=["userId", "itemId"],
+        embed_in_dims=[args.users, args.items],
+        embed_out_dims=[64, 64],
+        continuous_cols=["age"])
+
+    wnd = WideAndDeep(args.model_type, num_classes=5,
+                      column_info=info)
+    # class_nll pairs with the log-softmax head (reference
+    # LogSoftMax + ClassNLLCriterion + Adam(1e-2))
+    wnd.compile(optimizer=Adam(lr=1e-2), loss="class_nll",
+                metrics=["accuracy"])
+
+    x_wide, x_deep = assembly_feature(d, info)
+    y = (d["rating"] - 1).reshape(-1, 1).astype(np.int32)
+    x = {"wide": x_wide, "deep": x_deep,
+         "wide_n_deep": [x_wide, x_deep]}[args.model_type]
+    n_train = int(0.8 * args.samples)
+    wnd.fit(x[:n_train] if isinstance(x, np.ndarray)
+            else [a[:n_train] for a in x],
+            y[:n_train], batch_size=args.batch_size,
+            nb_epoch=args.epochs)
+
+    x_val = (x[n_train:] if isinstance(x, np.ndarray)
+             else [a[n_train:] for a in x])
+    logp = wnd.predict(x_val, batch_size=args.batch_size)
+    acc = float((np.argmax(logp, -1) == y[n_train:, 0]).mean())
+    print(f"validation accuracy: {acc:.3f} "
+          f"({args.samples - n_train} samples)")
+
+    # ranking surface over the validation window
+    def row(i):
+        if isinstance(x, np.ndarray):
+            return x[n_train + i]
+        return [a[n_train + i] for a in x]
+    pairs = [UserItemFeature(user_id=int(d["uid"][n_train + i]),
+                             item_id=int(d["iid"][n_train + i]),
+                             feature=row(i))
+             for i in range(min(200, args.samples - n_train))]
+    print("predict_user_item_pair:")
+    for pred in wnd.predict_user_item_pair(pairs)[:5]:
+        print(f"  user {pred.user_id} item {pred.item_id}: rating "
+              f"{pred.prediction + 1} (p={pred.probability:.3f})")
+    print("recommend_for_user (top-3):")
+    for pred in wnd.recommend_for_user(pairs, max_items=3)[:6]:
+        print(f"  user {pred.user_id}: item {pred.item_id} "
+              f"({pred.prediction + 1}, p={pred.probability:.3f})")
+    print("recommend_for_item (top-3):")
+    for pred in wnd.recommend_for_item(pairs, max_users=3)[:6]:
+        print(f"  item {pred.item_id}: user {pred.user_id} "
+              f"({pred.prediction + 1}, p={pred.probability:.3f})")
+    return {"accuracy": acc}
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,191 @@
+"""Credit-card fraud-detection app (reference
+`apps/fraud-detection/fraud-detection.ipynb`): imbalanced tabular
+binary classification through the nnframes ML-pipeline surface.
+
+The reference recipe on the Kaggle `creditcard.csv` schema
+(Time, V1..V28, Amount, Class):
+  1. assemble V1..V28 + Amount into a 29-feature vector, standardize;
+  2. time-based 70/30 split (`approxQuantile("Time", 0.7)`);
+  3. `DLClassifier(Sequential(Linear(29,10), Linear(10,2),
+     LogSoftMax), ClassNLL)`;
+  4. evaluate precision/recall/areaUnderROC on the validation window;
+  5. fight the ~0.17% positive-class imbalance with a bagging
+     ensemble over stratified bootstrap samples (fraud oversampled
+     10x, majority downsampled to 5%) and a vote threshold.
+
+This app runs the same workflow TPU-natively: NNClassifier over a
+pandas (or Spark) DataFrame, softmax head + sparse CE (the log-prob
+head pairing), and the same stratified-bagging ensemble with a vote
+threshold swept on validation recall/precision. With no Kaggle
+download in this environment, `--csv` reads a real creditcard.csv;
+omitted, a synthetic generator reproduces the shape: two Gaussian
+clusters in V-space at the published 0.17% fraud rate with
+time-drifting means (so the time-based split matters).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+import pandas as pd
+
+
+def synth_creditcard(n: int, fraud_rate: float, rng) -> pd.DataFrame:
+    """creditcard.csv-shaped frame: Time, V1..V28, Amount, Class."""
+    n_fraud = max(int(n * fraud_rate), 8)
+    n_ok = n - n_fraud
+    t = np.sort(rng.uniform(0, 172800, size=n))  # 2 days of seconds
+    is_fraud = np.zeros(n, bool)
+    is_fraud[rng.choice(n, size=n_fraud, replace=False)] = True
+    drift = (t / 172800.0)[:, None]              # legit cluster drifts
+    v = rng.randn(n, 28) * 1.2 + drift
+    centre = np.linspace(1.8, -1.8, 28)          # fraud cluster offset
+    v[is_fraud] += centre[None, :]
+    amount = np.where(is_fraud,
+                      rng.lognormal(4.5, 1.0, n),
+                      rng.lognormal(3.0, 1.2, n))
+    df = pd.DataFrame(v, columns=[f"V{i}" for i in range(1, 29)])
+    df.insert(0, "Time", t)
+    df["Amount"] = amount
+    df["Class"] = is_fraud.astype(np.int64)
+    return df
+
+
+def to_features(df: pd.DataFrame, mean=None, std=None):
+    """VectorAssembler(V1..V28, Amount) + StandardScaler analog."""
+    cols = [f"V{i}" for i in range(1, 29)] + ["Amount"]
+    x = df[cols].to_numpy(np.float32)
+    if mean is None:
+        mean, std = x.mean(0), x.std(0) + 1e-8
+    x = (x - mean) / std
+    out = pd.DataFrame({"features": [row for row in x],
+                        "label": df["Class"].to_numpy(np.int64)})
+    return out, mean, std
+
+
+def build_classifier(lr: float, batch: int, epochs: int):
+    from analytics_zoo_tpu.feature.common import SeqToTensor
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential, \
+        layers as L
+    from analytics_zoo_tpu.pipeline.nnframes import NNClassifier
+    m = Sequential()
+    m.add(L.Dense(10, input_shape=(29,)))
+    m.add(L.Dense(2, activation="softmax"))  # reference: LogSoftMax
+    return (NNClassifier(m, "sparse_categorical_crossentropy",
+                         SeqToTensor((29,)))
+            .set_batch_size(batch).set_max_epoch(epochs)
+            .set_learning_rate(lr))
+
+
+def stratified_bootstrap(df: pd.DataFrame, rng,
+                         fraud_mult: float = 10.0,
+                         ok_ratio: float = 3.0) -> pd.DataFrame:
+    """Reference `StratifiedSampler(Map(fraud -> 10, ok -> 0.05))`:
+    oversample fraud with replacement, downsample the majority. On
+    the reference's 284k-row dataset those rates leave ~3 legit rows
+    per oversampled fraud row; expressing the majority sample as that
+    RATIO keeps the bootstrap balance at any dataset size."""
+    fraud = df[df["label"] == 1]
+    ok = df[df["label"] == 0]
+    fraud_s = fraud.sample(n=int(len(fraud) * fraud_mult),
+                           replace=True, random_state=rng)
+    ok_s = ok.sample(n=min(len(ok), int(len(fraud_s) * ok_ratio)),
+                     random_state=rng)
+    return pd.concat([fraud_s, ok_s]).sample(
+        frac=1.0, random_state=rng).reset_index(drop=True)
+
+
+def evaluate(y_true, scores, preds):
+    """precision / recall / ROC-AUC like the reference's
+    Binary+MulticlassClassificationEvaluator cell."""
+    from analytics_zoo_tpu.ops.metrics import AUC
+    tp = int(((preds == 1) & (y_true == 1)).sum())
+    fp = int(((preds == 1) & (y_true == 0)).sum())
+    fn = int(((preds == 0) & (y_true == 1)).sum())
+    precision = tp / (tp + fp) if tp + fp else 0.0
+    recall = tp / (tp + fn) if tp + fn else 0.0
+    auc_m = AUC()
+    stats = auc_m.batch_stats(y_true.astype(np.float32),
+                              scores.astype(np.float32))
+    auc = float(auc_m.aggregate(
+        {k: np.asarray(v) for k, v in stats.items()}))
+    return precision, recall, auc
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--csv", default=None,
+                   help="path to a real creditcard.csv; omit for "
+                        "synthetic data with the same schema")
+    p.add_argument("--rows", type=int, default=20000,
+                   help="synthetic row count")
+    p.add_argument("--fraud-rate", type=float, default=0.0017)
+    p.add_argument("--epochs", type=int, default=12)
+    p.add_argument("--batch-size", type=int, default=1024)
+    p.add_argument("--lr", type=float, default=3e-2)
+    p.add_argument("--models", type=int, default=5,
+                   help="bagging ensemble size (reference: 10)")
+    args = p.parse_args(argv)
+
+    from analytics_zoo_tpu import init_nncontext
+    init_nncontext(seed=0)
+    rng = np.random.RandomState(0)
+
+    if args.csv:
+        data = pd.read_csv(args.csv)
+    else:
+        data = synth_creditcard(args.rows, args.fraud_rate, rng)
+        print(f"synthetic creditcard data: {len(data)} rows, "
+              f"{int(data['Class'].sum())} fraud")
+
+    # time-based split at the 0.7 quantile (reference approxQuantile)
+    split_t = float(data["Time"].quantile(0.7))
+    train_raw = data[data["Time"] < split_t]
+    valid_raw = data[data["Time"] >= split_t]
+    print(f"training records: {len(train_raw)}  "
+          f"validation records: {len(valid_raw)}")
+
+    train_df, mean, std = to_features(train_raw)
+    valid_df, _, _ = to_features(valid_raw, mean, std)
+    y_valid = valid_df["label"].to_numpy()
+
+    # ---- single model on the raw (imbalanced) training window ------
+    clf = build_classifier(args.lr, args.batch_size, args.epochs)
+    model = clf.fit(train_df)
+    scores = model.estimator.predict(
+        np.stack(valid_df["features"]))[:, 1]
+    preds = model.transform(valid_df)["prediction"].to_numpy()
+    prec, rec, auc = evaluate(y_valid, scores, preds)
+    print(f"single model: precision={prec:.3f} recall={rec:.3f} "
+          f"AUC={auc:.3f}")
+
+    # ---- bagging over stratified bootstrap samples -----------------
+    votes = np.zeros(len(valid_df))
+    for i in range(args.models):
+        boot = stratified_bootstrap(train_df,
+                                    np.random.RandomState(100 + i))
+        m_i = build_classifier(args.lr, args.batch_size,
+                               args.epochs).fit(boot)
+        votes += m_i.transform(valid_df)["prediction"].to_numpy()
+    # vote-threshold sweep (reference fixes threshold=15 of 20; with
+    # an adjustable ensemble size, sweep and report the best-F1 row)
+    best = None
+    for thr in range(1, args.models + 1):
+        preds_t = (votes >= thr).astype(np.int64)
+        prec, rec, auc_t = evaluate(y_valid, votes / args.models,
+                                    preds_t)
+        f1 = 2 * prec * rec / (prec + rec) if prec + rec else 0.0
+        print(f"bagging threshold {thr}/{args.models}: "
+              f"precision={prec:.3f} recall={rec:.3f} f1={f1:.3f}")
+        if best is None or f1 > best[0]:
+            best = (f1, thr, prec, rec)
+    f1, thr, prec, rec = best
+    print(f"best ensemble: threshold={thr} precision={prec:.3f} "
+          f"recall={rec:.3f} f1={f1:.3f}")
+    if not args.csv and (prec + rec):
+        assert rec >= 0.5, "ensemble failed to learn the fraud class"
+
+
+if __name__ == "__main__":
+    main()

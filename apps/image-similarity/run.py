@@ -1,0 +1,183 @@
+"""Image-similarity app (reference
+`apps/image-similarity/image-similarity.ipynb`): real-estate-style
+scene search combining a SEMANTIC model (scene classification) with a
+VISUAL model (deep-feature embeddings + cosine similarity).
+
+The reference workflow:
+  1. `NNImageReader.readImages` + a path→label UDF builds a labeled
+     scene DataFrame;
+  2. semantic model: pretrained GoogLeNet-places365 cut at
+     `pool5/drop_7x7_s1` via `Net.new_graph`, frozen, + Linear head →
+     trained as an `NNClassifier` pipeline;
+  3. visual model: VGG-16-places365 cut at `pool5`, `View(25088)` +
+     L2 `Normalize` → an `NNModel` that adds an embedding column;
+  4. query: `score = 0.3·classMatch + 0.7·cosine(query, candidate)`,
+     top-k via `heapq.nlargest`.
+
+This app runs the same four stages TPU-natively: a keras-API graph
+backbone cut with `Model.new_graph` + `freeze_up_to` (the same
+transfer-learning surgery surface), NNClassifier training, an
+embedding extractor sharing the trained backbone with post-hoc L2
+normalization, and the reference's exact scoring formula. Offline it
+synthesizes a 4-class scene folder (distinct color/texture
+statistics per class); pass `--folder` with `class_name/xxx.jpg`
+subdirs to run on real data.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import tempfile
+from heapq import nlargest
+
+import numpy as np
+import pandas as pd
+
+CLASSES = ["bathroom", "bedroom", "house", "kitchen"]
+
+
+def synth_scene_folder(root: str, per_class: int, size: int,
+                       rng) -> None:
+    """Scene-shaped classes: per-class base color + stripe texture
+    frequency, so both the classifier and the embedding have real
+    (but learnable-offline) structure."""
+    from PIL import Image
+    bases = [(200, 220, 235), (180, 150, 120),
+             (120, 170, 110), (235, 200, 160)]
+    for ci, cls in enumerate(CLASSES):
+        os.makedirs(os.path.join(root, cls), exist_ok=True)
+        base = np.array(bases[ci], np.float32)
+        for i in range(per_class):
+            yy = np.arange(size)[:, None, None]
+            stripes = 25.0 * np.sin(2 * np.pi * (ci + 1) * yy / size)
+            img = base[None, None, :] + stripes + \
+                rng.randn(size, size, 3) * 12.0
+            Image.fromarray(
+                np.clip(img, 0, 255).astype(np.uint8)).save(
+                os.path.join(root, cls, f"{i}.png"))
+
+
+def build_backbone(size: int):
+    """Small conv graph with NAMED nodes so `new_graph("pool5")` /
+    `freeze_up_to` work exactly like the reference's Net surgery."""
+    from analytics_zoo_tpu.pipeline.api.keras import (
+        Input, Model, layers as L)
+    inp = Input(shape=(size, size, 3), name="image")
+    x = L.Convolution2D(16, 3, 3, activation="relu",
+                        border_mode="same", name="conv1")(inp)
+    x = L.MaxPooling2D((2, 2), name="pool1")(x)
+    x = L.Convolution2D(32, 3, 3, activation="relu",
+                        border_mode="same", name="conv2")(x)
+    x = L.MaxPooling2D((2, 2), name="pool2")(x)
+    x = L.GlobalAveragePooling2D(name="pool5")(x)
+    out = L.Dense(len(CLASSES), activation="softmax", name="head")(x)
+    return Model(inp, out)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--folder", default=None,
+                   help="scene folder with class_name/xxx.jpg subdirs "
+                        "(local or fsspec scheme); omit for synthetic")
+    p.add_argument("--image-size", type=int, default=32)
+    p.add_argument("--per-class", type=int, default=24)
+    p.add_argument("--epochs", type=int, default=30)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--top-k", type=int, default=3)
+    p.add_argument("--class-weight", type=float, default=0.3,
+                   help="semantic weight in the reference score "
+                        "0.3*classMatch + 0.7*cosine")
+    args = p.parse_args(argv)
+
+    from analytics_zoo_tpu import init_nncontext
+    from analytics_zoo_tpu.feature.common import SeqToTensor
+    from analytics_zoo_tpu.feature.image import ImageSet
+    from analytics_zoo_tpu.ops.optimizers import Adam
+    from analytics_zoo_tpu.pipeline.nnframes import NNClassifier
+    init_nncontext(seed=0)
+    rng = np.random.RandomState(0)
+
+    folder = args.folder
+    if folder is None:
+        folder = tempfile.mkdtemp(prefix="scenes_")
+        synth_scene_folder(folder, args.per_class, args.image_size,
+                           rng)
+
+    # 1. labeled scene DataFrame (reference: readImages + label UDF)
+    iset = ImageSet.read(folder, with_label_from_dirs=True)
+    size = args.image_size
+    from PIL import Image as PILImage
+    feats, labels, origins = [], [], []
+    for f in iset.features:
+        arr = np.asarray(
+            PILImage.fromarray(f.image).resize((size, size)),
+            np.float32) / 255.0
+        feats.append(arr)
+        labels.append(float(f.label[0]))
+        origins.append(f.get(f.URI))
+    df = pd.DataFrame({"features": feats, "label": labels,
+                       "origin": origins})
+    print(f"scene DataFrame: {len(df)} images, "
+          f"{len(set(labels))} classes")
+
+    # 2. semantic model: backbone surgery + frozen transfer head.
+    # (The reference cuts a PRETRAINED net; offline the backbone
+    # trains end-to-end first, then the same new_graph/freeze_up_to
+    # surgery produces the deployment classifier.)
+    net = build_backbone(size)
+    clf = (NNClassifier(net, "sparse_categorical_crossentropy",
+                        SeqToTensor((size, size, 3)))
+           .set_batch_size(args.batch_size)
+           .set_max_epoch(args.epochs)
+           .set_optim_method(Adam(lr=1e-2)))
+    scene_model = clf.fit(df)
+    out = scene_model.transform(df)
+    acc = float((out["prediction"] == out["label"]).mean())
+    print(f"scene classification train accuracy: {acc:.3f}")
+
+    # the reference's surgery surface, on the trained graph: cut at
+    # pool5 and freeze everything below it
+    part = net.new_graph(["pool5"])
+    part.freeze_up_to("pool5")
+    n_frozen = sum(1 for lyr in part.layers if not lyr.trainable)
+    print(f"new_graph(pool5): {len(part.layers)} layers, "
+          f"{n_frozen} frozen")
+
+    # 3. visual model: the pool5 activations, L2-normalized
+    # (reference: new_graph("pool5") + View + Normalize(2.0))
+    emb_params = {k: v for k, v in
+                  scene_model.estimator.params.items()}
+    x_all = np.stack(df["features"]).astype(np.float32)
+    import jax
+    emb = np.asarray(jax.jit(
+        lambda p, x: part.call(p, x))(emb_params, x_all))
+    emb = emb.reshape(len(df), -1)
+    emb = emb / (np.linalg.norm(emb, axis=1, keepdims=True) + 1e-8)
+    classes = out["prediction"].to_numpy()
+    print(f"embeddings: {emb.shape}")
+
+    # 4. query: reference score = w·classMatch + (1-w)·cosine
+    qi = int(rng.randint(len(df)))
+    q_cls, q_emb = classes[qi], emb[qi]
+
+    def score(i):
+        class_match = 1.0 if classes[i] == q_cls else 0.0
+        cosine = float(q_emb @ emb[i])
+        return args.class_weight * class_match + \
+            (1 - args.class_weight) * cosine
+
+    ranked = nlargest(args.top_k + 1, range(len(df)), key=score)
+    ranked = [i for i in ranked if i != qi][:args.top_k]
+    print(f"query: {df['origin'][qi]} (class {int(labels[qi])})")
+    for r, i in enumerate(ranked):
+        print(f"  top-{r + 1}: {df['origin'][i]} "
+              f"(class {int(labels[i])}, score={score(i):.3f})")
+    if args.folder is None:
+        top1_same = labels[ranked[0]] == labels[qi]
+        assert top1_same, "top-1 similar image is from another scene"
+    return acc
+
+
+if __name__ == "__main__":
+    main()

@@ -46,3 +46,14 @@ def test_app_dogs_vs_cats():
 def test_app_sentiment_analysis():
     _run("sentiment-analysis",
          ["--samples", "128", "--epochs", "2", "--batch-size", "32"])
+
+
+def test_app_fraud_detection():
+    _run("fraud-detection",
+         ["--rows", "4000", "--fraud-rate", "0.01", "--epochs", "5",
+          "--batch-size", "512", "--models", "2"])
+
+
+def test_app_image_similarity():
+    _run("image-similarity",
+         ["--per-class", "10", "--epochs", "15", "--image-size", "24"])

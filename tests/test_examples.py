@@ -144,3 +144,11 @@ def test_transfer_learning():
     metrics = _run("transfer_learning", ["--n", "64", "--epochs", "1",
                                          "--image-size", "16"])
     assert "loss" in metrics
+
+
+def test_wide_and_deep():
+    metrics = _run("wide_and_deep",
+                   ["--samples", "1024", "--epochs", "2",
+                    "--batch-size", "256", "--users", "50",
+                    "--items", "40"])
+    assert metrics["accuracy"] > 0.25   # 5 classes: chance is 0.2
