@@ -330,7 +330,8 @@ def make_explicit_fn(fn: Callable, input_signature: Sequence,
 def to_jax_fn(fn: Callable, input_signature: Sequence,
               variables: Optional[Sequence] = None,
               prefer_native: bool = True,
-              with_updates: bool = False):
+              with_updates: bool = False,
+              max_trip_count: Optional[int] = None):
     """TF function → JAX function ``(jax_fn(*weights, *inputs), vars)``.
 
     Preferred path: the GraphDef→jnp interpreter (`graphdef_jax`) — the
@@ -361,7 +362,7 @@ def to_jax_fn(fn: Callable, input_signature: Sequence,
         feeds.update(rw.const_feeds)
         gfn = GraphDefFunction(
             rw.gd, read_names + rw.input_names, list(rw.output_names),
-            const_feeds=feeds)
+            const_feeds=feeds, max_trip_count=max_trip_count)
         missing = gfn.unsupported_ops()
         if not missing and with_updates and upd_tensors:
             # updates ride along only if THEIR subgraph also
@@ -369,7 +370,8 @@ def to_jax_fn(fn: Callable, input_signature: Sequence,
             # call_tf fallback because of an assign-value op
             gfn_full = GraphDefFunction(
                 rw.gd, read_names + rw.input_names,
-                list(rw.output_names) + upd_tensors, const_feeds=feeds)
+                list(rw.output_names) + upd_tensors, const_feeds=feeds,
+                max_trip_count=max_trip_count)
             if gfn_full.unsupported_ops():
                 logger.warning(
                     "to_jax_fn: ops %s in the variable-update subgraph "
