@@ -10,10 +10,17 @@ from analytics_zoo_tpu.models.common import ZooModel
 
 
 def _fused_resnet() -> bool:
-    """ZOO_TPU_FUSED_RESNET=1 builds registry ResNets with the fused
-    Pallas conv+BN bottlenecks (`ops/conv_bn.py`) by default."""
+    """ZOO_TPU_FUSED_RESNET: "1"/"0" pin the fused Pallas conv+BN
+    bottlenecks (`ops/conv_bn.py`) on/off; "auto" (the default) routes
+    fused on a TPU backend once `conv_bn.fused_profitable()` reports a
+    measured on-chip win — the same policy shape as attention's
+    flash "auto" (`ops/attention.py:33-61`)."""
     import os
-    return os.environ.get("ZOO_TPU_FUSED_RESNET", "0") == "1"
+    mode = os.environ.get("ZOO_TPU_FUSED_RESNET", "auto")
+    if mode == "auto":
+        from analytics_zoo_tpu.ops.conv_bn import fused_profitable
+        return fused_profitable()
+    return mode == "1"
 
 
 def _build_resnet(depth, s, c, fused=False):

@@ -44,6 +44,25 @@ from jax.experimental.pallas import tpu as pltpu
 # test observability, like ops.flash_attention.invocations
 invocations = 0
 
+# Measured-win gate for the fused-ResNet "auto" default (the flash
+# playbook, VERDICT r3 next-round #3): flip to True once
+# scripts/measure_fused.py shows the fused bottlenecks beating the
+# XLA graph on real hardware. Until then "auto" resolves unfused —
+# the kernels stay opt-in (ZOO_TPU_FUSED_RESNET=1) because they are
+# conformance-clean but chip-unmeasured (the round-3 tunnel outage).
+MEASURED_WIN = False
+
+
+def fused_profitable() -> bool:
+    """Whether the "auto" fused-ResNet default may route to the Pallas
+    conv+BN bottlenecks: a real TPU backend AND a measured on-chip win
+    (``MEASURED_WIN``). ``ZOO_TPU_FUSED_WIN=0/1`` overrides both (1:
+    CPU kernel-coverage tests and measurement runs; 0: kill switch)."""
+    env = os.environ.get("ZOO_TPU_FUSED_WIN")
+    if env is not None:
+        return env == "1"
+    return MEASURED_WIN and jax.default_backend() in ("tpu", "axon")
+
 
 def _pick_blocks(m: int, k: int, n: int, itemsize: int = 2
                  ) -> Tuple[int, int]:
@@ -509,7 +528,7 @@ def conv1x1_bn(x: jnp.ndarray, w: jnp.ndarray, stride: int = 1,
 # 3×3 stride-1 SAME conv + BN (the residual-block 3×3s)
 # ---------------------------------------------------------------------------
 
-def _conv3_ref(x, w, s, t, sh, relu_in, affine_in):
+def _conv3_ref(x, w, s, t, sh, relu_in, affine_in, stride=1):
     """Reference expression for conv3x3_bn — the ground truth the
     kernel is tested against AND the function whose `jax.vjp` is the
     backward (exact gradients, standard XLA conv backward perf)."""
@@ -520,7 +539,8 @@ def _conv3_ref(x, w, s, t, sh, relu_in, affine_in):
     if relu_in:
         xf = jnp.maximum(xf, 0.0)
     y = jax.lax.conv_general_dilated(
-        xf.astype(x.dtype), w.astype(x.dtype), window_strides=(1, 1),
+        xf.astype(x.dtype), w.astype(x.dtype),
+        window_strides=(stride, stride),
         padding="SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"),
         preferred_element_type=f32)
     d = y - sh[None, None, None, :]
@@ -530,11 +550,14 @@ def _conv3_ref(x, w, s, t, sh, relu_in, affine_in):
 
 def _conv3_kernel(x_ref, w_ref, s_ref, t_ref, sh_ref,
                   y_ref, sum_ref, sq_ref, *,
-                  relu_in: bool, affine_in: bool, out_dtype):
+                  relu_in: bool, affine_in: bool, out_dtype,
+                  stride: int = 1):
     """Grid (bi,): one batch tile, FULL spatial plane in VMEM — no
     halos. Prologue (affine+ReLU) runs once on the tile; the 3×3 is
-    nine shifted (bb·H·W, Cin)@(Cin, Cout) MXU taps accumulated in
-    f32; the epilogue reduces the accumulator for the BN statistics."""
+    nine shifted (bb·Ho·Wo, Cin)@(Cin, Cout) MXU taps accumulated in
+    f32; the epilogue reduces the accumulator for the BN statistics.
+    ``stride=2`` (even H/W, SAME ⇒ pad (0,1)): each tap takes every
+    other row/column via an even reshape — no strided loads."""
     bi = pl.program_id(0)
     xb = x_ref[...].astype(jnp.float32)
     if affine_in:
@@ -544,17 +567,33 @@ def _conv3_kernel(x_ref, w_ref, s_ref, t_ref, sh_ref,
     xb = xb.astype(w_ref.dtype)
     bb, h, wd, cin = xb.shape
     cout = w_ref.shape[3]
-    xp = jnp.pad(xb, ((0, 0), (1, 1), (1, 1), (0, 0)))
-    acc = jnp.zeros((bb * h * wd, cout), jnp.float32)
+    if stride == 1:
+        ho, wo = h, wd
+        xp = jnp.pad(xb, ((0, 0), (1, 1), (1, 1), (0, 0)))
+
+        def tap(dh, dw):
+            return jax.lax.slice(
+                xp, (0, dh, dw, 0), (bb, dh + h, dw + wd, cin))
+    else:
+        ho, wo = h // 2, wd // 2
+        # SAME @ stride 2, even extent: pad (0, 1); one extra row/col
+        # of zeros keeps the every-other-row reshape even
+        xp = jnp.pad(xb, ((0, 0), (0, 2), (0, 2), (0, 0)))
+
+        def tap(dh, dw):
+            win = jax.lax.slice(
+                xp, (0, dh, dw, 0),
+                (bb, dh + 2 * ho, dw + 2 * wo, cin))
+            win = win.reshape(bb, ho, 2, wo, 2, cin)
+            return win[:, :, 0, :, 0, :]
+    acc = jnp.zeros((bb * ho * wo, cout), jnp.float32)
     for dh in range(3):
         for dw in range(3):
-            tap = jax.lax.slice(
-                xp, (0, dh, dw, 0), (bb, dh + h, dw + wd, cin))
             acc += jax.lax.dot_general(
-                tap.reshape(bb * h * wd, cin), w_ref[dh, dw],
+                tap(dh, dw).reshape(bb * ho * wo, cin), w_ref[dh, dw],
                 (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
-    y_ref[...] = acc.reshape(bb, h, wd, cout).astype(out_dtype)
+    y_ref[...] = acc.reshape(bb, ho, wo, cout).astype(out_dtype)
     d = acc - sh_ref[0, :]
     snew = jnp.sum(d, axis=0, keepdims=True)
     qnew = jnp.sum(d * d, axis=0, keepdims=True)
@@ -570,15 +609,16 @@ def _conv3_kernel(x_ref, w_ref, s_ref, t_ref, sh_ref,
         sq_ref[...] += qnew
 
 
-def _conv3_batch_tile(shape, cout, itemsize) -> Optional[int]:
+def _conv3_batch_tile(shape, cout, itemsize, stride=1) -> Optional[int]:
     """Largest divisor of B whose full-plane residency (input tile +
     padded prologue copy + f32 accumulator + output tile + weights)
     fits the VMEM budget; None when even one image does not fit."""
     b, h, wd, cin = shape
+    ho, wo = h // stride, wd // stride
     per_img = (h * wd * cin * itemsize +
                (h + 2) * (wd + 2) * cin * itemsize +
-               h * wd * cout * 4 +
-               h * wd * cout * itemsize)
+               ho * wo * cout * 4 +
+               ho * wo * cout * itemsize)
     w_bytes = 9 * cin * cout * itemsize
     for cand in range(min(b, 16), 0, -1):
         if b % cand == 0 and \
@@ -587,17 +627,20 @@ def _conv3_batch_tile(shape, cout, itemsize) -> Optional[int]:
     return None
 
 
-def _conv3_fwd_pallas(x, w, s, t, sh, relu_in, affine_in, interpret):
+def _conv3_fwd_pallas(x, w, s, t, sh, relu_in, affine_in, stride,
+                      interpret):
     b, h, wd, cin = x.shape
     cout = w.shape[3]
+    ho, wo = h // stride, wd // stride
     bb = _conv3_batch_tile(x.shape, cout,
-                           jnp.dtype(x.dtype).itemsize)
+                           jnp.dtype(x.dtype).itemsize, stride)
     assert bb is not None  # conv3x3_bn falls back before reaching here
     f32 = jnp.float32
     y, ssum, ssq = pl.pallas_call(
         functools.partial(_conv3_kernel, relu_in=relu_in,
                           affine_in=affine_in,
-                          out_dtype=jnp.dtype(x.dtype)),
+                          out_dtype=jnp.dtype(x.dtype),
+                          stride=stride),
         grid=(b // bb,),
         in_specs=[
             pl.BlockSpec((bb, h, wd, cin), lambda bi: (bi, 0, 0, 0)),
@@ -607,12 +650,12 @@ def _conv3_fwd_pallas(x, w, s, t, sh, relu_in, affine_in, interpret):
             pl.BlockSpec((1, cout), lambda bi: (0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((bb, h, wd, cout), lambda bi: (bi, 0, 0, 0)),
+            pl.BlockSpec((bb, ho, wo, cout), lambda bi: (bi, 0, 0, 0)),
             pl.BlockSpec((1, cout), lambda bi: (0, 0)),
             pl.BlockSpec((1, cout), lambda bi: (0, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, wd, cout), x.dtype),
+            jax.ShapeDtypeStruct((b, ho, wo, cout), x.dtype),
             jax.ShapeDtypeStruct((1, cout), f32),
             jax.ShapeDtypeStruct((1, cout), f32),
         ],
@@ -623,20 +666,21 @@ def _conv3_fwd_pallas(x, w, s, t, sh, relu_in, affine_in, interpret):
     return y, ssum[0], ssq[0]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
-def _conv3(x, w, s, t, sh, relu_in, affine_in, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _conv3(x, w, s, t, sh, relu_in, affine_in, stride, interpret):
     return _conv3_fwd_pallas(x, w, s, t, sh, relu_in, affine_in,
-                             interpret)
+                             stride, interpret)
 
 
-def _conv3_vjp_fwd(x, w, s, t, sh, relu_in, affine_in, interpret):
+def _conv3_vjp_fwd(x, w, s, t, sh, relu_in, affine_in, stride,
+                   interpret):
     out = _conv3_fwd_pallas(x, w, s, t, sh, relu_in, affine_in,
-                            interpret)
+                            stride, interpret)
     y, _, _ = out
     return out, (x, w, s, t, sh, y)
 
 
-def _conv3_vjp_bwd(relu_in, affine_in, interpret, res, cots):
+def _conv3_vjp_bwd(relu_in, affine_in, stride, interpret, res, cots):
     """XLA backward: the conv is linear in each operand, so
     `jax.linear_transpose` gives dW/dxp without re-running the
     forward; the stats cotangents fold into the same augmented g as
@@ -657,7 +701,7 @@ def _conv3_vjp_bwd(relu_in, affine_in, interpret, res, cots):
 
     def conv(l, r):
         return jax.lax.conv_general_dilated(
-            l, r, window_strides=(1, 1), padding="SAME",
+            l, r, window_strides=(stride, stride), padding="SAME",
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
             preferred_element_type=f32)
 
@@ -688,19 +732,24 @@ def conv3x3_bn(x: jnp.ndarray, w: jnp.ndarray,
                in_shift: Optional[jnp.ndarray] = None,
                relu_in: bool = False,
                stat_shift: Optional[jnp.ndarray] = None,
+               stride: int = 1,
                interpret: Optional[bool] = None):
-    """Fused 3×3 stride-1 SAME conv + BN statistics (the VERDICT r3
-    target: the residual-block 3×3s). x: (B, H, W, Cin); w:
-    (3, 3, Cin, Cout), Cin/Cout 64-multiples. Prologue/epilogue and
-    returns exactly like :func:`matmul_bn`; ``stat_shift`` must be
-    non-differentiated (pass the BN's moving mean stop-gradded — its
-    cotangent is defined as zero, like matmul_bn's). Backward runs as
-    XLA `linear_transpose` convs. Planes too large for a one-image
-    VMEM tile fall back to the XLA reference expression."""
+    """Fused 3×3 SAME conv + BN statistics (the VERDICT r3 target:
+    the residual-block 3×3s). x: (B, H, W, Cin); w: (3, 3, Cin, Cout),
+    Cin/Cout 64-multiples; ``stride`` 1 or 2 (2 covers the stage-
+    transition blocks — VERDICT r4 lever; even H/W required, else the
+    XLA reference path). Prologue/epilogue and returns exactly like
+    :func:`matmul_bn`; ``stat_shift`` must be non-differentiated (pass
+    the BN's moving mean stop-gradded — its cotangent is defined as
+    zero, like matmul_bn's). Backward runs as XLA `linear_transpose`
+    convs. Planes too large for a one-image VMEM tile fall back to the
+    XLA reference expression."""
     global invocations
     invocations += 1
     if w.shape[:2] != (3, 3):
         raise ValueError(f"kernel must be 3x3, got {w.shape[:2]}")
+    if stride not in (1, 2):
+        raise ValueError(f"stride must be 1 or 2, got {stride}")
     cin, cout = w.shape[2], w.shape[3]
     if cin % 64 or cout % 64:
         raise ValueError(f"Cin={cin} and Cout={cout} must be "
@@ -715,11 +764,15 @@ def conv3x3_bn(x: jnp.ndarray, w: jnp.ndarray,
            jnp.zeros((cin,), f32))
     sh_v = (stat_shift.astype(f32) if stat_shift is not None else
             jnp.zeros((cout,), f32))
-    if _conv3_batch_tile(x.shape, cout,
-                         jnp.dtype(x.dtype).itemsize) is None:
-        # plane too large for VMEM: the reference expression (autodiff
-        # supplies the same gradients the custom path computes)
-        return _conv3_ref(x, w, s_v, t_v, sh_v, relu_in, affine_in)
+    odd = stride == 2 and (x.shape[1] % 2 or x.shape[2] % 2)
+    if odd or _conv3_batch_tile(x.shape, cout,
+                                jnp.dtype(x.dtype).itemsize,
+                                stride) is None:
+        # plane too large for VMEM (or odd strided extent): the
+        # reference expression (autodiff supplies the same gradients
+        # the custom path computes)
+        return _conv3_ref(x, w, s_v, t_v, sh_v, relu_in, affine_in,
+                          stride)
     return _conv3(x, w, s_v.reshape(1, cin), t_v.reshape(1, cin),
                   sh_v.reshape(1, cout), relu_in, affine_in,
-                  bool(interpret))
+                  int(stride), bool(interpret))

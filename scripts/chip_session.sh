@@ -1,24 +1,34 @@
 #!/bin/bash
-# Round-3 chip session: run the full measurement sequence, appending
-# everything to chip_session.log. Safe to re-run; each phase is
-# independent. Serialize against other chip jobs (axon contention
-# corrupts timings — PERF.md).
+# Round-4 chip session: the full measurement sequence for the moment
+# the axon tunnel returns, appending everything to chip_session.log.
+# Safe to re-run; each phase is independent. Serialize against other
+# chip jobs (axon contention corrupts timings — PERF.md).
 cd "$(dirname "$0")/.." || exit 1
+set -o pipefail   # run() pipes through tee: the probe gate below must
+                  # see the COMMAND's status, not tee's
 LOG=chip_session.log
 run() { echo "### $(date +%H:%M:%S) $*" | tee -a "$LOG"; "$@" 2>&1 | tee -a "$LOG"; }
 
-# 0. chip sanity
-run timeout 60 python -c "import jax, numpy as np, jax.numpy as jnp; print('chip ok:', float(np.asarray(jax.jit(lambda a: a+1)(jnp.zeros(())))))" || exit 1
+# 0. chip sanity (fast: bench's own probe path)
+run timeout 120 python bench.py --probe || exit 1
 
-# 1. per-shape kernel micro A/B (fwd and fwd+bwd) + model A/B at batch 128
+# 1. per-shape kernel micro A/B (fwd and fwd+bwd) + model A/B at
+#    batch 128 — now including the stride-2 conv3x3_bn blocks
 run python scripts/measure_fused.py --steps 20
 
-# 2. batch sweep on the fused path (BN traffic reduced: 256 may win now)
+# 2. batch sweep on the fused path (BN traffic reduced further by the
+#    strided kernel: 192/256 may win now)
 for b in 192 256; do
-  ZOO_TPU_BENCH_FUSED=1 ZOO_TPU_BENCH_BATCH=$b run python bench.py
+  ZOO_TPU_BENCH_FUSED=1 ZOO_TPU_BENCH_BATCH=$b ZOO_TPU_BENCH_NCF=0 run python bench.py
 done
 
-# 3. profile capture of both variants for PERF.md
-ZOO_TPU_BENCH_PROFILE_DIR=/tmp/zoo_r3_profile run python bench.py
+# 3. full bench with the round-4 contract (auto A/B + NCF extra
+#    metric + model-FLOPs MFU fields)
+run python bench.py
 
-echo "### done — results in $LOG; profiles in /tmp/zoo_r3_profile" | tee -a "$LOG"
+# 4. profile capture of both variants for PERF.md
+ZOO_TPU_BENCH_PROFILE_DIR=/tmp/zoo_r4_profile ZOO_TPU_BENCH_NCF=0 run python bench.py
+
+echo "### done — results in $LOG; profiles in /tmp/zoo_r4_profile" | tee -a "$LOG"
+echo "### if fused won: flip MEASURED_WIN=True in ops/conv_bn.py (the"
+echo "### 'auto' default then routes fused on TPU) and update PERF.md"

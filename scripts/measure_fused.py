@@ -137,13 +137,16 @@ def main():
 
     if not args.skip_model:
         print("# model A/B: ZOO_TPU_BENCH_FUSED 0 vs 1:", flush=True)
+        import json
         import subprocess
         here = os.path.dirname(
             os.path.dirname(os.path.abspath(__file__)))
+        values = {}
         for fused in ("0", "1"):
             env = dict(os.environ, ZOO_TPU_BENCH_FUSED=fused,
                        ZOO_TPU_BENCH_STEPS=str(steps),
-                       ZOO_TPU_BENCH_BATCH=str(args.batch))
+                       ZOO_TPU_BENCH_BATCH=str(args.batch),
+                       ZOO_TPU_BENCH_NCF="0")  # A/B needs no NCF leg
             if args.tiny:
                 env.update(ZOO_TPU_BENCH_BATCH="4",
                            ZOO_TPU_BENCH_IMAGE="64",
@@ -158,6 +161,20 @@ def main():
             diag = next((l for l in out.stderr.splitlines()
                          if "step_time" in l), "")
             print(f"fused={fused}: {line}\n  {diag}", flush=True)
+            try:
+                values[fused] = float(json.loads(line)["value"])
+            except (ValueError, KeyError):
+                values[fused] = 0.0
+        if values.get("1", 0.0) > values.get("0", 0.0) > 0.0:
+            print(f"# FUSED WINS ({values['1']:.1f} vs "
+                  f"{values['0']:.1f} img/s) — flip "
+                  "ops/conv_bn.py MEASURED_WIN to True so the 'auto' "
+                  "default routes fused on TPU", flush=True)
+        elif values.get("0", 0.0) > 0.0:
+            print("# fused does not beat unfused at this config — "
+                  "keep MEASURED_WIN=False, iterate fusion coverage "
+                  "(stride-2 conv3x3_bn, bn3+residual epilogue)",
+                  flush=True)
 
 
 if __name__ == "__main__":

@@ -243,6 +243,65 @@ def test_conv3x3_bn_matches_reference(scale, shift, relu, rng):
                                rtol=1e-4, atol=0.1)
 
 
+@pytest.mark.parametrize("h,w_,dtype", [
+    (8, 8, jnp.float32),       # even extents, exact
+    (14, 14, jnp.bfloat16),    # the s2 stage shape at bf16
+    (9, 9, jnp.float32),       # odd: falls back to the XLA reference
+])
+def test_conv3x3_bn_stride2_matches_reference(h, w_, dtype, rng):
+    # VERDICT r4 lever: the stage-transition stride-2 3×3s run the
+    # fused kernel too (every-other-row taps via an even reshape)
+    from analytics_zoo_tpu.ops.conv_bn import _conv3_ref, conv3x3_bn
+    b, cin, cout = 2, 64, 128
+    x = jnp.asarray(rng.randn(b, h, w_, cin), dtype)
+    w = jnp.asarray(rng.randn(3, 3, cin, cout) * 0.1, dtype)
+    s = jnp.asarray(rng.rand(cin) + 0.5, jnp.float32)
+    t = jnp.asarray(rng.randn(cin), jnp.float32)
+    sh = jnp.asarray(rng.randn(cout), jnp.float32)
+    y, sm, sq = conv3x3_bn(x, w, in_scale=s, in_shift=t,
+                           relu_in=True, stat_shift=sh, stride=2)
+    ry, rsm, rsq = _conv3_ref(x, w, s, t, sh, True, True, 2)
+    assert y.shape == ((b, (h + 1) // 2, (w_ + 1) // 2, cout))
+    tol = 1e-3 if dtype == jnp.float32 else 0.25
+    np.testing.assert_allclose(
+        np.asarray(y, np.float32), np.asarray(ry, np.float32),
+        rtol=1e-2 if dtype != jnp.float32 else 1e-4, atol=tol)
+    np.testing.assert_allclose(np.asarray(sm), np.asarray(rsm),
+                               rtol=1e-2, atol=2.0)
+    np.testing.assert_allclose(np.asarray(sq), np.asarray(rsq),
+                               rtol=1e-2, atol=2.0)
+
+
+def test_conv3x3_bn_stride2_grads_match(rng):
+    from analytics_zoo_tpu.ops.conv_bn import _conv3_ref, conv3x3_bn
+    b, h, w_, cin, cout = 2, 8, 8, 64, 64
+    x = jnp.asarray(rng.randn(b, h, w_, cin), jnp.float32)
+    w = jnp.asarray(rng.randn(3, 3, cin, cout) * 0.1, jnp.float32)
+    s = jnp.asarray(rng.rand(cin) + 0.5, jnp.float32)
+    t = jnp.asarray(rng.randn(cin), jnp.float32)
+    sh = jnp.asarray(rng.randn(cout) * 0.1, jnp.float32)
+
+    def mk(fn, *extra):
+        def loss(x, w, s, t):
+            y, sm, sq = fn(x, w, s, t, *extra)
+            return (jnp.sum(y.astype(jnp.float32) * 0.3) +
+                    jnp.sum(jnp.sin(sm)) + jnp.sum(jnp.sqrt(sq + 1.0)))
+        return loss
+
+    loss_fused = mk(lambda x, w, s, t: conv3x3_bn(
+        x, w, in_scale=s, in_shift=t, relu_in=True, stat_shift=sh,
+        stride=2))
+    loss_ref = mk(lambda x, w, s, t: _conv3_ref(
+        x, w, s, t, sh, True, True, 2))
+    g1 = jax.grad(loss_fused, argnums=(0, 1, 2, 3))(x, w, s, t)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(x, w, s, t)
+    for name, a, b_ in zip("x w s t".split(), g1, g2):
+        a, b_ = np.asarray(a), np.asarray(b_)
+        tol = 2e-3 * max(float(np.abs(b_).max()), 1.0)
+        np.testing.assert_allclose(a, b_, rtol=2e-3, atol=tol,
+                                   err_msg=f"d{name}")
+
+
 def test_conv3x3_bn_grads_match(rng):
     from analytics_zoo_tpu.ops.conv_bn import _conv3_ref, conv3x3_bn
     b, h, w_, cin, cout = 2, 6, 6, 64, 64
@@ -432,9 +491,16 @@ def test_registry_resnet_fused_env(monkeypatch, tmp_path):
                         classes=10)
     assert is_fused(m) and m.fused
     monkeypatch.delenv("ZOO_TPU_FUSED_RESNET")
+    # default "auto": off-TPU (or pre-measurement) resolves unfused...
     assert not is_fused(ImageClassifier("resnet-50",
                                         input_shape=(32, 32, 3),
                                         classes=10))
+    # ...and routes fused once the measured-win gate reports true
+    monkeypatch.setenv("ZOO_TPU_FUSED_WIN", "1")
+    assert is_fused(ImageClassifier("resnet-50",
+                                    input_shape=(32, 32, 3),
+                                    classes=10))
+    monkeypatch.delenv("ZOO_TPU_FUSED_WIN")
     # explicit arg beats env; identity survives the checkpoint
     m3 = ImageClassifier("resnet-50", input_shape=(32, 32, 3),
                          classes=10, fused=True)
