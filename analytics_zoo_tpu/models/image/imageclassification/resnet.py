@@ -201,6 +201,8 @@ class FusedBottleneck(KerasLayer):
 
     def apply(self, params, x, *, training=False, rng=None):
         from analytics_zoo_tpu.ops.conv_bn import conv1x1_bn, conv3x3_bn
+        if not training:
+            return self._apply_eval(params, x), {}
         updates = {}
         mm = lambda bn: jax.lax.stop_gradient(
             params[bn]["_state"]["moving_mean"])
@@ -257,6 +259,42 @@ class FusedBottleneck(KerasLayer):
             shortcut.astype(y3.dtype), 0)
         return out, updates
 
+    def _apply_eval(self, params, x):
+        """Eval: every BN is a known moving-stats fold, so the whole
+        block runs in three kernels with NO whole-tensor elementwise
+        pass — c3's epilogue applies bn3 + residual + ReLU while the
+        output writes (`matmul_bn_apply`), and the downsample shortcut
+        folds bnd the same way. The raw y3 never exists in HBM
+        (round-4 inference lever; the training path cannot do this —
+        bn3's batch statistics only exist after the matmul)."""
+        from analytics_zoo_tpu.ops.conv_bn import (
+            conv1x1_bn_apply, conv3x3_bn_apply)
+        none = (None,) * 3
+        scale1, shift1, _ = self._bn_vectors(params["bn1"], *none,
+                                             training=False)
+        scale2, shift2, _ = self._bn_vectors(params["bn2"], *none,
+                                             training=False)
+        scale3, shift3, _ = self._bn_vectors(params["bn3"], *none,
+                                             training=False)
+        # every epilogue applies its BN fold directly — no statistics
+        # computed anywhere, no whole-tensor elementwise pass
+        z1 = conv1x1_bn_apply(x, params["c1"], out_scale=scale1,
+                              out_shift=shift1, relu_out=True)
+        z2 = conv3x3_bn_apply(z1, params["c2"], out_scale=scale2,
+                              out_shift=shift2, relu_out=True,
+                              stride=self.stride)
+        if self.downsample:
+            scaled, shiftd, _ = self._bn_vectors(params["bnd"], *none,
+                                                 training=False)
+            shortcut = conv1x1_bn_apply(
+                x, params["down"], stride=self.stride,
+                out_scale=scaled, out_shift=shiftd)
+        else:
+            shortcut = x
+        return conv1x1_bn_apply(
+            z2, params["c3"], out_scale=scale3, out_shift=shift3,
+            residual=shortcut, relu_out=True)
+
     def call(self, params, x, *, training=False, rng=None):
         y, _ = self.apply(params, x, training=training, rng=rng)
         return y
@@ -312,6 +350,55 @@ class ResNet:
         x = GlobalAveragePooling2D()(x)
         out = Dense(classes, name="fc")(x)
         return Model(inp, out, name=f"resnet{self.depth}")
+
+
+# fused param-group name ↔ unfused layer-name suffix, per block
+_FUSED_PARTS = [("c1", "_c1", "kernel"), ("c2", "_c2", "kernel"),
+                ("c3", "_c3", "kernel"), ("down", "_down", "kernel"),
+                ("bn1", "_c1_bn", None), ("bn2", "_c2_bn", None),
+                ("bn3", "_c3_bn", None), ("bnd", "_down_bn", None)]
+
+
+def convert_resnet_params(src_params: dict, dst_params: dict) -> dict:
+    """Translate a ResNet params dict BETWEEN the fused and unfused
+    layouts (same depth/stem/classes): a `FusedBottleneck` layer
+    ``s{i}b{j}`` groups exactly the per-conv/per-BN entries the
+    unfused graph keeps as separate ``s{i}b{j}_c1`` /
+    ``s{i}b{j}_c1_bn`` / … layers, so pretrained weights move across
+    layouts losslessly in either direction (the checkpoint-portability
+    contract behind the ``fused`` construction flag — an unfused-saved
+    `.model` loads into the fused TPU runtime and vice versa).
+    Non-block layers (stem, fc) copy by name. Returns a params dict
+    shaped like ``dst_params``."""
+    out = {}
+    for name, sub in dst_params.items():
+        if not jax.tree_util.tree_leaves(sub):
+            out[name] = sub     # parameterless (Activation, pooling)
+        elif name in src_params:
+            out[name] = src_params[name]            # same layout
+        elif isinstance(sub, dict) and "bn1" in sub and "c1" in sub:
+            # dst fused ← src unfused: gather the block's pieces
+            grp = {}
+            for key, suffix, leaf in _FUSED_PARTS:
+                if key not in sub:
+                    continue
+                layer = src_params[name + suffix]
+                grp[key] = layer[leaf] if leaf else layer
+            out[name] = grp
+        elif "_c" in name or "_down" in name:
+            # dst unfused ← src fused: explode the block's group
+            base, _, suffix = name.partition("_")
+            key = next(k for k, sfx, _ in _FUSED_PARTS
+                       if sfx == "_" + suffix)
+            leaf = dict(
+                (k, l) for k, _, l in _FUSED_PARTS)[key]
+            grp = src_params[base][key]
+            out[name] = {"kernel": grp} if leaf else grp
+        else:
+            raise KeyError(
+                f"layer {name!r} has no counterpart in the source "
+                "params (different depth/stem?)")
+    return out
 
 
 def resnet50(input_shape=(224, 224, 3), classes: int = 1000,

@@ -81,17 +81,12 @@ def _pick_blocks(m: int, k: int, n: int, itemsize: int = 2
     return max(bm, 128), bk
 
 
-def _kernel(x_ref, w_ref, s_ref, t_ref, sh_ref,
-            y_ref, sum_ref, sq_ref, acc_ref, *,
-            n_k: int, relu_in: bool, affine_in: bool, out_dtype):
-    """One (mi, ki) grid step. Refs:
-    x (bm, bk) input tile; w (bk, N); s/t (1, K-slice? no — (1, bk))
-    prologue scale/shift; sh (1, N) stats shift; outputs y (bm, N),
-    sum/sq (1, N) f32 accumulated across mi; acc (bm, N) f32 scratch.
-    Grid order (mi, ki): ki innermost."""
-    mi = pl.program_id(0)
-    ki = pl.program_id(1)
-
+def _prologue_accumulate(x_ref, w_ref, s_ref, t_ref, acc_ref, ki,
+                         relu_in, affine_in):
+    """The compute path SHARED by the stats (`_kernel`) and apply
+    (`_apply_kernel`) epilogues: zero the accumulator at ki==0, apply
+    the input affine+ReLU prologue in VMEM, accumulate one
+    (bm, bk)@(bk, N) MXU tap in f32."""
     @pl.when(ki == 0)
     def _init_acc():
         acc_ref[...] = jnp.zeros_like(acc_ref)
@@ -106,6 +101,20 @@ def _kernel(x_ref, w_ref, s_ref, t_ref, sh_ref,
     acc_ref[...] += jax.lax.dot_general(
         x, w_ref[...], (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
+
+
+def _kernel(x_ref, w_ref, s_ref, t_ref, sh_ref,
+            y_ref, sum_ref, sq_ref, acc_ref, *,
+            n_k: int, relu_in: bool, affine_in: bool, out_dtype):
+    """One (mi, ki) grid step. Refs:
+    x (bm, bk) input tile; w (bk, N); s/t (1, K-slice? no — (1, bk))
+    prologue scale/shift; sh (1, N) stats shift; outputs y (bm, N),
+    sum/sq (1, N) f32 accumulated across mi; acc (bm, N) f32 scratch.
+    Grid order (mi, ki): ki innermost."""
+    mi = pl.program_id(0)
+    ki = pl.program_id(1)
+    _prologue_accumulate(x_ref, w_ref, s_ref, t_ref, acc_ref, ki,
+                         relu_in, affine_in)
 
     @pl.when(ki == n_k - 1)
     def _finalize():
@@ -510,6 +519,190 @@ def matmul_bn(x: jnp.ndarray, w: jnp.ndarray,
                       relu_in, affine_in, bool(interpret))
 
 
+def _apply_kernel(x_ref, w_ref, s_ref, t_ref, os_ref, ot_ref,
+                  *rest, n_k: int, relu_in: bool,
+                  affine_in: bool, has_res: bool, relu_out: bool,
+                  out_dtype):
+    """Eval-mode variant of `_kernel`: no statistics epilogue; instead
+    the OUTPUT affine (this BN's moving-stats fold), an optional
+    residual tile, and an optional ReLU apply while the tile writes —
+    the raw conv output never exists in HBM. ``rest`` is Pallas's
+    input→output→scratch tail: ``([r_ref,] y_ref, acc_ref)``."""
+    if has_res:
+        r_ref, y_ref, acc_ref = rest
+    else:
+        y_ref, acc_ref = rest
+    ki = pl.program_id(1)
+    _prologue_accumulate(x_ref, w_ref, s_ref, t_ref, acc_ref, ki,
+                         relu_in, affine_in)
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        y = acc_ref[...] * os_ref[0, :][None, :] + \
+            ot_ref[0, :][None, :]
+        if has_res:
+            y = y + r_ref[...].astype(jnp.float32)
+        if relu_out:
+            y = jnp.maximum(y, 0.0)
+        y_ref[...] = y.astype(out_dtype)
+
+
+def _apply_ref(x, w, s, t, os_, ot, res, relu_in, affine_in,
+               relu_out):
+    """Reference expression for `matmul_bn_apply` (ground truth +
+    the autodiff backward). Accepts the affine vectors 1-D or as the
+    kernel's (1, K)/(1, N) rows."""
+    f32 = jnp.float32
+    s = None if s is None else s.reshape(-1)
+    t = None if t is None else t.reshape(-1)
+    os_ = os_.reshape(-1)
+    ot = ot.reshape(-1)
+    xf = x.astype(f32)
+    if affine_in:
+        xf = xf * s[None, :] + t[None, :]
+    if relu_in:
+        xf = jnp.maximum(xf, 0.0)
+    y = jax.lax.dot_general(xf.astype(w.dtype), w,
+                            (((1,), (0,)), ((), ())),
+                            preferred_element_type=f32)
+    y = y * os_[None, :] + ot[None, :]
+    if res is not None:
+        y = y + res.astype(f32)
+    if relu_out:
+        y = jnp.maximum(y, 0.0)
+    return y.astype(x.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10))
+def _matmul_apply(x, w, s, t, os_, ot, res, relu_in, affine_in,
+                  relu_out, interpret):
+    m, k = x.shape
+    n = w.shape[1]
+    bm, bk = _pick_blocks(
+        m, k, n, max(jnp.dtype(x.dtype).itemsize,
+                     jnp.dtype(w.dtype).itemsize))
+    has_res = res is not None
+    if m % bm:
+        pad = bm - m % bm
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+        if has_res:
+            res = jnp.pad(res, ((0, pad), (0, 0)))
+        mp = m + pad
+    else:
+        mp = m
+    n_m, n_k = mp // bm, k // bk
+    kernel = functools.partial(
+        _apply_kernel, n_k=n_k, relu_in=relu_in, affine_in=affine_in,
+        has_res=has_res, relu_out=relu_out, out_dtype=jnp.dtype(x.dtype))
+    in_specs = [
+        pl.BlockSpec((bm, bk), lambda mi, ki: (mi, ki)),
+        pl.BlockSpec((bk, n), lambda mi, ki: (ki, 0)),
+        pl.BlockSpec((1, bk), lambda mi, ki: (0, ki)),
+        pl.BlockSpec((1, bk), lambda mi, ki: (0, ki)),
+        pl.BlockSpec((1, n), lambda mi, ki: (0, 0)),
+        pl.BlockSpec((1, n), lambda mi, ki: (0, 0)),
+    ]
+    operands = [x, w, s, t, os_, ot]
+    if has_res:
+        in_specs.append(pl.BlockSpec((bm, n), lambda mi, ki: (mi, 0)))
+        operands.append(res)
+    y = pl.pallas_call(
+        kernel,
+        grid=(n_m, n_k),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((bm, n), lambda mi, ki: (mi, 0)),
+        out_shape=jax.ShapeDtypeStruct((mp, n), x.dtype),
+        scratch_shapes=[pltpu.VMEM((bm, n), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(*operands)
+    return y[:m] if mp != m else y
+
+
+def _matmul_apply_vjp_fwd(x, w, s, t, os_, ot, res, relu_in,
+                          affine_in, relu_out, interpret):
+    y = _matmul_apply(x, w, s, t, os_, ot, res, relu_in, affine_in,
+                      relu_out, interpret)
+    return y, (x, w, s, t, os_, ot, res)
+
+
+def _matmul_apply_vjp_bwd(relu_in, affine_in, relu_out, interpret,
+                          primals, dy):
+    # the apply path is an INFERENCE fold; a rare grad through it uses
+    # autodiff of the reference expression (XLA-fused, exact)
+    x, w, s, t, os_, ot, res = primals
+    if res is None:
+        def f(x, w, s, t, os_, ot):
+            return _apply_ref(x, w, s, t, os_, ot, None, relu_in,
+                              affine_in, relu_out)
+        _, vjp = jax.vjp(f, x, w, s, t, os_, ot)
+        return vjp(dy) + (None,)
+    _, vjp = jax.vjp(
+        lambda x, w, s, t, os_, ot, res: _apply_ref(
+            x, w, s, t, os_, ot, res, relu_in, affine_in, relu_out),
+        x, w, s, t, os_, ot, res)
+    return vjp(dy)
+
+
+_matmul_apply.defvjp(_matmul_apply_vjp_fwd, _matmul_apply_vjp_bwd)
+
+
+def matmul_bn_apply(x: jnp.ndarray, w: jnp.ndarray,
+                    in_scale: Optional[jnp.ndarray] = None,
+                    in_shift: Optional[jnp.ndarray] = None,
+                    relu_in: bool = False,
+                    out_scale: Optional[jnp.ndarray] = None,
+                    out_shift: Optional[jnp.ndarray] = None,
+                    residual: Optional[jnp.ndarray] = None,
+                    relu_out: bool = False,
+                    interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Inference fold of ``relu(prologue(x) @ w · out_scale +
+    out_shift + residual)`` — :func:`matmul_bn` for EVAL mode, where
+    this BN's moving-stats fold (``out_scale``/``out_shift``) is known
+    BEFORE the matmul, so the epilogue applies it (plus the residual
+    add and ReLU) while the tile writes: the raw conv output and a
+    separate whole-tensor apply pass never exist in HBM. Returns just
+    ``y (M, N)`` (no statistics — eval uses moving stats)."""
+    global invocations
+    invocations += 1
+    m, k = x.shape
+    n = w.shape[1]
+    if k % 64 or n % 64:
+        raise ValueError(f"K={k} and N={n} must be 64-multiples")
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    affine_in = in_scale is not None or in_shift is not None
+    f32 = jnp.float32
+    s_v = (in_scale.astype(f32) if in_scale is not None else
+           jnp.ones((k,), f32)).reshape(1, k)
+    t_v = (in_shift.astype(f32) if in_shift is not None else
+           jnp.zeros((k,), f32)).reshape(1, k)
+    os_v = (out_scale.astype(f32) if out_scale is not None else
+            jnp.ones((n,), f32)).reshape(1, n)
+    ot_v = (out_shift.astype(f32) if out_shift is not None else
+            jnp.zeros((n,), f32)).reshape(1, n)
+    return _matmul_apply(x, w, s_v, t_v, os_v, ot_v, residual,
+                         relu_in, affine_in, relu_out, bool(interpret))
+
+
+def conv1x1_bn_apply(x: jnp.ndarray, w: jnp.ndarray, stride: int = 1,
+                     residual: Optional[jnp.ndarray] = None,
+                     **kwargs) -> jnp.ndarray:
+    """NHWC wrapper over :func:`matmul_bn_apply` (eval fold).
+    ``residual``: (N, H', W', F), added pre-ReLU."""
+    if w.ndim == 4:
+        w = w[0, 0]
+    if stride != 1:
+        x = x[:, ::stride, ::stride, :]
+    b, h, wd, c = x.shape
+    res2 = residual.reshape(b * h * wd, w.shape[-1]) \
+        if residual is not None else None
+    y2 = matmul_bn_apply(x.reshape(b * h * wd, c), w, residual=res2,
+                         **kwargs)
+    return y2.reshape(b, h, wd, w.shape[-1])
+
+
 def conv1x1_bn(x: jnp.ndarray, w: jnp.ndarray, stride: int = 1,
                **kwargs):
     """NHWC 1×1 conv + BN statistics via :func:`matmul_bn`.
@@ -548,17 +741,14 @@ def _conv3_ref(x, w, s, t, sh, relu_in, affine_in, stride=1):
             jnp.sum(d * d, axis=(0, 1, 2)))
 
 
-def _conv3_kernel(x_ref, w_ref, s_ref, t_ref, sh_ref,
-                  y_ref, sum_ref, sq_ref, *,
-                  relu_in: bool, affine_in: bool, out_dtype,
-                  stride: int = 1):
-    """Grid (bi,): one batch tile, FULL spatial plane in VMEM — no
-    halos. Prologue (affine+ReLU) runs once on the tile; the 3×3 is
-    nine shifted (bb·Ho·Wo, Cin)@(Cin, Cout) MXU taps accumulated in
-    f32; the epilogue reduces the accumulator for the BN statistics.
-    ``stride=2`` (even H/W, SAME ⇒ pad (0,1)): each tap takes every
-    other row/column via an even reshape — no strided loads."""
-    bi = pl.program_id(0)
+def _conv3_acc(x_ref, w_ref, s_ref, t_ref, relu_in, affine_in,
+               stride):
+    """3×3-tap compute SHARED by the stats and apply conv kernels:
+    prologue (affine+ReLU) once on the full-plane tile, then the 3×3
+    as shifted (bb·Ho·Wo, Cin)@(Cin, Cout) MXU taps accumulated in
+    f32. ``stride=2`` (even H/W, SAME ⇒ pad (0,1)): each tap takes
+    every other row/column via an even reshape — no strided loads.
+    Returns (acc, bb, ho, wo, cout)."""
     xb = x_ref[...].astype(jnp.float32)
     if affine_in:
         xb = xb * s_ref[0, :] + t_ref[0, :]
@@ -593,6 +783,19 @@ def _conv3_kernel(x_ref, w_ref, s_ref, t_ref, sh_ref,
                 tap(dh, dw).reshape(bb * ho * wo, cin), w_ref[dh, dw],
                 (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
+    return acc, bb, ho, wo, cout
+
+
+def _conv3_kernel(x_ref, w_ref, s_ref, t_ref, sh_ref,
+                  y_ref, sum_ref, sq_ref, *,
+                  relu_in: bool, affine_in: bool, out_dtype,
+                  stride: int = 1):
+    """Grid (bi,): one batch tile, FULL spatial plane in VMEM — no
+    halos; the epilogue reduces the accumulator for the BN
+    statistics (compute path shared with `_conv3_apply_kernel`)."""
+    bi = pl.program_id(0)
+    acc, bb, ho, wo, cout = _conv3_acc(x_ref, w_ref, s_ref, t_ref,
+                                       relu_in, affine_in, stride)
     y_ref[...] = acc.reshape(bb, ho, wo, cout).astype(out_dtype)
     d = acc - sh_ref[0, :]
     snew = jnp.sum(d, axis=0, keepdims=True)
@@ -607,6 +810,143 @@ def _conv3_kernel(x_ref, w_ref, s_ref, t_ref, sh_ref,
     def _rest():
         sum_ref[...] += snew
         sq_ref[...] += qnew
+
+
+def _conv3_apply_kernel(x_ref, w_ref, s_ref, t_ref, os_ref, ot_ref,
+                        y_ref, *, relu_in: bool, affine_in: bool,
+                        relu_out: bool, out_dtype, stride: int = 1):
+    """Eval-mode conv3 epilogue: this BN's moving-stats fold (+ReLU)
+    applies while the tile writes — no statistics, no separate
+    whole-tensor apply pass (compute path shared with
+    `_conv3_kernel`)."""
+    acc, bb, ho, wo, cout = _conv3_acc(x_ref, w_ref, s_ref, t_ref,
+                                       relu_in, affine_in, stride)
+    y = acc * os_ref[0, :][None, :] + ot_ref[0, :][None, :]
+    if relu_out:
+        y = jnp.maximum(y, 0.0)
+    y_ref[...] = y.reshape(bb, ho, wo, cout).astype(out_dtype)
+
+
+def _conv3_apply_ref(x, w, s, t, os_, ot, relu_in, affine_in,
+                     relu_out, stride):
+    """Ground truth + autodiff backward for `conv3x3_bn_apply`."""
+    f32 = jnp.float32
+    xf = x.astype(f32)
+    if affine_in:
+        xf = xf * s.reshape(-1)[None, None, None, :] + \
+            t.reshape(-1)[None, None, None, :]
+    if relu_in:
+        xf = jnp.maximum(xf, 0.0)
+    y = jax.lax.conv_general_dilated(
+        xf.astype(x.dtype), w.astype(x.dtype),
+        window_strides=(stride, stride), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=f32)
+    y = y * os_.reshape(-1)[None, None, None, :] + \
+        ot.reshape(-1)[None, None, None, :]
+    if relu_out:
+        y = jnp.maximum(y, 0.0)
+    return y.astype(x.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10))
+def _conv3_apply(x, w, s, t, os_, ot, relu_in, affine_in, relu_out,
+                 stride, interpret):
+    b, h, wd, cin = x.shape
+    cout = w.shape[3]
+    ho, wo = h // stride, wd // stride
+    bb = _conv3_batch_tile(x.shape, cout,
+                           jnp.dtype(x.dtype).itemsize, stride)
+    return pl.pallas_call(
+        functools.partial(_conv3_apply_kernel, relu_in=relu_in,
+                          affine_in=affine_in, relu_out=relu_out,
+                          out_dtype=jnp.dtype(x.dtype), stride=stride),
+        grid=(b // bb,),
+        in_specs=[
+            pl.BlockSpec((bb, h, wd, cin), lambda bi: (bi, 0, 0, 0)),
+            pl.BlockSpec((3, 3, cin, cout), lambda bi: (0, 0, 0, 0)),
+            pl.BlockSpec((1, cin), lambda bi: (0, 0)),
+            pl.BlockSpec((1, cin), lambda bi: (0, 0)),
+            pl.BlockSpec((1, cout), lambda bi: (0, 0)),
+            pl.BlockSpec((1, cout), lambda bi: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((bb, ho, wo, cout),
+                               lambda bi: (bi, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, ho, wo, cout), x.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(x, w.astype(x.dtype), s, t, os_, ot)
+
+
+def _conv3_apply_vjp_fwd(x, w, s, t, os_, ot, relu_in, affine_in,
+                         relu_out, stride, interpret):
+    y = _conv3_apply(x, w, s, t, os_, ot, relu_in, affine_in,
+                     relu_out, stride, interpret)
+    return y, (x, w, s, t, os_, ot)
+
+
+def _conv3_apply_vjp_bwd(relu_in, affine_in, relu_out, stride,
+                         interpret, primals, dy):
+    # inference fold; a rare grad uses autodiff of the reference
+    x, w, s, t, os_, ot = primals
+    _, vjp = jax.vjp(
+        lambda x, w, s, t, os_, ot: _conv3_apply_ref(
+            x, w, s, t, os_, ot, relu_in, affine_in, relu_out,
+            stride),
+        x, w, s, t, os_, ot)
+    return vjp(dy)
+
+
+_conv3_apply.defvjp(_conv3_apply_vjp_fwd, _conv3_apply_vjp_bwd)
+
+
+def conv3x3_bn_apply(x: jnp.ndarray, w: jnp.ndarray,
+                     in_scale: Optional[jnp.ndarray] = None,
+                     in_shift: Optional[jnp.ndarray] = None,
+                     relu_in: bool = False,
+                     out_scale: Optional[jnp.ndarray] = None,
+                     out_shift: Optional[jnp.ndarray] = None,
+                     relu_out: bool = False,
+                     stride: int = 1,
+                     interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Inference fold of the 3×3: :func:`conv3x3_bn` for EVAL mode —
+    the known moving-stats fold (``out_scale``/``out_shift``) and ReLU
+    apply in the epilogue; returns just ``y``. Same constraints as
+    `conv3x3_bn`; oversized planes/odd strided extents fall back to
+    the XLA reference expression."""
+    global invocations
+    invocations += 1
+    if w.shape[:2] != (3, 3):
+        raise ValueError(f"kernel must be 3x3, got {w.shape[:2]}")
+    if stride not in (1, 2):
+        raise ValueError(f"stride must be 1 or 2, got {stride}")
+    cin, cout = w.shape[2], w.shape[3]
+    if cin % 64 or cout % 64:
+        raise ValueError(f"Cin={cin} and Cout={cout} must be "
+                         "64-multiples")
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    affine_in = in_scale is not None or in_shift is not None
+    f32 = jnp.float32
+    s_v = (in_scale.astype(f32) if in_scale is not None else
+           jnp.ones((cin,), f32))
+    t_v = (in_shift.astype(f32) if in_shift is not None else
+           jnp.zeros((cin,), f32))
+    os_v = (out_scale.astype(f32) if out_scale is not None else
+            jnp.ones((cout,), f32))
+    ot_v = (out_shift.astype(f32) if out_shift is not None else
+            jnp.zeros((cout,), f32))
+    odd = stride == 2 and (x.shape[1] % 2 or x.shape[2] % 2)
+    if odd or _conv3_batch_tile(x.shape, cout,
+                                jnp.dtype(x.dtype).itemsize,
+                                stride) is None:
+        return _conv3_apply_ref(x, w, s_v, t_v, os_v, ot_v, relu_in,
+                                affine_in, relu_out, stride)
+    return _conv3_apply(x, w, s_v.reshape(1, cin), t_v.reshape(1, cin),
+                        os_v.reshape(1, cout), ot_v.reshape(1, cout),
+                        relu_in, affine_in, relu_out, int(stride),
+                        bool(interpret))
 
 
 def _conv3_batch_tile(shape, cout, itemsize, stride=1) -> Optional[int]:

@@ -29,6 +29,8 @@ run python bench.py
 # 4. profile capture of both variants for PERF.md
 ZOO_TPU_BENCH_PROFILE_DIR=/tmp/zoo_r4_profile ZOO_TPU_BENCH_NCF=0 run python bench.py
 
-echo "### done — results in $LOG; profiles in /tmp/zoo_r4_profile" | tee -a "$LOG"
-echo "### if fused won: flip MEASURED_WIN=True in ops/conv_bn.py (the"
-echo "### 'auto' default then routes fused on TPU) and update PERF.md"
+{
+  echo "### done — results in $LOG; profiles in /tmp/zoo_r4_profile"
+  echo "### if fused won: flip MEASURED_WIN=True in ops/conv_bn.py (the"
+  echo "### 'auto' default then routes fused on TPU) and update PERF.md"
+} | tee -a "$LOG"

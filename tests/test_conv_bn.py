@@ -529,3 +529,166 @@ def test_fused_resnet50_builds_and_trains(rng):
     y = rng.randint(0, 10, size=(4, 1)).astype(np.int32)
     res = est.train(x, y, batch_size=4, nb_epoch=1)
     assert np.isfinite(res.history[-1]["loss"])
+
+
+# ---------------------------------------------------------------------------
+# matmul_bn_apply: the eval-mode epilogue fold (round 4)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("residual,relu_out,dtype", [
+    (True, True, jnp.float32),     # full block-output fold
+    (False, False, jnp.float32),   # downsample-shortcut fold
+    (True, True, jnp.bfloat16),
+])
+def test_matmul_bn_apply_matches_reference(residual, relu_out, dtype,
+                                           rng):
+    from analytics_zoo_tpu.ops.conv_bn import _apply_ref, matmul_bn_apply
+    m, k, n = 192, 128, 256
+    x = jnp.asarray(rng.randn(m, k), dtype)
+    w = jnp.asarray(rng.randn(k, n) * 0.1, dtype)
+    s = jnp.asarray(rng.rand(k) + 0.5, jnp.float32)
+    t = jnp.asarray(rng.randn(k) * 0.1, jnp.float32)
+    os_ = jnp.asarray(rng.rand(n) + 0.5, jnp.float32)
+    ot = jnp.asarray(rng.randn(n) * 0.1, jnp.float32)
+    res = jnp.asarray(rng.randn(m, n), dtype) if residual else None
+    y = matmul_bn_apply(x, w, in_scale=s, in_shift=t, relu_in=True,
+                        out_scale=os_, out_shift=ot, residual=res,
+                        relu_out=relu_out)
+    ry = _apply_ref(x, w, s, t, os_, ot, res, True, True, relu_out)
+    assert y.shape == (m, n) and y.dtype == dtype
+    tol = 1e-4 if dtype == jnp.float32 else 0.25
+    np.testing.assert_allclose(np.asarray(y, np.float32),
+                               np.asarray(ry, np.float32),
+                               rtol=1e-2, atol=tol)
+
+
+def test_matmul_bn_apply_row_padding_and_grads(rng):
+    # M not a block multiple exercises the pad/slice path; grads run
+    # the autodiff-of-reference backward
+    from analytics_zoo_tpu.ops.conv_bn import _apply_ref, matmul_bn_apply
+    m, k, n = 100, 64, 64
+    x = jnp.asarray(rng.randn(m, k), jnp.float32)
+    w = jnp.asarray(rng.randn(k, n) * 0.1, jnp.float32)
+    os_ = jnp.asarray(rng.rand(n) + 0.5, jnp.float32)
+    ot = jnp.asarray(rng.randn(n) * 0.1, jnp.float32)
+    res = jnp.asarray(rng.randn(m, n), jnp.float32)
+    y = matmul_bn_apply(x, w, out_scale=os_, out_shift=ot,
+                        residual=res, relu_out=True)
+    ry = _apply_ref(x, w, None, None, os_, ot, res, False, False, True)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ry),
+                               rtol=1e-4, atol=1e-4)
+
+    def loss_k(x, w, res):
+        return jnp.sum(matmul_bn_apply(
+            x, w, out_scale=os_, out_shift=ot, residual=res,
+            relu_out=True) ** 2)
+
+    def loss_r(x, w, res):
+        return jnp.sum(_apply_ref(x, w, None, None, os_, ot, res,
+                                  False, False, True) ** 2)
+
+    g1 = jax.grad(loss_k, argnums=(0, 1, 2))(x, w, res)
+    g2 = jax.grad(loss_r, argnums=(0, 1, 2))(x, w, res)
+    for name, a, b_ in zip("x w res".split(), g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-3, atol=1e-2,
+                                   err_msg=f"d{name}")
+
+
+def test_fused_bottleneck_eval_single_kernel_output(rng):
+    # eval mode: block output comes straight from the c3 epilogue —
+    # matches the training-structured eval math (moving stats)
+    from analytics_zoo_tpu.models.image.imageclassification.resnet \
+        import FusedBottleneck
+    blk = FusedBottleneck(64, stride=2, downsample=True,
+                          input_shape=(8, 8, 64))
+    params = blk.build(jax.random.PRNGKey(0), (8, 8, 64))
+    # distinctive moving stats so the fold actually matters
+    for bn in ("bn1", "bn2", "bn3", "bnd"):
+        st = params[bn]["_state"]
+        st["moving_mean"] = jnp.asarray(
+            rng.randn(*st["moving_mean"].shape) * 0.1, jnp.float32)
+        st["moving_var"] = jnp.asarray(
+            rng.rand(*st["moving_var"].shape) + 0.5, jnp.float32)
+    x = jnp.asarray(rng.randn(2, 8, 8, 64), jnp.float32)
+    y, upd = blk.apply(params, x, training=False)
+    assert upd == {} and y.shape == (2, 4, 4, 256)
+    # ground truth: the explicit moving-stats expression
+    from analytics_zoo_tpu.ops.conv_bn import _conv3_ref
+    from analytics_zoo_tpu.pipeline.api.keras.layers.normalization \
+        import bn_fold
+
+    def fold(bn):
+        st = params[bn]["_state"]
+        return bn_fold(st["moving_mean"], st["moving_var"],
+                       params[bn]["gamma"], params[bn]["beta"],
+                       blk.epsilon)
+
+    s1, t1 = fold("bn1")
+    s2, t2 = fold("bn2")
+    s3, t3 = fold("bn3")
+    sd, td = fold("bnd")
+    y1 = jax.lax.conv_general_dilated(
+        x, params["c1"], (1, 1), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    z1 = jnp.maximum(y1 * s1 + t1, 0)
+    y2 = jax.lax.conv_general_dilated(
+        z1, params["c2"], (2, 2), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    z2 = jnp.maximum(y2 * s2 + t2, 0)
+    y3 = jax.lax.conv_general_dilated(
+        z2, params["c3"], (1, 1), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    sc = jax.lax.conv_general_dilated(
+        x, params["down"], (2, 2), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC")) * sd + td
+    want = jnp.maximum(y3 * s3 + t3 + sc, 0)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(want),
+                               rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize("stride", [1, 2])
+def test_conv3x3_bn_apply_matches_reference(stride, rng):
+    from analytics_zoo_tpu.ops.conv_bn import (_conv3_apply_ref,
+                                               conv3x3_bn_apply)
+    b, h, w_, cin, cout = 2, 8, 8, 64, 64
+    x = jnp.asarray(rng.randn(b, h, w_, cin), jnp.float32)
+    w = jnp.asarray(rng.randn(3, 3, cin, cout) * 0.1, jnp.float32)
+    os_ = jnp.asarray(rng.rand(cout) + 0.5, jnp.float32)
+    ot = jnp.asarray(rng.randn(cout) * 0.1, jnp.float32)
+    y = conv3x3_bn_apply(x, w, out_scale=os_, out_shift=ot,
+                         relu_out=True, stride=stride)
+    ry = _conv3_apply_ref(x, w, None, None, os_, ot, False, False,
+                          True, stride)
+    assert y.shape == (b, h // stride, w_ // stride, cout)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ry),
+                               rtol=1e-4, atol=1e-3)
+    # grad through the fold routes to the autodiff-of-reference bwd
+    g = jax.grad(lambda a: jnp.sum(conv3x3_bn_apply(
+        a, w, out_scale=os_, out_shift=ot, relu_out=True,
+        stride=stride) ** 2))(x)
+    gr = jax.grad(lambda a: jnp.sum(_conv3_apply_ref(
+        a, w, None, None, os_, ot, False, False, True,
+        stride) ** 2))(x)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gr),
+                               rtol=1e-3, atol=1e-2)
+
+
+def test_convert_resnet_params_round_trip(rng):
+    # pretrained weights move losslessly between the fused and
+    # unfused layouts in both directions (the checkpoint-portability
+    # contract behind the `fused` construction flag)
+    from analytics_zoo_tpu.models.image.imageclassification.resnet \
+        import convert_resnet_params, resnet50
+    fused = resnet50(input_shape=(32, 32, 3), classes=10, fused=True)
+    unfused = resnet50(input_shape=(32, 32, 3), classes=10,
+                       fused=False)
+    fp = fused.init_params()
+    up = convert_resnet_params(fp, unfused.init_params())
+    fp2 = convert_resnet_params(up, fp)
+    flat1 = jax.tree_util.tree_leaves_with_path(fp)
+    flat2 = jax.tree_util.tree_leaves_with_path(fp2)
+    assert len(flat1) == len(flat2)
+    for (p1, l1), (p2, l2) in zip(flat1, flat2):
+        assert p1 == p2
+        np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
