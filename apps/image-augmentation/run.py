@@ -1,0 +1,110 @@
+"""Image-augmentation app (reference
+`apps/image-augmentation/image-augmentation.ipynb`): the notebook
+walks every image transformer over one test image and displays each
+result; this runs the same gallery through `feature.image` —
+ImageSet.read → transformer → written PNG per step — plus the chained
+random pipeline the training recipes use.
+
+Pass ``--image`` for a real photo; omitted, a synthetic scene is
+generated so the app runs offline. Outputs land in ``--out-dir``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import tempfile
+
+import numpy as np
+
+
+def synth_image(path: str, rng) -> None:
+    from PIL import Image
+    h, w = 240, 320
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+    img = np.stack([
+        120 + 80 * np.sin(2 * np.pi * xx / w),
+        100 + 60 * np.cos(2 * np.pi * yy / h),
+        140 + 50 * np.sin(2 * np.pi * (xx + yy) / (h + w)),
+    ], -1) + rng.randn(h, w, 3) * 8
+    Image.fromarray(np.clip(img, 0, 255).astype(np.uint8)).save(path)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--image", default=None,
+                   help="input image path (local or fsspec scheme); "
+                        "omit for a synthetic test image")
+    p.add_argument("--out-dir", default=None,
+                   help="where the per-transformer PNGs go "
+                        "(default: a temp dir)")
+    args = p.parse_args(argv)
+
+    from PIL import Image
+
+    from analytics_zoo_tpu import init_nncontext
+    from analytics_zoo_tpu.feature.common import ChainedPreprocessing
+    from analytics_zoo_tpu.feature.image import ImageSet
+    from analytics_zoo_tpu.feature.image import transforms as T
+
+    init_nncontext(seed=0)
+    rng = np.random.RandomState(0)
+    path = args.image
+    if path is None:
+        path = os.path.join(tempfile.mkdtemp(prefix="aug_"),
+                            "test.png")
+        synth_image(path, rng)
+    out_dir = args.out_dir or tempfile.mkdtemp(prefix="aug_out_")
+    os.makedirs(out_dir, exist_ok=True)
+
+    # the notebook's gallery, one transformer at a time
+    gallery = [
+        ("brightness", T.ImageBrightness(0.0, 32.0, seed=0)),
+        ("hue", T.ImageHue(-18.0, 18.0, seed=0)),
+        ("saturation", T.ImageSaturation(10.0, 20.0, seed=0)),
+        ("channel_order", T.ImageChannelOrder()),
+        ("color_jitter", T.ImageColorJitter(seed=0)),
+        ("resize", T.ImageResize(300, 300)),
+        ("aspect_scale", T.ImageAspectScale(200, max_size=3000)),
+        ("random_aspect_scale",
+         T.ImageRandomAspectScale([100, 300], max_size=3000, seed=0)),
+        ("channel_normalize",
+         T.ImageChannelNormalize(20.0, 30.0, 40.0, 2.0, 3.0, 4.0)),
+        ("center_crop", T.ImageCenterCrop(200, 200)),
+        ("random_crop", T.ImageRandomCrop(200, 200, seed=0)),
+        ("fixed_crop", T.ImageFixedCrop(0.0, 0.0, 200.0, 200.0,
+                                        normalized=False)),
+        ("filler", T.ImageFiller(0.0, 0.0, 0.5, 0.5, 255)),
+        ("expand", T.ImageExpand(means=(123, 117, 104),
+                                 max_expand_ratio=2.0, seed=0)),
+        ("hflip", T.ImageHFlip()),
+    ]
+    written = []
+    for name, tr in gallery:
+        iset = ImageSet.read(path).transform(tr)
+        img = np.asarray(iset.features[0].image)
+        if img.dtype != np.uint8:      # normalized outputs: rescale
+            lo, hi = float(img.min()), float(img.max())
+            img = ((img - lo) / (hi - lo + 1e-8) * 255).astype(
+                np.uint8)
+        dest = os.path.join(out_dir, f"{name}.png")
+        Image.fromarray(img).save(dest)
+        written.append((name, img.shape))
+        print(f"{name:22s} -> {img.shape}")
+
+    # the chained random pipeline (what a training recipe composes)
+    chain = ChainedPreprocessing([
+        T.ImageBrightness(seed=0), T.ImageHFlip(),
+        T.ImageResize(256, 256), T.ImageRandomCrop(224, 224, seed=0),
+    ])
+    out = ImageSet.read(path).transform(chain)
+    shape = np.asarray(out.features[0].image).shape
+    print(f"{'chained pipeline':22s} -> {shape}")
+    assert shape[:2] == (224, 224)
+    assert len(written) == len(gallery)
+    print(f"{len(written) + 1} outputs in {out_dir}")
+    return out_dir
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,80 @@
+"""Object-detection app (reference `apps/object-detection/
+object-detection.ipynb`): load an SSD detector from the model zoo,
+run batched detection over an image set, and write box-annotated
+images with the `Visualizer` (the notebook's visualize cells).
+
+Random weights + synthetic images by default so the app runs offline
+(no pretrained-zoo download here); point ``--weights`` at a trained
+checkpoint and raise ``--conf`` for real detections. The detection
+recipe itself mirrors `analytics_zoo_tpu/examples/
+object_detection.py` (reference `pyzoo/zoo/examples/objectdetection/
+predict.py`)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import tempfile
+
+import numpy as np
+
+VOC_CLASSES = [
+    "aeroplane", "bicycle", "bird", "boat", "bottle", "bus", "car",
+    "cat", "chair", "cow", "diningtable", "dog", "horse", "motorbike",
+    "person", "pottedplant", "sheep", "sofa", "train", "tvmonitor"]
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model", default="ssd-vgg16-300x300")
+    p.add_argument("--weights", default=None,
+                   help="trained .model checkpoint")
+    p.add_argument("--images", type=int, default=2)
+    p.add_argument("--conf", type=float, default=0.05,
+                   help="random weights score low; raise for a "
+                        "trained checkpoint")
+    p.add_argument("--out-dir", default=None)
+    args = p.parse_args(argv)
+
+    from PIL import Image
+
+    from analytics_zoo_tpu import init_nncontext
+    from analytics_zoo_tpu.models.image.objectdetection import (
+        ObjectDetector, Visualizer)
+
+    init_nncontext(seed=0)
+    rng = np.random.RandomState(0)
+    out_dir = args.out_dir or tempfile.mkdtemp(prefix="objdet_")
+    os.makedirs(out_dir, exist_ok=True)
+
+    detector = ObjectDetector(args.model)
+    if args.weights:
+        detector.model.load_weights(args.weights)
+    else:
+        detector.compile()   # random weights: demonstrates the flow
+    size = detector.img_size
+    images = rng.rand(args.images, size, size, 3).astype(np.float32)
+    results = detector.detect(images, batch_size=args.images,
+                              conf_threshold=args.conf)
+
+    viz = Visualizer(VOC_CLASSES, score_threshold=args.conf)
+    n_boxes = 0
+    for i, dets in enumerate(results):
+        annotated = viz.draw(
+            (images[i] * 255).astype(np.uint8), dets)
+        dest = os.path.join(out_dir, f"det_{i}.png")
+        Image.fromarray(annotated).save(dest)
+        n_boxes += len(dets)
+        print(f"image {i}: {len(dets)} detections -> {dest}")
+        for d in dets[:3]:
+            name = (VOC_CLASSES[d.class_id]
+                    if d.class_id < len(VOC_CLASSES)
+                    else str(d.class_id))
+            print(f"  {name} score={d.score:.3f} "
+                  f"box={np.round(d.box, 3).tolist()}")
+    print(f"{n_boxes} boxes over {args.images} images in {out_dir}")
+    return n_boxes
+
+
+if __name__ == "__main__":
+    main()

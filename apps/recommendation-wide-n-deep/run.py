@@ -1,0 +1,24 @@
+"""Wide&Deep recommendation app (reference
+`apps/recommendation-wide-n-deep/wide_n_deep.ipynb`): the ml-1m
+workflow — feature assembly (wide base/cross, indicators, id
+embeddings, continuous age), `WideAndDeep` training with Adam +
+class_nll, then `predict_user_item_pair` / `recommend_for_user` /
+`recommend_for_item`.
+
+The full recipe lives in
+`analytics_zoo_tpu/examples/wide_and_deep.py` (reference
+`Ml1mWideAndDeep.scala`); this app drives it at tutorial scale and
+reports the ranking surfaces, with every knob exposed."""
+
+from __future__ import annotations
+
+import sys
+
+
+def main(argv=None):
+    from analytics_zoo_tpu.examples.wide_and_deep import main as run
+    return run(argv if argv is not None else sys.argv[1:])
+
+
+if __name__ == "__main__":
+    main()

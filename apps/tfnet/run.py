@@ -1,0 +1,141 @@
+"""TFNet app (reference `apps/tfnet/image_classification_inference.ipynb`):
+the notebook exports a pretrained slim Inception-v1 with `export_tf`,
+wraps it in `TFNet` for distributed inference, then re-exports the
+graph CUT AT THE POOLING LAYER and trains a new classifier head on
+those embeddings (DLClassifier pipeline).
+
+This app runs the same three stages offline and TPU-natively:
+  1. a TF-authored CNN (stand-in for the slim checkpoint) is trained
+     briefly in TF on synthetic data, frozen to a GraphDef;
+  2. `TFNet.from_frozen_graph` executes it — the graph becomes one
+     XLA program — and its predictions must agree with TF eager;
+  3. the frozen graph cut at the pool layer yields embeddings, and an
+     `NNClassifier` trains a new head on them (the transfer-learning
+     workflow).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import tempfile
+
+import numpy as np
+
+
+def synth_images(n, size, rng):
+    """Two classes separated by color statistics + stripe frequency."""
+    y = rng.randint(0, 2, n)
+    base = np.where(y[:, None, None, None] == 0, 0.3, 0.7)
+    yy = np.arange(size)[None, :, None, None]
+    stripes = 0.2 * np.sin(2 * np.pi * (y[:, None, None, None] + 1) *
+                           yy / size)
+    x = base + stripes + rng.randn(n, size, size, 3) * 0.05
+    return x.astype(np.float32), y.astype(np.int64)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--samples", type=int, default=256)
+    p.add_argument("--image-size", type=int, default=24)
+    p.add_argument("--tf-epochs", type=int, default=3)
+    p.add_argument("--head-epochs", type=int, default=10)
+    p.add_argument("--batch-size", type=int, default=32)
+    args = p.parse_args(argv)
+
+    import tensorflow as tf
+
+    from analytics_zoo_tpu import init_nncontext
+    from analytics_zoo_tpu.feature.common import SeqToTensor
+    from analytics_zoo_tpu.pipeline.api.net import TFNet
+    from analytics_zoo_tpu.pipeline.nnframes import NNClassifier
+
+    init_nncontext(seed=0)
+    rng = np.random.RandomState(0)
+    size = args.image_size
+    x, y = synth_images(args.samples, size, rng)
+
+    # -- 1. the "pretrained" TF model (trained here since no download)
+    tf.keras.utils.set_random_seed(0)
+    backbone = tf.keras.Sequential([
+        tf.keras.layers.Conv2D(16, 3, activation="relu",
+                               padding="same",
+                               input_shape=(size, size, 3)),
+        tf.keras.layers.MaxPooling2D(2),
+        tf.keras.layers.Conv2D(32, 3, activation="relu",
+                               padding="same"),
+        tf.keras.layers.GlobalAveragePooling2D(name="pool"),
+    ])
+    model = tf.keras.Sequential(
+        [backbone, tf.keras.layers.Dense(2, name="logits")])
+    model.compile(optimizer="adam",
+                  loss=tf.keras.losses.SparseCategoricalCrossentropy(
+                      from_logits=True))
+    model.fit(x, y, batch_size=args.batch_size,
+              epochs=args.tf_epochs, verbose=0)
+
+    # freeze (the notebook's export_tf) to a .pb
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2)
+    f = tf.function(lambda img: model(img, training=False))
+    cf = f.get_concrete_function(
+        tf.TensorSpec([None, size, size, 3], tf.float32))
+    frozen = convert_variables_to_constants_v2(cf)
+    gd = frozen.graph.as_graph_def()
+    export_dir = tempfile.mkdtemp(prefix="tfnet_")
+    pb = os.path.join(export_dir, "frozen.pb")
+    with open(pb, "wb") as fh:
+        fh.write(gd.SerializeToString())
+    in_name = frozen.inputs[0].name
+    out_name = frozen.outputs[0].name
+    print(f"frozen graph -> {pb} ({len(gd.node)} nodes, "
+          f"{in_name} -> {out_name})")
+
+    # -- 2. TFNet inference: one XLA program, must agree with TF
+    net = TFNet.from_frozen_graph(pb, inputs=[in_name],
+                                  outputs=[out_name])
+    preds = net.predict(x[:64], batch_size=args.batch_size)
+    want = model(x[:64]).numpy()
+    np.testing.assert_allclose(preds, want, atol=1e-4)
+    acc = float((np.argmax(preds, -1) == y[:64]).mean())
+    print(f"TFNet inference agrees with TF eager; accuracy={acc:.3f}")
+
+    # -- 3. cut at the pool layer -> embeddings -> new NNClassifier
+    # head (the notebook's transfer-learning part)
+    f_pool = tf.function(lambda img: backbone(img, training=False))
+    cf_pool = f_pool.get_concrete_function(
+        tf.TensorSpec([None, size, size, 3], tf.float32))
+    frozen_pool = convert_variables_to_constants_v2(cf_pool)
+    pb_pool = os.path.join(export_dir, "frozen_pool.pb")
+    with open(pb_pool, "wb") as fh:
+        fh.write(frozen_pool.graph.as_graph_def().SerializeToString())
+    emb_net = TFNet.from_frozen_graph(
+        pb_pool, inputs=[frozen_pool.inputs[0].name],
+        outputs=[frozen_pool.outputs[0].name])
+    emb = emb_net.predict(x, batch_size=args.batch_size)
+    print(f"pool embeddings: {emb.shape}")
+
+    import pandas as pd
+
+    from analytics_zoo_tpu.pipeline.api.keras import (
+        Sequential as ZSequential, layers as L)
+    head = ZSequential()
+    head.add(L.Dense(2, activation="softmax",
+                     input_shape=(emb.shape[1],)))
+    df = pd.DataFrame({"features": [e for e in emb],
+                       "label": y.astype(np.float64)})
+    clf = (NNClassifier(head, "sparse_categorical_crossentropy",
+                        SeqToTensor((emb.shape[1],)))
+           .set_batch_size(args.batch_size)
+           .set_max_epoch(args.head_epochs)
+           .set_learning_rate(0.05))
+    nn_model = clf.fit(df)
+    out = nn_model.transform(df)
+    head_acc = float((out["prediction"] == out["label"]).mean())
+    print(f"transfer head accuracy on embeddings: {head_acc:.3f}")
+    assert head_acc > 0.8
+    return head_acc
+
+
+if __name__ == "__main__":
+    main()

@@ -57,3 +57,37 @@ def test_app_fraud_detection():
 def test_app_image_similarity():
     _run("image-similarity",
          ["--per-class", "10", "--epochs", "15", "--image-size", "24"])
+
+
+def test_app_image_augmentation(tmp_path):
+    _run("image-augmentation", ["--out-dir", str(tmp_path)])
+    assert len(list(tmp_path.glob("*.png"))) >= 15
+
+
+def test_app_image_augmentation_3d(tmp_path):
+    _run("image-augmentation-3d", ["--out-dir", str(tmp_path)])
+    assert len(list(tmp_path.glob("*.png"))) == 4
+
+
+def test_app_tfnet():
+    _run("tfnet", ["--samples", "96", "--tf-epochs", "2",
+                   "--head-epochs", "8", "--image-size", "20"])
+
+
+def test_app_variational_autoencoder(tmp_path):
+    _run("variational-autoencoder",
+         ["--samples", "96", "--epochs", "2", "--batch-size", "32",
+          "--image-size", "24", "--out-dir", str(tmp_path)])
+    assert len(list(tmp_path.glob("epoch_*.png"))) == 2
+
+
+def test_app_recommendation_wide_n_deep():
+    _run("recommendation-wide-n-deep",
+         ["--samples", "1024", "--epochs", "2", "--batch-size", "256",
+          "--users", "50", "--items", "40"])
+
+
+def test_app_object_detection(tmp_path):
+    _run("object-detection",
+         ["--images", "1", "--out-dir", str(tmp_path)])
+    assert len(list(tmp_path.glob("det_*.png"))) == 1
