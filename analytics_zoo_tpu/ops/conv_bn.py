@@ -82,11 +82,15 @@ def _pick_blocks(m: int, k: int, n: int, itemsize: int = 2
 
 
 def _prologue_accumulate(x_ref, w_ref, s_ref, t_ref, acc_ref, ki,
-                         relu_in, affine_in):
+                         relu_in, affine_in, r_ref=None):
     """The compute path SHARED by the stats (`_kernel`) and apply
     (`_apply_kernel`) epilogues: zero the accumulator at ki==0, apply
-    the input affine+ReLU prologue in VMEM, accumulate one
-    (bm, bk)@(bk, N) MXU tap in f32."""
+    the input affine (+ optional residual tile) + ReLU prologue in
+    VMEM, accumulate one (bm, bk)@(bk, N) MXU tap in f32. The
+    residual adds AFTER the affine, BEFORE the ReLU — the form of a
+    deferred bottleneck output ``relu(y3·scale3+shift3 + shortcut)``
+    consumed by the NEXT block's 1×1 (the round-5 deferred-apply
+    lever)."""
     @pl.when(ki == 0)
     def _init_acc():
         acc_ref[...] = jnp.zeros_like(acc_ref)
@@ -95,6 +99,8 @@ def _prologue_accumulate(x_ref, w_ref, s_ref, t_ref, acc_ref, ki,
     if affine_in:
         x = x.astype(jnp.float32) * s_ref[0, :][None, :] + \
             t_ref[0, :][None, :]
+    if r_ref is not None:
+        x = x.astype(jnp.float32) + r_ref[...].astype(jnp.float32)
     if relu_in:
         x = jnp.maximum(x, 0.0)
     x = x.astype(w_ref.dtype)
@@ -103,18 +109,24 @@ def _prologue_accumulate(x_ref, w_ref, s_ref, t_ref, acc_ref, ki,
         preferred_element_type=jnp.float32)
 
 
-def _kernel(x_ref, w_ref, s_ref, t_ref, sh_ref,
-            y_ref, sum_ref, sq_ref, acc_ref, *,
-            n_k: int, relu_in: bool, affine_in: bool, out_dtype):
+def _kernel(x_ref, w_ref, s_ref, t_ref, sh_ref, *rest,
+            n_k: int, relu_in: bool, affine_in: bool, has_res: bool,
+            out_dtype):
     """One (mi, ki) grid step. Refs:
-    x (bm, bk) input tile; w (bk, N); s/t (1, K-slice? no — (1, bk))
-    prologue scale/shift; sh (1, N) stats shift; outputs y (bm, N),
-    sum/sq (1, N) f32 accumulated across mi; acc (bm, N) f32 scratch.
+    x (bm, bk) input tile; w (bk, N); s/t (1, bk) prologue
+    scale/shift; sh (1, N) stats shift; ``rest`` is Pallas's
+    input→output→scratch tail ``([r (bm, bk),] y (bm, N), sum/sq
+    (1, N) f32 accumulated across mi, acc (bm, N) f32 scratch)``.
     Grid order (mi, ki): ki innermost."""
+    if has_res:
+        r_ref, y_ref, sum_ref, sq_ref, acc_ref = rest
+    else:
+        r_ref = None
+        y_ref, sum_ref, sq_ref, acc_ref = rest
     mi = pl.program_id(0)
     ki = pl.program_id(1)
     _prologue_accumulate(x_ref, w_ref, s_ref, t_ref, acc_ref, ki,
-                         relu_in, affine_in)
+                         relu_in, affine_in, r_ref=r_ref)
 
     @pl.when(ki == n_k - 1)
     def _finalize():
@@ -133,33 +145,43 @@ def _kernel(x_ref, w_ref, s_ref, t_ref, sh_ref,
             sq_ref[...] += jnp.sum(d * d, axis=0, keepdims=True)
 
 
-def _matmul_bn_fwd_pallas(x, w, s, t, sh, relu_in, affine_in,
+def _matmul_bn_fwd_pallas(x, w, s, t, sh, r, relu_in, affine_in,
                           interpret):
     m, k = x.shape
     n = w.shape[1]
-    bm, bk = _pick_blocks(
-        m, k, n, max(jnp.dtype(x.dtype).itemsize,
-                     jnp.dtype(w.dtype).itemsize))
+    has_res = r is not None
+    isz = max(jnp.dtype(x.dtype).itemsize,
+              jnp.dtype(w.dtype).itemsize)
+    # the residual adds a second (bm, bk) double-buffered input tile:
+    # doubling the x-tile itemsize keeps the budget formula honest
+    bm, bk = _pick_blocks(m, k, n, isz * 2 if has_res else isz)
     if m % bm:                       # pad rows to a block multiple
         pad = bm - m % bm
         x = jnp.pad(x, ((0, pad), (0, 0)))
+        if has_res:
+            r = jnp.pad(r, ((0, pad), (0, 0)))
         mp = m + pad
     else:
         mp = m
     n_m, n_k = mp // bm, k // bk
     kernel = functools.partial(
         _kernel, n_k=n_k, relu_in=relu_in, affine_in=affine_in,
-        out_dtype=jnp.dtype(x.dtype))
+        has_res=has_res, out_dtype=jnp.dtype(x.dtype))
+    in_specs = [
+        pl.BlockSpec((bm, bk), lambda mi, ki: (mi, ki)),
+        pl.BlockSpec((bk, n), lambda mi, ki: (ki, 0)),
+        pl.BlockSpec((1, bk), lambda mi, ki: (0, ki)),
+        pl.BlockSpec((1, bk), lambda mi, ki: (0, ki)),
+        pl.BlockSpec((1, n), lambda mi, ki: (0, 0)),
+    ]
+    operands = [x, w, s, t, sh]
+    if has_res:
+        in_specs.append(pl.BlockSpec((bm, bk), lambda mi, ki: (mi, ki)))
+        operands.append(r)
     y, ssum, ssq = pl.pallas_call(
         kernel,
         grid=(n_m, n_k),
-        in_specs=[
-            pl.BlockSpec((bm, bk), lambda mi, ki: (mi, ki)),
-            pl.BlockSpec((bk, n), lambda mi, ki: (ki, 0)),
-            pl.BlockSpec((1, bk), lambda mi, ki: (0, ki)),
-            pl.BlockSpec((1, bk), lambda mi, ki: (0, ki)),
-            pl.BlockSpec((1, n), lambda mi, ki: (0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((bm, n), lambda mi, ki: (mi, 0)),
             pl.BlockSpec((1, n), lambda mi, ki: (0, 0)),
@@ -174,10 +196,11 @@ def _matmul_bn_fwd_pallas(x, w, s, t, sh, relu_in, affine_in,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret,
-    )(x, w, s, t, sh)
+    )(*operands)
     if mp != m:
         # padded (all-zero) input rows still produce a nonzero output
-        # row when the prologue has a shift/ReLU: y0 = prologue(0) @ w.
+        # row when the prologue has a shift/ReLU: y0 = prologue(0) @ w
+        # (the residual pads with ZEROS, so row0 is unchanged by it).
         # Subtract their exact statistics contribution.
         extra = jnp.float32(mp - m)
         if affine_in:
@@ -199,30 +222,38 @@ def _matmul_bn_fwd_pallas(x, w, s, t, sh, relu_in, affine_in,
     return y, ssum[0], ssq[0]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
-def _matmul_bn(x, w, s, t, sh, relu_in, affine_in, interpret):
-    return _matmul_bn_fwd_pallas(x, w, s, t, sh, relu_in, affine_in,
-                                 interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8))
+def _matmul_bn(x, w, s, t, sh, r, relu_in, affine_in, interpret):
+    return _matmul_bn_fwd_pallas(x, w, s, t, sh, r, relu_in,
+                                 affine_in, interpret)
 
 
-def _matmul_bn_vjp_fwd(x, w, s, t, sh, relu_in, affine_in, interpret):
-    out = _matmul_bn_fwd_pallas(x, w, s, t, sh, relu_in, affine_in,
+def _matmul_bn_vjp_fwd(x, w, s, t, sh, r, relu_in, affine_in,
+                       interpret):
+    out = _matmul_bn_fwd_pallas(x, w, s, t, sh, r, relu_in, affine_in,
                                 interpret)
     y, _, _ = out
-    return out, (x, w, s, t, sh, y)
+    return out, (x, w, s, t, sh, r, y)
 
 
 def _matmul_bn_vjp_bwd(relu_in, affine_in, interpret, res, cots):
-    x, w, s, t, sh, y = res
+    x, w, s, t, sh, r, y = res
     dy, dsum, dsq = cots
-    if os.environ.get("ZOO_TPU_CONV_BN_PALLAS_BWD", "1") == "1":
-        return _bwd_pallas(x, w, s, t, sh, y, dy, dsum, dsq,
-                           relu_in, affine_in, interpret)
+    if r is None:
+        if os.environ.get("ZOO_TPU_CONV_BN_PALLAS_BWD", "1") == "1":
+            return _bwd_pallas(x, w, s, t, sh, y, dy, dsum, dsq,
+                               relu_in, affine_in, interpret) + (None,)
+        return _bwd_jax(x, w, s, t, sh, y, dy, dsum, dsq,
+                        relu_in, affine_in) + (None,)
+    # residual prologue: the XLA backward (the Pallas bwd kernels
+    # don't carry the extra r tile yet — extend when the
+    # deferred-apply lever is measured worth it)
     return _bwd_jax(x, w, s, t, sh, y, dy, dsum, dsq,
-                    relu_in, affine_in)
+                    relu_in, affine_in, r=r)
 
 
-def _bwd_jax(x, w, s, t, sh, y, dy, dsum, dsq, relu_in, affine_in):
+def _bwd_jax(x, w, s, t, sh, y, dy, dsum, dsq, relu_in, affine_in,
+             r=None):
     """XLA-expressed backward (the `ZOO_TPU_CONV_BN_PALLAS_BWD=0`
     reference path, and the ground truth the Pallas backward is
     conformance-tested against)."""
@@ -237,6 +268,8 @@ def _bwd_jax(x, w, s, t, sh, y, dy, dsum, dsq, relu_in, affine_in):
         xa = x.astype(f32) * s[0, :][None, :] + t[0, :][None, :]
     else:
         xa = x.astype(f32)
+    if r is not None:
+        xa = xa + r.astype(f32)
     xp = jnp.maximum(xa, 0.0) if relu_in else xa
     # backward matmuls run in the forward's compute dtype (bf16 on the
     # MXU) with f32 accumulation — mixed-precision standard; only the
@@ -259,9 +292,12 @@ def _bwd_jax(x, w, s, t, sh, y, dy, dsum, dsq, relu_in, affine_in):
         dx = dxp
         ds = jnp.zeros_like(s)
         dt = jnp.zeros_like(t)
-    return (dx.astype(x.dtype), dw.astype(w.dtype),
+    base = (dx.astype(x.dtype), dw.astype(w.dtype),
             ds.astype(s.dtype), dt.astype(t.dtype),
             jnp.zeros_like(sh))
+    # 5-tuple without r (matching _bwd_pallas and its fallbacks into
+    # this function); 6-tuple with the residual cotangent otherwise
+    return base if r is None else base + (dxp.astype(r.dtype),)
 
 
 def _g_tile(dy, y, sh_row, dsum_row, dsq_row):
@@ -481,9 +517,10 @@ def matmul_bn(x: jnp.ndarray, w: jnp.ndarray,
               in_shift: Optional[jnp.ndarray] = None,
               relu_in: bool = False,
               stat_shift: Optional[jnp.ndarray] = None,
+              in_residual: Optional[jnp.ndarray] = None,
               interpret: Optional[bool] = None):
-    """Fused ``relu(x·in_scale+in_shift) @ w`` with BN-statistics
-    epilogue.
+    """Fused ``relu(x·in_scale+in_shift [+ in_residual]) @ w`` with
+    BN-statistics epilogue.
 
     x: (M, K); w: (K, N) — K, N must be 64-multiples (128 preferred:
     the native lane width; 64 covers ResNet's stage-0 convs via lane
@@ -494,7 +531,12 @@ def matmul_bn(x: jnp.ndarray, w: jnp.ndarray,
 
     `in_scale`/`in_shift` (K,): previous-BN folded apply on the input,
     in VMEM (skip both for a raw matmul); ``relu_in`` applies ReLU
-    after the affine. Differentiable in x, w, in_scale, in_shift.
+    after the affine. ``in_residual`` (M, K) adds after the affine,
+    before the ReLU — the shape of a DEFERRED bottleneck output
+    ``relu(y3·scale3+shift3 + shortcut)`` consumed here instead of
+    being materialized by its own whole-tensor pass (the round-5
+    deferred-apply lever; with a residual the backward runs the XLA
+    path). Differentiable in x, w, in_scale, in_shift, in_residual.
     """
     global invocations
     invocations += 1
@@ -504,6 +546,9 @@ def matmul_bn(x: jnp.ndarray, w: jnp.ndarray,
         # 128 is the native lane width; 64 still compiles (Mosaic pads
         # lanes) and covers ResNet's stage-0 64-channel convs
         raise ValueError(f"K={k} and N={n} must be 64-multiples")
+    if in_residual is not None and in_residual.shape != (m, k):
+        raise ValueError(f"in_residual must be {(m, k)}, got "
+                         f"{in_residual.shape}")
     if interpret is None:
         interpret = jax.default_backend() not in ("tpu", "axon")
     # shift-only callers get scale=1, not a silently dropped shift
@@ -515,7 +560,7 @@ def matmul_bn(x: jnp.ndarray, w: jnp.ndarray,
          jnp.zeros((k,), f32)).reshape(1, k)
     sh = (stat_shift.astype(f32) if stat_shift is not None else
           jnp.zeros((n,), f32)).reshape(1, n)
-    return _matmul_bn(x, w.astype(x.dtype), s, t, sh,
+    return _matmul_bn(x, w.astype(x.dtype), s, t, sh, in_residual,
                       relu_in, affine_in, bool(interpret))
 
 
@@ -704,15 +749,19 @@ def conv1x1_bn_apply(x: jnp.ndarray, w: jnp.ndarray, stride: int = 1,
 
 
 def conv1x1_bn(x: jnp.ndarray, w: jnp.ndarray, stride: int = 1,
+               in_residual: Optional[jnp.ndarray] = None,
                **kwargs):
     """NHWC 1×1 conv + BN statistics via :func:`matmul_bn`.
-    x: (N, H, W, C); w: (1, 1, C, F) or (C, F). Returns
+    x: (N, H, W, C); w: (1, 1, C, F) or (C, F); ``in_residual``
+    (N, H', W', C) joins the prologue (see `matmul_bn`). Returns
     ``(y (N, H', W', F), sum (F,), sumsq (F,))``."""
     if w.ndim == 4:
         w = w[0, 0]
     if stride != 1:
         x = x[:, ::stride, ::stride, :]
     b, h, wd, c = x.shape
+    if in_residual is not None:
+        kwargs["in_residual"] = in_residual.reshape(b * h * wd, c)
     y2, ssum, ssq = matmul_bn(x.reshape(b * h * wd, c), w, **kwargs)
     return y2.reshape(b, h, wd, w.shape[-1]), ssum, ssq
 

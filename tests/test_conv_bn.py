@@ -692,3 +692,78 @@ def test_convert_resnet_params_round_trip(rng):
     for (p1, l1), (p2, l2) in zip(flat1, flat2):
         assert p1 == p2
         np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+
+
+# ---------------------------------------------------------------------------
+# matmul_bn in_residual: the deferred-apply prologue (round-5 lever prep)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("m,affine,relu,dtype", [
+    (512, True, True, jnp.float32),    # the deferred-block form
+    (300, True, True, jnp.float32),    # padded rows (r pads with 0)
+    (256, False, False, jnp.float32),  # raw matmul + residual
+    (384, True, True, jnp.bfloat16),
+])
+def test_matmul_bn_in_residual_matches_reference(m, affine, relu,
+                                                 dtype, rng):
+    k, n = 128, 256
+    x = jnp.asarray(rng.randn(m, k), dtype)
+    w = jnp.asarray(rng.randn(k, n) * 0.1, dtype)
+    r = jnp.asarray(rng.randn(m, k), dtype)
+    s = jnp.asarray(rng.rand(k) + 0.5, jnp.float32) if affine else None
+    t = jnp.asarray(rng.randn(k), jnp.float32) if affine else None
+    sh = jnp.asarray(rng.randn(n), jnp.float32)
+    y, sm, sq = matmul_bn(x, w, in_scale=s, in_shift=t, relu_in=relu,
+                          stat_shift=sh, in_residual=r)
+
+    xf = x.astype(jnp.float32)
+    if affine:
+        xf = xf * s[None, :] + t[None, :]
+    xf = xf + r.astype(jnp.float32)
+    if relu:
+        xf = jnp.maximum(xf, 0.0)
+    ry = (xf.astype(x.dtype) @ w.astype(x.dtype)).astype(jnp.float32)
+    d = ry - sh[None, :]
+    tol = (1e-4, 1e-2) if dtype == jnp.float32 else (2e-2, 4.0)
+    np.testing.assert_allclose(np.asarray(y, np.float32),
+                               np.asarray(ry.astype(x.dtype),
+                                          np.float32),
+                               rtol=tol[0] * 10, atol=tol[0] * 10)
+    np.testing.assert_allclose(np.asarray(sm), np.asarray(
+        jnp.sum(d, 0)), rtol=2e-2, atol=tol[1])
+    np.testing.assert_allclose(np.asarray(sq), np.asarray(
+        jnp.sum(d * d, 0)), rtol=2e-2, atol=tol[1])
+
+
+def test_matmul_bn_in_residual_grads_match(rng):
+    # the residual path's backward (XLA) must agree with autodiff of
+    # the unfused expression in all five operands
+    m, k, n = 300, 128, 128
+    x = jnp.asarray(rng.randn(m, k), jnp.float32)
+    w = jnp.asarray(rng.randn(k, n) * 0.1, jnp.float32)
+    r = jnp.asarray(rng.randn(m, k), jnp.float32)
+    s = jnp.asarray(rng.rand(k) + 0.5, jnp.float32)
+    t = jnp.asarray(rng.randn(k), jnp.float32)
+    sh = jnp.asarray(rng.randn(n), jnp.float32)
+
+    def loss_fused(x, w, s, t, r):
+        y, sm, sq = matmul_bn(x, w, in_scale=s, in_shift=t,
+                              relu_in=True, stat_shift=sh,
+                              in_residual=r)
+        return (jnp.sum(y.astype(jnp.float32) * 0.3) +
+                jnp.sum(jnp.sin(sm)) + jnp.sum(jnp.sqrt(sq + 1.0)))
+
+    def loss_ref(x, w, s, t, r):
+        xp = jnp.maximum(x * s[None, :] + t[None, :] + r, 0.0)
+        y = xp @ w
+        d = y - sh[None, :]
+        return (jnp.sum(y * 0.3) + jnp.sum(jnp.sin(jnp.sum(d, 0))) +
+                jnp.sum(jnp.sqrt(jnp.sum(d * d, 0) + 1.0)))
+
+    g1 = jax.grad(loss_fused, argnums=(0, 1, 2, 3, 4))(x, w, s, t, r)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2, 3, 4))(x, w, s, t, r)
+    for name, a, b_ in zip("x w s t r".split(), g1, g2):
+        a, b_ = np.asarray(a), np.asarray(b_)
+        tol = 2e-3 * max(float(np.abs(b_).max()), 1.0)
+        np.testing.assert_allclose(a, b_, rtol=2e-3, atol=tol,
+                                   err_msg=f"d{name}")
