@@ -8,6 +8,7 @@ Each module exposes ``main(argv)``; run via
 EXAMPLES = [
     "lenet_mnist",
     "ncf_recommendation",
+    "wide_and_deep",
     "text_classification",
     "anomaly_detection",
     "object_detection",
