@@ -200,18 +200,54 @@ class FusedBottleneck(KerasLayer):
         return scale, shift, upd
 
     def apply(self, params, x, *, training=False, rng=None):
-        from analytics_zoo_tpu.ops.conv_bn import conv1x1_bn, conv3x3_bn
         if not training:
             return self._apply_eval(params, x), {}
+        return self._apply_train(params, x)
+
+    def _apply_train(self, params, x, *, pending_in=None,
+                     defer_out=False):
+        """Training forward. ``pending_in``/``defer_out`` implement
+        the DEFERRED-APPLY scheme (`fused_stage_forward`): a pending
+        value is ``(y3, scale3, shift3, sc)`` representing the
+        previous block's unmaterialized output
+        ``relu(y3·scale3+shift3 + sc)``. With ``pending_in``, this
+        block's c1 consumes it in the kernel prologue
+        (`matmul_bn(in_residual=)`) — the previous block's output
+        never gets its own whole-tensor pass; the block's own
+        shortcut re-derives it as a fused 3-input elementwise. With
+        ``defer_out`` (stride-1, no downsample only) this block
+        returns its own pending tuple instead of materializing."""
+        from analytics_zoo_tpu.ops.conv_bn import conv1x1_bn, conv3x3_bn
+        if pending_in is not None and self.downsample:
+            raise ValueError("pending input requires an identity "
+                             "shortcut (no downsample)")
+        if defer_out and (self.stride != 1 or self.downsample):
+            raise ValueError("defer_out requires a stride-1 "
+                             "identity-shortcut block")
         updates = {}
         mm = lambda bn: jax.lax.stop_gradient(
             params[bn]["_state"]["moving_mean"])
 
-        # c1: 1×1 matmul + bn1 stats epilogue
-        y1, s1, q1 = conv1x1_bn(x, params["c1"], stat_shift=mm("bn1"))
+        # c1: 1×1 matmul + bn1 stats epilogue (with a pending input,
+        # the previous bn3 apply + residual + relu fold into the
+        # prologue)
+        if pending_in is None:
+            y1, s1, q1 = conv1x1_bn(x, params["c1"],
+                                    stat_shift=mm("bn1"))
+        else:
+            y3p, s3p, t3p, scp = pending_in
+            y1, s1, q1 = conv1x1_bn(
+                y3p, params["c1"], in_scale=s3p, in_shift=t3p,
+                relu_in=True, in_residual=scp, stat_shift=mm("bn1"))
+            # the block's own shortcut: re-derive the previous output
+            # (XLA fuses this 3-input elementwise into its consumer —
+            # cheaper than materializing out_prev with its own pass)
+            x = jnp.maximum(
+                y3p * s3p.astype(y3p.dtype) + t3p.astype(y3p.dtype) +
+                scp.astype(y3p.dtype), 0)
         n1 = float(np.prod(y1.shape[:-1]))
         scale1, shift1, upd1 = self._bn_vectors(
-            params["bn1"], s1, q1, n1, training)
+            params["bn1"], s1, q1, n1, True)
         if upd1:
             updates["bn1"] = upd1
 
@@ -226,7 +262,7 @@ class FusedBottleneck(KerasLayer):
             relu_in=True, stat_shift=mm("bn2"), stride=self.stride)
         n2 = float(np.prod(y2.shape[:-1]))
         scale2, shift2, upd2 = self._bn_vectors(
-            params["bn2"], s2, q2, n2, training)
+            params["bn2"], s2, q2, n2, True)
         if upd2:
             updates["bn2"] = upd2
 
@@ -236,7 +272,7 @@ class FusedBottleneck(KerasLayer):
             relu_in=True, stat_shift=mm("bn3"))
         n3 = float(np.prod(y3.shape[:-1]))
         scale3, shift3, upd3 = self._bn_vectors(
-            params["bn3"], s3, q3, n3, training)
+            params["bn3"], s3, q3, n3, True)
         if upd3:
             updates["bn3"] = upd3
 
@@ -246,13 +282,17 @@ class FusedBottleneck(KerasLayer):
                                      stat_shift=mm("bnd"))
             nd = float(np.prod(ysc.shape[:-1]))
             scaled, shiftd, updd = self._bn_vectors(
-                params["bnd"], sd, qd, nd, training)
+                params["bnd"], sd, qd, nd, True)
             if updd:
                 updates["bnd"] = updd
             shortcut = ysc * scaled.astype(ysc.dtype) + \
                 shiftd.astype(ysc.dtype)
         else:
             shortcut = x
+        if defer_out:
+            # hand (y3, scale3, shift3, sc) to the next block's c1
+            # prologue instead of materializing the output
+            return (y3, scale3, shift3, shortcut), updates
         # bn3 apply + residual add + relu: one elementwise pass
         out = jnp.maximum(
             y3 * scale3.astype(y3.dtype) + shift3.astype(y3.dtype) +
@@ -350,6 +390,52 @@ class ResNet:
         x = GlobalAveragePooling2D()(x)
         out = Dense(classes, name="fc")(x)
         return Model(inp, out, name=f"resnet{self.depth}")
+
+
+def fused_stage_forward(blocks, params_list, x, training=True):
+    """Run a stage of `FusedBottleneck` blocks with ALTERNATING
+    deferred apply (the round-5 HBM-traffic lever, exercised here for
+    conformance ahead of the on-chip measurement that decides whether
+    the ResNet builder adopts it):
+
+    an eligible block (stride-1 identity shortcut, not the last)
+    defers its final bn3+residual+ReLU pass; the NEXT block consumes
+    the pending ``(y3, scale3, shift3, sc)`` in its c1 kernel
+    prologue (`matmul_bn(in_residual=)`) and re-derives its own
+    shortcut as a fused elementwise — per deferred pair, one
+    whole-tensor write (and its read-back) of the stage's widest
+    tensor disappears. Same math as running the blocks sequentially;
+    eval mode just chains the (already optimal) eval folds.
+
+    ``blocks``/``params_list``: the stage's `FusedBottleneck` layers
+    and their param dicts. Returns ``(out, updates_per_block)``."""
+    if len(blocks) != len(params_list):
+        raise ValueError(f"{len(blocks)} blocks but "
+                         f"{len(params_list)} param dicts")
+    if not training:
+        out, upds = x, []
+        for blk, p in zip(blocks, params_list):
+            out, u = blk.apply(p, out, training=False)
+            upds.append(u)
+        return out, upds
+    updates_per_block = []
+    pending = None
+    for i, (blk, p) in enumerate(zip(blocks, params_list)):
+        eligible = (blk.stride == 1 and not blk.downsample)
+        defer = (eligible and pending is None
+                 and i + 1 < len(blocks)
+                 and blocks[i + 1].stride == 1
+                 and not blocks[i + 1].downsample)
+        out, upd = blk._apply_train(
+            p, x if pending is None else None,
+            pending_in=pending, defer_out=defer)
+        updates_per_block.append(upd)
+        if defer:
+            pending = out
+        else:
+            pending = None
+            x = out
+    return x, updates_per_block
 
 
 # fused param-group name ↔ unfused layer-name suffix, per block

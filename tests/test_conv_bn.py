@@ -767,3 +767,71 @@ def test_matmul_bn_in_residual_grads_match(rng):
         tol = 2e-3 * max(float(np.abs(b_).max()), 1.0)
         np.testing.assert_allclose(a, b_, rtol=2e-3, atol=tol,
                                    err_msg=f"d{name}")
+
+
+def test_fused_stage_forward_matches_sequential(rng):
+    # the alternating deferred-apply stage (round-5 lever groundwork)
+    # must match running the same blocks sequentially — outputs,
+    # BN-state updates, and gradients
+    from analytics_zoo_tpu.models.image.imageclassification.resnet \
+        import FusedBottleneck, fused_stage_forward
+    blocks = [FusedBottleneck(64, stride=1, downsample=True,
+                              input_shape=(8, 8, 128), name="b0")]
+    for i in range(1, 4):
+        blocks.append(FusedBottleneck(64, stride=1, downsample=False,
+                                      name=f"b{i}"))
+    shapes = [(8, 8, 128)] + [(8, 8, 256)] * 3
+    params = [blk.build(jax.random.PRNGKey(i), shp)
+              for i, (blk, shp) in enumerate(zip(blocks, shapes))]
+    for p in params:                      # off the init fixed point
+        for bn in ("bn1", "bn2", "bn3", "bnd"):
+            if bn not in p:
+                continue
+            n = p[bn]["gamma"].shape[0]
+            p[bn]["gamma"] = jnp.asarray(rng.rand(n) + 0.5,
+                                         jnp.float32)
+            p[bn]["beta"] = jnp.asarray(rng.randn(n) * 0.1,
+                                        jnp.float32)
+    x = jnp.asarray(rng.randn(2, 8, 8, 128), jnp.float32)
+
+    def seq(params, x):
+        upds = []
+        for blk, p in zip(blocks, params):
+            x, u = blk.apply(p, x, training=True)
+            upds.append(u)
+        return x, upds
+
+    # training: the deferred chain must match sequential apply
+    ref, ref_upds = seq(params, x)
+    got, got_upds = fused_stage_forward(blocks, params, x,
+                                        training=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+    for u_got, u_ref in zip(got_upds, ref_upds):
+        assert u_got.keys() == u_ref.keys()
+        for bn in u_got:
+            for k in u_got[bn]["_state"]:
+                np.testing.assert_allclose(
+                    np.asarray(u_got[bn]["_state"][k]),
+                    np.asarray(u_ref[bn]["_state"][k]),
+                    rtol=1e-4, atol=1e-4, err_msg=f"{bn}.{k}")
+
+    # eval: the chained eval folds must match sequential eval apply
+    def seq_eval(params, x):
+        for blk, p in zip(blocks, params):
+            x, _ = blk.apply(p, x, training=False)
+        return x
+
+    got_ev, _ = fused_stage_forward(blocks, params, x,
+                                    training=False)
+    np.testing.assert_allclose(np.asarray(got_ev),
+                               np.asarray(seq_eval(params, x)),
+                               rtol=2e-4, atol=2e-4)
+
+    # gradients through the deferred chain match the sequential chain
+    g1 = jax.grad(lambda a: jnp.sum(
+        fused_stage_forward(blocks, params, a)[0] ** 2))(x)
+    g2 = jax.grad(lambda a: jnp.sum(seq(params, a)[0] ** 2))(x)
+    tol = 2e-3 * max(float(jnp.abs(g2).max()), 1.0)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               rtol=2e-3, atol=tol)
