@@ -359,10 +359,16 @@ class ResNet:
 
     def build(self, input_shape=(224, 224, 3), classes: int = 1000,
               space_to_depth: bool = False,
-              fused: bool = False) -> Model:
+              fused=False) -> Model:
         """``fused=True`` uses :class:`FusedBottleneck` (the Pallas
         matmul+BN kernel on the 1×1 convs) — same math, less HBM
-        traffic; weights are per-conv/per-BN either way."""
+        traffic; ``fused="defer"`` additionally runs each stage as
+        one :class:`FusedStage` with the alternating deferred-apply
+        scheme. Weights are per-conv/per-BN in every layout
+        (`convert_resnet_params` maps between them)."""
+        if fused not in (False, True, "defer"):
+            raise ValueError(f"fused must be False/True/'defer', "
+                             f"got {fused!r}")
         blocks = self.DEPTH_BLOCKS[self.depth]
         inp = Input(input_shape, name="image")
         if space_to_depth:
@@ -376,20 +382,78 @@ class ResNet:
         x = MaxPooling2D(pool_size=3, strides=2, border_mode="same")(x)
         filters = 64
         for stage, n_blocks in enumerate(blocks):
-            for b in range(n_blocks):
-                stride = 2 if (b == 0 and stage > 0) else 1
-                if fused:
-                    x = FusedBottleneck(filters, stride=stride,
+            first_stride = 2 if stage > 0 else 1
+            if fused == "defer":
+                x = FusedStage(filters, n_blocks,
+                               first_stride=first_stride,
+                               name=f"s{stage}")(x)
+            else:
+                for b in range(n_blocks):
+                    stride = first_stride if b == 0 else 1
+                    if fused:
+                        x = FusedBottleneck(filters, stride=stride,
+                                            downsample=(b == 0),
+                                            name=f"s{stage}b{b}")(x)
+                    else:
+                        x = _bottleneck(x, filters, stride=stride,
                                         downsample=(b == 0),
-                                        name=f"s{stage}b{b}")(x)
-                else:
-                    x = _bottleneck(x, filters, stride=stride,
-                                    downsample=(b == 0),
-                                    name=f"s{stage}b{b}")
+                                        name=f"s{stage}b{b}")
             filters *= 2
         x = GlobalAveragePooling2D()(x)
         out = Dense(classes, name="fc")(x)
         return Model(inp, out, name=f"resnet{self.depth}")
+
+
+class FusedStage(KerasLayer):
+    """One ResNet stage as a SINGLE layer running its
+    `FusedBottleneck` blocks through `fused_stage_forward` (the
+    alternating deferred-apply scheme — `resnet50(fused="defer")`).
+    Params nest per block: ``{"b0": <FusedBottleneck params>, ...}``,
+    so `convert_resnet_params` maps them to/from the other layouts by
+    name."""
+
+    def __init__(self, filters: int, n_blocks: int,
+                 first_stride: int = 1, epsilon: float = 1e-3,
+                 momentum: float = 0.99, init="glorot_uniform",
+                 input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.filters = int(filters)
+        self.n_blocks = int(n_blocks)
+        self.first_stride = int(first_stride)
+        self.blocks = [
+            FusedBottleneck(filters,
+                            stride=first_stride if b == 0 else 1,
+                            downsample=(b == 0), epsilon=epsilon,
+                            momentum=momentum, init=init,
+                            name=f"b{b}")
+            for b in range(self.n_blocks)]
+
+    def build(self, rng, input_shape):
+        params = {}
+        shape = input_shape
+        for b, blk in enumerate(self.blocks):
+            params[f"b{b}"] = blk.build(
+                jax.random.fold_in(rng, b), shape)
+            shape = blk.compute_output_shape(shape)
+        return params
+
+    def apply(self, params, x, *, training=False, rng=None):
+        out, upds = fused_stage_forward(
+            self.blocks, [params[f"b{b}"]
+                          for b in range(self.n_blocks)],
+            x, training=training)
+        updates = {f"b{b}": u for b, u in enumerate(upds) if u}
+        return out, updates
+
+    def call(self, params, x, *, training=False, rng=None):
+        y, _ = self.apply(params, x, training=training, rng=rng)
+        return y
+
+    def compute_output_shape(self, input_shape):
+        shape = input_shape
+        for blk in self.blocks:
+            shape = blk.compute_output_shape(shape)
+        return shape
 
 
 def fused_stage_forward(blocks, params_list, x, training=True):
@@ -454,8 +518,32 @@ def convert_resnet_params(src_params: dict, dst_params: dict) -> dict:
     layouts losslessly in either direction (the checkpoint-portability
     contract behind the ``fused`` construction flag — an unfused-saved
     `.model` loads into the fused TPU runtime and vice versa).
-    Non-block layers (stem, fc) copy by name. Returns a params dict
-    shaped like ``dst_params``."""
+    The stage layout (`fused="defer"`: one ``s{i}`` layer with nested
+    ``b{j}`` block groups) converts to/from both as well. Non-block
+    layers (stem, fc) copy by name. Returns a params dict shaped like
+    ``dst_params``."""
+    import re
+
+    def src_block(flat):
+        """The fused param group for flat block name ``s{i}b{j}``,
+        from a per-block-fused, stage, or unfused source."""
+        if flat in src_params:
+            return src_params[flat]
+        msb = re.fullmatch(r"(s\d+)(b\d+)", flat)
+        if msb and msb.group(1) in src_params and \
+                msb.group(2) in src_params[msb.group(1)]:
+            return src_params[msb.group(1)][msb.group(2)]
+        return None
+
+    def gather_unfused(flat, like):
+        grp = {}
+        for key, suffix, leaf in _FUSED_PARTS:
+            if key not in like:
+                continue
+            layer = src_params[flat + suffix]
+            grp[key] = layer[leaf] if leaf else layer
+        return grp
+
     out = {}
     for name, sub in dst_params.items():
         if not jax.tree_util.tree_leaves(sub):
@@ -463,23 +551,31 @@ def convert_resnet_params(src_params: dict, dst_params: dict) -> dict:
         elif name in src_params:
             out[name] = src_params[name]            # same layout
         elif isinstance(sub, dict) and "bn1" in sub and "c1" in sub:
-            # dst fused ← src unfused: gather the block's pieces
-            grp = {}
-            for key, suffix, leaf in _FUSED_PARTS:
-                if key not in sub:
-                    continue
-                layer = src_params[name + suffix]
-                grp[key] = layer[leaf] if leaf else layer
-            out[name] = grp
+            # dst per-block fused ← src stage or unfused
+            grp = src_block(name)
+            out[name] = grp if grp is not None else \
+                gather_unfused(name, sub)
+        elif isinstance(sub, dict) and all(
+                re.fullmatch(r"b\d+", k) for k in sub):
+            # dst STAGE ← src per-block fused or unfused
+            stage = {}
+            for bkey, bsub in sub.items():
+                flat = name + bkey
+                grp = src_block(flat)
+                stage[bkey] = grp if grp is not None else \
+                    gather_unfused(flat, bsub)
+            out[name] = stage
         elif "_c" in name or "_down" in name:
-            # dst unfused ← src fused: explode the block's group
+            # dst unfused ← src per-block fused or stage
             base, _, suffix = name.partition("_")
             key = next(k for k, sfx, _ in _FUSED_PARTS
                        if sfx == "_" + suffix)
             leaf = dict(
                 (k, l) for k, _, l in _FUSED_PARTS)[key]
-            grp = src_params[base][key]
-            out[name] = {"kernel": grp} if leaf else grp
+            grp = src_block(base)
+            if grp is None:
+                raise KeyError(f"no source block for {base!r}")
+            out[name] = {"kernel": grp[key]} if leaf else grp[key]
         else:
             raise KeyError(
                 f"layer {name!r} has no counterpart in the source "
@@ -489,6 +585,6 @@ def convert_resnet_params(src_params: dict, dst_params: dict) -> dict:
 
 def resnet50(input_shape=(224, 224, 3), classes: int = 1000,
              space_to_depth: bool = False,
-             fused: bool = False) -> Model:
+             fused=False) -> Model:
     return ResNet(50).build(input_shape, classes,
                             space_to_depth=space_to_depth, fused=fused)

@@ -780,13 +780,15 @@ def _conv3_ref(x, w, s, t, sh, relu_in, affine_in, stride=1):
         xf = xf * s[None, None, None, :] + t[None, None, None, :]
     if relu_in:
         xf = jnp.maximum(xf, 0.0)
+    # compute-dtype conv without a promoted output type: the conv
+    # transpose rule needs all three dtypes equal, so a promoted-f32
+    # output makes bf16 autodiff through this expression crash
     y = jax.lax.conv_general_dilated(
         xf.astype(x.dtype), w.astype(x.dtype),
         window_strides=(stride, stride),
-        padding="SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        preferred_element_type=f32)
-    d = y - sh[None, None, None, :]
-    return (y.astype(x.dtype), jnp.sum(d, axis=(0, 1, 2)),
+        padding="SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    d = y.astype(f32) - sh[None, None, None, :]
+    return (y, jnp.sum(d, axis=(0, 1, 2)),
             jnp.sum(d * d, axis=(0, 1, 2)))
 
 
@@ -889,9 +891,8 @@ def _conv3_apply_ref(x, w, s, t, os_, ot, relu_in, affine_in,
     y = jax.lax.conv_general_dilated(
         xf.astype(x.dtype), w.astype(x.dtype),
         window_strides=(stride, stride), padding="SAME",
-        dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        preferred_element_type=f32)
-    y = y * os_.reshape(-1)[None, None, None, :] + \
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    y = y.astype(f32) * os_.reshape(-1)[None, None, None, :] + \
         ot.reshape(-1)[None, None, None, :]
     if relu_out:
         y = jnp.maximum(y, 0.0)
@@ -1089,10 +1090,20 @@ def _conv3_vjp_bwd(relu_in, affine_in, stride, interpret, res, cots):
     cd = x.dtype
 
     def conv(l, r):
+        # f32 operands throughout: the conv transpose rule rebuilds a
+        # conv between the cotangent and the other operand and
+        # requires all three dtypes EQUAL — bf16 operands with a
+        # promoted-f32 output (round 3's form) crash it, and bf16
+        # operands without promotion round the gradients to bf16.
+        # Casting INSIDE keeps the transposed computation f32 end to
+        # end (the cast transposes through convert_element_type);
+        # precision beats the matmul backward's bf16-operand dots at
+        # some conv-backward MXU rate — revisit if the profile shows
+        # these two convs hot.
         return jax.lax.conv_general_dilated(
-            l, r, window_strides=(stride, stride), padding="SAME",
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            preferred_element_type=f32)
+            l.astype(f32), r.astype(f32),
+            window_strides=(stride, stride), padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
     xpc = xp.astype(cd)
     wc = w.astype(cd)

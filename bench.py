@@ -195,7 +195,8 @@ def main():
     s2d = os.environ.get("ZOO_TPU_BENCH_S2D", "1") == "1"
     # ZOO_TPU_BENCH_FUSED: "auto" (default) measures BOTH the unfused
     # XLA graph and the Pallas fused-bottleneck variant and reports
-    # the faster; "0"/"1" pin one variant.
+    # the faster; "0"/"1" pin one variant; "defer" pins the
+    # alternating deferred-apply stage variant (fused="defer").
     fused_mode = os.environ.get("ZOO_TPU_BENCH_FUSED", "auto")
     loss_fn = losses.softmax_cross_entropy
     tx = optimizers.SGD(lr=0.1, momentum=0.9).to_optax()
@@ -250,8 +251,11 @@ def main():
     # no second backend compile).
     ref_flops_holder = {}
 
-    def measure_variant(fused: bool):
-        tag = "fused" if fused else "unfused"
+    VARIANT_TAGS = {False: "unfused", True: "fused",
+                    "defer": "defer"}
+
+    def measure_variant(fused):
+        tag = VARIANT_TAGS[fused]
         _result["diag"] = f"building {tag} model"
         model = resnet50(input_shape=(image, image, 3), classes=1000,
                          space_to_depth=s2d, fused=fused)
@@ -351,8 +355,8 @@ def main():
               f"compile={t_compile:.1f}s", file=sys.stderr, flush=True)
         return images_per_sec
 
-    variants = {"0": [False], "1": [True]}.get(fused_mode,
-                                               [False, True])
+    variants = {"0": [False], "1": [True],
+                "defer": ["defer"]}.get(fused_mode, [False, True])
     succeeded, last_err = 0, None
     for fused in variants:
         try:
@@ -360,11 +364,11 @@ def main():
             succeeded += 1
         except Exception as e:
             # one variant failing must not cost the round's number
-            print(f"# [{'fused' if fused else 'unfused'}] FAILED: "
+            print(f"# [{VARIANT_TAGS[fused]}] FAILED: "
                   f"{type(e).__name__}: {e}", file=sys.stderr,
                   flush=True)
             last_err = e
-            if fused_mode in ("0", "1"):
+            if fused_mode in ("0", "1", "defer"):
                 raise
     if not succeeded:
         # both variants failed: surface the error (diag JSON + rc 1)

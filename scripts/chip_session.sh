@@ -16,8 +16,10 @@ run timeout 120 python bench.py --probe || exit 1
 #    batch 128 — now including the stride-2 conv3x3_bn blocks
 run python scripts/measure_fused.py --steps 20
 
-# 2. batch sweep on the fused path (BN traffic reduced further by the
-#    strided kernel: 192/256 may win now)
+# 2. the deferred-apply stage variant (fused="defer") A/B against
+#    plain fused, then a batch sweep on the fused path (BN traffic
+#    reduced further by the strided kernel: 192/256 may win now)
+ZOO_TPU_BENCH_FUSED=defer ZOO_TPU_BENCH_NCF=0 run python bench.py
 for b in 192 256; do
   ZOO_TPU_BENCH_FUSED=1 ZOO_TPU_BENCH_BATCH=$b ZOO_TPU_BENCH_NCF=0 run python bench.py
 done
