@@ -90,6 +90,47 @@ class ImageClassifier(ZooModel):
         if self.fused and not name.startswith("resnet-"):
             raise ValueError(f"fused=True is ResNet-only, not {name}")
 
+    def load_weights(self, path: str):
+        """Load a ``save_weights`` ``.npz``; for ResNets a checkpoint
+        saved in a DIFFERENT layout (unfused ↔ per-block fused ↔
+        stage) is converted on the fly via `convert_resnet_params` —
+        the checkpoint-portability leg of the fused "auto" default:
+        existing unfused checkpoints load into the fused TPU runtime
+        without user action."""
+        try:
+            return super().load_weights(path)
+        except KeyError:
+            if not self.model_name.startswith("resnet-"):
+                raise
+        import jax
+        import numpy as np
+
+        from analytics_zoo_tpu.models.image.imageclassification \
+            .resnet import convert_resnet_params
+        est = self.model.estimator
+        if est.params is None:
+            est._ensure_initialized()
+        nested: dict = {}
+        with np.load(path) as data:
+            for key in data.files:
+                parts = key.split("/")
+                d = nested
+                for p in parts[:-1]:
+                    d = d.setdefault(p, {})
+                d[parts[-1]] = data[key]
+        target = jax.device_get(est.params)
+        converted = convert_resnet_params(nested, target)
+        for (kp1, l1), (kp2, l2) in zip(
+                jax.tree_util.tree_leaves_with_path(converted),
+                jax.tree_util.tree_leaves_with_path(target)):
+            if tuple(np.shape(l1)) != tuple(np.shape(l2)):
+                raise ValueError(
+                    f"shape mismatch at {kp2}: saved "
+                    f"{np.shape(l1)} vs model {np.shape(l2)}")
+        est.params = jax.device_put(converted)
+        est._train_step = None
+        return self
+
     def hyper_parameters(self):
         return {"model_name": self.model_name,
                 "input_shape": self.input_shape,

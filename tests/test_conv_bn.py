@@ -925,3 +925,40 @@ def test_conv3x3_bn_bf16_grads(stride, rng):
         tol = 3e-2 * max(float(np.abs(b_).max()), 1.0)
         np.testing.assert_allclose(a, b_, rtol=3e-2, atol=tol,
                                    err_msg=f"d{name} (stride={stride})")
+
+
+def test_image_classifier_cross_layout_load(tmp_path, rng):
+    # an UNFUSED-saved checkpoint loads into the fused runtime (and
+    # back) with on-the-fly layout conversion — the portability leg
+    # of the fused "auto" default
+    from analytics_zoo_tpu.models.image.imageclassification import \
+        ImageClassifier
+    unfused = ImageClassifier("resnet-50", input_shape=(32, 32, 3),
+                              classes=10, fused=False)
+    unfused.compile()
+    unfused.model.estimator._ensure_initialized()
+    wpath = str(tmp_path / "w.npz")
+    unfused.save_weights(wpath)
+
+    fused = ImageClassifier("resnet-50", input_shape=(32, 32, 3),
+                            classes=10, fused=True)
+    fused.compile()
+    fused.load_weights(wpath)
+    up = unfused.model.estimator.params
+    fp = fused.model.estimator.params
+    np.testing.assert_array_equal(
+        np.asarray(fp["s0b0"]["c1"]),
+        np.asarray(up["s0b0_c1"]["kernel"]))
+    np.testing.assert_array_equal(
+        np.asarray(fp["s2b3"]["bn2"]["gamma"]),
+        np.asarray(up["s2b3_c2_bn"]["gamma"]))
+    np.testing.assert_array_equal(np.asarray(fp["fc"]["kernel"]),
+                                  np.asarray(up["fc"]["kernel"]))
+    # and the same-layout path still goes through the strict loader
+    fused2 = ImageClassifier("resnet-50", input_shape=(32, 32, 3),
+                             classes=10, fused=False)
+    fused2.compile()
+    fused2.load_weights(wpath)
+    np.testing.assert_array_equal(
+        np.asarray(fused2.model.estimator.params["fc"]["kernel"]),
+        np.asarray(up["fc"]["kernel"]))
