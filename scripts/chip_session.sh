@@ -10,23 +10,25 @@ LOG=chip_session.log
 run() { echo "### $(date +%H:%M:%S) $*" | tee -a "$LOG"; "$@" 2>&1 | tee -a "$LOG"; }
 
 # 0. chip sanity (fast: bench's own probe path)
-run timeout 120 python bench.py --probe || exit 1
+run timeout 150 python bench.py --probe || exit 1
 
-# 1. per-shape kernel micro A/B (fwd and fwd+bwd) + model A/B at
-#    batch 128 — now including the stride-2 conv3x3_bn blocks
-run python scripts/measure_fused.py --steps 20
+# 1. FIRST: the full round-4 bench contract (auto A/B + NCF extra
+#    metric + model-FLOPs MFU fields). The tunnel flaps — bank the
+#    headline artifact before anything else.
+run python bench.py
 
-# 2. the deferred-apply stage variant (fused="defer") A/B against
+# 2. per-shape kernel micro A/B (fwd and fwd+bwd) — the model A/B
+#    comes from the bench.py auto runs in steps 1/3, so skip the
+#    subprocess duplicate here
+run python scripts/measure_fused.py --steps 20 --skip-model
+
+# 3. the deferred-apply stage variant (fused="defer") A/B against
 #    plain fused, then a batch sweep on the fused path (BN traffic
 #    reduced further by the strided kernel: 192/256 may win now)
 ZOO_TPU_BENCH_FUSED=defer ZOO_TPU_BENCH_NCF=0 run python bench.py
 for b in 192 256; do
   ZOO_TPU_BENCH_FUSED=1 ZOO_TPU_BENCH_BATCH=$b ZOO_TPU_BENCH_NCF=0 run python bench.py
 done
-
-# 3. full bench with the round-4 contract (auto A/B + NCF extra
-#    metric + model-FLOPs MFU fields)
-run python bench.py
 
 # 4. profile capture of both variants for PERF.md
 ZOO_TPU_BENCH_PROFILE_DIR=/tmp/zoo_r4_profile ZOO_TPU_BENCH_NCF=0 run python bench.py

@@ -1,0 +1,17 @@
+#!/bin/bash
+# Probe the chip every ~3 min; the moment it answers, run the full
+# chip session (scripts/chip_session.sh) and exit. History in
+# /tmp/chip_probe_history.log. Serialize against other chip jobs.
+cd "$(dirname "$0")/.." || exit 1
+HIST=/tmp/chip_probe_history.log
+while true; do
+  if timeout 150 python bench.py --probe >/tmp/chip_probe.out 2>&1 \
+      && grep -q PROBE_OK /tmp/chip_probe.out; then
+    echo "$(date +%H:%M:%S) PROBE_OK — starting chip session" >> "$HIST"
+    bash scripts/chip_session.sh
+    echo "$(date +%H:%M:%S) chip session finished rc=$?" >> "$HIST"
+    exit 0
+  fi
+  echo "$(date +%H:%M:%S) probe failed" >> "$HIST"
+  sleep 170
+done

@@ -21,6 +21,9 @@ import time
 
 import numpy as np
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
 # (M, K, N): ResNet-50 1x1 conv shapes at batch 128
 _RESNET_SHAPES = [
     (128 * 56 * 56, 64, 64),      # s0 c1
