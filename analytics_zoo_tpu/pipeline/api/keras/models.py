@@ -60,16 +60,41 @@ class KerasNet(KerasLayer):
 
     # -- params -------------------------------------------------------------
     def init_params(self, rng=None,
-                    input_shape: Optional[ShapeLike] = None) -> dict:
+                    input_shape: Optional[ShapeLike] = None,
+                    device=None) -> dict:
         """Build the whole parameter pytree.
 
         ``rng`` defaults to a key from the process NNContext so plain
         ``model.init_params()`` "just works" after ``init_nncontext()``.
+
+        Init is ~hundreds of tiny eager ops (one per leaf); against a
+        remote accelerator each would pay a dispatch round trip, so on
+        non-CPU backends the ops run on the host CPU backend and the
+        finished pytree transfers in ONE ``device_put`` (the
+        remote-TPU analog of the reference's driver-side weight init +
+        broadcast). ``device``: a placement target, or ``"host"`` to
+        skip the transfer and return the CPU-resident pytree (callers
+        that re-place with their own shardings — Estimator — avoid a
+        full-replica round trip through device 0 that way).
         """
+        import jax
+
         if rng is None:
             from analytics_zoo_tpu.common.nncontext import get_nncontext
             rng = get_nncontext().next_rng_key()
-        return self.init(rng, input_shape)
+        try:
+            cpu0 = jax.local_devices(backend="cpu")[0]
+        except RuntimeError:  # no host backend under a platform pin
+            cpu0 = None
+        if cpu0 is None or (device is None
+                            and jax.default_backend() == "cpu"):
+            return self.init(rng, input_shape)
+        with jax.default_device(cpu0):
+            params = self.init(jax.device_put(rng, cpu0), input_shape)
+        if device == "host":
+            return params
+        return jax.device_put(
+            params, device if device is not None else jax.devices()[0])
 
     def forward(self, params: dict, inputs, *, training: bool = False,
                 rng=None):

@@ -172,7 +172,8 @@ class _TFFunctionNet:
         self.name = "tf_function_net"
         self.layers = []
 
-    def init_params(self, rng=None):
+    def init_params(self, rng=None, input_shape=None,
+                    device=None):  # host numpy either way
         return {"weights": [np.asarray(w) for w in self._template]}
 
     def init(self, rng, input_shape=None):

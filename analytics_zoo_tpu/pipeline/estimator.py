@@ -518,12 +518,17 @@ class Estimator:
 
     def _ensure_initialized(self, sample_batch=None):
         if self.params is None:
-            self.params = self.model.init_params(
-                self.ctx.next_rng_key())
-            self.params = self._place_params(self.params)
+            # host init, then ONE sharded placement — device-0 never
+            # holds a transient full replica under FSDP/TP
+            self.params = self._place_params(self.model.init_params(
+                self.ctx.next_rng_key(), device="host"))
         if self.opt_state is None:
             tx = self._tx()
-            self.opt_state = tx.init(self.params)
+            # one compiled program, one dispatch — eager tx.init is a
+            # per-leaf op storm over a remote-device transport, and jit
+            # inherits the params' shardings for the momentum/adam
+            # buffers (the state lands pre-sharded under FSDP/TP/EP)
+            self.opt_state = jax.jit(tx.init)(self.params)
             self._train_step = self._build_train_step(tx)
         elif self._train_step is None:
             self._train_step = self._build_train_step(self._tx())
@@ -738,7 +743,8 @@ class Estimator:
         # opt_state leaves are keyed by the saving process's layer names;
         # rebuild the state tree for THIS model and pour the leaves in
         tx = self._tx()
-        template = tx.init(self.params)
+        # structure only — eval_shape runs zero device ops
+        template = jax.eval_shape(tx.init, self.params)
         saved_leaves = jax.tree_util.tree_leaves(state["opt_state"])
         template_def = jax.tree_util.tree_structure(template)
         if len(saved_leaves) != template_def.num_leaves:

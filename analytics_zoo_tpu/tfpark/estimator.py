@@ -83,7 +83,8 @@ class _TFEstimatorNet:
         self.name = "tf_estimator_net"
         self.layers: list = []
 
-    def init_params(self, rng=None):
+    def init_params(self, rng=None, input_shape=None,
+                    device=None):  # host numpy either way
         return {"weights": [w.copy() for w in self._float_values]}
 
     def init(self, rng, input_shape=None):

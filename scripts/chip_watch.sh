@@ -3,6 +3,9 @@
 # chip session (scripts/chip_session.sh) and exit. History in
 # /tmp/chip_probe_history.log. Serialize against other chip jobs.
 cd "$(dirname "$0")/.." || exit 1
+# one watcher at a time: concurrent chip sessions corrupt timings
+exec 9>/tmp/chip_session.lock
+flock -n 9 || { echo "another chip_watch holds the lock"; exit 1; }
 HIST=/tmp/chip_probe_history.log
 while true; do
   if timeout 150 python bench.py --probe >/tmp/chip_probe.out 2>&1 \
