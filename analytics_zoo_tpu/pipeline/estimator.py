@@ -18,6 +18,8 @@ gradient clipping mirror the reference's training features (SURVEY.md §5).
 
 from __future__ import annotations
 
+import contextlib
+import logging
 import os
 import pickle
 import time
@@ -36,6 +38,8 @@ from analytics_zoo_tpu.ops import losses as losses_lib
 from analytics_zoo_tpu.ops import metrics as metrics_lib
 from analytics_zoo_tpu.ops import optimizers as optim_lib
 from analytics_zoo_tpu.parallel.mesh import shard_batch, shard_params
+
+logger = logging.getLogger("analytics_zoo_tpu")
 
 
 # ---------------------------------------------------------------------------
@@ -226,6 +230,70 @@ def to_dataset(data, y=None):
         from analytics_zoo_tpu.feature.feature_set import FeatureSet
         return FeatureSet.from_rdd(data)
     return ArrayDataset(data, y)
+
+
+def _prefetch_iter(it, place, depth: int):
+    """Pipeline host batch prep + device placement `depth` batches
+    ahead of compute on a background thread (flax
+    ``prefetch_to_device`` pattern; role of the reference's
+    executor-side Sample→MiniBatch pipelining, SURVEY.md §3.2).
+
+    ``place`` runs IN the worker thread (numpy prep + ``device_put``
+    are thread-safe and async); exceptions re-raise at the consumer's
+    next pull. ``depth<=0`` = synchronous (debugging / profiling the
+    unpipelined path)."""
+    if depth <= 0:
+        for item in it:
+            yield place(item)
+        return
+    import queue
+    import threading
+
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+    sentinel = object()
+
+    def _put(obj) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(obj, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def worker():
+        try:
+            for item in it:
+                if stop.is_set() or not _put(place(item)):
+                    return
+            _put(sentinel)
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            _put(e)
+
+    t = threading.Thread(target=worker, daemon=True,
+                         name="zoo-tpu-prefetch")
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is sentinel:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+
+
+def _prefetch_depth() -> int:
+    raw = os.environ.get("ZOO_TPU_PREFETCH", "2")
+    try:
+        return int(raw)
+    except ValueError:
+        logger.warning("ZOO_TPU_PREFETCH=%r is not an integer; "
+                       "using default depth 2", raw)
+        return 2
 
 
 def _cast_floats(x, dtype):
@@ -559,32 +627,49 @@ class Estimator:
             # would stall the dispatch pipeline (expensive over remote
             # device transports)
             pending: "list[tuple[int, Any]]" = []
-            for xb, yb in ds.iter_batches(batch_size, shuffle=True,
-                                          seed=epoch):
-                xb = shard_batch(xb, self.ctx.mesh)
-                yb = shard_batch(yb, self.ctx.mesh)
-                rng = jax.random.fold_in(base_rng, self.step)
-                if self._profile_dir and not self._profiling and \
-                        self.step + 1 >= p_start:
-                    jax.profiler.start_trace(self._profile_dir)
-                    self._profiling = True
-                self.params, self.opt_state, loss = self._train_step(
-                    self.params, self.opt_state, rng, xb, yb)
-                self.step += 1
-                if self._profiling and self.step >= p_end:
-                    jax.block_until_ready(loss)  # capture device time
-                    jax.profiler.stop_trace()
-                    self._profiling = False
-                    self._profile_dir = None
-                n_records += batch_size
-                pending.append((self.step, loss))
-                if self.checkpoint_path and self.checkpoint_trigger(
-                        epoch, self.step, False):
-                    self.save_checkpoint()
-                if end_trigger is not None and end_trigger(
-                        epoch - 1, self.step, False):
-                    stop = True
-                    break
+            mesh = self.ctx.mesh
+
+            def _place(batch, mesh=mesh):
+                xb, yb = batch
+                return (shard_batch(xb, mesh), shard_batch(yb, mesh))
+
+            # closing(): break/exception must stop the worker thread
+            # NOW, not at GC — a retained traceback would otherwise pin
+            # depth+1 device-resident batches (notebook OOM-retry trap)
+            batches = _prefetch_iter(
+                ds.iter_batches(batch_size, shuffle=True, seed=epoch),
+                _place, _prefetch_depth())
+            try:
+                for xb, yb in batches:
+                    rng = jax.random.fold_in(base_rng, self.step)
+                    if self._profile_dir and not self._profiling and \
+                            self.step + 1 >= p_start:
+                        jax.profiler.start_trace(self._profile_dir)
+                        self._profiling = True
+                    self.params, self.opt_state, loss = \
+                        self._train_step(self.params, self.opt_state,
+                                         rng, xb, yb)
+                    self.step += 1
+                    if self._profiling and self.step >= p_end:
+                        jax.block_until_ready(loss)  # device time
+                        jax.profiler.stop_trace()
+                        self._profiling = False
+                        self._profile_dir = None
+                    n_records += batch_size
+                    pending.append((self.step, loss))
+                    if self.checkpoint_path and self.checkpoint_trigger(
+                            epoch, self.step, False):
+                        self.save_checkpoint()
+                    if end_trigger is not None and end_trigger(
+                            epoch - 1, self.step, False):
+                        stop = True
+                        break
+            finally:
+                # break/exception must stop the worker thread NOW, not
+                # at GC — a retained traceback would otherwise pin
+                # depth+1 device-resident batches (notebook OOM-retry
+                # trap)
+                batches.close()
 
             losses_np = ([float(v) for v in
                           jax.device_get([v for _, v in pending])]
@@ -654,23 +739,33 @@ class Estimator:
         # and the eval step compiles exactly once
         dp = self.ctx.data_parallel_size
         padded = -(-batch_size // dp) * dp
-        for xb, yb in ds.iter_batches(batch_size, shuffle=False,
-                                      drop_last=False):
+        mesh = self.ctx.mesh
+
+        def _place(batch, mesh=mesh):
+            xb, yb = batch
             bsize = _batch_dim(xb)
             w = np.zeros((padded,), np.float32)
             w[:bsize] = 1.0
             if bsize < padded:
                 xb = _pad_batch(xb, padded)
                 yb = _pad_batch(yb, padded) if yb is not None else None
-            xb = shard_batch(xb, self.ctx.mesh)
-            yb = shard_batch(yb, self.ctx.mesh)
-            wb = shard_batch(w, self.ctx.mesh)
-            stats = jax.device_get(
-                self._eval_step(self.params, xb, yb, wb))
-            for mname, mstats in stats.items():
-                acc = totals.setdefault(mname, {})
-                for k, v in mstats.items():
-                    acc[k] = acc.get(k, 0) + np.asarray(v)
+            return (shard_batch(xb, mesh), shard_batch(yb, mesh),
+                    shard_batch(w, mesh))
+
+        batches = _prefetch_iter(
+            ds.iter_batches(batch_size, shuffle=False,
+                            drop_last=False),
+            _place, _prefetch_depth())
+        try:
+            for xb, yb, wb in batches:
+                stats = jax.device_get(
+                    self._eval_step(self.params, xb, yb, wb))
+                for mname, mstats in stats.items():
+                    acc = totals.setdefault(mname, {})
+                    for k, v in mstats.items():
+                        acc[k] = acc.get(k, 0) + np.asarray(v)
+        finally:
+            batches.close()  # deterministic worker shutdown
         out = {}
         if "loss" in totals:
             out["loss"] = float(totals["loss"]["loss_sum"] /
@@ -692,14 +787,25 @@ class Estimator:
         # divide) and trim after
         dp = self.ctx.data_parallel_size
         padded = -(-batch_size // dp) * dp
-        for xb, _ in ds.iter_batches(batch_size, shuffle=False,
-                                     drop_last=False):
+        mesh = self.ctx.mesh
+
+        def _place(batch, mesh=mesh):
+            xb, _ = batch
             bsize = _batch_dim(xb)
             if bsize < padded:  # pad to keep the compiled shape
                 xb = _pad_batch(xb, padded)
-            xb = shard_batch(xb, self.ctx.mesh)
-            y = jax.device_get(self._predict_fn(self.params, xb))
-            outs.append(_trim_batch(y, bsize))
+            return shard_batch(xb, mesh), bsize
+
+        batches = _prefetch_iter(
+            ds.iter_batches(batch_size, shuffle=False,
+                            drop_last=False),
+            _place, _prefetch_depth())
+        try:
+            for xb, bsize in batches:
+                y = jax.device_get(self._predict_fn(self.params, xb))
+                outs.append(_trim_batch(y, bsize))
+        finally:
+            batches.close()  # deterministic worker shutdown
         if not outs:
             return np.empty((0,))
         return _concat_pytree(outs)[:n] if not isinstance(outs[0], (list,

@@ -3,6 +3,7 @@ analog (fit/evaluate/predict with checkpoint + clipping on a local
 multi-device mesh, SURVEY.md §4.2)."""
 
 import os
+import time
 
 import jax
 import numpy as np
@@ -414,3 +415,88 @@ def test_min_loss_max_score_triggers(rng):
     assert s(1, 10, True, val_metrics={"accuracy": 0.95})
     assert not s(1, 10, True, val_metrics={"accuracy": 0.5})
     assert not s(1, 10, True)
+
+
+class TestPrefetch:
+    """Input-pipeline prefetch (`_prefetch_iter`): numerics must be
+    identical to the synchronous path, and worker-thread exceptions
+    must surface at the consumer."""
+
+    def _fit(self, rng, monkeypatch, depth):
+        from analytics_zoo_tpu.pipeline.api.keras.models import Sequential
+        from analytics_zoo_tpu.pipeline.api.keras import layers as L
+        from analytics_zoo_tpu.common import nncontext
+        from analytics_zoo_tpu.pipeline.estimator import Estimator
+        nncontext.reset_nncontext()  # same init RNG for both fits
+        monkeypatch.setenv("ZOO_TPU_PREFETCH", str(depth))
+        x = rng.rand(48, 6).astype(np.float32)
+        y = rng.randint(0, 3, size=(48, 1))
+        m = Sequential()
+        m.add(L.Dense(16, input_shape=(6,), activation="relu"))
+        m.add(L.Dense(3, activation="softmax"))
+        est = Estimator(m, optimizer="sgd",
+                        loss="sparse_categorical_crossentropy")
+        res = est.train(x, y, batch_size=16, nb_epoch=2)
+        ev = est.evaluate(x, y, batch_size=16)
+        pred = est.predict(x[:20], batch_size=16)
+        return [h["loss"] for h in res.history], ev["loss"], pred
+
+    def test_prefetch_matches_sync(self, rng, monkeypatch):
+        l0, e0, p0 = self._fit(np.random.RandomState(7), monkeypatch, 0)
+        l2, e2, p2 = self._fit(np.random.RandomState(7), monkeypatch, 3)
+        np.testing.assert_allclose(l0, l2, rtol=1e-6)
+        np.testing.assert_allclose(e0, e2, rtol=1e-6)
+        np.testing.assert_allclose(p0, p2, rtol=1e-6)
+
+    def test_worker_exception_propagates(self):
+        from analytics_zoo_tpu.pipeline.estimator import _prefetch_iter
+
+        def gen():
+            yield 1
+            raise RuntimeError("augment failed")
+
+        it = _prefetch_iter(gen(), lambda v: v * 2, depth=2)
+        assert next(it) == 2
+        with pytest.raises(RuntimeError, match="augment failed"):
+            list(it)
+
+    def test_early_break_stops_worker(self):
+        import threading
+
+        from analytics_zoo_tpu.pipeline.estimator import _prefetch_iter
+
+        produced = []
+
+        def gen():
+            for i in range(1000):
+                produced.append(i)
+                yield i
+
+        it = _prefetch_iter(gen(), lambda v: v, depth=2)
+        for v in it:
+            if v >= 3:
+                break
+        it.close()  # GeneratorExit → stop event → worker drains out
+        deadline = time.time() + 5
+        while time.time() < deadline and any(
+                t.name == "zoo-tpu-prefetch" and t.is_alive()
+                for t in threading.enumerate()):
+            time.sleep(0.05)
+        assert not any(t.name == "zoo-tpu-prefetch" and t.is_alive()
+                       for t in threading.enumerate())
+        assert len(produced) < 1000  # did NOT run the iterator dry
+
+    def test_bad_env_value_falls_back(self, monkeypatch, caplog):
+        import logging
+
+        from analytics_zoo_tpu.pipeline.estimator import _prefetch_depth
+        monkeypatch.setenv("ZOO_TPU_PREFETCH", "off")
+        # the package logger sets propagate=False once nncontext
+        # configures it, so attach caplog's handler directly
+        zlog = logging.getLogger("analytics_zoo_tpu")
+        zlog.addHandler(caplog.handler)
+        try:
+            assert _prefetch_depth() == 2
+        finally:
+            zlog.removeHandler(caplog.handler)
+        assert "ZOO_TPU_PREFETCH" in caplog.text
