@@ -20,6 +20,7 @@ EXAMPLES = [
     "rdd_ingest",
     "quantized_serving",
     "long_context",
+    "bert_finetune",
     "autograd_custom",
     "qa_ranker",
     "transformer_sentiment",

@@ -1,0 +1,105 @@
+"""BERT fine-tune over a data-parallel TPU mesh — BASELINE config #5
+("TFPark TFOptimizer: distributed BERT-base fine-tune on TPU pod").
+
+The reference fine-tunes BERT by running its frozen TF graph through
+TFOptimizer on BigDL's data-parallel loop (`P/tfpark/`, SURVEY.md
+§2.5). Here the zoo's native :class:`BERT` encoder
+(`layers/transformer.py`, reference `BERT.scala:53-110`) trains under
+the Estimator's jitted SPMD step: batch sharded over the mesh's
+``data`` axis, gradient all-reduce as an XLA collective over ICI,
+``remat=True`` to fit long contexts, flash attention auto-routed past
+the measured crossover.
+
+Synthetic sentence-pair classification data stands in for GLUE (the
+reference apps ship no corpora either); real token ids drop in
+unchanged. On CPU:
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python -m analytics_zoo_tpu.examples bert_finetune --devices 8
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--devices", type=int, default=0,
+                   help="0 = use all visible devices")
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--hidden", type=int, default=128,
+                   help="128 keeps the demo fast; BERT-base is 768")
+    p.add_argument("--blocks", type=int, default=2,
+                   help="2 keeps the demo fast; BERT-base is 12")
+    p.add_argument("--batch-per-device", type=int, default=4)
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--freeze-encoder", action="store_true",
+                   help="train only the classifier head (feature-"
+                        "extraction fine-tune)")
+    args = p.parse_args(argv)
+
+    import jax
+
+    from analytics_zoo_tpu import init_nncontext
+    from analytics_zoo_tpu.ops.optimizers import Adam, warmup
+    from analytics_zoo_tpu.pipeline.api.autograd import Lambda
+    from analytics_zoo_tpu.pipeline.api.keras import layers as L
+    from analytics_zoo_tpu.pipeline.api.keras.models import Sequential
+    from analytics_zoo_tpu.pipeline.estimator import Estimator
+
+    n = args.devices or len(jax.devices())
+    ctx = init_nncontext(tpu_mesh={"data": n},
+                         devices=jax.devices()[:n], seed=0)
+    t, h = args.seq_len, args.hidden
+    batch = args.batch_per_device * n
+    n_cls, vocab = 2, 1000
+
+    # -- model: BERT encoder + pooled-output classifier head ----------
+    bert = L.BERT(vocab=vocab, hidden_size=h, n_block=args.blocks,
+                  n_head=max(2, h // 64), seq_len=t,
+                  intermediate_size=4 * h, output_all_block=False,
+                  remat=True, name="bert",
+                  input_shape=[(t,)] * 4)
+    if args.freeze_encoder:
+        bert.trainable = False
+    model = Sequential()
+    model.add(bert)
+    # BERT outputs [sequence_output, pooled_output]; classify on pooled
+    model.add(Lambda(lambda outs: outs[1], name="take_pooled",
+                     output_shape=(h,)))
+    model.add(L.Dropout(0.1))
+    model.add(L.Dense(n_cls, activation="softmax", name="classifier"))
+
+    # -- synthetic sentence-pair batch (GLUE-shaped) -------------------
+    rs = np.random.RandomState(0)
+    n_samples = batch * 8
+    tok = rs.randint(1, vocab, size=(n_samples, t)).astype(np.int32)
+    seg = (np.arange(t)[None, :] >= t // 2).astype(np.int32) \
+        * np.ones((n_samples, 1), np.int32)
+    pos = np.tile(np.arange(t, dtype=np.int32), (n_samples, 1))
+    mask = np.ones((n_samples, t), np.float32)
+    # separable labels: class = whether the first segment's mean token
+    # id is above the vocab midpoint (learnable from embeddings alone)
+    y = (tok[:, : t // 2].mean(axis=1) > vocab / 2).astype(
+        np.int32)[:, None]
+
+    est = Estimator(
+        model,
+        optimizer=Adam(lr=warmup(5e-5, 8, delta=(5e-4 - 5e-5) / 8)),
+        loss="sparse_categorical_crossentropy",
+        metrics=["accuracy"], ctx=ctx)
+    res = est.train([tok, seg, pos, mask], y, batch_size=batch,
+                    nb_epoch=args.epochs)
+    scores = est.evaluate([tok, seg, pos, mask], y, batch_size=batch)
+    print(f"devices={n} seq_len={t} blocks={args.blocks} "
+          f"frozen={args.freeze_encoder}")
+    print(f"final train loss={res.history[-1]['loss']:.4f} "
+          f"eval={scores}")
+    return scores
+
+
+if __name__ == "__main__":
+    main()

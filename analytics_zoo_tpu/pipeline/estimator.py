@@ -331,8 +331,13 @@ class Estimator:
                  dtype_policy: Optional[str] = None):
         if parallel_mode not in ("dp", "fsdp", "tp", "ep"):
             raise ValueError("parallel_mode must be dp|fsdp|tp|ep")
+        # default: bf16 activations on TPU (the MXU-native dtype,
+        # PERF.md), exact f32 elsewhere (golden tests, CPU parity);
+        # explicit arg > env > backend default
         dtype_policy = dtype_policy or os.environ.get(
-            "ZOO_TPU_DTYPE_POLICY", "float32")
+            "ZOO_TPU_DTYPE_POLICY") or (
+            "mixed_bfloat16"
+            if jax.default_backend() in ("tpu", "axon") else "float32")
         if dtype_policy not in ("float32", "mixed_bfloat16"):
             raise ValueError(
                 "dtype_policy must be float32|mixed_bfloat16")

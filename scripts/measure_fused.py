@@ -51,6 +51,12 @@ def main():
     import jax
     import jax.numpy as jnp
 
+    # the axon plugin registers regardless of JAX_PLATFORMS; the
+    # config update is authoritative (conftest.py does the same)
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms",
+                          os.environ["JAX_PLATFORMS"])
+
     try:
         jax.config.update("jax_compilation_cache_dir",
                           "/tmp/zoo_tpu_xla_cache")

@@ -152,3 +152,19 @@ def test_wide_and_deep():
                     "--batch-size", "256", "--users", "50",
                     "--items", "40"])
     assert metrics["accuracy"] > 0.25   # 5 classes: chance is 0.2
+
+
+def test_bert_finetune():
+    scores = _run("bert_finetune",
+                  ["--devices", "2", "--seq-len", "32", "--hidden",
+                   "32", "--blocks", "1", "--batch-per-device", "2",
+                   "--epochs", "1"])
+    assert "accuracy" in scores
+
+
+def test_bert_finetune_frozen_encoder():
+    scores = _run("bert_finetune",
+                  ["--devices", "2", "--seq-len", "32", "--hidden",
+                   "32", "--blocks", "1", "--batch-per-device", "2",
+                   "--epochs", "1", "--freeze-encoder"])
+    assert np.isfinite(scores["loss"])

@@ -500,3 +500,25 @@ class TestPrefetch:
         finally:
             zlog.removeHandler(caplog.handler)
         assert "ZOO_TPU_PREFETCH" in caplog.text
+
+
+def test_dtype_policy_resolution(monkeypatch):
+    """Default policy: bf16 on TPU backends, f32 elsewhere; explicit
+    arg > env > backend default."""
+    from analytics_zoo_tpu.pipeline import estimator as est_mod
+    from analytics_zoo_tpu.pipeline.api.keras import layers as L
+    from analytics_zoo_tpu.pipeline.api.keras.models import Sequential
+
+    def mk(**kw):
+        m = Sequential()
+        m.add(L.Dense(1, input_shape=(2,)))
+        return est_mod.Estimator(m, optimizer="sgd", loss="mse", **kw)
+
+    monkeypatch.delenv("ZOO_TPU_DTYPE_POLICY", raising=False)
+    assert mk().dtype_policy == "float32"          # cpu backend
+    monkeypatch.setattr(est_mod.jax, "default_backend", lambda: "tpu")
+    assert mk().dtype_policy == "mixed_bfloat16"   # tpu default
+    monkeypatch.setenv("ZOO_TPU_DTYPE_POLICY", "float32")
+    assert mk().dtype_policy == "float32"          # env beats backend
+    assert mk(dtype_policy="mixed_bfloat16").dtype_policy \
+        == "mixed_bfloat16"                        # arg beats env
