@@ -64,6 +64,23 @@ def read_bytes(path: str) -> bytes:
         return f.read()
 
 
+def parallel_map(fn, items, env_knob: str = "ZOO_TPU_DECODE_WORKERS",
+                 default_workers: int = 8, min_items: int = 4):
+    """Order-preserving thread-pool map for GIL-releasing per-item
+    work (PIL decode/resize, numpy transforms). Serial when the knob
+    is <=1, unparseable-but-small, or the batch is tiny."""
+    try:
+        workers = int(os.environ.get(env_knob, str(default_workers)))
+    except ValueError:
+        workers = default_workers
+    items = list(items)
+    if workers > 1 and len(items) >= min_items:
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(min(workers, len(items))) as ex:
+            return list(ex.map(fn, items))
+    return [fn(i) for i in items]
+
+
 def read_bytes_many(paths) -> "dict":
     """``{path: bytes}`` for a batch of paths. Remote schemes fetch in
     ONE ``fs.cat`` call (concurrent under the hood) instead of a

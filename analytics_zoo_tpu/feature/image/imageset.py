@@ -76,13 +76,25 @@ def _decode_many(blobs, keyed) -> "list":
     """Decode `(key, extra)` pairs via ``blobs[key]``; undecodable
     files are skipped with ONE summary warning (reference: Spark's
     input machinery logs bad records rather than failing the job or
-    silently shrinking the dataset)."""
-    out, dropped = [], []
-    for key, extra in keyed:
+    silently shrinking the dataset).
+
+    Decoding runs on a thread pool (``ZOO_TPU_DECODE_WORKERS``,
+    default 8): PIL's decompressors release the GIL, so this plays
+    the role of the reference's per-executor parallel OpenCV decode
+    for a many-thousand-image read."""
+    def dec(pair):
+        key, extra = pair
         try:
-            out.append((key, extra, _decode_bytes(blobs[key])))
+            return (key, extra, _decode_bytes(blobs[key]))
         except Exception:
+            return (key, extra, None)  # None image == undecodable
+
+    out, dropped = [], []
+    for key, extra, img in zutils.parallel_map(dec, keyed):
+        if img is None:
             dropped.append(key)
+        else:
+            out.append((key, extra, img))
     if dropped:
         logger.warning(
             "ImageSet.read: skipped %d of %d file(s) that failed to "

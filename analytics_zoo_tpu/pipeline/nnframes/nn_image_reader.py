@@ -56,19 +56,26 @@ class NNImageReader:
         # one batched fetch (fs.cat) for remote schemes; IO errors
         # propagate — only DECODE failures mark a file as non-image
         blobs = zutils.read_bytes_many(files)
-        rows = []
-        dropped: List[str] = []
-        for f in files:
+
+        def dec(f):
             try:
                 with Image.open(io.BytesIO(blobs[f])) as im:
                     rgb = im.convert("RGB")
                     if resize_h > 0 and resize_w > 0:
                         rgb = rgb.resize((resize_w, resize_h),
                                          Image.BILINEAR)
-                    arr = np.asarray(rgb, np.uint8)
+                    return np.asarray(rgb, np.uint8)
             except Exception:
+                return None  # non-image file → skipped (with warning)
+
+        # PIL decode/resize release the GIL: thread-pool the batch
+        # (same knob as ImageSet.read's decoder)
+        rows = []
+        dropped: List[str] = []
+        for f, arr in zip(files, zutils.parallel_map(dec, files)):
+            if arr is None:
                 dropped.append(f)
-                continue  # non-image files are skipped (with a warning)
+                continue
             rows.append({
                 NNImageSchema.ORIGIN: f,
                 NNImageSchema.HEIGHT: arr.shape[0],

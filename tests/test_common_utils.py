@@ -259,3 +259,15 @@ class TestRemoteFS:
         from analytics_zoo_tpu.common import utils
         with pytest.raises(NotImplementedError, match="s3"):
             utils.read_bytes("s3a://bucket/key")
+
+
+def test_parallel_map_order_and_fallbacks(monkeypatch):
+    from analytics_zoo_tpu.common.utils import parallel_map
+    items = list(range(20))
+    fn = lambda i: i * i  # noqa: E731
+    monkeypatch.setenv("ZOO_TPU_DECODE_WORKERS", "4")
+    assert parallel_map(fn, items) == [i * i for i in items]
+    monkeypatch.setenv("ZOO_TPU_DECODE_WORKERS", "1")  # serial
+    assert parallel_map(fn, items) == [i * i for i in items]
+    monkeypatch.setenv("ZOO_TPU_DECODE_WORKERS", "bogus")  # default
+    assert parallel_map(fn, [1, 2]) == [1, 4]  # tiny batch → serial

@@ -420,3 +420,26 @@ def test_fit_accepts_textset_and_imageset_directly():
                loss="sparse_categorical_crossentropy")
     res = mi.fit(iset, batch_size=8, nb_epoch=1)
     assert np.isfinite(res.history[-1]["loss"])
+
+
+def test_imageset_parallel_decode_matches_serial(tmp_path, monkeypatch):
+    """>3 files routes through the decode thread pool; order and
+    content must match the serial path, bad files still dropped."""
+    from PIL import Image
+
+    from analytics_zoo_tpu.feature.image import ImageSet
+    rs = np.random.RandomState(3)
+    for i in range(6):
+        Image.fromarray(
+            rs.randint(0, 255, (5 + i, 7, 3)).astype(np.uint8)) \
+            .save(tmp_path / f"im{i}.png")
+    (tmp_path / "zz_bad.png").write_bytes(b"nope")
+
+    monkeypatch.setenv("ZOO_TPU_DECODE_WORKERS", "4")
+    par = ImageSet.read(str(tmp_path))
+    monkeypatch.setenv("ZOO_TPU_DECODE_WORKERS", "1")
+    ser = ImageSet.read(str(tmp_path))
+    assert len(par.features) == len(ser.features) == 6
+    for a, b in zip(par.features, ser.features):
+        assert a[a.URI] == b[b.URI]
+        np.testing.assert_array_equal(a.image, b.image)
