@@ -728,6 +728,8 @@ class Estimator:
             self._profile_dir = None
         if tb is not None:
             tb.flush()
+        # durable on return: join any in-flight async checkpoint write
+        self.wait_for_checkpoint()
         return TrainResult(history, self.params, self.opt_state, self.step)
 
     def evaluate(self, data, y=None, batch_size: int = 32
@@ -818,28 +820,81 @@ class Estimator:
 
     # -- checkpoint / resume (reference `Topology.scala:238-248,996-1004`,
     #    resume via Module.load, SURVEY.md §5 "Checkpoint / resume") -------
-    def save_checkpoint(self, path: Optional[str] = None):
+    def save_checkpoint(self, path: Optional[str] = None,
+                        block: Optional[bool] = None):
+        """Snapshot params/opt_state/step to ``path``.
+
+        The device→host fetch is always synchronous (donated step
+        buffers make a background fetch unsafe); with ``block=False``
+        (or ``ZOO_TPU_ASYNC_CKPT=1``) the pickle + atomic write happen
+        on a background thread so the train loop resumes immediately.
+        Writes are serialized; a failed background write re-raises at
+        the next save (or at :meth:`wait_for_checkpoint`)."""
         path = path or self.checkpoint_path
         if path is None:
             raise ValueError("no checkpoint path set")
+        if block is None:
+            block = os.environ.get("ZOO_TPU_ASYNC_CKPT", "0") != "1"
+        self.wait_for_checkpoint()  # serialize + surface prior errors
         os.makedirs(path, exist_ok=True)
         state = {
             "params": jax.device_get(self.params),
             "opt_state": jax.device_get(self.opt_state),
             "step": self.step,
         }
-        tmp = os.path.join(path, f".tmp_ckpt_{self.step}")
-        with open(tmp, "wb") as f:
-            pickle.dump(state, f)
-        final = os.path.join(path, f"ckpt_{self.step}.pkl")
-        os.replace(tmp, final)
-        latest = os.path.join(path, "LATEST")
-        with open(latest, "w") as f:
-            f.write(os.path.basename(final))
-        return final
+        step = self.step
+
+        def write():
+            tmp = os.path.join(path, f".tmp_ckpt_{step}")
+            with open(tmp, "wb") as f:
+                pickle.dump(state, f)
+            final = os.path.join(path, f"ckpt_{step}.pkl")
+            os.replace(tmp, final)
+            latest = os.path.join(path, "LATEST")
+            with open(latest, "w") as f:
+                f.write(os.path.basename(final))
+            return final
+
+        if block:
+            return write()
+
+        def worker():
+            try:
+                write()
+            except BaseException as e:  # noqa: BLE001 — re-raised
+                self._ckpt_error = e
+
+        import threading
+        # non-daemon: if training dies mid-write, interpreter shutdown
+        # still joins the writer, so the newest checkpoint survives —
+        # the exact crash-recovery scenario async writes exist for
+        t = threading.Thread(target=worker, daemon=False,
+                             name="zoo-tpu-ckpt-write")
+        t.start()
+        self._ckpt_thread = t
+        return os.path.join(path, f"ckpt_{step}.pkl")
+
+    def _join_ckpt_write(self):
+        """Join any in-flight async checkpoint write without raising
+        (safe inside ``finally`` — must not mask an active
+        exception)."""
+        t = getattr(self, "_ckpt_thread", None)
+        if t is not None:
+            t.join()
+            self._ckpt_thread = None
+
+    def wait_for_checkpoint(self):
+        """Join any in-flight async checkpoint write; re-raise its
+        error if it failed."""
+        self._join_ckpt_write()
+        err = getattr(self, "_ckpt_error", None)
+        if err is not None:
+            self._ckpt_error = None
+            raise err
 
     def load_checkpoint(self, path: Optional[str] = None,
                         step: Optional[int] = None):
+        self.wait_for_checkpoint()  # LATEST may be mid-rewrite
         path = path or self.checkpoint_path
         if step is not None:
             fname = os.path.join(path, f"ckpt_{step}.pkl")

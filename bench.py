@@ -250,6 +250,10 @@ def main():
     # program's visible count (cost_analysis on the LOWERED program —
     # no second backend compile).
     ref_flops_holder = {}
+    # unfused 20-step loss: the numeric-sanity reference for the
+    # fused/defer variants (same data, same step count; init RNGs
+    # differ so the band is deliberately loose)
+    ref_loss_holder = {}
 
     VARIANT_TAGS = {False: "unfused", True: "fused",
                     "defer": "defer"}
@@ -350,6 +354,20 @@ def main():
         best_dt, loss = None, float("nan")
         for _ in range(2):
             dt_i, loss = timed()
+            # numeric sanity: a variant whose 20-step loss is not
+            # finite (or wildly off the unfused reference's — garbage
+            # computed fast) must not win the A/B on speed alone
+            if not np.isfinite(loss):
+                raise RuntimeError(
+                    f"non-finite loss {loss} after {steps} steps")
+            ref_loss = ref_loss_holder.get("loss")
+            if ref_loss is not None and not (
+                    0.5 * ref_loss < loss < 2.0 * ref_loss):
+                raise RuntimeError(
+                    f"loss {loss:.3f} diverges from the unfused "
+                    f"reference's {ref_loss:.3f}")
+            if not fused:
+                ref_loss_holder["loss"] = loss
             best_dt = dt_i if best_dt is None else min(best_dt, dt_i)
             dt, images_per_sec, mfu, mfu_model = derive(best_dt)
             # record as soon as one measurement exists (and only if

@@ -522,3 +522,35 @@ def test_dtype_policy_resolution(monkeypatch):
     assert mk().dtype_policy == "float32"          # env beats backend
     assert mk(dtype_policy="mixed_bfloat16").dtype_policy \
         == "mixed_bfloat16"                        # arg beats env
+
+
+def test_async_checkpoint_write(tmp_path, monkeypatch):
+    """ZOO_TPU_ASYNC_CKPT=1: writes land on a background thread, are
+    durable by train() return, and resume identically to sync."""
+    monkeypatch.setenv("ZOO_TPU_ASYNC_CKPT", "1")
+    init_nncontext(seed=9)
+    x, y = _regression_data(64)
+    m = Sequential()
+    m.add(L.Dense(1, input_shape=(4,)))
+    m.compile(optimizer=O.Adam(lr=0.05), loss="mse")
+    m.set_checkpoint(str(tmp_path / "ckpt"),
+                     trigger=SeveralIteration(1))  # save every step
+    m.fit(x, y, batch_size=32, nb_epoch=2)
+    step = m.estimator.step
+    assert (tmp_path / "ckpt" / f"ckpt_{step}.pkl").exists()
+    assert (tmp_path / "ckpt" / "LATEST").read_text() \
+        == f"ckpt_{step}.pkl"
+
+    m2 = Sequential()
+    m2.add(L.Dense(1, input_shape=(4,)))
+    m2.compile(optimizer=O.Adam(lr=0.05), loss="mse")
+    m2.estimator.load_checkpoint(str(tmp_path / "ckpt"))
+    assert m2.estimator.step == step
+
+    # a failed background write surfaces at the next save
+    est = m.estimator
+    est.save_checkpoint(str(tmp_path / "ckpt"), block=False)
+    est.wait_for_checkpoint()
+    est._ckpt_error = RuntimeError("disk full")
+    with pytest.raises(RuntimeError, match="disk full"):
+        est.save_checkpoint(str(tmp_path / "ckpt"))
