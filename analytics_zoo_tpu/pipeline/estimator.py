@@ -894,7 +894,9 @@ class Estimator:
 
     def load_checkpoint(self, path: Optional[str] = None,
                         step: Optional[int] = None):
-        self.wait_for_checkpoint()  # LATEST may be mid-rewrite
+        # join only (no raise): LATEST may be mid-rewrite, but a stale
+        # failed-save error must not abort an unrelated load
+        self._join_ckpt_write()
         path = path or self.checkpoint_path
         if step is not None:
             fname = os.path.join(path, f"ckpt_{step}.pkl")

@@ -22,10 +22,9 @@ run python bench.py
 #    subprocess duplicate here
 run python scripts/measure_fused.py --steps 20 --skip-model
 
-# 3. the deferred-apply stage variant (fused="defer") A/B against
-#    plain fused, then a batch sweep on the fused path (BN traffic
-#    reduced further by the strided kernel: 192/256 may win now)
-ZOO_TPU_BENCH_FUSED=defer ZOO_TPU_BENCH_NCF=0 run python bench.py
+# 3. batch sweep on the fused path (auto in step 1 already covers
+#    unfused/fused/defer at 128; BN traffic reduced by the strided
+#    kernel means 192/256 may win now)
 for b in 192 256; do
   ZOO_TPU_BENCH_FUSED=1 ZOO_TPU_BENCH_BATCH=$b ZOO_TPU_BENCH_NCF=0 run python bench.py
 done
