@@ -1,0 +1,163 @@
+"""On-device batched image augmentation (jit/vmap-native).
+
+The reference augments per-record on executor CPUs through OpenCV
+(`Z/feature/image/*.scala`, SURVEY.md §2.2); the host-side analog here
+is `feature/image/transforms.py`. This module is the TPU-first
+alternative: pure-JAX augmentations over an NHWC batch that run
+*inside* the jitted train step — per-image randomness from one
+`jax.random` key, static output shapes (XLA-friendly `dynamic_slice`
+crops), elementwise color math fused by XLA into neighbouring ops.
+Augmenting on-device frees host cores for decode/IO and rides the
+batch's existing sharding (each data-parallel shard augments its own
+images; no host round trip).
+
+Example::
+
+    aug = augment_pipeline(
+        random_crop((224, 224)), random_hflip(),
+        random_brightness(0.2), random_contrast(0.2),
+        normalize(mean=(123.68, 116.779, 103.939)))
+    ...
+    def train_step(params, opt_state, rng, x, y):
+        x = aug(rng, x)                      # traced into the step
+        ...
+
+Every op is ``fn(rng, images) -> images`` over float NHWC; compose
+with :func:`augment_pipeline` (per-op keys are position-`fold_in`
+derived: appending ops preserves earlier ops' randomness).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+AugmentOp = Callable[[jax.Array, jax.Array], jax.Array]
+
+
+def augment_pipeline(*ops: AugmentOp) -> AugmentOp:
+    """Compose ops left-to-right under one rng key. Op i's key is
+    ``fold_in(rng, i)`` — positional, so APPENDING ops never changes
+    the randomness of earlier ones; inserting/reordering does."""
+    def run(rng, images):
+        for i, op in enumerate(ops):
+            images = op(jax.random.fold_in(rng, i), images)
+        return images
+    return run
+
+
+def random_crop(size: "Tuple[int, int]") -> AugmentOp:
+    """Random spatial crop to ``(h, w)`` — static output shape, one
+    `dynamic_slice` per image (reference `ImageRandomCrop`)."""
+    ch, cw = int(size[0]), int(size[1])
+
+    def op(rng, images):
+        n, h, w, c = images.shape
+        if h < ch or w < cw:
+            raise ValueError(f"crop {ch}x{cw} larger than input "
+                             f"{h}x{w}")
+        ky, kx = jax.random.split(rng)
+        ys = jax.random.randint(ky, (n,), 0, h - ch + 1)
+        xs = jax.random.randint(kx, (n,), 0, w - cw + 1)
+
+        def crop_one(img, y, x):
+            return jax.lax.dynamic_slice(img, (y, x, 0), (ch, cw, c))
+
+        return jax.vmap(crop_one)(images, ys, xs)
+    return op
+
+
+def center_crop(size: "Tuple[int, int]") -> AugmentOp:
+    """Deterministic center crop (eval-path twin of `random_crop`)."""
+    ch, cw = int(size[0]), int(size[1])
+
+    def op(rng, images):
+        del rng
+        n, h, w, c = images.shape
+        y, x = (h - ch) // 2, (w - cw) // 2
+        return jax.lax.dynamic_slice(
+            images, (0, y, x, 0), (n, ch, cw, c))
+    return op
+
+
+def random_hflip(p: float = 0.5) -> AugmentOp:
+    """Horizontal flip with probability ``p`` per image (reference
+    `ImageHFlip`)."""
+    def op(rng, images):
+        n = images.shape[0]
+        flip = jax.random.bernoulli(rng, p, (n,))
+        flipped = images[:, :, ::-1, :]
+        return jnp.where(flip[:, None, None, None], flipped, images)
+    return op
+
+
+def random_brightness(max_delta: float) -> AugmentOp:
+    """Additive brightness jitter in ``[-max_delta, max_delta]``
+    (fraction of the 255 range; reference `ImageBrightness`)."""
+    def op(rng, images):
+        n = images.shape[0]
+        delta = jax.random.uniform(
+            rng, (n, 1, 1, 1), minval=-max_delta, maxval=max_delta)
+        return images + delta * 255.0
+    return op
+
+
+def random_contrast(max_delta: float) -> AugmentOp:
+    """Contrast jitter: blend with the per-image mean by a factor in
+    ``[1-max_delta, 1+max_delta]`` (reference `ImageContrast`)."""
+    def op(rng, images):
+        n = images.shape[0]
+        f = jax.random.uniform(rng, (n, 1, 1, 1),
+                               minval=1.0 - max_delta,
+                               maxval=1.0 + max_delta)
+        mean = jnp.mean(images, axis=(1, 2, 3), keepdims=True)
+        return (images - mean) * f + mean
+    return op
+
+
+def random_saturation(max_delta: float) -> AugmentOp:
+    """Saturation jitter: blend with the grayscale image (ITU-R 601
+    luma — the OpenCV coefficients the reference uses)."""
+    def op(rng, images):
+        n = images.shape[0]
+        f = jax.random.uniform(rng, (n, 1, 1, 1),
+                               minval=1.0 - max_delta,
+                               maxval=1.0 + max_delta)
+        gray = (0.299 * images[..., 0] + 0.587 * images[..., 1]
+                + 0.114 * images[..., 2])[..., None]
+        return (images - gray) * f + gray
+    return op
+
+
+def normalize(mean: Sequence[float],
+              std: Sequence[float] = (1.0, 1.0, 1.0)) -> AugmentOp:
+    """Per-channel ``(x - mean) / std`` (reference
+    `ImageChannelNormalize`)."""
+    mean_a = jnp.asarray(mean, jnp.float32)
+    std_a = jnp.asarray(std, jnp.float32)
+
+    def op(rng, images):
+        del rng
+        return (images - mean_a) / std_a
+    return op
+
+
+def cutout(size: int, fill: float = 0.0) -> AugmentOp:
+    """Zero a random ``size``×``size`` square per image (regularizer;
+    no reference analog — TPU-era extra)."""
+    s = int(size)
+
+    def op(rng, images):
+        n, h, w, _ = images.shape
+        ky, kx = jax.random.split(rng)
+        # random top-left corner of an exactly s x s window
+        y0 = jax.random.randint(ky, (n, 1, 1), 0, max(h - s, 0) + 1)
+        x0 = jax.random.randint(kx, (n, 1, 1), 0, max(w - s, 0) + 1)
+        yy = jnp.arange(h)[None, :, None]
+        xx = jnp.arange(w)[None, None, :]
+        inside = ((yy >= y0) & (yy < y0 + s)
+                  & (xx >= x0) & (xx < x0 + s))
+        return jnp.where(inside[..., None], fill, images)
+    return op

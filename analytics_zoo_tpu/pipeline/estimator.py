@@ -894,9 +894,17 @@ class Estimator:
 
     def load_checkpoint(self, path: Optional[str] = None,
                         step: Optional[int] = None):
-        # join only (no raise): LATEST may be mid-rewrite, but a stale
-        # failed-save error must not abort an unrelated load
+        # join only (no raise): LATEST may be mid-rewrite, and a
+        # failed-save error must not abort the load — but the caller
+        # must know LATEST may be older than they think, and the error
+        # stays pending so the next save/wait still raises it
         self._join_ckpt_write()
+        err = getattr(self, "_ckpt_error", None)
+        if err is not None:
+            logger.warning(
+                "an async checkpoint write failed (%s); LATEST may "
+                "point at an older step. The error will re-raise at "
+                "the next save_checkpoint/wait_for_checkpoint.", err)
         path = path or self.checkpoint_path
         if step is not None:
             fname = os.path.join(path, f"ckpt_{step}.pkl")

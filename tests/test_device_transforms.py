@@ -1,0 +1,114 @@
+"""On-device augmentation (`feature/image/device_transforms`): shape,
+determinism, numeric semantics vs numpy, jit-ability, and sharded-batch
+execution on the 8-device mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.feature.image.device_transforms import (
+    augment_pipeline, center_crop, cutout, normalize, random_brightness,
+    random_contrast, random_crop, random_hflip, random_saturation)
+
+
+@pytest.fixture
+def batch(rng):
+    return jnp.asarray(rng.rand(8, 16, 20, 3).astype(np.float32) * 255)
+
+
+def test_random_crop_shape_and_content(batch):
+    key = jax.random.PRNGKey(0)
+    out = random_crop((8, 10))(key, batch)
+    assert out.shape == (8, 8, 10, 3)
+    # every crop is a contiguous window of the source image
+    src = np.asarray(batch[0])
+    win = np.asarray(out[0])
+    found = any(
+        np.array_equal(src[y:y + 8, x:x + 10], win)
+        for y in range(16 - 8 + 1) for x in range(20 - 10 + 1))
+    assert found
+
+
+def test_random_crop_rejects_oversize(batch):
+    with pytest.raises(ValueError, match="larger than input"):
+        random_crop((64, 64))(jax.random.PRNGKey(0), batch)
+
+
+def test_center_crop(batch):
+    out = center_crop((8, 10))(jax.random.PRNGKey(0), batch)
+    np.testing.assert_array_equal(
+        np.asarray(out), np.asarray(batch)[:, 4:12, 5:15, :])
+
+
+def test_hflip_semantics(batch):
+    out = random_hflip(1.0)(jax.random.PRNGKey(0), batch)  # always
+    np.testing.assert_array_equal(
+        np.asarray(out), np.asarray(batch)[:, :, ::-1, :])
+    out0 = random_hflip(0.0)(jax.random.PRNGKey(0), batch)  # never
+    np.testing.assert_array_equal(np.asarray(out0), np.asarray(batch))
+
+
+def test_color_ops_match_numpy(batch):
+    key = jax.random.PRNGKey(3)
+    x = np.asarray(batch)
+    # factor pinned to 1; (x-mean)+mean cancellation leaves ~1e-5 abs
+    out = random_contrast(0.0)(key, batch)
+    np.testing.assert_allclose(np.asarray(out), x, rtol=1e-4,
+                               atol=1e-3)
+    out = random_saturation(0.0)(key, batch)
+    np.testing.assert_allclose(np.asarray(out), x, rtol=1e-4,
+                               atol=1e-3)
+    out = random_brightness(0.0)(key, batch)
+    np.testing.assert_allclose(np.asarray(out), x, rtol=1e-5)
+
+    mean, std = (10.0, 20.0, 30.0), (2.0, 4.0, 8.0)
+    out = normalize(mean, std)(key, batch)
+    np.testing.assert_allclose(
+        np.asarray(out), (x - np.asarray(mean)) / np.asarray(std),
+        rtol=1e-5)
+
+
+def test_cutout_zeroes_a_window(batch):
+    out = cutout(6, fill=0.0)(jax.random.PRNGKey(1), batch)
+    x, o = np.asarray(batch), np.asarray(out)
+    assert (o == 0.0).sum() > (x == 0.0).sum()   # something was cut
+    assert np.all((o == x) | (o == 0.0))          # only zeroing
+
+
+def test_pipeline_deterministic_and_jittable(batch):
+    aug = augment_pipeline(
+        random_crop((8, 10)), random_hflip(),
+        random_brightness(0.2), random_contrast(0.2),
+        random_saturation(0.2), normalize((128.0,) * 3, (64.0,) * 3))
+    key = jax.random.PRNGKey(7)
+    eager = aug(key, batch)
+    jitted = jax.jit(aug)(key, batch)
+    # XLA fuses/reassociates the color math: last-ulp level wobble
+    np.testing.assert_allclose(np.asarray(eager), np.asarray(jitted),
+                               rtol=1e-4, atol=1e-3)
+    again = jax.jit(aug)(key, batch)
+    np.testing.assert_array_equal(np.asarray(jitted), np.asarray(again))
+    other = jax.jit(aug)(jax.random.PRNGKey(8), batch)
+    assert not np.array_equal(np.asarray(jitted), np.asarray(other))
+
+
+def test_pipeline_on_sharded_batch(rng):
+    """Augmentation rides the batch's DP sharding inside jit."""
+    from analytics_zoo_tpu import init_nncontext
+    from analytics_zoo_tpu.parallel.mesh import shard_batch
+    ctx = init_nncontext(tpu_mesh={"data": 8})
+    aug = augment_pipeline(random_crop((8, 8)), random_hflip(),
+                           normalize((128.0,) * 3))
+    x = jnp.asarray(rng.rand(16, 12, 12, 3).astype(np.float32))
+    xs = shard_batch(x, ctx.mesh)
+    out = jax.jit(aug)(jax.random.PRNGKey(0), xs)
+    assert out.shape == (16, 8, 8, 3)
+    assert len(out.sharding.device_set) == 8
+
+
+def test_cutout_exact_window_size(rng):
+    x = jnp.ones((4, 20, 20, 3), jnp.float32)
+    out = np.asarray(cutout(6)(jax.random.PRNGKey(5), x))
+    for i in range(4):
+        assert (out[i] == 0).sum() == 6 * 6 * 3  # exactly 6x6 window
