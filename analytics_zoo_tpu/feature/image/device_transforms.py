@@ -15,7 +15,7 @@ Example::
 
     aug = augment_pipeline(
         random_crop((224, 224)), random_hflip(),
-        random_brightness(0.2), random_contrast(0.2),
+        random_brightness(32.0), random_contrast(0.8, 1.2),
         normalize(mean=(123.68, 116.779, 103.939)))
     ...
     def train_step(params, opt_state, rng, x, y):
@@ -29,7 +29,7 @@ derived: appending ops preserves earlier ops' randomness).
 
 from __future__ import annotations
 
-from typing import Callable, Sequence, Tuple
+from typing import Callable, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -93,41 +93,50 @@ def random_hflip(p: float = 0.5) -> AugmentOp:
     return op
 
 
-def random_brightness(max_delta: float) -> AugmentOp:
-    """Additive brightness jitter in ``[-max_delta, max_delta]``
-    (fraction of the 255 range; reference `ImageBrightness`)."""
+def random_brightness(delta_low: float,
+                      delta_high: Optional[float] = None) -> AugmentOp:
+    """Additive brightness jitter: per-image delta in pixel units,
+    uniform in ``[delta_low, delta_high]`` (``(d)`` means ``(-d, d)``),
+    clipped to [0, 255] — the host `ImageBrightness` semantics
+    (`transforms.py`)."""
+    lo, hi = ((-abs(delta_low), abs(delta_low))
+              if delta_high is None else (delta_low, delta_high))
+
     def op(rng, images):
         n = images.shape[0]
-        delta = jax.random.uniform(
-            rng, (n, 1, 1, 1), minval=-max_delta, maxval=max_delta)
-        return images + delta * 255.0
+        delta = jax.random.uniform(rng, (n, 1, 1, 1),
+                                   minval=lo, maxval=hi)
+        return jnp.clip(images + delta, 0.0, 255.0)
     return op
 
 
-def random_contrast(max_delta: float) -> AugmentOp:
-    """Contrast jitter: blend with the per-image mean by a factor in
-    ``[1-max_delta, 1+max_delta]`` (reference `ImageContrast`)."""
+def random_contrast(delta_low: float = 0.5,
+                    delta_high: float = 1.5) -> AugmentOp:
+    """Multiplicative contrast jitter: per-image ``x * f`` with ``f``
+    uniform in ``[delta_low, delta_high]``, clipped to [0, 255] — the
+    host `ImageContrast` semantics."""
     def op(rng, images):
         n = images.shape[0]
         f = jax.random.uniform(rng, (n, 1, 1, 1),
-                               minval=1.0 - max_delta,
-                               maxval=1.0 + max_delta)
-        mean = jnp.mean(images, axis=(1, 2, 3), keepdims=True)
-        return (images - mean) * f + mean
+                               minval=delta_low, maxval=delta_high)
+        return jnp.clip(images * f, 0.0, 255.0)
     return op
 
 
-def random_saturation(max_delta: float) -> AugmentOp:
-    """Saturation jitter: blend with the grayscale image (ITU-R 601
-    luma — the OpenCV coefficients the reference uses)."""
+def random_saturation(delta_low: float = 0.5,
+                      delta_high: float = 1.5) -> AugmentOp:
+    """Saturation jitter by blending with the ITU-R 601 luma gray
+    image, factor uniform in ``[delta_low, delta_high]``, clipped to
+    [0, 255]. Close to (but cheaper than) the host `ImageSaturation`'s
+    HSV round trip: XLA fuses the blend; an HSV conversion would not
+    fuse."""
     def op(rng, images):
         n = images.shape[0]
         f = jax.random.uniform(rng, (n, 1, 1, 1),
-                               minval=1.0 - max_delta,
-                               maxval=1.0 + max_delta)
+                               minval=delta_low, maxval=delta_high)
         gray = (0.299 * images[..., 0] + 0.587 * images[..., 1]
                 + 0.114 * images[..., 2])[..., None]
-        return (images - gray) * f + gray
+        return jnp.clip((images - gray) * f + gray, 0.0, 255.0)
     return op
 
 

@@ -328,7 +328,8 @@ class Estimator:
                  metrics: Optional[List] = None,
                  ctx: Optional[NNContext] = None,
                  parallel_mode: str = "dp",
-                 dtype_policy: Optional[str] = None):
+                 dtype_policy: Optional[str] = None,
+                 augment: Optional[Callable] = None):
         if parallel_mode not in ("dp", "fsdp", "tp", "ep"):
             raise ValueError("parallel_mode must be dp|fsdp|tp|ep")
         # default: bf16 activations on TPU (the MXU-native dtype,
@@ -345,6 +346,7 @@ class Estimator:
         # dtype), params + loss in f32 — the framework-wide policy the
         # round-1 bench applied ad hoc (VERDICT "What's weak" #8)
         self.dtype_policy = dtype_policy
+        self.augment = augment  # train-only on-device augmentation
         self.model = model
         self.ctx = ctx or get_nncontext()
         self.parallel_mode = parallel_mode
@@ -501,8 +503,16 @@ class Estimator:
         model = self.model
         loss_fn = self.loss_fn
         mixed = self.dtype_policy == "mixed_bfloat16"
+        augment = self.augment
 
         def train_step(params, opt_state, rng, x, y):
+            if augment is not None:
+                # train-only, traced into the step (on-device; see
+                # feature/image/device_transforms) — eval/predict
+                # never augment, like the reference's train-phase
+                # transformer chains
+                r_aug, rng = jax.random.split(rng)
+                x = augment(r_aug, x)
             if mixed:
                 x = _cast_floats(x, jnp.bfloat16)
 

@@ -52,15 +52,25 @@ def test_hflip_semantics(batch):
 def test_color_ops_match_numpy(batch):
     key = jax.random.PRNGKey(3)
     x = np.asarray(batch)
-    # factor pinned to 1; (x-mean)+mean cancellation leaves ~1e-5 abs
-    out = random_contrast(0.0)(key, batch)
+    # factors pinned to 1 / delta pinned to 0 -> identity
+    out = random_contrast(1.0, 1.0)(key, batch)
     np.testing.assert_allclose(np.asarray(out), x, rtol=1e-4,
                                atol=1e-3)
-    out = random_saturation(0.0)(key, batch)
+    out = random_saturation(1.0, 1.0)(key, batch)
     np.testing.assert_allclose(np.asarray(out), x, rtol=1e-4,
                                atol=1e-3)
     out = random_brightness(0.0)(key, batch)
     np.testing.assert_allclose(np.asarray(out), x, rtol=1e-5)
+    # host-parity: fixed factor f -> clip(x*f) (ImageContrast math)
+    out = random_contrast(1.3, 1.3)(key, batch)
+    np.testing.assert_allclose(
+        np.asarray(out), np.clip(x * 1.3, 0, 255), rtol=1e-4,
+        atol=1e-2)
+    # additive pixel-unit delta, clipped (ImageBrightness math)
+    out = random_brightness(40.0, 40.0)(key, batch)
+    np.testing.assert_allclose(
+        np.asarray(out), np.clip(x + 40.0, 0, 255), rtol=1e-4,
+        atol=1e-2)
 
     mean, std = (10.0, 20.0, 30.0), (2.0, 4.0, 8.0)
     out = normalize(mean, std)(key, batch)
@@ -79,8 +89,9 @@ def test_cutout_zeroes_a_window(batch):
 def test_pipeline_deterministic_and_jittable(batch):
     aug = augment_pipeline(
         random_crop((8, 10)), random_hflip(),
-        random_brightness(0.2), random_contrast(0.2),
-        random_saturation(0.2), normalize((128.0,) * 3, (64.0,) * 3))
+        random_brightness(32.0), random_contrast(0.8, 1.2),
+        random_saturation(0.8, 1.2),
+        normalize((128.0,) * 3, (64.0,) * 3))
     key = jax.random.PRNGKey(7)
     eager = aug(key, batch)
     jitted = jax.jit(aug)(key, batch)
@@ -112,3 +123,47 @@ def test_cutout_exact_window_size(rng):
     out = np.asarray(cutout(6)(jax.random.PRNGKey(5), x))
     for i in range(4):
         assert (out[i] == 0).sum() == 6 * 6 * 3  # exactly 6x6 window
+
+
+def test_estimator_augment_train_only():
+    """Estimator(augment=...) applies in the train step only: training
+    behaves differently from the unaugmented run, while evaluate and
+    predict are untouched by the augment fn."""
+    from analytics_zoo_tpu import init_nncontext
+    from analytics_zoo_tpu.common import nncontext
+    from analytics_zoo_tpu.pipeline.api.keras import layers as L
+    from analytics_zoo_tpu.pipeline.api.keras.models import Sequential
+    from analytics_zoo_tpu.pipeline.estimator import Estimator
+
+    rs = np.random.RandomState(0)
+    x = rs.rand(32, 8, 8, 3).astype(np.float32) * 255
+    y = rs.randint(0, 2, (32, 1))
+
+    def build(augment):
+        nncontext.reset_nncontext()
+        init_nncontext(seed=11)
+        m = Sequential()
+        m.add(L.Flatten(input_shape=(6, 6, 3)))
+        m.add(L.Dense(2, activation="softmax"))
+        return Estimator(m, optimizer="sgd",
+                         loss="sparse_categorical_crossentropy",
+                         augment=augment)
+
+    aug = augment_pipeline(random_crop((6, 6)), random_hflip())
+    est = build(aug)
+    res = est.train(x, y, batch_size=16, nb_epoch=2)
+    assert np.isfinite(res.history[-1]["loss"])
+
+    # eval/predict consume the model's input shape directly (6x6) —
+    # the augment fn must NOT run there: identical to a no-augment
+    # estimator with the same params
+    xe = x[:, :6, :6, :]
+    est2 = build(None)
+    est2._ensure_initialized()
+    est2.params = est.params
+    np.testing.assert_allclose(
+        np.asarray(est.predict(xe, batch_size=16)),
+        np.asarray(est2.predict(xe, batch_size=16)), rtol=1e-6)
+    e1 = est.evaluate(xe, y, batch_size=16)
+    e2 = est2.evaluate(xe, y, batch_size=16)
+    assert np.isclose(e1["loss"], e2["loss"], rtol=1e-6)
