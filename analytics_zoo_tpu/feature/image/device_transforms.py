@@ -110,33 +110,127 @@ def random_brightness(delta_low: float,
     return op
 
 
-def random_contrast(delta_low: float = 0.5,
-                    delta_high: float = 1.5) -> AugmentOp:
-    """Multiplicative contrast jitter: per-image ``x * f`` with ``f``
-    uniform in ``[delta_low, delta_high]``, clipped to [0, 255] — the
-    host `ImageContrast` semantics."""
+def _factor_range(delta_low, delta_high, default=(0.5, 1.5)):
+    """Uniform-factor bounds around the identity 1.0: no args →
+    ``default`` (the host transformers' default); ONE arg d →
+    symmetric ``[1-d, 1+d]`` (mirrors `random_brightness(d)`); two
+    args → ``[delta_low, delta_high]`` verbatim."""
+    if delta_low is None:
+        return default
+    if delta_high is None:
+        return (1.0 - delta_low, 1.0 + delta_low)
+    if delta_high < delta_low:
+        raise ValueError(f"empty factor range [{delta_low}, "
+                         f"{delta_high}]")
+    return (float(delta_low), float(delta_high))
+
+
+def random_contrast(delta_low: Optional[float] = None,
+                    delta_high: Optional[float] = None) -> AugmentOp:
+    """Multiplicative contrast jitter: per-image ``x * f``, clipped to
+    [0, 255] — the host `ImageContrast` semantics. ``f`` is uniform in
+    the :func:`_factor_range` bounds (default [0.5, 1.5]; one arg d
+    means [1-d, 1+d])."""
+    lo, hi = _factor_range(delta_low, delta_high)
+
     def op(rng, images):
         n = images.shape[0]
-        f = jax.random.uniform(rng, (n, 1, 1, 1),
-                               minval=delta_low, maxval=delta_high)
+        f = jax.random.uniform(rng, (n, 1, 1, 1), minval=lo, maxval=hi)
         return jnp.clip(images * f, 0.0, 255.0)
     return op
 
 
-def random_saturation(delta_low: float = 0.5,
-                      delta_high: float = 1.5) -> AugmentOp:
+def random_saturation(delta_low: Optional[float] = None,
+                      delta_high: Optional[float] = None) -> AugmentOp:
     """Saturation jitter by blending with the ITU-R 601 luma gray
-    image, factor uniform in ``[delta_low, delta_high]``, clipped to
-    [0, 255]. Close to (but cheaper than) the host `ImageSaturation`'s
-    HSV round trip: XLA fuses the blend; an HSV conversion would not
-    fuse."""
+    image, factor uniform in the :func:`_factor_range` bounds (default
+    [0.5, 1.5]; one arg d means [1-d, 1+d]), clipped to [0, 255].
+    Close to (but cheaper than) the host `ImageSaturation`'s HSV round
+    trip: XLA fuses the blend; an HSV conversion would not fuse."""
+    lo, hi = _factor_range(delta_low, delta_high)
+
     def op(rng, images):
         n = images.shape[0]
-        f = jax.random.uniform(rng, (n, 1, 1, 1),
-                               minval=delta_low, maxval=delta_high)
+        f = jax.random.uniform(rng, (n, 1, 1, 1), minval=lo, maxval=hi)
         gray = (0.299 * images[..., 0] + 0.587 * images[..., 1]
                 + 0.114 * images[..., 2])[..., None]
         return jnp.clip((images - gray) * f + gray, 0.0, 255.0)
+    return op
+
+
+def random_hue(delta_low: float = -18.0,
+               delta_high: float = 18.0) -> AugmentOp:
+    """Hue shift by a per-image angle in degrees, uniform in
+    ``[delta_low, delta_high]``, implemented as a chroma rotation in
+    YIQ space — the fuseable APPROXIMATION of the host `ImageHue`'s
+    HSV round trip. Positive degrees shift in the HSV-positive
+    direction (red → green); angles in the I-Q chroma plane track HSV
+    hue only approximately (tens of degrees of warp across the wheel),
+    so match ranges by eye, not digit-for-digit."""
+    def op(rng, images):
+        n = images.shape[0]
+        theta = jax.random.uniform(
+            rng, (n, 1, 1), minval=delta_low, maxval=delta_high) \
+            * (jnp.pi / 180.0)
+        r, g, b = (images[..., 0], images[..., 1], images[..., 2])
+        # RGB -> YIQ
+        yy = 0.299 * r + 0.587 * g + 0.114 * b
+        ii = 0.596 * r - 0.274 * g - 0.322 * b
+        qq = 0.211 * r - 0.523 * g + 0.312 * b
+        # rotate chroma by -theta: HSV hue + YIQ chroma angle run in
+        # opposite directions, so this makes +degrees = red -> green,
+        # matching ImageHue's positive direction
+        c, s = jnp.cos(theta), jnp.sin(theta)
+        i2 = c * ii + s * qq
+        q2 = -s * ii + c * qq
+        # YIQ -> RGB
+        r2 = yy + 0.956 * i2 + 0.621 * q2
+        g2 = yy - 0.272 * i2 - 0.647 * q2
+        b2 = yy - 1.106 * i2 + 1.703 * q2
+        return jnp.clip(jnp.stack([r2, g2, b2], axis=-1), 0.0, 255.0)
+    return op
+
+
+def random_resized_crop(size: "Tuple[int, int]",
+                        scale: "Tuple[float, float]" = (0.08, 1.0),
+                        ratio: "Tuple[float, float]" = (0.75, 4 / 3)
+                        ) -> AugmentOp:
+    """Inception-style crop: sample an area fraction in ``scale`` and
+    an aspect ratio in ``ratio``, then bilinearly resample that window
+    to ``size`` — the standard ImageNet training crop. Variable window
+    sizes stay XLA-static by expressing the crop as a per-image
+    `jax.image.scale_and_translate` (affine bilinear sampling), not a
+    dynamic-shape slice."""
+    th, tw = int(size[0]), int(size[1])
+
+    def op(rng, images):
+        n, h, w, c = images.shape
+        k_area, k_ratio, k_y, k_x = jax.random.split(rng, 4)
+        area = jax.random.uniform(k_area, (n,), minval=scale[0],
+                                  maxval=scale[1]) * (h * w)
+        log_r = jax.random.uniform(
+            k_ratio, (n,), minval=jnp.log(ratio[0]),
+            maxval=jnp.log(ratio[1]))
+        r = jnp.exp(log_r)
+        # window (wh, ww), clamped inside the image (>=1px: the
+        # caller's scale range is otherwise honored verbatim)
+        ww = jnp.clip(jnp.sqrt(area * r), 1.0, float(w))
+        wh = jnp.clip(jnp.sqrt(area / r), 1.0, float(h))
+        y0 = jax.random.uniform(k_y, (n,)) * (h - wh)
+        x0 = jax.random.uniform(k_x, (n,)) * (w - ww)
+        # output pixel (i, j) samples input at (y0 + i*wh/th, ...):
+        # scale_and_translate maps in->out as out = in*scale + trans,
+        # so scale = th/wh and trans = -y0*scale
+        sy, sx = th / wh, tw / ww
+
+        def one(img, sy_, sx_, ty, tx):
+            return jax.image.scale_and_translate(
+                img, (th, tw, c), (0, 1),
+                jnp.array([sy_, sx_]), jnp.array([ty, tx]),
+                method="bilinear")
+
+        out = jax.vmap(one)(images, sy, sx, -y0 * sy, -x0 * sx)
+        return out
     return op
 
 

@@ -167,3 +167,71 @@ def test_estimator_augment_train_only():
     e1 = est.evaluate(xe, y, batch_size=16)
     e2 = est2.evaluate(xe, y, batch_size=16)
     assert np.isclose(e1["loss"], e2["loss"], rtol=1e-6)
+
+
+def test_random_hue_identity_and_rotation(batch):
+    from analytics_zoo_tpu.feature.image.device_transforms import \
+        random_hue
+    key = jax.random.PRNGKey(2)
+    # zero rotation ~ identity (rounded YIQ matrices: <0.5/255 error)
+    out = random_hue(0.0, 0.0)(key, batch)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(batch),
+                               rtol=1e-2, atol=0.5)
+    out = random_hue(30.0, 30.0)(key, batch)  # rotation changes chroma
+    assert not np.allclose(np.asarray(out), np.asarray(batch),
+                           atol=1.0)
+    # luma is invariant under hue rotation
+    def luma(x):
+        return (0.299 * x[..., 0] + 0.587 * x[..., 1]
+                + 0.114 * x[..., 2])
+    inside = np.all((np.asarray(out) > 0) & (np.asarray(out) < 255),
+                    axis=-1)  # clip-free pixels only
+    np.testing.assert_allclose(luma(np.asarray(out))[inside],
+                               luma(np.asarray(batch))[inside],
+                               rtol=1e-2, atol=0.5)
+
+
+def test_random_resized_crop(batch):
+    from analytics_zoo_tpu.feature.image.device_transforms import \
+        random_resized_crop
+    key = jax.random.PRNGKey(4)
+    out = random_resized_crop((8, 8))(key, batch)
+    assert out.shape == (8, 8, 8, 3)
+    assert np.all(np.isfinite(np.asarray(out)))
+    # full-window, square-ratio crop on a square image == plain resize
+    sq = batch[:, :, :16, :]
+    out_full = random_resized_crop((8, 8), scale=(1.0, 1.0),
+                                   ratio=(1.0, 1.0))(key, sq)
+    expect = jax.image.resize(sq, (8, 8, 8, 3), method="bilinear")
+    np.testing.assert_allclose(np.asarray(out_full),
+                               np.asarray(expect), rtol=1e-3, atol=0.5)
+    # jit-able
+    j = jax.jit(random_resized_crop((8, 8)))(key, batch)
+    assert j.shape == (8, 8, 8, 3)
+
+
+def test_hue_positive_degrees_match_hsv_direction():
+    """+120 deg must take red toward GREEN (HSV-positive direction,
+    host ImageHue parity), not blue."""
+    import colorsys
+
+    from analytics_zoo_tpu.feature.image.device_transforms import \
+        random_hue
+    img = jnp.zeros((1, 4, 4, 3)).at[:, :, :, 0].set(200.0) \
+        .at[:, :, :, 1].set(40.0).at[:, :, :, 2].set(40.0)
+    out = np.asarray(random_hue(120.0, 120.0)(
+        jax.random.PRNGKey(0), img))[0, 0, 0]
+    h = colorsys.rgb_to_hsv(*(out / 255.0))[0] * 360
+    assert 90 < h < 150, f"expected green-ish hue, got {h}"
+
+
+def test_color_single_arg_symmetric_convention():
+    """ONE arg d means the symmetric factor range [1-d, 1+d] for
+    contrast/saturation (mirroring random_brightness(d) = (-d, d))."""
+    batch = jnp.full((4, 4, 4, 3), 100.0)
+    out = np.asarray(random_contrast(0.2)(jax.random.PRNGKey(0),
+                                          batch))
+    # factors live in [0.8, 1.2] -> outputs in [80, 120]
+    assert out.min() >= 80 - 1e-3 and out.max() <= 120 + 1e-3
+    with pytest.raises(ValueError, match="empty factor range"):
+        random_saturation(1.5, 0.5)
