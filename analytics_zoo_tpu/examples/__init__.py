@@ -21,6 +21,7 @@ EXAMPLES = [
     "quantized_serving",
     "long_context",
     "bert_finetune",
+    "resnet_imagenet",
     "autograd_custom",
     "qa_ranker",
     "transformer_sentiment",

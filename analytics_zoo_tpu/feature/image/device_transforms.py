@@ -118,7 +118,9 @@ def _factor_range(delta_low, delta_high, default=(0.5, 1.5)):
     if delta_low is None:
         return default
     if delta_high is None:
-        return (1.0 - delta_low, 1.0 + delta_low)
+        # symmetric around 1, floored at 0 (negative factors would
+        # invert images)
+        return (max(0.0, 1.0 - delta_low), 1.0 + delta_low)
     if delta_high < delta_low:
         raise ValueError(f"empty factor range [{delta_low}, "
                          f"{delta_high}]")
@@ -158,15 +160,25 @@ def random_saturation(delta_low: Optional[float] = None,
     return op
 
 
-def random_hue(delta_low: float = -18.0,
-               delta_high: float = 18.0) -> AugmentOp:
-    """Hue shift by a per-image angle in degrees, uniform in
-    ``[delta_low, delta_high]``, implemented as a chroma rotation in
-    YIQ space — the fuseable APPROXIMATION of the host `ImageHue`'s
-    HSV round trip. Positive degrees shift in the HSV-positive
-    direction (red → green); angles in the I-Q chroma plane track HSV
-    hue only approximately (tens of degrees of warp across the wheel),
-    so match ranges by eye, not digit-for-digit."""
+def random_hue(delta_low: Optional[float] = None,
+               delta_high: Optional[float] = None) -> AugmentOp:
+    """Hue shift by a per-image angle in degrees — no args →
+    ``[-18, 18]`` (the host `ImageHue` default); ONE arg d →
+    symmetric ``[-|d|, |d|]`` (the module's one-arg convention); two
+    args verbatim. Implemented as a chroma rotation in YIQ space —
+    the fuseable APPROXIMATION of the host `ImageHue`'s HSV round
+    trip. Positive degrees shift in the HSV-positive direction
+    (red → green); angles in the I-Q chroma plane track HSV hue only
+    approximately (tens of degrees of warp across the wheel), so
+    match ranges by eye, not digit-for-digit."""
+    if delta_low is None:
+        delta_low, delta_high = -18.0, 18.0
+    elif delta_high is None:
+        delta_low, delta_high = -abs(delta_low), abs(delta_low)
+    elif delta_high < delta_low:
+        raise ValueError(f"empty degree range [{delta_low}, "
+                         f"{delta_high}]")
+
     def op(rng, images):
         n = images.shape[0]
         theta = jax.random.uniform(

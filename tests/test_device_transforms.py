@@ -235,3 +235,18 @@ def test_color_single_arg_symmetric_convention():
     assert out.min() >= 80 - 1e-3 and out.max() <= 120 + 1e-3
     with pytest.raises(ValueError, match="empty factor range"):
         random_saturation(1.5, 0.5)
+
+
+def test_one_arg_conventions_clamped_and_symmetric():
+    from analytics_zoo_tpu.feature.image.device_transforms import (
+        _factor_range, random_hue)
+    assert _factor_range(1.5, None) == (0.0, 2.5)  # floored at 0
+    assert _factor_range(0.2, None) == (0.8, 1.2)
+    with pytest.raises(ValueError, match="empty degree range"):
+        random_hue(30.0, 18.0)
+    # one-arg hue is symmetric: both signs of shift must occur
+    img = jnp.zeros((64, 2, 2, 3)).at[..., 0].set(200.0) \
+        .at[..., 1].set(40.0).at[..., 2].set(40.0)
+    out = np.asarray(random_hue(30.0)(jax.random.PRNGKey(0), img))
+    g, b = out[..., 1], out[..., 2]
+    assert (g > b + 1).any() and (b > g + 1).any()  # both directions

@@ -168,3 +168,21 @@ def test_bert_finetune_frozen_encoder():
                    "32", "--blocks", "1", "--batch-per-device", "2",
                    "--epochs", "1", "--freeze-encoder"])
     assert np.isfinite(scores["loss"])
+
+
+def test_resnet_imagenet_recipe(tmp_path):
+    from PIL import Image
+    rs = np.random.RandomState(0)
+    for cls in ("cat", "dog"):
+        (tmp_path / cls).mkdir()
+        for i in range(4):
+            Image.fromarray(
+                rs.randint(0, 255, (40, 40, 3)).astype(np.uint8)) \
+                .save(tmp_path / cls / f"{i}.png")
+    hist = _run("resnet_imagenet",
+                ["--folder", str(tmp_path), "--devices", "2",
+                 "--image-size", "32", "--batch-per-device", "2",
+                 "--epochs", "1", "--fused", "0",
+                 "--checkpoint", str(tmp_path / "ck")])
+    assert np.isfinite(hist[-1]["loss"])
+    assert (tmp_path / "ck" / "LATEST").exists()
