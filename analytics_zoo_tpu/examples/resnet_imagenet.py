@@ -41,7 +41,8 @@ def main(argv=None):
     p.add_argument("--lr", type=float, default=0.1)
     p.add_argument("--checkpoint", default=None)
     p.add_argument("--fused", default="auto",
-                   help="auto|0|1|defer — Pallas fused conv+BN path")
+                   choices=["auto", "0", "1", "defer"],
+                   help="Pallas fused conv+BN path")
     args = p.parse_args(argv)
 
     import jax
@@ -79,6 +80,12 @@ def main(argv=None):
             .astype(np.float32) * 255
         y = rs.randint(0, args.classes, size=(n_samples, 1))
         classes = args.classes
+
+    if len(x) < batch:
+        raise ValueError(
+            f"{len(x)} samples < global batch {batch} "
+            f"({args.batch_per_device} x {n} devices): every epoch "
+            "would run zero steps")
 
     # -- on-device augmentation (train-only, inside the jitted step) ---
     aug = D.augment_pipeline(

@@ -190,6 +190,13 @@ class KerasNet(KerasLayer):
         self.estimator.set_tensorboard(log_dir, app_name)
         return self
 
+    def set_summary_trigger(self, name: str, trigger):
+        """Extra TB summaries on a trigger — "Parameters" writes
+        per-layer weight histograms (BigDL
+        `TrainSummary.setSummaryTrigger`)."""
+        self.estimator.set_summary_trigger(name, trigger)
+        return self
+
     def set_checkpoint(self, path: str, trigger=None):
         """(reference `Topology.scala:238-248`)"""
         self.estimator.set_checkpoint(path, trigger)

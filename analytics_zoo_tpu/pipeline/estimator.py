@@ -369,6 +369,7 @@ class Estimator:
         self.tensorboard_dir: Optional[str] = None
         self.tensorboard_app: str = "zoo_tpu"
         self._tb_writer = None
+        self._summary_triggers: "Dict[str, Trigger]" = {}
         # jax.profiler trace capture (SURVEY §5: the TPU analog of the
         # reference's TrainSummary observability)
         self._profile_dir: Optional[str] = None
@@ -412,6 +413,28 @@ class Estimator:
         self.tensorboard_dir = log_dir
         self.tensorboard_app = app_name
         return self
+
+    def set_summary_trigger(self, name: str, trigger: Trigger):
+        """Enable extra TensorBoard summaries on a trigger (BigDL
+        `TrainSummary.setSummaryTrigger`). Supported: "Parameters" —
+        per-layer weight histograms (device fetch per firing; keep the
+        trigger sparse on remote transports)."""
+        if name != "Parameters":
+            raise ValueError(
+                f"unsupported summary {name!r}; supported: Parameters")
+        self._summary_triggers[name] = trigger
+        return self
+
+    def _write_param_histograms(self, tb, step: int):
+        # ONE whole-tree fetch (per-leaf device_get would be a
+        # round-trip storm on remote transports)
+        flat, _ = jax.tree_util.tree_flatten_with_path(
+            jax.device_get(self.params))
+        for path, leaf in flat:
+            tag = jax.tree_util.keystr(path).strip("'[]").replace(
+                "']['", "/")
+            tb.add_histogram(f"Parameters/{tag}", np.asarray(leaf),
+                             step)
 
     def set_dtype_policy(self, policy: str):
         """"float32" or "mixed_bfloat16" (bf16 activations, f32
@@ -672,6 +695,11 @@ class Estimator:
                         self._profile_dir = None
                     n_records += batch_size
                     pending.append((self.step, loss))
+                    if tb is not None and self._summary_triggers:
+                        trig = self._summary_triggers.get("Parameters")
+                        if trig is not None and trig(
+                                epoch, self.step, False):
+                            self._write_param_histograms(tb, self.step)
                     if self.checkpoint_path and self.checkpoint_trigger(
                             epoch, self.step, False):
                         self.save_checkpoint()
@@ -724,6 +752,11 @@ class Estimator:
             if self.checkpoint_path and self.checkpoint_trigger(
                     epoch, self.step, True):
                 self.save_checkpoint()
+            if tb is not None and self._summary_triggers:
+                trig = self._summary_triggers.get("Parameters")
+                if trig is not None and trig(epoch, self.step, True):
+                    # epoch-end firing (EveryEpoch-style triggers)
+                    self._write_param_histograms(tb, self.step)
             history.append(entry)
             logger.info("epoch %d: %s", epoch, entry)
             if stop or (end_trigger is not None and end_trigger(

@@ -554,3 +554,45 @@ def test_async_checkpoint_write(tmp_path, monkeypatch):
     est._ckpt_error = RuntimeError("disk full")
     with pytest.raises(RuntimeError, match="disk full"):
         est.save_checkpoint(str(tmp_path / "ckpt"))
+
+
+def test_parameter_summary_trigger(monkeypatch):
+    """set_summary_trigger("Parameters", ...) writes weight histograms
+    on the trigger's schedule (BigDL TrainSummary.setSummaryTrigger)."""
+    init_nncontext(seed=12)
+    x, y = _regression_data(64)
+    m = Sequential()
+    m.add(L.Dense(1, input_shape=(4,)))
+    m.compile(optimizer=O.SGD(lr=0.01), loss="mse")
+    est = m.estimator
+
+    class FakeTB:
+        def __init__(self):
+            self.hist = []
+            self.scalars = []
+
+        def add_scalar(self, tag, v, s):
+            self.scalars.append(tag)
+
+        def add_histogram(self, tag, vals, s):
+            self.hist.append((tag, s))
+
+        def flush(self):
+            pass
+
+    fake = FakeTB()
+    est.tensorboard_dir = "unused"
+    est._tb_writer = fake
+    est.set_summary_trigger("Parameters", SeveralIteration(2))
+    est.train(x, y, batch_size=32, nb_epoch=2)   # 4 steps → fires at 2,4
+    steps_fired = sorted({s for _, s in fake.hist})
+    assert steps_fired == [2, 4]
+    # epoch-end triggers (EveryEpoch) fire via the epoch_end=True check
+    fake.hist.clear()
+    est.set_summary_trigger("Parameters", EveryEpoch())
+    est.train(x, y, batch_size=32, nb_epoch=1)
+    assert len({s for _, s in fake.hist}) == 1
+    assert any(t.startswith("Parameters/") and "kernel" in t
+               for t, _ in fake.hist)
+    with pytest.raises(ValueError, match="unsupported summary"):
+        est.set_summary_trigger("Gradients", SeveralIteration(2))
