@@ -22,6 +22,8 @@ EXAMPLES = [
     "long_context",
     "bert_finetune",
     "resnet_imagenet",
+    "chatbot",
+    "streaming_inference",
     "autograd_custom",
     "qa_ranker",
     "transformer_sentiment",

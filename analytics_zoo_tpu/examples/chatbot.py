@@ -1,0 +1,124 @@
+"""Chatbot example — the reference's Scala chatbot example
+(`Z/examples/chatbot/Train.scala`: ZooDictionary + Seq2seq over a
+dialog corpus, greedy generation) on the TPU-native stack:
+`ZooDictionary` builds the word↔index vocab, tokens become one-hot
+vectors, `Seq2seq` (LSTM encoder/decoder + dense bridge + Dense
+generator) trains teacher-forced, and `infer` greedily generates a
+reply word by word.
+
+A tiny built-in dialog corpus keeps the demo offline; point
+``--corpus`` at a two-column TSV (utterance<TAB>reply) for real data.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+_TINY_DIALOGS = [
+    ("hello", "hi there"),
+    ("hi", "hello"),
+    ("how are you", "i am fine"),
+    ("what is your name", "i am zoo"),
+    ("bye", "goodbye"),
+    ("thanks", "you are welcome"),
+]
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--corpus", default=None,
+                   help="TSV file: utterance<TAB>reply per line")
+    p.add_argument("--epochs", type=int, default=30)
+    p.add_argument("--hidden", type=int, default=32)
+    p.add_argument("--max-len", type=int, default=6)
+    p.add_argument("--ask", default="how are you")
+    args = p.parse_args(argv)
+
+    from analytics_zoo_tpu import init_nncontext
+    from analytics_zoo_tpu.common.dictionary import ZooDictionary
+    from analytics_zoo_tpu.models.seq2seq import (
+        Bridge, RNNDecoder, RNNEncoder, Seq2seq)
+    from analytics_zoo_tpu.ops.optimizers import Adam
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+
+    ctx = init_nncontext(seed=0)
+    if args.corpus:
+        pairs = []
+        with open(args.corpus) as f:
+            for line in f:
+                parts = line.rstrip("\n").split("\t")
+                if len(parts) == 2:
+                    pairs.append((parts[0], parts[1]))
+    else:
+        pairs = _TINY_DIALOGS
+
+    # -- vocab (reference: ZooDictionary over the corpus) --------------
+    sos, eos, pad = "<sos>", "<eos>", "<pad>"
+    sentences = [q.split() for q, _ in pairs] + \
+        [a.split() for _, a in pairs] + [[sos, eos, pad]]
+    vocab = ZooDictionary.from_corpus(sentences)
+    v = len(vocab)
+    t = args.max_len
+
+    def encode(words, add_sos=False, add_eos=False):
+        # unseen words map to <pad> (no KeyError for novel --ask words)
+        unk = vocab.get_index(pad)
+        keep = t - int(add_sos) - int(add_eos)
+        ids = [vocab.get_index(w, default=unk) for w in words][:keep]
+        if add_sos:
+            ids = [vocab.get_index(sos)] + ids
+        if add_eos:
+            ids = ids + [vocab.get_index(eos)]
+        ids += [unk] * (t - len(ids))
+        return ids[:t]
+
+    def onehot(ids):
+        out = np.zeros((len(ids), v), np.float32)
+        out[np.arange(len(ids)), ids] = 1.0
+        return out
+
+    enc_in = np.stack([onehot(encode(q.split())) for q, _ in pairs])
+    dec_in = np.stack([onehot(encode(a.split(), add_sos=True))
+                       for _, a in pairs])
+    target = np.stack([onehot(encode(a.split(), add_eos=True))
+                       for _, a in pairs])
+
+    # -- model (teacher-forced training) -------------------------------
+    s2s = Seq2seq(encoder=RNNEncoder("lstm", 1, args.hidden),
+                  decoder=RNNDecoder("lstm", 1, args.hidden),
+                  input_shape=(t, v), output_shape=(t, v),
+                  bridge=Bridge("dense"),
+                  generator=Dense(v, activation="softmax",
+                                  name="generator"))
+    s2s.compile(optimizer=Adam(lr=0.02), loss="categorical_crossentropy")
+    # batch must divide over the data-parallel mesh axis; tile the tiny
+    # corpus up to a multiple of it
+    dp = ctx.data_parallel_size
+    total = -(-len(pairs) // dp) * dp
+    idx = np.resize(np.arange(len(pairs)), total)
+    batch = min(total, -(-8 // dp) * dp)   # ~8, dp-divisible
+    res = s2s.fit([enc_in[idx], dec_in[idx]], target[idx],
+                  batch_size=batch, nb_epoch=args.epochs)
+
+    # -- greedy chat (reference infer loop) ----------------------------
+    q = onehot(encode(args.ask.split()))[None]
+    start = onehot([vocab.get_index(sos)])[0]
+    gen = s2s.infer(q[0], start_sign=start, max_seq_len=t)
+    words = []
+    for step in range(1, gen.shape[1]):        # skip the <sos> start
+        w = vocab.get_word(int(np.argmax(gen[0, step])))
+        if w == eos:
+            break
+        words.append(w)
+    reply = " ".join(words)
+    print(f"loss: {res.history[0]['loss']:.3f} -> "
+          f"{res.history[-1]['loss']:.3f} over {args.epochs} epochs")
+    print(f"> {args.ask}")
+    print(f"< {reply or '(silence)'}")
+    return {"loss": res.history[-1]["loss"], "reply": reply}
+
+
+if __name__ == "__main__":
+    main()

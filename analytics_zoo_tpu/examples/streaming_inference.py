@@ -1,0 +1,114 @@
+"""Streaming micro-batch inference — the reference's Spark Streaming
+examples (`Z/examples/streaming/{objectdetection,textclassification}`:
+a DStream of records scored per micro-batch) rebuilt without Spark:
+a producer thread feeds a bounded queue (the stream source), a
+consumer drains it into micro-batches on a time/size trigger, and an
+`InferenceModel` pool (compiled-executable queue, `pipeline/inference`)
+scores each batch concurrently. Prints per-batch latency and a final
+throughput summary.
+
+The demo streams synthetic text through the TextClassifier; swap the
+producer for a socket/Kafka reader for real streams.
+"""
+
+from __future__ import annotations
+
+import argparse
+import queue
+import threading
+import time
+
+import numpy as np
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--records", type=int, default=96,
+                   help="total records the producer emits")
+    p.add_argument("--rate", type=float, default=400.0,
+                   help="producer records/sec")
+    p.add_argument("--batch-max", type=int, default=16)
+    p.add_argument("--batch-interval-ms", type=int, default=100,
+                   help="micro-batch trigger (reference: the DStream "
+                        "batch duration)")
+    p.add_argument("--concurrency", type=int, default=2)
+    args = p.parse_args(argv)
+
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu import init_nncontext
+    from analytics_zoo_tpu.models.textclassification import \
+        TextClassifier
+    from analytics_zoo_tpu.pipeline.inference import InferenceModel
+
+    init_nncontext(seed=0)
+    seq_len, token_len, classes = 32, 16, 3
+
+    # model under test: a TextClassifier scored through the
+    # InferenceModel pool (weights random — the pipeline is the demo);
+    # records arrive pre-embedded (T, token_len) like the reference's
+    # WordEmbedding-preprocessed stream
+    tc = TextClassifier(class_num=classes, token_length=token_len,
+                        sequence_length=seq_len, encoder="cnn")
+    tc.compile(optimizer="adam",
+               loss="sparse_categorical_crossentropy")
+    im = InferenceModel(supported_concurrent_num=args.concurrency)
+    im.load_keras_net(tc.model)   # params auto-initialized
+
+    # -- stream source: producer thread -> bounded queue ---------------
+    q: "queue.Queue" = queue.Queue(maxsize=args.batch_max * 4)
+    rs = np.random.RandomState(0)
+    records = rs.randn(args.records, seq_len, token_len) \
+        .astype(np.float32)
+
+    def produce():
+        for rec in records:
+            q.put(rec)
+            time.sleep(1.0 / args.rate)
+        q.put(None)  # end-of-stream
+
+    threading.Thread(target=produce, daemon=True).start()
+
+    # -- micro-batch consumer ------------------------------------------
+    interval = args.batch_interval_ms / 1000.0
+    done, n_scored, n_batches = False, 0, 0
+    lat_ms = []
+    t_start = time.time()
+    while not done:
+        batch, deadline = [], time.time() + interval
+        while len(batch) < args.batch_max:
+            timeout = deadline - time.time()
+            if timeout <= 0:
+                break
+            try:
+                item = q.get(timeout=timeout)
+            except queue.Empty:
+                break
+            if item is None:
+                done = True
+                break
+            batch.append(item)
+        if not batch:
+            continue
+        t0 = time.time()
+        x = np.zeros((args.batch_max, seq_len, token_len),
+                     np.float32)
+        x[: len(batch)] = np.stack(batch)      # pad to compiled shape
+        scores = np.asarray(im.predict([jnp.asarray(x)]))
+        preds = scores[: len(batch)].argmax(-1)
+        dt = (time.time() - t0) * 1000
+        lat_ms.append(dt)
+        n_scored += len(batch)
+        n_batches += 1
+        print(f"batch {n_batches}: {len(batch)} records "
+              f"classes={np.bincount(preds, minlength=classes)} "
+              f"latency={dt:.1f}ms")
+    wall = time.time() - t_start
+    print(f"stream done: {n_scored} records in {n_batches} "
+          f"micro-batches, {n_scored / wall:.0f} rec/s end-to-end, "
+          f"median batch latency {np.median(lat_ms):.1f}ms")
+    return {"records": n_scored, "batches": n_batches}
+
+
+if __name__ == "__main__":
+    main()

@@ -186,3 +186,17 @@ def test_resnet_imagenet_recipe(tmp_path):
                  "--checkpoint", str(tmp_path / "ck")])
     assert np.isfinite(hist[-1]["loss"])
     assert (tmp_path / "ck" / "LATEST").exists()
+
+
+def test_chatbot():
+    r = _run("chatbot", ["--epochs", "3", "--hidden", "16"])
+    assert np.isfinite(r["loss"])
+    assert isinstance(r["reply"], str)
+
+
+def test_streaming_inference():
+    r = _run("streaming_inference",
+             ["--records", "24", "--rate", "3000",
+              "--batch-max", "8", "--batch-interval-ms", "50"])
+    assert r["records"] == 24
+    assert r["batches"] >= 3
