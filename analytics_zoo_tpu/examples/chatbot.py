@@ -53,6 +53,9 @@ def main(argv=None):
                     pairs.append((parts[0], parts[1]))
     else:
         pairs = _TINY_DIALOGS
+    if not pairs:
+        raise SystemExit(
+            "no utterance<TAB>reply lines found in --corpus")
 
     # -- vocab (reference: ZooDictionary over the corpus) --------------
     sos, eos, pad = "<sos>", "<eos>", "<pad>"
@@ -66,7 +69,7 @@ def main(argv=None):
         # unseen words map to <pad> (no KeyError for novel --ask words)
         unk = vocab.get_index(pad)
         keep = t - int(add_sos) - int(add_eos)
-        ids = [vocab.get_index(w, default=unk) for w in words][:keep]
+        ids = vocab.encode(words, unk_index=unk)[:keep]
         if add_sos:
             ids = [vocab.get_index(sos)] + ids
         if add_eos:
@@ -109,7 +112,7 @@ def main(argv=None):
     words = []
     for step in range(1, gen.shape[1]):        # skip the <sos> start
         w = vocab.get_word(int(np.argmax(gen[0, step])))
-        if w == eos:
+        if w in (eos, pad, sos):   # stop at end/filler tokens
             break
         words.append(w)
     reply = " ".join(words)

@@ -34,8 +34,6 @@ def main(argv=None):
     p.add_argument("--concurrency", type=int, default=2)
     args = p.parse_args(argv)
 
-    import jax.numpy as jnp
-
     from analytics_zoo_tpu import init_nncontext
     from analytics_zoo_tpu.models.textclassification import \
         TextClassifier
@@ -94,7 +92,7 @@ def main(argv=None):
         x = np.zeros((args.batch_max, seq_len, token_len),
                      np.float32)
         x[: len(batch)] = np.stack(batch)      # pad to compiled shape
-        scores = np.asarray(im.predict([jnp.asarray(x)]))
+        scores = np.asarray(im.predict([x]))
         preds = scores[: len(batch)].argmax(-1)
         dt = (time.time() - t0) * 1000
         lat_ms.append(dt)

@@ -1,0 +1,103 @@
+"""Pipeline parallelism (GPipe-style) over a mesh axis.
+
+Absent from the reference (data-parallel only, SURVEY.md §2.10) and
+listed there as TPU-native headroom: stage parameters live sharded
+over the mesh's ``pipe`` axis, microbatches march through the stages
+with `lax.ppermute` neighbor exchanges (ICI), and the whole schedule
+is ONE differentiable jitted program — `jax.grad` flows through the
+scan and the permutes (ppermute's transpose is the reverse permute),
+so the same function serves forward, training, and inference.
+
+The collective-pipeline recipe (scaling-book style):
+
+- stage params are stacked on a leading axis and sharded over
+  ``pipe`` — device i holds stage i's slice;
+- the input is split into M microbatches; at schedule step t, device 0
+  feeds microbatch t (if any), every device applies its stage to its
+  current buffer, and the result rotates one hop forward;
+- after ``M + S - 1`` steps the last device has emitted every
+  microbatch; bubble outputs are sliced off.
+
+Uniform stages (same signature/shapes, e.g. transformer blocks) are
+the supported shape — the same restriction scan-over-layers imposes.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def stack_stage_params(param_list):
+    """Stack per-stage param pytrees (same structure) on a new leading
+    stage axis — the layout `gpipe_apply` expects (shard it over the
+    ``pipe`` axis with :func:`shard_stage_params`)."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *param_list)
+
+
+def shard_stage_params(stacked, mesh: Mesh, axis: str = "pipe"):
+    """Place stacked stage params with the leading axis sharded over
+    ``axis`` (device i holds stage i)."""
+    def put(leaf):
+        spec = P(axis, *([None] * (leaf.ndim - 1)))
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+    return jax.tree_util.tree_map(put, stacked)
+
+
+def gpipe_apply(stage_fn: Callable, stacked_params, x, *,
+                mesh: Mesh, axis: str = "pipe",
+                microbatches: int):
+    """Run ``x`` through ``S = mesh.shape[axis]`` pipeline stages.
+
+    ``stage_fn(params_i, h) -> h`` must preserve ``h``'s shape (a
+    uniform residual-block/transformer-layer pipeline). ``x``:
+    ``(batch, ...)`` with ``batch % microbatches == 0``; stages see
+    microbatches of ``batch // microbatches``. Returns ``stage_{S-1}(
+    ... stage_0(x))`` exactly (validated against the sequential
+    composition in tests), computed with GPipe scheduling: per-device
+    activation memory is one microbatch, utilization is
+    ``M / (M + S - 1)``.
+    """
+    s = mesh.shape[axis]
+    m = int(microbatches)
+    batch = x.shape[0]
+    if batch % m != 0:
+        raise ValueError(f"batch {batch} % microbatches {m} != 0")
+    mb = batch // m
+    xs = x.reshape((m, mb) + x.shape[1:])
+    t_total = m + s - 1
+
+    def per_device(params_local, xs_all):
+        # params_local: (1, ...) slice of the stacked stage params;
+        # xs_all: the full (M, mb, ...) microbatch stack (replicated)
+        params_i = jax.tree_util.tree_map(lambda a: a[0], params_local)
+        idx = jax.lax.axis_index(axis)
+        perm = [(i, (i + 1) % s) for i in range(s)]
+        # the carry becomes device-varying after the first ppermute;
+        # type the initial zeros accordingly (shard_map vma typing)
+        buf0 = jax.lax.pcast(jnp.zeros_like(xs_all[0]), (axis,),
+                             to="varying")
+
+        def step(buf, t):
+            # device 0 injects microbatch t (clamped during drain)
+            feed = xs_all[jnp.clip(t, 0, m - 1)]
+            h_in = jnp.where(idx == 0, feed, buf)
+            h_out = stage_fn(params_i, h_in)
+            buf_next = jax.lax.ppermute(h_out, axis, perm)
+            return buf_next, h_out
+
+        _, outs = jax.lax.scan(step, buf0, jnp.arange(t_total))
+        return outs[None]  # (1, T, mb, ...) — stacked over pipe
+
+    outs = jax.shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(axis), P()),
+        out_specs=P(axis))(stacked_params, xs)
+    # device S-1's emissions at steps S-1 .. T-1 are the pipeline
+    # outputs, in microbatch order
+    y = outs[s - 1, s - 1:]
+    return y.reshape((batch,) + y.shape[2:])

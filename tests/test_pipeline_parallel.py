@@ -1,0 +1,106 @@
+"""GPipe pipeline parallelism (`parallel/pipeline.py`): exact numeric
+parity with the sequential composition, gradient flow through the
+schedule, and scheduling-shape checks — on a 4-way pipe mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu import init_nncontext
+from analytics_zoo_tpu.parallel.pipeline import (
+    gpipe_apply, shard_stage_params, stack_stage_params)
+
+S, D = 4, 8
+
+
+def _stage_fn(params, h):
+    # uniform residual MLP block (shape-preserving)
+    return h + jnp.tanh(h @ params["w"] + params["b"])
+
+
+@pytest.fixture
+def setup(rng):
+    ctx = init_nncontext(tpu_mesh={"pipe": S},
+                         devices=jax.devices()[:S], seed=0)
+    params = [{"w": jnp.asarray(rng.randn(D, D).astype(np.float32))
+               * 0.3,
+               "b": jnp.asarray(rng.randn(D).astype(np.float32))
+               * 0.1}
+              for _ in range(S)]
+    x = jnp.asarray(rng.randn(16, D).astype(np.float32))
+    return ctx, params, x
+
+
+def _sequential(params, x):
+    for p in params:
+        x = _stage_fn(p, x)
+    return x
+
+
+def test_gpipe_matches_sequential(setup):
+    ctx, params, x = setup
+    stacked = shard_stage_params(stack_stage_params(params), ctx.mesh)
+    for m in (2, 4, 8):
+        y = gpipe_apply(_stage_fn, stacked, x, mesh=ctx.mesh,
+                        microbatches=m)
+        np.testing.assert_allclose(np.asarray(y),
+                                   np.asarray(_sequential(params, x)),
+                                   rtol=2e-5, atol=1e-5)
+
+
+def test_gpipe_is_differentiable(setup):
+    ctx, params, x = setup
+    stacked_host = stack_stage_params(params)
+    stacked = shard_stage_params(stacked_host, ctx.mesh)
+
+    def loss_pp(sp):
+        y = gpipe_apply(_stage_fn, sp, x, mesh=ctx.mesh,
+                        microbatches=4)
+        return jnp.sum(y ** 2)
+
+    def loss_seq(plist):
+        return jnp.sum(_sequential(plist, x) ** 2)
+
+    g_pp = jax.jit(jax.grad(loss_pp))(stacked)
+    g_seq = jax.grad(loss_seq)(params)
+    g_seq_stacked = stack_stage_params(g_seq)
+    for k in ("w", "b"):
+        np.testing.assert_allclose(np.asarray(g_pp[k]),
+                                   np.asarray(g_seq_stacked[k]),
+                                   rtol=5e-5, atol=1e-5)
+
+
+def test_gpipe_under_jit_trains(setup):
+    """One SGD step through the pipeline reduces the loss."""
+    import optax
+
+    ctx, params, x = setup
+    stacked = shard_stage_params(stack_stage_params(params), ctx.mesh)
+    target = jnp.zeros_like(x)
+    tx = optax.sgd(0.05)
+
+    @jax.jit
+    def step(sp, opt):
+        def loss(sp):
+            y = gpipe_apply(_stage_fn, sp, x, mesh=ctx.mesh,
+                            microbatches=4)
+            return jnp.mean((y - target) ** 2)
+        l, g = jax.value_and_grad(loss)(sp)
+        upd, opt = tx.update(g, opt)
+        return optax.apply_updates(sp, upd), opt, l
+
+    opt = tx.init(stacked)
+    losses = []
+    for _ in range(5):
+        stacked, opt, l = step(stacked, opt)
+        losses.append(float(l))
+    assert losses[-1] < losses[0]
+
+
+def test_gpipe_validates_microbatching(setup):
+    ctx, params, x = setup
+    stacked = shard_stage_params(stack_stage_params(params), ctx.mesh)
+    with pytest.raises(ValueError, match="microbatches"):
+        gpipe_apply(_stage_fn, stacked, x, mesh=ctx.mesh,
+                    microbatches=3)  # 16 % 3 != 0
