@@ -50,17 +50,27 @@ def shard_stage_params(stacked, mesh: Mesh, axis: str = "pipe"):
 
 def gpipe_apply(stage_fn: Callable, stacked_params, x, *,
                 mesh: Mesh, axis: str = "pipe",
-                microbatches: int):
+                microbatches: int, microbatched_args=(),
+                broadcast_args=(), pass_mb_index: bool = False):
     """Run ``x`` through ``S = mesh.shape[axis]`` pipeline stages.
 
-    ``stage_fn(params_i, h) -> h`` must preserve ``h``'s shape (a
-    uniform residual-block/transformer-layer pipeline). ``x``:
-    ``(batch, ...)`` with ``batch % microbatches == 0``; stages see
-    microbatches of ``batch // microbatches``. Returns ``stage_{S-1}(
-    ... stage_0(x))`` exactly (validated against the sequential
-    composition in tests), computed with GPipe scheduling: per-device
-    activation memory is one microbatch, utilization is
+    ``stage_fn(params_i, h, *extras) -> h`` must preserve ``h``'s
+    shape (a uniform residual-block/transformer-layer pipeline).
+    ``x``: ``(batch, ...)`` with ``batch % microbatches == 0``;
+    stages see microbatches of ``batch // microbatches``. Returns
+    ``stage_{S-1}(... stage_0(x))`` exactly (validated against the
+    sequential composition in tests), computed with GPipe scheduling:
+    per-device activation memory is one microbatch, utilization is
     ``M / (M + S - 1)``.
+
+    Stage extras, in the order ``stage_fn`` receives them after the
+    activation: the scalar microbatch index (when ``pass_mb_index``),
+    then ``microbatched_args`` (leading dim MUST be ``batch``; split
+    like ``x`` — device i at schedule step t receives the slice for
+    microbatch ``t - i``, the one resident on it: attention masks,
+    per-sample weights, ...), then ``broadcast_args`` (microbatch-
+    independent arrays handed to every stage whole: broadcastable
+    masks, shared conditioning, ...).
     """
     s = mesh.shape[axis]
     m = int(microbatches)
@@ -69,11 +79,23 @@ def gpipe_apply(stage_fn: Callable, stacked_params, x, *,
         raise ValueError(f"batch {batch} % microbatches {m} != 0")
     mb = batch // m
     xs = x.reshape((m, mb) + x.shape[1:])
+    margs = []
+    for a in microbatched_args:
+        a = jnp.asarray(a)
+        if a.shape[0] != batch:
+            raise ValueError(
+                f"microbatched arg leading dim {a.shape[0]} != batch "
+                f"{batch}; pass microbatch-independent arrays via "
+                f"broadcast_args")
+        margs.append(a.reshape((m, mb) + a.shape[1:]))
+    bargs = tuple(jnp.asarray(a) for a in broadcast_args)
     t_total = m + s - 1
 
-    def per_device(params_local, xs_all):
+    def per_device(params_local, xs_all, *rest):
         # params_local: (1, ...) slice of the stacked stage params;
-        # xs_all: the full (M, mb, ...) microbatch stack (replicated)
+        # xs_all/margs: full (M, ...) stacks; bargs whole (replicated)
+        margs_all = rest[: len(margs)]
+        bargs_all = rest[len(margs):]
         params_i = jax.tree_util.tree_map(lambda a: a[0], params_local)
         idx = jax.lax.axis_index(axis)
         perm = [(i, (i + 1) % s) for i in range(s)]
@@ -83,10 +105,14 @@ def gpipe_apply(stage_fn: Callable, stacked_params, x, *,
                              to="varying")
 
         def step(buf, t):
-            # device 0 injects microbatch t (clamped during drain)
-            feed = xs_all[jnp.clip(t, 0, m - 1)]
-            h_in = jnp.where(idx == 0, feed, buf)
-            h_out = stage_fn(params_i, h_in)
+            # device i processes microbatch t - i (clamped in the
+            # fill/drain bubbles); device 0 injects it from the input
+            sel = jnp.clip(t - idx, 0, m - 1)
+            h_in = jnp.where(idx == 0, xs_all[sel], buf)
+            extras = (((sel,) if pass_mb_index else ())
+                      + tuple(a[sel] for a in margs_all)
+                      + tuple(bargs_all))
+            h_out = stage_fn(params_i, h_in, *extras)
             buf_next = jax.lax.ppermute(h_out, axis, perm)
             return buf_next, h_out
 
@@ -95,8 +121,8 @@ def gpipe_apply(stage_fn: Callable, stacked_params, x, *,
 
     outs = jax.shard_map(
         per_device, mesh=mesh,
-        in_specs=(P(axis), P()),
-        out_specs=P(axis))(stacked_params, xs)
+        in_specs=(P(axis), P()) + (P(),) * (len(margs) + len(bargs)),
+        out_specs=P(axis))(stacked_params, xs, *margs, *bargs)
     # device S-1's emissions at steps S-1 .. T-1 are the pipeline
     # outputs, in microbatch order
     y = outs[s - 1, s - 1:]

@@ -151,11 +151,23 @@ class TransformerLayer(KerasLayer):
                  sequence_parallel_mode: str = "ring",
                  attention_impl: Optional[str] = None,
                  remat: bool = False,
+                 pipeline_parallel_axis: Optional[str] = None,
+                 pipeline_microbatches: Optional[int] = None,
                  input_shape=None, name=None, **kwargs):
         super().__init__(input_shape=input_shape or (seq_len,),
                          name=name, **kwargs)
         if hidden_size % n_head:
             raise ValueError("hidden_size must divide by n_head")
+        if pipeline_parallel_axis and sequence_parallel_axis:
+            raise ValueError(
+                "pipeline_parallel_axis and sequence_parallel_axis "
+                "cannot combine (nested shard_map); pick one")
+        if pipeline_parallel_axis and output_all_block:
+            raise ValueError(
+                "output_all_block is unavailable under pipeline "
+                "parallelism (only the final stage's output exists)")
+        self.pipeline_parallel_axis = pipeline_parallel_axis
+        self.pipeline_microbatches = pipeline_microbatches
         from analytics_zoo_tpu.parallel import get_sp_attention
         get_sp_attention(sequence_parallel_mode)  # validate early
         self.sequence_parallel_mode = sequence_parallel_mode
@@ -229,8 +241,7 @@ class TransformerLayer(KerasLayer):
         rngs = (jax.random.split(rng, n) if rng is not None
                 else jnp.zeros((n, 2), jnp.uint32))
 
-        def block(x, inputs):
-            p, blk_rng = inputs
+        def block_body(x, p, blk_rng, mask):
             b, t, hsz = x.shape
             r1 = r2 = r3 = None
             if rng is not None:
@@ -271,7 +282,7 @@ class TransformerLayer(KerasLayer):
                 p["mlp_out_bias"].astype(x.dtype)
             mlp = _dropout(mlp, self.hidden_p_drop, r2, training)
             x = _layer_norm(x + mlp, p["ln2_g"], p["ln2_b"])
-            return x, x
+            return x
 
         if rng is not None:
             rngs_data = jax.vmap(jax.random.key_data)(rngs)
@@ -283,10 +294,84 @@ class TransformerLayer(KerasLayer):
             # them live — O(1)-in-depth activation memory for ~1/3
             # extra FLOPs (the TPU HBM lever for deep/long-context
             # training; composes with the scan's O(1) compile time)
-            block = jax.checkpoint(block)
+            block_body = jax.checkpoint(block_body)
+
+        if self.pipeline_parallel_axis:
+            final = self._run_blocks_gpipe(params, h0, mask,
+                                           rngs_data, block_body)
+            return final, None
+
+        def block(x, inputs):
+            p, blk_rng = inputs
+            out = block_body(x, p, blk_rng, mask)
+            return out, out
+
         final, all_blocks = jax.lax.scan(
             block, h0, (params["blocks"], rngs_data))
         return final, all_blocks
+
+    def _run_blocks_gpipe(self, params, h0, mask, rngs_data,
+                          block_body):
+        """GPipe the block stack over the mesh's
+        ``pipeline_parallel_axis``: ``n_block/S`` consecutive blocks
+        per stage, microbatches rotating via ppermute
+        (`parallel/pipeline.py`). Per-microbatch dropout keys are
+        derived by folding the microbatch index into each block's key
+        (the sequential path draws ONE key per block for the whole
+        batch, so training randomness differs — inference and no-
+        dropout training match exactly)."""
+        from analytics_zoo_tpu.common.nncontext import get_nncontext
+        from analytics_zoo_tpu.parallel.pipeline import gpipe_apply
+
+        axis = self.pipeline_parallel_axis
+        mesh = get_nncontext().mesh
+        if axis not in mesh.shape:
+            raise ValueError(
+                f"pipeline_parallel_axis {axis!r} not in mesh axes "
+                f"{tuple(mesh.shape)}")
+        s = mesh.shape[axis]
+        n = self.n_block
+        if n % s:
+            raise ValueError(
+                f"n_block {n} must divide by the {axis!r} axis size "
+                f"{s}")
+        nb = n // s
+        stage_params = {
+            "blocks": jax.tree_util.tree_map(
+                lambda a: a.reshape((s, nb) + a.shape[1:]),
+                params["blocks"]),
+            "rngs": rngs_data.reshape((s, nb) + rngs_data.shape[1:]),
+        }
+        m = self.pipeline_microbatches or s
+        # per-sample masks (batch-leading, e.g. BERT's (B,1,1,T))
+        # ride per microbatch; broadcastable masks ((1,1,T,T), (T,T))
+        # are microbatch-independent and go to every stage whole
+        margs, bargs = [], []
+        if mask is not None:
+            per_sample = mask.ndim == 4 and \
+                mask.shape[0] == h0.shape[0]
+            (margs if per_sample else bargs).append(mask)
+
+        def stage(sp, h, mb_idx, *rest):
+            mask_mb = rest[0] if rest else None
+
+            def inner(x, inp):
+                p, blk_rng = inp
+                # distinct dropout per microbatch: fold mb_idx in
+                blk_rng = jax.random.key_data(jax.random.fold_in(
+                    jax.random.wrap_key_data(blk_rng), mb_idx))
+                out = block_body(x, p, blk_rng, mask_mb)
+                return out, None
+
+            h, _ = jax.lax.scan(inner, h,
+                                (sp["blocks"], sp["rngs"]))
+            return h
+
+        return gpipe_apply(stage, stage_params, h0, mesh=mesh,
+                           axis=axis, microbatches=m,
+                           microbatched_args=margs,
+                           broadcast_args=bargs,
+                           pass_mb_index=True)
 
     def call(self, params, x, *, training=False, rng=None, mask=None):
         r_embed = None

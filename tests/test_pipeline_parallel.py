@@ -104,3 +104,99 @@ def test_gpipe_validates_microbatching(setup):
     with pytest.raises(ValueError, match="microbatches"):
         gpipe_apply(_stage_fn, stacked, x, mesh=ctx.mesh,
                     microbatches=3)  # 16 % 3 != 0
+
+
+class TestTransformerPipeline:
+    """TransformerLayer(pipeline_parallel_axis=...): inference parity
+    with the sequential layer and a training step over a pipe mesh."""
+
+    def _mk(self, rng, **kw):
+        from analytics_zoo_tpu.pipeline.api.keras.layers import \
+            TransformerLayer
+        return TransformerLayer(n_block=4, hidden_size=16, n_head=2,
+                                seq_len=8, vocab=32, **kw)
+
+    def test_inference_matches_sequential(self, rng):
+        import jax.numpy as jnp
+
+        from analytics_zoo_tpu.common import nncontext
+        nncontext.reset_nncontext()
+        ctx = init_nncontext(tpu_mesh={"pipe": 4},
+                             devices=jax.devices()[:4], seed=0)
+        seq = self._mk(rng)
+        pp = self._mk(rng, pipeline_parallel_axis="pipe",
+                      pipeline_microbatches=4)
+        params = seq.build(jax.random.PRNGKey(0), (8,))
+        x = jnp.asarray(rng.randint(0, 32, (8, 8)).astype(np.int32))
+        y_seq = seq.call(params, x, training=False)
+        y_pp = pp.call(params, x, training=False)
+        np.testing.assert_allclose(np.asarray(y_pp),
+                                   np.asarray(y_seq),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_trains_under_estimator(self, rng):
+        from analytics_zoo_tpu.common import nncontext
+        from analytics_zoo_tpu.pipeline.api.keras import (
+            Sequential, layers as L)
+        from analytics_zoo_tpu.pipeline.estimator import Estimator
+        nncontext.reset_nncontext()
+        ctx = init_nncontext(tpu_mesh={"pipe": 4},
+                             devices=jax.devices()[:4], seed=1)
+        m = Sequential()
+        m.add(L.TransformerLayer(n_block=4, hidden_size=16, n_head=2,
+                                 seq_len=8, vocab=32,
+                                 pipeline_parallel_axis="pipe",
+                                 input_shape=(8,)))
+        m.add(L.Select(1, -1))
+        m.add(L.Dense(4))
+        est = Estimator(m, optimizer="adam",
+                        loss="softmax_cross_entropy", ctx=ctx)
+        x = rng.randint(0, 32, (8, 8)).astype(np.int32)
+        y = rng.randint(0, 4, (8, 1)).astype(np.int32)
+        res = est.train(x, y, batch_size=8, nb_epoch=2)
+        assert np.isfinite(res.history[-1]["loss"])
+
+    def test_invalid_configs_rejected(self, rng):
+        with pytest.raises(ValueError, match="cannot combine"):
+            self._mk(rng, pipeline_parallel_axis="pipe",
+                     sequence_parallel_axis="seq")
+        with pytest.raises(ValueError, match="output_all_block"):
+            self._mk(rng, pipeline_parallel_axis="pipe",
+                     output_all_block=True)
+        from analytics_zoo_tpu.common import nncontext
+        nncontext.reset_nncontext()
+        init_nncontext(tpu_mesh={"pipe": 3},
+                       devices=jax.devices()[:3], seed=0)
+        lyr = self._mk(rng, pipeline_parallel_axis="pipe")  # 4 % 3
+        import jax.numpy as jnp
+        params = lyr.build(jax.random.PRNGKey(0), (8,))
+        with pytest.raises(ValueError, match="must divide"):
+            lyr.call(params, jnp.zeros((6, 8), jnp.int32),
+                     training=False)
+
+    def test_batch_equals_microbatches_and_broadcast_mask(self, rng):
+        """Regression: batch == microbatches (microbatch size 1) and
+        broadcastable (1,1,T,T)/(T,T) masks both work and match the
+        sequential layer."""
+        import jax.numpy as jnp
+
+        from analytics_zoo_tpu.common import nncontext
+        nncontext.reset_nncontext()
+        init_nncontext(tpu_mesh={"pipe": 4},
+                       devices=jax.devices()[:4], seed=0)
+        seq = self._mk(rng)
+        pp = self._mk(rng, pipeline_parallel_axis="pipe",
+                      pipeline_microbatches=4)
+        params = seq.build(jax.random.PRNGKey(0), (8,))
+        x = jnp.asarray(rng.randint(0, 32, (4, 8)).astype(np.int32))
+        y_seq = seq.call(params, x, training=False)
+        y_pp = pp.call(params, x, training=False)   # batch 4 == m 4
+        np.testing.assert_allclose(np.asarray(y_pp),
+                                   np.asarray(y_seq), rtol=2e-5,
+                                   atol=2e-5)
+        for mask in (jnp.ones((1, 1, 8, 8)), jnp.ones((4, 1, 1, 8))):
+            y_seq = seq.call(params, x, training=False, mask=mask)
+            y_pp = pp.call(params, x, training=False, mask=mask)
+            np.testing.assert_allclose(np.asarray(y_pp),
+                                       np.asarray(y_seq), rtol=2e-5,
+                                       atol=2e-5)
