@@ -917,6 +917,81 @@ class Estimator:
         self._ckpt_thread = t
         return os.path.join(path, f"ckpt_{step}.pkl")
 
+    def save_checkpoint_sharded(self, path: Optional[str] = None):
+        """Orbax-backed checkpoint: each host writes only its own
+        param/opt-state shards (no full-tree gather through one host —
+        the scalable path for FSDP/TP models too big for a single
+        host's RAM; the pickle path stays the default for small
+        models and whole-file portability). Layout:
+        ``<path>/sharded/<step>`` + the same ``LATEST`` pointer file
+        with a ``sharded:`` prefix, so :meth:`load_checkpoint`
+        dispatches transparently."""
+        import orbax.checkpoint as ocp
+
+        path = path or self.checkpoint_path
+        if path is None:
+            raise ValueError("no checkpoint path set")
+        self.wait_for_checkpoint()
+        root = os.path.join(os.path.abspath(path), "sharded")
+        os.makedirs(root, exist_ok=True)
+        step_dir = os.path.join(root, str(self.step))
+        with ocp.StandardCheckpointer() as ckptr:
+            # force=True: orbax writes to a tmp dir and renames, so an
+            # existing same-step checkpoint stays intact until the new
+            # one is complete (the pickle path's tmp+os.replace
+            # atomicity)
+            ckptr.save(step_dir,
+                       {"params": self.params,
+                        "opt_state": self.opt_state},
+                       force=True)
+        with open(os.path.join(path, "LATEST"), "w") as f:
+            f.write(f"sharded:{self.step}")
+        return step_dir
+
+    def _load_checkpoint_sharded(self, path: str, step: int):
+        import orbax.checkpoint as ocp
+
+        self._ensure_initialized()  # abstract tree + shardings
+        step_dir = os.path.join(os.path.abspath(path), "sharded",
+                                str(step))
+        tx = self._tx()
+        # ONE opt-state materialization serves both the restore target
+        # and the placement template (a second one would transiently
+        # double the Adam-state footprint on large FSDP models)
+        template = jax.jit(tx.init)(self.params)
+
+        def absify(tree):  # aval + SHARDING per leaf (scalars too)
+            return jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(
+                    a.shape, a.dtype, sharding=a.sharding), tree)
+
+        target = {
+            "params": absify(self.params),
+            "opt_state": absify(template),
+        }
+        with ocp.StandardCheckpointer() as ckptr:
+            state = ckptr.restore(step_dir, target)
+        # explicit re-placement: orbax (and jit's own output layout
+        # for fresh scalars like optimizer step counts) can leave 0-d
+        # leaves on a single device; mesh-replicate anything without a
+        # mesh sharding so the train step sees one device set
+        from jax.sharding import NamedSharding, PartitionSpec
+        mesh = self.ctx.mesh
+
+        def place(tmpl, restored):
+            def put(t, r):
+                sh = t.sharding
+                if not isinstance(sh, NamedSharding):
+                    sh = NamedSharding(mesh, PartitionSpec())
+                return jax.device_put(jnp.asarray(r), sh)
+            return jax.tree_util.tree_map(put, tmpl, restored)
+
+        self.params = place(self.params, state["params"])
+        self.opt_state = place(template, state["opt_state"])
+        self.step = step
+        self._train_step = self._build_train_step(tx)
+        return self
+
     def _join_ckpt_write(self):
         """Join any in-flight async checkpoint write without raising
         (safe inside ``finally`` — must not mask an active
@@ -950,10 +1025,16 @@ class Estimator:
                 "the next save_checkpoint/wait_for_checkpoint.", err)
         path = path or self.checkpoint_path
         if step is not None:
+            if os.path.isdir(os.path.join(path, "sharded", str(step))):
+                return self._load_checkpoint_sharded(path, step)
             fname = os.path.join(path, f"ckpt_{step}.pkl")
         else:
             with open(os.path.join(path, "LATEST")) as f:
-                fname = os.path.join(path, f.read().strip())
+                latest = f.read().strip()
+            if latest.startswith("sharded:"):
+                return self._load_checkpoint_sharded(
+                    path, int(latest.split(":", 1)[1]))
+            fname = os.path.join(path, latest)
         from analytics_zoo_tpu.common.safe_pickle import checked_load
         state = checked_load(fname)  # class-whitelist deserialization
         params = state["params"]
