@@ -22,6 +22,7 @@ from __future__ import annotations
 import threading
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
+import jax
 import numpy as np
 
 from analytics_zoo_tpu.common.nncontext import get_nncontext, logger
@@ -143,7 +144,14 @@ class InferenceModel:
         try:
             xs = (inputs if isinstance(inputs, (list, tuple))
                   else [inputs])
-            xs = [np.asarray(x) for x in xs]
+            # device-resident inputs pass straight to a jit fn —
+            # np.asarray would round-trip them through the host. The
+            # AOT path (example_inputs) keeps the conversion: its
+            # executable pins the example arrays' layout, which a
+            # committed/sharded caller array need not match.
+            xs = [x if isinstance(x, jax.Array)
+                  and not self._compiled else np.asarray(x)
+                  for x in xs]
             out = self._predict_fn(*xs)
             if isinstance(out, (list, tuple)):
                 return [np.asarray(o) for o in out]

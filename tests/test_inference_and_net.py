@@ -430,3 +430,43 @@ def test_native_http_serving(rng):
     # factory falls back cleanly
     srv2 = make_inference_server(im)
     srv2.stop() if hasattr(srv2, "_srv") else None
+
+
+def test_inference_model_accepts_device_arrays(rng):
+    """jax.Array inputs skip the host round trip and score the same
+    as numpy inputs."""
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.pipeline.api.keras import (
+        Sequential, layers as L)
+    from analytics_zoo_tpu.pipeline.inference import InferenceModel
+    m = Sequential()
+    m.add(L.Dense(4, input_shape=(6,)))
+    m.compile(optimizer="sgd", loss="mse")
+    im = InferenceModel()
+    im.load_keras_net(m)
+    x = rng.randn(8, 6).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(im.predict([jnp.asarray(x)])),
+        np.asarray(im.predict([x])), rtol=1e-6)
+
+
+def test_inference_model_aot_path_accepts_device_arrays(rng):
+    """With example_inputs (AOT path) device arrays are converted, not
+    passed through, so committed/sharded inputs keep working."""
+    import jax
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.pipeline.api.keras import (
+        Sequential, layers as L)
+    from analytics_zoo_tpu.pipeline.inference import InferenceModel
+    m = Sequential()
+    m.add(L.Dense(4, input_shape=(6,)))
+    m.compile(optimizer="sgd", loss="mse")
+    x = rng.randn(8, 6).astype(np.float32)
+    im = InferenceModel()
+    im.load_keras_net(m, example_inputs=[x])
+    committed = jax.device_put(jnp.asarray(x), jax.devices()[-1])
+    np.testing.assert_allclose(
+        np.asarray(im.predict([committed])),
+        np.asarray(im.predict([x])), rtol=1e-6)

@@ -200,3 +200,34 @@ class TestTransformerPipeline:
             np.testing.assert_allclose(np.asarray(y_pp),
                                        np.asarray(y_seq), rtol=2e-5,
                                        atol=2e-5)
+
+    def test_bert_pipelined_matches_sequential(self, rng):
+        """BERT(pipeline_parallel_axis=..., output_all_block=False):
+        sequence and pooled outputs match the sequential encoder."""
+        import jax.numpy as jnp
+
+        from analytics_zoo_tpu.common import nncontext
+        from analytics_zoo_tpu.pipeline.api.keras.layers import BERT
+        nncontext.reset_nncontext()
+        init_nncontext(tpu_mesh={"pipe": 4},
+                       devices=jax.devices()[:4], seed=0)
+
+        def mk(**kw):
+            return BERT(vocab=32, hidden_size=16, n_block=4, n_head=2,
+                        seq_len=8, intermediate_size=32,
+                        output_all_block=False, **kw)
+
+        seq = mk()
+        pp = mk(pipeline_parallel_axis="pipe",
+                pipeline_microbatches=4)
+        params = seq.build(jax.random.PRNGKey(0), [(8,)] * 4)
+        tok = jnp.asarray(rng.randint(1, 32, (8, 8)).astype(np.int32))
+        seg = jnp.zeros((8, 8), jnp.int32)
+        pos = jnp.tile(jnp.arange(8), (8, 1))
+        msk = jnp.ones((8, 8), jnp.float32)
+        out_seq = seq.call(params, [tok, seg, pos, msk],
+                           training=False)
+        out_pp = pp.call(params, [tok, seg, pos, msk], training=False)
+        for a, b in zip(out_seq, out_pp):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       rtol=2e-5, atol=2e-5)
