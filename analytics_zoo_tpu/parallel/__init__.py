@@ -16,7 +16,22 @@ __all__ = [
     "DP_RULES",
     "FSDP_RULES",
     "TP_RULES",
+    "gpipe_apply",
+    "shard_stage_params",
+    "stack_stage_params",
 ]
+
+
+def __getattr__(name):
+    # pipeline helpers lazily (keep `import analytics_zoo_tpu.parallel`
+    # light; mirrors the ring/ulysses dispatch below)
+    if name in ("gpipe_apply", "shard_stage_params",
+                "stack_stage_params"):
+        import importlib
+        mod = importlib.import_module(
+            "analytics_zoo_tpu.parallel.pipeline")
+        return getattr(mod, name)
+    raise AttributeError(name)
 
 
 def get_sp_attention(mode: str):

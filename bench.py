@@ -428,6 +428,38 @@ def main():
         except Exception as e:
             print(f"# [ncf] FAILED: {type(e).__name__}: {e}",
                   file=sys.stderr, flush=True)
+    # third BASELINE workload (config #5, BERT fine-tune) — budget-
+    # aware: "auto" runs it only when enough budget remains after the
+    # headline + NCF; "1" forces, "0" skips
+    bert_mode = os.environ.get("ZOO_TPU_BENCH_BERT", "auto")
+    remaining = budget - (time.perf_counter() - _t_start)
+    skip_why = None
+    if bert_mode == "auto" and jax.default_backend() not in (
+            "tpu", "axon"):
+        bert_mode, skip_why = "0", "non-TPU backend (base-width " \
+            "BERT is minutes on CPU; ZOO_TPU_BENCH_BERT=1 forces)"
+    elif bert_mode == "auto" and remaining <= 150:
+        bert_mode, skip_why = "0", \
+            f"{remaining:.0f}s budget left (<150s)"
+    if bert_mode in ("1", "auto"):
+        _result["diag"] = "bert tertiary"
+        try:
+            from bench_bert import measure as bert_measure
+            _result.setdefault("extra_metrics", []).append(
+                bert_measure(
+                    batch=int(os.environ.get(
+                        "ZOO_TPU_BENCH_BERT_BATCH", "32")),
+                    steps=min(steps, 10),
+                    hidden=int(os.environ.get(
+                        "ZOO_TPU_BENCH_BERT_HIDDEN", "768")),
+                    blocks=int(os.environ.get(
+                        "ZOO_TPU_BENCH_BERT_BLOCKS", "4"))))
+        except Exception as e:
+            print(f"# [bert] FAILED: {type(e).__name__}: {e}",
+                  file=sys.stderr, flush=True)
+    elif skip_why:
+        print(f"# [bert] skipped: {skip_why}", file=sys.stderr,
+              flush=True)
     _emit(final=True)
     print(f"# init={t_init:.1f}s "
           f"total={time.perf_counter() - _t_start:.1f}s",

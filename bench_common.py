@@ -1,0 +1,51 @@
+"""Shared timing harness for the secondary benchmarks (bench_ncf,
+bench_bert; bench.py's multi-variant supervisor keeps its own copy of
+the same methodology).
+
+Measurement recipe (PERF.md): ONE compiled lax.scan chain per
+workload, one scalar host fetch per run, the constant dispatch/round-
+trip overhead (min of 5 tiny-jit samples — a single transient RPC
+spike must not inflate throughput) subtracted from the best of
+``reps`` runs.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+def dispatch_overhead(samples: int = 5) -> float:
+    """Constant per-dispatch round-trip cost, min over ``samples``."""
+    import jax
+    import jax.numpy as jnp
+
+    tiny = jax.jit(lambda a: a + 1.0).lower(
+        jnp.zeros((), jnp.float32)).compile()
+    float(np.asarray(tiny(jnp.zeros((), jnp.float32))))  # warm
+    overhead = float("inf")
+    for _ in range(samples):
+        t0 = time.perf_counter()
+        float(np.asarray(tiny(jnp.zeros((), jnp.float32))))
+        overhead = min(overhead, time.perf_counter() - t0)
+    return overhead
+
+
+def time_chain(compiled, args, reps: int = 3):
+    """Best wall time of ``compiled(*args)`` (last output = scalar
+    loss fetched to host as the sync point) minus the dispatch
+    overhead. Returns ``(dt_seconds, last_loss)``."""
+    def timed():
+        t0 = time.perf_counter()
+        out = compiled(*args)
+        loss = out[-1] if isinstance(out, (list, tuple)) else out
+        return time.perf_counter() - t0, float(np.asarray(loss))
+
+    timed()                                   # warmup run
+    overhead = dispatch_overhead()
+    best_dt, loss = None, float("nan")
+    for _ in range(reps):
+        dt_i, loss = timed()
+        best_dt = dt_i if best_dt is None else min(best_dt, dt_i)
+    return max(best_dt - overhead, 1e-9), loss

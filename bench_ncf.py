@@ -91,32 +91,12 @@ def measure(batch: int = 8192, steps: int = 20,
     compiled = jax.jit(run).lower(params, opt_state, x, y).compile()
     t_compile = time.perf_counter() - t0
 
-    tiny = jax.jit(lambda a: a + 1.0).lower(
-        jnp.zeros((), jnp.float32)).compile()
-    float(np.asarray(tiny(jnp.zeros((), jnp.float32))))
-    overhead = float("inf")
-    for _ in range(5):
-        t0 = time.perf_counter()
-        float(np.asarray(tiny(jnp.zeros((), jnp.float32))))
-        overhead = min(overhead, time.perf_counter() - t0)
-
-    def timed():
-        t0 = time.perf_counter()
-        p, o, loss = compiled(params, opt_state, x, y)
-        loss_val = float(np.asarray(loss))
-        return time.perf_counter() - t0, loss_val
-
-    timed()                                   # warmup
-    best_dt, loss = None, float("nan")
-    for _ in range(3):
-        dt_i, loss = timed()
-        best_dt = dt_i if best_dt is None else min(best_dt, dt_i)
-
-    dt = max(best_dt - overhead, 1e-9)
+    from bench_common import time_chain
+    dt, loss = time_chain(compiled, (params, opt_state, x, y))
     samples_per_sec = batch * steps / dt
     print(f"# [ncf] batch={batch} steps={steps} "
           f"step_time={dt / steps * 1e6:.0f}us loss={loss:.3f} "
-          f"overhead={overhead * 1000:.1f}ms compile={t_compile:.1f}s",
+          f"compile={t_compile:.1f}s",
           file=sys.stderr, flush=True)
     return {
         "metric": metric,

@@ -29,7 +29,13 @@ for b in 192 256; do
   ZOO_TPU_BENCH_FUSED=1 ZOO_TPU_BENCH_BATCH=$b ZOO_TPU_BENCH_NCF=0 run python bench.py
 done
 
-# 4. profile capture of both variants for PERF.md
+# 4. BERT fine-tune throughput standalone (full detail for PERF.md;
+#    the bench embeds it budget-permitting). bench_bert has no
+#    internal watchdog — bound it so a tunnel flap can't hang the
+#    session before the profile step
+run timeout 420 python bench_bert.py
+
+# 5. profile capture of both variants for PERF.md
 ZOO_TPU_BENCH_PROFILE_DIR=/tmp/zoo_r4_profile ZOO_TPU_BENCH_NCF=0 run python bench.py
 
 {
