@@ -34,6 +34,8 @@ def main(argv=None):
     p.add_argument("--hidden", type=int, default=32)
     p.add_argument("--max-len", type=int, default=6)
     p.add_argument("--ask", default="how are you")
+    p.add_argument("--beam", type=int, default=1,
+                   help=">1 switches the reply decode to beam search")
     args = p.parse_args(argv)
 
     from analytics_zoo_tpu import init_nncontext
@@ -105,16 +107,24 @@ def main(argv=None):
     res = s2s.fit([enc_in[idx], dec_in[idx]], target[idx],
                   batch_size=batch, nb_epoch=args.epochs)
 
-    # -- greedy chat (reference infer loop) ----------------------------
+    # -- chat: greedy (reference infer loop) or beam search ------------
     q = onehot(encode(args.ask.split()))[None]
-    start = onehot([vocab.get_index(sos)])[0]
-    gen = s2s.infer(q[0], start_sign=start, max_seq_len=t)
-    words = []
-    for step in range(1, gen.shape[1]):        # skip the <sos> start
-        w = vocab.get_word(int(np.argmax(gen[0, step])))
-        if w in (eos, pad, sos):   # stop at end/filler tokens
-            break
-        words.append(w)
+    if args.beam > 1:
+        ids, score = s2s.infer_beam(
+            q[0], start_token=vocab.get_index(sos),
+            beam_size=args.beam, max_seq_len=t,
+            stop_token=vocab.get_index(eos))
+        words = [vocab.get_word(i) for i in ids]
+    else:
+        start = onehot([vocab.get_index(sos)])[0]
+        gen = s2s.infer(q[0], start_sign=start, max_seq_len=t)
+        words = []
+        for step in range(1, gen.shape[1]):    # skip the <sos> start
+            w = vocab.get_word(int(np.argmax(gen[0, step])))
+            if w in (eos, pad, sos):  # stop at end/filler tokens
+                break
+            words.append(w)
+    words = [w for w in words if w not in (eos, pad, sos)]
     reply = " ".join(words)
     print(f"loss: {res.history[0]['loss']:.3f} -> "
           f"{res.history[-1]['loss']:.3f} over {args.epochs} epochs")

@@ -240,3 +240,66 @@ class Seq2seq(ZooModel):
                 break
             cur = np.concatenate([cur, nxt], axis=1)
         return cur
+
+    def infer_beam(self, input_seq: np.ndarray, start_token: int,
+                   beam_size: int = 4, max_seq_len: int = 30,
+                   stop_token: Optional[int] = None,
+                   length_penalty: float = 0.6
+                   ) -> "tuple[list[int], float]":
+        """Beam-search decoding over a CATEGORICAL generator (the
+        decoder must end in a vocab-sized softmax, e.g. the chatbot's
+        ``generator=Dense(V, activation="softmax")``); tokens feed
+        back as one-hot rows. Beyond the reference (its `infer` is
+        greedy only). Returns ``(token_ids, score)`` for the best
+        finished hypothesis — ids exclude the start token — with
+        GNMT-style length normalization ``logp / ((5+L)/6)**alpha``.
+        """
+        est = self.model.estimator
+        est._ensure_initialized()
+        params = est.params
+        if input_seq.ndim == 2:
+            input_seq = input_seq[None]
+        vocab = self.output_shape[-1]
+
+        def onehot(ids):
+            arr = np.zeros((1, len(ids), vocab), np.float32)
+            arr[0, np.arange(len(ids)), ids] = 1.0
+            return arr
+
+        def norm(logp, length):
+            return logp / (((5.0 + length) / 6.0) ** length_penalty)
+
+        beams = [([start_token], 0.0)]          # (ids incl. start, logp)
+        finished: "list[tuple[list[int], float]]" = []
+        for _ in range(max_seq_len):
+            if not beams:
+                break
+            # ONE batched forward for all live hypotheses
+            dec = np.concatenate([onehot(ids) for ids, _ in beams])
+            enc = np.repeat(input_seq, len(beams), axis=0)
+            out = np.asarray(self.model.forward(
+                params, [jnp.asarray(enc), jnp.asarray(dec)]))
+            logp_next = np.log(np.clip(out[:, -1, :], 1e-20, 1.0))
+            cand = []
+            for (ids, lp), row in zip(beams, logp_next):
+                for tok in np.argsort(row)[-beam_size:]:
+                    cand.append((ids + [int(tok)], lp + row[tok]))
+            cand.sort(key=lambda c: c[1], reverse=True)
+            beams = []
+            for ids, lp in cand[: beam_size * 2]:
+                if stop_token is not None and ids[-1] == stop_token:
+                    finished.append((ids[1:-1], norm(lp, len(ids) - 1)))
+                elif len(beams) < beam_size:
+                    beams.append((ids, lp))
+            if len(finished) >= beam_size:
+                break
+        # unfinished sweeps score over their SCORED tokens only
+        # (len(ids)-1 excludes the start token, same count the
+        # stop-finished branch uses) — else junk that ran out the
+        # clock out-scores an equally likely eos-terminated reply
+        finished.extend((ids[1:], norm(lp, len(ids) - 1))
+                        for ids, lp in beams)
+        if not finished:
+            return [], float("-inf")
+        best = max(finished, key=lambda c: c[1])
+        return list(best[0]), float(best[1])

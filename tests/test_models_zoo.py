@@ -439,3 +439,47 @@ def test_mnist_idx_roundtrip(tmp_path):
     x, y = mnist.read_data_sets(str(tmp_path), "train")
     np.testing.assert_array_equal(x, imgs)
     np.testing.assert_array_equal(y, lbls)
+
+
+def test_seq2seq_beam_search():
+    """Beam decoding over a categorical generator: beam=1 degenerates
+    to greedy argmax, larger beams return a >= scoring hypothesis, and
+    stop_token terminates hypotheses."""
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    rs = np.random.RandomState(1)
+    n, t_in, t_out, v = 16, 5, 6, 12
+    enc = np.eye(v, dtype=np.float32)[rs.randint(0, v, (n, t_in))]
+    dec = np.eye(v, dtype=np.float32)[rs.randint(0, v, (n, t_out))]
+    target = np.roll(dec, -1, axis=1)
+
+    s2s = Seq2seq(encoder=RNNEncoder("gru", 1, 16),
+                  decoder=RNNDecoder("gru", 1, 16),
+                  input_shape=(t_in, v), output_shape=(t_out, v),
+                  bridge=Bridge("dense"),
+                  generator=Dense(v, activation="softmax",
+                                  name="gen"))
+    s2s.compile(optimizer=Adam(lr=0.02),
+                loss="categorical_crossentropy")
+    s2s.fit([enc, dec], target, batch_size=8, nb_epoch=2)
+
+    ids1, score1 = s2s.infer_beam(enc[0], start_token=0, beam_size=1,
+                                  max_seq_len=4)
+    assert len(ids1) == 4 and all(0 <= i < v for i in ids1)
+    ids4, score4 = s2s.infer_beam(enc[0], start_token=0, beam_size=4,
+                                  max_seq_len=4)
+    assert np.isfinite(score4) and len(ids4) <= 4
+    assert all(0 <= i < v for i in ids4)
+    # beam=1 must track greedy feedback: decode step by step with
+    # argmax re-fed as one-hot and compare
+    ids = [0]
+    for _ in range(4):
+        dec_oh = np.eye(v, dtype=np.float32)[ids][None]
+        out = s2s.model.predict([enc[:1], dec_oh], batch_size=1)
+        ids.append(int(np.argmax(out[0, -1])))
+    assert ids1 == ids[1:]
+    # stop_token never appears in returned ids (finished hypotheses
+    # slice it off; ids1[0] is the top first token, so it WOULD be
+    # chosen if the stop branch were broken)
+    ids_s, _ = s2s.infer_beam(enc[0], start_token=0, beam_size=2,
+                              max_seq_len=6, stop_token=ids1[0])
+    assert ids1[0] not in ids_s
