@@ -50,11 +50,16 @@ class _ConvND(KerasLayer):
                  activation=None, border_mode: str = "valid",
                  subsample=1, dilation=1, dim_ordering: str = "tf",
                  w_regularizer=None, b_regularizer=None, bias: bool = True,
-                 input_shape=None, name=None, **kwargs):
+                 groups: int = 1, input_shape=None, name=None, **kwargs):
         super().__init__(input_shape=input_shape, name=name, **kwargs)
         if border_mode not in ("valid", "same"):
             raise ValueError(f"border_mode must be valid|same, "
                              f"got {border_mode}")
+        self.groups = int(groups)
+        if self.groups < 1 or int(nb_filter) % self.groups:
+            raise ValueError(
+                f"nb_filter {nb_filter} must divide by groups "
+                f"{groups}")
         if dim_ordering not in ("tf", "th"):
             raise ValueError("dim_ordering must be 'tf' (channels-last) or "
                              "'th' (channels-first)")
@@ -88,8 +93,13 @@ class _ConvND(KerasLayer):
 
     def build(self, rng, input_shape: Shape) -> dict:
         in_ch = self._in_channels(input_shape)
+        if in_ch % self.groups:
+            raise ValueError(
+                f"input channels {in_ch} must divide by groups "
+                f"{self.groups}")
         k_key, _ = jax.random.split(rng)
-        w_shape = self.kernel_size + (in_ch, self.nb_filter)
+        w_shape = self.kernel_size + (in_ch // self.groups,
+                                      self.nb_filter)
         params = {"kernel": self.kernel_init(k_key, w_shape)}
         if self.bias:
             params["bias"] = jnp.zeros((self.nb_filter,), jnp.float32)
@@ -101,6 +111,7 @@ class _ConvND(KerasLayer):
             window_strides=self.subsample,
             padding=self.border_mode.upper(),
             rhs_dilation=self.dilation,
+            feature_group_count=self.groups,
             dimension_numbers=self._dn())
 
     def call(self, params, x, *, training=False, rng=None):

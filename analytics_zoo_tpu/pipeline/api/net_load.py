@@ -241,8 +241,6 @@ def _torch_to_zoo(module):
                 asg["bias"] = m.bias.detach().numpy()
             weights[id(lyr)] = asg
         elif isinstance(m, nn.Conv2d):
-            if m.groups != 1:
-                raise NotImplementedError("grouped torch Conv2d")
             if m.padding_mode != "zeros":
                 raise NotImplementedError(
                     f"Conv2d padding_mode={m.padding_mode!r}; only "
@@ -258,7 +256,9 @@ def _torch_to_zoo(module):
                 m.out_channels, *_pair(m.kernel_size),
                 subsample=_pair(m.stride), border_mode=border,
                 dilation=_pair(m.dilation), dim_ordering="th",
-                bias=m.bias is not None))
+                groups=m.groups, bias=m.bias is not None))
+            # torch grouped weight (O, I/g, kH, kW) transposes to the
+            # grouped HWIO layout (kH, kW, I/g, O) the same way
             # torch (O, I, kH, kW) → HWIO
             asg = {"kernel":
                    m.weight.detach().numpy().transpose(2, 3, 1, 0)}

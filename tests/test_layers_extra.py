@@ -213,3 +213,43 @@ def test_kernel_layer_wrapper():
     lyr = L.KerasLayerWrapper(lambda x: x * 2 + 1)
     out = lyr.call({}, jnp.ones((2, 3)))
     np.testing.assert_allclose(np.asarray(out), np.full((2, 3), 3.0))
+
+
+def test_grouped_conv2d_matches_torch(rng):
+    """Convolution2D(groups=g) golden vs torch nn.Conv2d(groups=g) —
+    incl. the grouped torch-loader path (ResNeXt/MobileNet blocks)."""
+    import torch
+
+    from analytics_zoo_tpu.pipeline.api.keras.layers import \
+        Convolution2D
+    g, cin, cout = 4, 8, 12
+    tconv = torch.nn.Conv2d(cin, cout, 3, groups=g, bias=True)
+    x = rng.randn(2, cin, 9, 9).astype(np.float32)
+    with torch.no_grad():
+        want = tconv(torch.from_numpy(x)).numpy()
+
+    lyr = Convolution2D(cout, 3, 3, dim_ordering="th", groups=g,
+                        border_mode="valid")
+    params = lyr.init(jax.random.PRNGKey(0), (cin, 9, 9))
+    params["kernel"] = jnp.asarray(
+        tconv.weight.detach().numpy().transpose(2, 3, 1, 0))
+    params["bias"] = jnp.asarray(tconv.bias.detach().numpy())
+    got = np.asarray(lyr.call(params, jnp.asarray(x)))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_torch_loader_imports_grouped_conv(rng):
+    import torch
+
+    from analytics_zoo_tpu.pipeline.api.net_load import Net
+    model = torch.nn.Sequential(
+        torch.nn.Conv2d(8, 16, 3, padding=1),
+        torch.nn.ReLU(),
+        torch.nn.Conv2d(16, 16, 3, groups=4, padding=1),
+    )
+    net = Net.load_torch(model, input_shape=(8, 12, 12))
+    x = rng.randn(2, 8, 12, 12).astype(np.float32)
+    with torch.no_grad():
+        want = model(torch.from_numpy(x)).numpy()
+    got = np.asarray(net.predict(x, batch_size=2))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
