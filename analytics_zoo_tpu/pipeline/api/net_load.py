@@ -272,17 +272,31 @@ def _torch_to_zoo(module):
                     "shapes would silently differ)")
             if getattr(m, "dilation", 1) not in (1, (1, 1)):
                 raise NotImplementedError("dilated torch MaxPool2d")
+            if isinstance(m, nn.AvgPool2d) and \
+                    getattr(m, "divisor_override", None) is not None:
+                raise NotImplementedError(
+                    "AvgPool2d divisor_override (fixed divisor "
+                    "replaces the kernel-area average)")
             pad = _pair(m.padding)
             if any(pad):
                 if isinstance(m, nn.AvgPool2d):
-                    raise NotImplementedError(
-                        "padded torch AvgPool2d (zero-inclusion "
-                        "semantics differ)")
-                # torch MaxPool pads implicitly with -inf, NOT zeros: a
-                # window of all-negative activations must keep its true
-                # max, so pad with the dtype floor
-                emit(L.ZeroPadding2D(padding=pad, dim_ordering="th",
-                                     value=float("-inf")))
+                    if not getattr(m, "count_include_pad", True):
+                        raise NotImplementedError(
+                            "padded torch AvgPool2d with "
+                            "count_include_pad=False (per-window "
+                            "divisor varies)")
+                    # count_include_pad=True (the torch default):
+                    # avg over the window INCLUDING pad zeros ==
+                    # explicit zero pad + valid average — exact
+                    emit(L.ZeroPadding2D(padding=pad,
+                                         dim_ordering="th"))
+                else:
+                    # torch MaxPool pads implicitly with -inf, NOT
+                    # zeros: a window of all-negative activations must
+                    # keep its true max, so pad with the dtype floor
+                    emit(L.ZeroPadding2D(padding=pad,
+                                         dim_ordering="th",
+                                         value=float("-inf")))
             cls = (L.MaxPooling2D if isinstance(m, nn.MaxPool2d)
                    else L.AveragePooling2D)
             stride = m.stride if m.stride is not None \

@@ -203,3 +203,26 @@ def test_load_zoo_model_roundtrip(rng, tmp_path):
     loaded = Net.load(path)
     after = loaded.predict(x, batch_size=16)
     assert_close(after, before, atol=1e-5)
+
+def test_torch_loader_padded_avgpool(rng):
+    """Padded AvgPool2d with count_include_pad=True (torch default)
+    imports exactly: zero pad + valid average. Divergent divisor
+    semantics stay loud errors."""
+    import torch
+
+    model = torch.nn.Sequential(
+        torch.nn.Conv2d(3, 8, 3, padding=1),
+        torch.nn.AvgPool2d(3, stride=2, padding=1),
+    )
+    net = Net.load_torch(model, input_shape=(3, 10, 10))
+    x = rng.randn(2, 3, 10, 10).astype(np.float32)
+    with torch.no_grad():
+        want = model(torch.from_numpy(x)).numpy()
+    assert_close(np.asarray(net.predict(x, batch_size=2)), want)
+    for bad in (
+            torch.nn.AvgPool2d(3, padding=1,
+                               count_include_pad=False),
+            torch.nn.AvgPool2d(3, divisor_override=5)):
+        with pytest.raises(NotImplementedError):
+            Net.load_torch(torch.nn.Sequential(bad),
+                           input_shape=(3, 10, 10))
