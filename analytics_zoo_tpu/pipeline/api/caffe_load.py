@@ -260,9 +260,7 @@ def load_caffe(def_path: str, model_path: Optional[str] = None,
             sw = _one(p, "stride_w", _one(p, "stride", 1))
             ph = _one(p, "pad_h", _one(p, "pad", 0))
             pw = _one(p, "pad_w", _one(p, "pad", 0))
-            if _one(p, "group", 1) != 1:
-                raise NotImplementedError(
-                    "grouped Caffe convolutions not supported")
+            groups = int(_one(p, "group", 1))
             if ph or pw:
                 converted.append((L.ZeroPadding2D(
                     padding=(ph, pw), dim_ordering="th"), {}))
@@ -278,7 +276,7 @@ def load_caffe(def_path: str, model_path: Optional[str] = None,
                     ws["bias"] = blobs[1].reshape(-1)
             converted.append((L.Convolution2D(
                 n_out, (kh, kw), subsample=(sh, sw),
-                border_mode="valid", dim_ordering="th",
+                border_mode="valid", dim_ordering="th", groups=groups,
                 bias=bool(bias_term), name=lname), ws))
         elif ltype == "InnerProduct":
             p = _one(ld, "inner_product_param", {})

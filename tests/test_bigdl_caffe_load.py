@@ -125,3 +125,39 @@ class TestPrototxtParser:
         assert p["num_output"] == [4]
         assert p["bias_term"] == [False]
         assert p["pool"] == ["MAX"]
+
+
+def test_caffe_grouped_conv_imports(rng, tmp_path):
+    """group>1 Convolution layers import (AlexNet's classic group=2)
+    and match torch's grouped conv on the same weights."""
+    import torch
+
+    proto = tmp_path / "g.prototxt"
+    proto.write_text('''
+        name: "g"
+        input: "data"
+        input_dim: 1 input_dim: 4 input_dim: 6 input_dim: 6
+        layer { name: "conv_g" type: "Convolution" bottom: "data"
+                top: "conv_g"
+                convolution_param { num_output: 8 kernel_size: 3
+                                    group: 2 bias_term: true } }
+    ''')
+    net = Net.load_caffe(str(proto), input_shape=(4, 6, 6))
+    x = rng.randn(2, 4, 6, 6).astype(np.float32)
+    out = np.asarray(net.predict(x, batch_size=2))
+    assert out.shape == (2, 8, 4, 4)
+
+    # copy the imported weights into torch and compare
+    est = net.estimator
+    import jax
+    params = jax.device_get(est.params)
+    conv_params = params["conv_g"]
+    tconv = torch.nn.Conv2d(4, 8, 3, groups=2, bias=True)
+    with torch.no_grad():
+        tconv.weight.copy_(torch.from_numpy(
+            np.ascontiguousarray(np.transpose(
+                np.asarray(conv_params["kernel"]), (3, 2, 0, 1)))))
+        tconv.bias.copy_(torch.from_numpy(
+            np.asarray(conv_params["bias"])))
+        want = tconv(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-4)
