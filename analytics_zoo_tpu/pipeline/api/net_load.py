@@ -117,7 +117,8 @@ class Net:
         if not isinstance(module, torch.nn.Module):
             raise TypeError(f"expected torch.nn.Module, got "
                             f"{type(module)}")
-        zoo_layers, weight_map = _torch_to_zoo(module)
+        zoo_layers, weight_map = _torch_to_zoo(
+            module, input_shape=input_shape)
         from analytics_zoo_tpu.pipeline.api.keras.models import \
             Sequential
         net = Sequential()
@@ -212,11 +213,14 @@ def _pair(v):
     return (v, v) if isinstance(v, int) else tuple(v)
 
 
-def _torch_to_zoo(module):
+def _torch_to_zoo(module, input_shape=None):
     """torch modules → (zoo layers, {zoo_layer_name: param assignments}).
 
     Images stay in torch's NCHW layout via ``dim_ordering="th"`` — no
     transpose nodes; XLA lays out either ordering onto the MXU.
+    ``input_shape`` (torch layout, no batch) lets the walker track the
+    running shape through the emitted layers, unlocking modules whose
+    mapping needs static sizes (AdaptiveAvgPool2d to any output size).
     """
     import torch.nn as nn
 
@@ -224,11 +228,21 @@ def _torch_to_zoo(module):
 
     zoo_layers = []
     weights = {}
+    shape = {"cur": tuple(input_shape) if input_shape else None}
 
     def emit(layer, assignments=None):
         zoo_layers.append(layer)
         if assignments:
             weights[id(layer)] = assignments
+        if shape["cur"] is not None:
+            try:
+                shape["cur"] = tuple(
+                    layer.compute_output_shape(shape["cur"]))
+            except Exception as e:
+                # stop tracking but keep importing; remember why so
+                # shape-dependent modules can say which layer broke it
+                shape["cur"] = None
+                shape["lost_at"] = f"{type(layer).__name__}: {e}"
         return layer
 
     for m in _flatten_torch(module):
@@ -304,10 +318,29 @@ def _torch_to_zoo(module):
             emit(cls(pool_size=_pair(m.kernel_size),
                      strides=_pair(stride), dim_ordering="th"))
         elif isinstance(m, nn.AdaptiveAvgPool2d):
-            if _pair(m.output_size) != (1, 1):
+            out_hw = _pair(m.output_size)
+            if None in out_hw:
                 raise NotImplementedError(
-                    "AdaptiveAvgPool2d only for output_size=1")
-            emit(L.GlobalAveragePooling2D(dim_ordering="th"))
+                    "AdaptiveAvgPool2d with a None output dim "
+                    "(keep-input-size) is not supported")
+            if out_hw == (1, 1):
+                emit(L.GlobalAveragePooling2D(dim_ordering="th"))
+            elif shape["cur"] is not None and len(shape["cur"]) == 3:
+                in_h, in_w = shape["cur"][1], shape["cur"][2]
+                if in_h % out_hw[0] or in_w % out_hw[1]:
+                    raise NotImplementedError(
+                        f"AdaptiveAvgPool2d {out_hw} from "
+                        f"({in_h},{in_w}): non-divisible adaptive "
+                        "windows (torch uses variable window sizes)")
+                kh, kw = in_h // out_hw[0], in_w // out_hw[1]
+                emit(L.AveragePooling2D(pool_size=(kh, kw),
+                                        strides=(kh, kw),
+                                        dim_ordering="th"))
+            else:
+                raise NotImplementedError(
+                    "AdaptiveAvgPool2d with output_size>1 needs the "
+                    "running shape, which was lost at "
+                    f"{shape.get('lost_at', 'a non-3D input_shape')}")
         elif isinstance(m, (nn.BatchNorm1d, nn.BatchNorm2d)):
             if m.running_mean is None:
                 raise NotImplementedError(

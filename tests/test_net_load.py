@@ -226,3 +226,25 @@ def test_torch_loader_padded_avgpool(rng):
         with pytest.raises(NotImplementedError):
             Net.load_torch(torch.nn.Sequential(bad),
                            input_shape=(3, 10, 10))
+
+
+def test_torch_loader_adaptive_avgpool_any_size(rng):
+    """AdaptiveAvgPool2d((2, 2)) imports via shape tracking when the
+    input divides evenly (the torchvision-VGG classifier head)."""
+    import torch
+
+    model = torch.nn.Sequential(
+        torch.nn.Conv2d(3, 8, 3, padding=1),
+        torch.nn.AdaptiveAvgPool2d((2, 2)),
+        torch.nn.Flatten(),
+        torch.nn.Linear(32, 4),
+    )
+    net = Net.load_torch(model, input_shape=(3, 8, 8))
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    with torch.no_grad():
+        want = model(torch.from_numpy(x)).numpy()
+    assert_close(np.asarray(net.predict(x, batch_size=2)), want)
+    # non-divisible target stays a loud error
+    bad = torch.nn.Sequential(torch.nn.AdaptiveAvgPool2d((3, 3)))
+    with pytest.raises(NotImplementedError, match="non-divisible"):
+        Net.load_torch(bad, input_shape=(3, 8, 8))
