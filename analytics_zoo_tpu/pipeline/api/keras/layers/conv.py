@@ -426,8 +426,15 @@ class ZeroPadding2D(KerasLayer):
     def __init__(self, padding=(1, 1), dim_ordering="tf", input_shape=None,
                  name=None, value=0.0, **kwargs):
         super().__init__(input_shape=input_shape, name=name, **kwargs)
-        p = _norm_tuple(padding, 2, "padding")
-        self.padding = ((p[0], p[0]), (p[1], p[1]))
+        if (isinstance(padding, (tuple, list)) and len(padding) == 2
+                and all(isinstance(q, (tuple, list)) and len(q) == 2
+                        for q in padding)):
+            # keras-2 style asymmetric form ((top, bottom), (l, r))
+            self.padding = (tuple(int(v) for v in padding[0]),
+                            tuple(int(v) for v in padding[1]))
+        else:
+            p = _norm_tuple(padding, 2, "padding")
+            self.padding = ((p[0], p[0]), (p[1], p[1]))
         self.dim_ordering = dim_ordering
         self.value = value
 

@@ -213,6 +213,21 @@ def _pair(v):
     return (v, v) if isinstance(v, int) else tuple(v)
 
 
+def _ceil_pool_extra(dim: int, k: int, stride: int, pad: int) -> int:
+    """Extra right/bottom padding that makes floor pooling produce
+    torch's ceil_mode output count. Zero when ceil == floor or the
+    extra window would start entirely in the right padding (torch
+    drops it)."""
+    span = dim + 2 * pad - k
+    out_floor = span // stride + 1
+    out_ceil = -(-span // stride) + 1
+    if out_ceil == out_floor:
+        return 0
+    if (out_ceil - 1) * stride >= dim + pad:
+        return 0   # window starts past input + left pad → dropped
+    return (out_ceil - 1) * stride + k - (dim + 2 * pad)
+
+
 def _torch_to_zoo(module, input_shape=None):
     """torch modules → (zoo layers, {zoo_layer_name: param assignments}).
 
@@ -280,10 +295,33 @@ def _torch_to_zoo(module, input_shape=None):
                 asg["bias"] = m.bias.detach().numpy()
             weights[id(lyr)] = asg
         elif isinstance(m, (nn.MaxPool2d, nn.AvgPool2d)):
+            ceil_extra = (0, 0)
             if getattr(m, "ceil_mode", False):
-                raise NotImplementedError(
-                    "torch pooling ceil_mode=True (floor-mode output "
-                    "shapes would silently differ)")
+                # MaxPool ceil_mode: with the running shape known, the
+                # ceil windows exist iff we extend the right/bottom
+                # -inf padding so floor pooling yields them (torch
+                # drops windows starting entirely in the right pad)
+                if shape["cur"] is None or len(shape["cur"]) != 3:
+                    raise NotImplementedError(
+                        "pooling ceil_mode=True needs a tracked "
+                        "running shape (lost at "
+                        f"{shape.get('lost_at', 'non-3D input')})")
+                kh, kw = _pair(m.kernel_size)
+                sh_, sw_ = _pair(m.stride if m.stride is not None
+                                 else m.kernel_size)
+                ph_, pw_ = _pair(m.padding)
+                ceil_extra = tuple(
+                    _ceil_pool_extra(dim, k, s_, p_)
+                    for dim, k, s_, p_ in (
+                        (shape["cur"][1], kh, sh_, ph_),
+                        (shape["cur"][2], kw, sw_, pw_)))
+                if isinstance(m, nn.AvgPool2d) and any(ceil_extra):
+                    # ceil genuinely adds windows; their avg divisor
+                    # excludes the ceil extension — no pad rewrite
+                    raise NotImplementedError(
+                        "AvgPool2d ceil_mode=True with ceil-extended "
+                        "windows (divisor excludes the extension); "
+                        "harmless ceil_mode (ceil==floor) imports")
             if getattr(m, "dilation", 1) not in (1, (1, 1)):
                 raise NotImplementedError("dilated torch MaxPool2d")
             if isinstance(m, nn.AvgPool2d) and \
@@ -308,9 +346,15 @@ def _torch_to_zoo(module, input_shape=None):
                     # torch MaxPool pads implicitly with -inf, NOT
                     # zeros: a window of all-negative activations must
                     # keep its true max, so pad with the dtype floor
-                    emit(L.ZeroPadding2D(padding=pad,
-                                         dim_ordering="th",
-                                         value=float("-inf")))
+                    emit(L.ZeroPadding2D(
+                        padding=((pad[0], pad[0] + ceil_extra[0]),
+                                 (pad[1], pad[1] + ceil_extra[1])),
+                        dim_ordering="th", value=float("-inf")))
+                    ceil_extra = (0, 0)
+            if any(ceil_extra):   # ceil windows without base padding
+                emit(L.ZeroPadding2D(
+                    padding=((0, ceil_extra[0]), (0, ceil_extra[1])),
+                    dim_ordering="th", value=float("-inf")))
             cls = (L.MaxPooling2D if isinstance(m, nn.MaxPool2d)
                    else L.AveragePooling2D)
             stride = m.stride if m.stride is not None \
@@ -318,7 +362,8 @@ def _torch_to_zoo(module, input_shape=None):
             emit(cls(pool_size=_pair(m.kernel_size),
                      strides=_pair(stride), dim_ordering="th"))
         elif isinstance(m, nn.AdaptiveAvgPool2d):
-            out_hw = _pair(m.output_size)
+            out_hw = (_pair(m.output_size)
+                      if m.output_size is not None else (None, None))
             if None in out_hw:
                 raise NotImplementedError(
                     "AdaptiveAvgPool2d with a None output dim "

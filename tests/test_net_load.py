@@ -157,7 +157,9 @@ def test_load_torch_bn_no_affine(rng):
 
 
 def test_load_torch_unsupported_pool_modes():
-    tm = nn.Sequential(nn.MaxPool2d(3, stride=2, ceil_mode=True))
+    # ceil_mode MaxPool now IMPORTS (test_torch_loader_ceil_mode_
+    # maxpool); ceil AvgPool remains unsupported
+    tm = nn.Sequential(nn.AvgPool2d(3, stride=2, ceil_mode=True))
     with pytest.raises(NotImplementedError, match="ceil_mode"):
         Net.load_torch(tm, input_shape=(3, 8, 8))
     tm2 = nn.Sequential(nn.Conv2d(3, 4, 3, padding=1,
@@ -248,3 +250,38 @@ def test_torch_loader_adaptive_avgpool_any_size(rng):
     bad = torch.nn.Sequential(torch.nn.AdaptiveAvgPool2d((3, 3)))
     with pytest.raises(NotImplementedError, match="non-divisible"):
         Net.load_torch(bad, input_shape=(3, 8, 8))
+
+
+def test_torch_loader_ceil_mode_maxpool(rng):
+    """ceil_mode MaxPool2d imports exactly via -inf right/bottom
+    extension (GoogleNet-era exports), incl. the window-dropped edge
+    and combined base padding; ceil AvgPool stays a loud error."""
+    import torch
+
+    for k, s, p, size in ((3, 2, 0, (7, 7)), (3, 2, 1, (8, 8)),
+                          (2, 2, 0, (7, 7)), (3, 3, 1, (6, 6)),
+                          ((3, 2), (2, 2), 0, (9, 6))):
+        model = torch.nn.Sequential(
+            torch.nn.Conv2d(3, 4, 3, padding=1),
+            torch.nn.MaxPool2d(k, stride=s, padding=p,
+                               ceil_mode=True))
+        net = Net.load_torch(model, input_shape=(3,) + size)
+        x = rng.randn(2, 3, *size).astype(np.float32)
+        with torch.no_grad():
+            want = model(torch.from_numpy(x)).numpy()
+        got = np.asarray(net.predict(x, batch_size=2))
+        assert got.shape == want.shape, (k, s, p, size)
+        assert_close(got, want)
+    # AvgPool ceil: harmless (ceil==floor) imports; genuine ceil
+    # extension stays loud
+    ok = torch.nn.Sequential(torch.nn.AvgPool2d(2, 2, ceil_mode=True))
+    net = Net.load_torch(ok, input_shape=(3, 8, 8))
+    xa = rng.randn(1, 3, 8, 8).astype(np.float32)
+    with torch.no_grad():
+        want = ok(torch.from_numpy(xa)).numpy()
+    assert_close(np.asarray(net.predict(xa, batch_size=1)), want)
+    bad = torch.nn.Sequential(
+        torch.nn.AvgPool2d(3, 2, ceil_mode=True))
+    with pytest.raises(NotImplementedError, match="ceil"):
+        Net.load_torch(bad, input_shape=(3, 8, 8))
+
