@@ -64,6 +64,20 @@ def read_bytes(path: str) -> bytes:
         return f.read()
 
 
+def ceil_pool_extra(dim: int, k_eff: int, stride: int,
+                    lo: int, hi: int) -> int:
+    """Extra trailing padding that makes floor pooling produce
+    ceil-mode's output count (torch/onnxruntime semantics: the last
+    window is dropped when it starts past input + leading pad).
+    Shared by the torch and ONNX importers."""
+    span = dim + lo + hi - k_eff
+    out_floor = span // stride + 1
+    out_ceil = -(-span // stride) + 1
+    if out_ceil == out_floor or (out_ceil - 1) * stride >= dim + lo:
+        return 0
+    return (out_ceil - 1) * stride + k_eff - (dim + lo + hi)
+
+
 def parallel_map(fn, items, env_knob: str = "ZOO_TPU_DECODE_WORKERS",
                  default_workers: int = 8, min_items: int = 4):
     """Order-preserving thread-pool map for GIL-releasing per-item

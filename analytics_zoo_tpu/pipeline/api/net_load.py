@@ -213,21 +213,6 @@ def _pair(v):
     return (v, v) if isinstance(v, int) else tuple(v)
 
 
-def _ceil_pool_extra(dim: int, k: int, stride: int, pad: int) -> int:
-    """Extra right/bottom padding that makes floor pooling produce
-    torch's ceil_mode output count. Zero when ceil == floor or the
-    extra window would start entirely in the right padding (torch
-    drops it)."""
-    span = dim + 2 * pad - k
-    out_floor = span // stride + 1
-    out_ceil = -(-span // stride) + 1
-    if out_ceil == out_floor:
-        return 0
-    if (out_ceil - 1) * stride >= dim + pad:
-        return 0   # window starts past input + left pad → dropped
-    return (out_ceil - 1) * stride + k - (dim + 2 * pad)
-
-
 def _torch_to_zoo(module, input_shape=None):
     """torch modules → (zoo layers, {zoo_layer_name: param assignments}).
 
@@ -310,8 +295,10 @@ def _torch_to_zoo(module, input_shape=None):
                 sh_, sw_ = _pair(m.stride if m.stride is not None
                                  else m.kernel_size)
                 ph_, pw_ = _pair(m.padding)
+                from analytics_zoo_tpu.common.utils import \
+                    ceil_pool_extra
                 ceil_extra = tuple(
-                    _ceil_pool_extra(dim, k, s_, p_)
+                    ceil_pool_extra(dim, k, s_, p_, p_)
                     for dim, k, s_, p_ in (
                         (shape["cur"][1], kh, sh_, ph_),
                         (shape["cur"][2], kw, sw_, pw_)))

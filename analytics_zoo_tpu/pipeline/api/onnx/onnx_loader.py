@@ -282,14 +282,16 @@ def _conv_transpose(a, i):
 
 
 # pooling
+from analytics_zoo_tpu.common.utils import ceil_pool_extra \
+    as _ceil_extra  # shared with the torch importer
+
+
 def _pool_common(a, x, reducer, init):
     n_sp = x.ndim - 2
     kernel = a["kernel_shape"]
     strides = a.get("strides", [1] * n_sp)
     dilations = a.get("dilations", [1] * n_sp)
     auto_pad = a.get("auto_pad", "NOTSET")
-    if a.get("ceil_mode", 0):
-        raise NotImplementedError("ceil_mode pooling")
     if auto_pad in ("SAME_UPPER", "SAME_LOWER"):
         padding = _auto_pads(auto_pad, x.shape[2:], kernel, strides,
                              dilations)
@@ -297,6 +299,15 @@ def _pool_common(a, x, reducer, init):
         padding = [(0, 0)] * n_sp
     else:
         padding = _pair_pads(a.get("pads", []), n_sp)
+    if a.get("ceil_mode", 0):
+        # extend the trailing padding so floor windows realize the
+        # ceil output count (pad cells take `init`: -inf for max,
+        # 0 for the sum/count passes); last-window rule matches
+        # torch/onnxruntime (dropped when starting past input+lo pad)
+        padding = [
+            (lo, hi + _ceil_extra(d, (k - 1) * dl + 1, st, lo, hi))
+            for d, k, st, dl, (lo, hi) in zip(
+                x.shape[2:], kernel, strides, dilations, padding)]
     dims = (1, 1) + tuple(kernel)
     strd = (1, 1) + tuple(strides)
     dil = (1, 1) + tuple(dilations)
@@ -314,6 +325,10 @@ def _maxpool(a, i):
 @_register("AveragePool")
 def _avgpool(a, i):
     x = i[0]
+    if a.get("count_include_pad", 0) and a.get("ceil_mode", 0):
+        raise NotImplementedError(
+            "AveragePool ceil_mode with count_include_pad (divisor "
+            "treatment of the ceil extension is runtime-ambiguous)")
     y, padding = _pool_common(a, x, lax.add, 0.0)
     if a.get("count_include_pad", 0):
         denom = float(np.prod(a["kernel_shape"]))

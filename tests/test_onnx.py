@@ -642,3 +642,36 @@ def test_supported_ops_inventory():
                      "BatchNormalization", "Relu", "Softmax", "Reshape",
                      "Concat", "Add", "MatMul", "Transpose", "Gather"]:
         assert required in ops
+
+
+def test_maxpool_ceil_mode_vs_torch(rng):
+    """MaxPool/AveragePool ceil_mode=1 matches torch's ceil pooling
+    (onnxruntime semantics), incl. padded, strided, and rectangular
+    dropped-window cases."""
+    for k, s, p, size in ((3, 2, 0, (7, 7)), (3, 2, 1, (8, 8)),
+                          (2, 2, 0, (9, 6)), (3, 3, 1, (6, 7))):
+        x = rng.randn(2, 3, *size).astype(np.float32)
+        node = helper.make_node(
+            "MaxPool", ["x"], ["y"], kernel_shape=[k, k],
+            strides=[s, s], pads=[p, p, p, p], ceil_mode=1)
+        (out,) = run_node(node, [x])
+        ref = F.max_pool2d(_t(x), k, stride=s, padding=p,
+                           ceil_mode=True).numpy()
+        assert out.shape == ref.shape, (k, s, p, size)
+        assert_close(out, ref)
+    # AveragePool ceil (count_include_pad=0, the ONNX default):
+    # divisor counts only real cells — torch's count_include_pad=False
+    x = rng.randn(2, 3, 7, 7).astype(np.float32)
+    node = helper.make_node("AveragePool", ["x"], ["y"],
+                            kernel_shape=[3, 3], strides=[2, 2],
+                            ceil_mode=1)
+    (out,) = run_node(node, [x])
+    ref = F.avg_pool2d(_t(x), 3, stride=2, ceil_mode=True,
+                       count_include_pad=False).numpy()
+    assert_close(out, ref)
+    # the ambiguous combination stays loud
+    node = helper.make_node("AveragePool", ["x"], ["y"],
+                            kernel_shape=[3, 3], strides=[2, 2],
+                            ceil_mode=1, count_include_pad=1)
+    with pytest.raises(NotImplementedError, match="ceil_mode"):
+        run_node(node, [x])
