@@ -105,6 +105,17 @@ _register("Log")(lambda a, i: jnp.log(i[0]))
 _register("Abs")(lambda a, i: jnp.abs(i[0]))
 _register("Neg")(lambda a, i: -i[0])
 _register("Sign")(lambda a, i: jnp.sign(i[0]))
+_register("Sin")(lambda a, i: jnp.sin(i[0]))
+_register("Cos")(lambda a, i: jnp.cos(i[0]))
+_register("Tan")(lambda a, i: jnp.tan(i[0]))
+_register("Asin")(lambda a, i: jnp.arcsin(i[0]))
+_register("Acos")(lambda a, i: jnp.arccos(i[0]))
+_register("Atan")(lambda a, i: jnp.arctan(i[0]))
+_register("Sinh")(lambda a, i: jnp.sinh(i[0]))
+_register("Cosh")(lambda a, i: jnp.cosh(i[0]))
+_register("Asinh")(lambda a, i: jnp.arcsinh(i[0]))
+_register("Acosh")(lambda a, i: jnp.arccosh(i[0]))
+_register("Atanh")(lambda a, i: jnp.arctanh(i[0]))
 _register("Floor")(lambda a, i: jnp.floor(i[0]))
 _register("Ceil")(lambda a, i: jnp.ceil(i[0]))
 _register("Round")(lambda a, i: jnp.round(i[0]))
@@ -611,6 +622,62 @@ _register("ReduceSum")(_reduce(jnp.sum))
 _register("ReduceMax")(_reduce(jnp.max))
 _register("ReduceMin")(_reduce(jnp.min))
 _register("ReduceProd")(_reduce(jnp.prod))
+_register("ReduceL1")(_reduce(lambda x, axis, keepdims:
+                              jnp.sum(jnp.abs(x), axis=axis,
+                                      keepdims=keepdims)))
+_register("ReduceSumSquare")(_reduce(lambda x, axis, keepdims:
+                                     jnp.sum(x * x, axis=axis,
+                                             keepdims=keepdims)))
+_register("ReduceLogSum")(_reduce(lambda x, axis, keepdims:
+                                  jnp.log(jnp.sum(x, axis=axis,
+                                                  keepdims=keepdims))))
+
+
+@_register("Einsum")
+def _einsum(a, i):
+    eq = a["equation"]
+    eq = eq.decode() if isinstance(eq, bytes) else eq
+    return jnp.einsum(eq, *i)
+
+
+@_register("TopK")
+def _topk(a, i):
+    x = i[0]
+    k = int(_static(i[1]).reshape(())) if len(i) > 1 else int(a["k"])
+    axis = int(a.get("axis", -1))
+    largest = bool(a.get("largest", 1))
+    xm = jnp.moveaxis(x, axis, -1)
+    if largest:
+        vals, idx = jax.lax.top_k(xm, k)
+    else:
+        # smallest-k via order inversion that is safe for EVERY dtype
+        # (arithmetic negation wraps for unsigned ints and INT_MIN):
+        # take top-k of the descending sort-rank instead
+        order = jnp.argsort(xm, axis=-1)           # ascending
+        idx = order[..., :k]
+        vals = jnp.take_along_axis(xm, idx, axis=-1)
+    # indices stay the x64-mode default int (int64 would silently
+    # truncate to int32 with a warning when x64 is off — run_node's
+    # documented caveat)
+    return (jnp.moveaxis(vals, -1, axis),
+            jnp.moveaxis(idx, -1, axis))
+
+
+@_register("CumSum")
+def _cumsum(a, i):
+    axis = int(_static(i[1]).reshape(()))
+    y = i[0]
+    if a.get("reverse", 0):
+        y = jnp.flip(y, axis)
+    out = jnp.cumsum(y, axis=axis)
+    if a.get("exclusive", 0):
+        out = jnp.concatenate(
+            [jnp.zeros_like(jnp.take(out, jnp.array([0]), axis=axis)),
+             jnp.take(out, jnp.arange(out.shape[axis] - 1),
+                      axis=axis)], axis=axis)
+    if a.get("reverse", 0):
+        out = jnp.flip(out, axis)
+    return out
 _register("ReduceL2")(_reduce(
     lambda x, axis, keepdims: jnp.sqrt(
         jnp.sum(x * x, axis=axis, keepdims=keepdims))))

@@ -675,3 +675,60 @@ def test_maxpool_ceil_mode_vs_torch(rng):
                             ceil_mode=1, count_include_pad=1)
     with pytest.raises(NotImplementedError, match="ceil_mode"):
         run_node(node, [x])
+
+
+def test_trig_and_reduce_ops(rng):
+    x = rng.rand(3, 4).astype(np.float32) * 0.8 + 0.1
+    for op, ref in (("Sin", np.sin), ("Cos", np.cos), ("Tan", np.tan),
+                    ("Asin", np.arcsin), ("Acos", np.arccos),
+                    ("Atan", np.arctan), ("Sinh", np.sinh),
+                    ("Cosh", np.cosh), ("Asinh", np.arcsinh),
+                    ("Atanh", np.arctanh)):
+        node = helper.make_node(op, ["x"], ["y"])
+        (out,) = run_node(node, [x])
+        assert_close(out, ref(x))
+    xg = x + 1.0   # arccosh needs inputs >= 1
+    (out,) = run_node(helper.make_node("Acosh", ["x"], ["y"]), [xg])
+    assert_close(out, np.arccosh(xg))
+    for op, ref in (
+            ("ReduceL1", np.abs(x).sum(1, keepdims=True)),
+            ("ReduceL2", np.sqrt((x * x).sum(1, keepdims=True))),
+            ("ReduceSumSquare", (x * x).sum(1, keepdims=True)),
+            ("ReduceLogSum", np.log(x.sum(1, keepdims=True)))):
+        node = helper.make_node(op, ["x"], ["y"], axes=[1])
+        (out,) = run_node(node, [x])
+        assert_close(out, ref)
+
+
+def test_einsum_topk_cumsum(rng):
+    a = rng.randn(3, 4).astype(np.float32)
+    b = rng.randn(4, 5).astype(np.float32)
+    node = helper.make_node("Einsum", ["a", "b"], ["y"],
+                            equation="ij,jk->ik")
+    (out,) = run_node(node, [a, b])
+    assert_close(out, a @ b)
+
+    x = rng.randn(2, 6).astype(np.float32)
+    node = helper.make_node("TopK", ["x", "k"], ["v", "i"], axis=-1)
+    v, idx = run_node(node, [x, np.array([3], np.int64)])
+    tv, ti = __import__("torch").topk(_t(x), 3, dim=-1)
+    assert_close(v, tv.numpy())
+    np.testing.assert_array_equal(np.asarray(idx), ti.numpy())
+    node = helper.make_node("TopK", ["x", "k"], ["v", "i"], axis=-1,
+                            largest=0)
+    v, idx = run_node(node, [x, np.array([2], np.int64)])
+    tv, ti = __import__("torch").topk(_t(x), 2, dim=-1, largest=False)
+    assert_close(v, tv.numpy())
+    # unsigned smallest-k: negation-wrap would pick the wrong element
+    xu = np.array([[0, 5, 3]], np.uint8)
+    v, idx = run_node(node, [xu, np.array([1], np.int64)])
+    np.testing.assert_array_equal(np.asarray(v), [[0]])
+
+    node = helper.make_node("CumSum", ["x", "ax"], ["y"])
+    (out,) = run_node(node, [x, np.array(1, np.int64)])
+    assert_close(out, np.cumsum(x, 1))
+    node = helper.make_node("CumSum", ["x", "ax"], ["y"], exclusive=1,
+                            reverse=1)
+    (out,) = run_node(node, [x, np.array(1, np.int64)])
+    ref = np.flip(np.cumsum(np.flip(x, 1), 1), 1) - x
+    assert_close(out, ref)
