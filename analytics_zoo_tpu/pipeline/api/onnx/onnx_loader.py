@@ -633,6 +633,162 @@ _register("ReduceLogSum")(_reduce(lambda x, axis, keepdims:
                                                   keepdims=keepdims))))
 
 
+def _rnn_common(a, i, n_gates):
+    """Shared ONNX LSTM/GRU plumbing: layouts, directions, defaults.
+    X (T,B,I); W (D,G*H,I); R (D,G*H,H); B (D,2*G*H) optional;
+    sequence_lens unsupported (guarded); initial states optional."""
+    x, w, r = i[0], i[1], i[2]
+    b = i[3] if len(i) > 3 and i[3] is not None else None
+    if len(i) > 4 and i[4] is not None:
+        raise NotImplementedError("RNN sequence_lens")
+    if len(i) > 7 and i[7] is not None:
+        raise NotImplementedError("LSTM peephole weights (P)")
+    for attr in ("activations", "activation_alpha",
+                 "activation_beta", "clip", "input_forget"):
+        if a.get(attr):
+            raise NotImplementedError(f"RNN attribute {attr!r} "
+                                      "(defaults only)")
+    direction = a.get("direction", "forward")
+    direction = direction.decode() if isinstance(direction, bytes) \
+        else direction
+    hidden = int(a["hidden_size"])
+    dirs = w.shape[0]
+    t, bsz, _ = x.shape
+    if b is None:
+        b = jnp.zeros((dirs, 2 * n_gates * hidden), x.dtype)
+    return x, w, r, b, direction, hidden, dirs, t, bsz
+
+
+def _lstm_dir(x, w, r, b, h0, c0, hidden):
+    """One direction. ONNX gate order i, o, f, c."""
+    wb, rb = b[: 4 * hidden], b[4 * hidden:]
+
+    def step(carry, xt):
+        h, c = carry
+        g = xt @ w.T + h @ r.T + wb + rb
+        i_, o_, f_, c_ = jnp.split(g, 4, axis=-1)
+        i_ = jax.nn.sigmoid(i_)
+        o_ = jax.nn.sigmoid(o_)
+        f_ = jax.nn.sigmoid(f_)
+        c2 = f_ * c + i_ * jnp.tanh(c_)
+        h2 = o_ * jnp.tanh(c2)
+        return (h2, c2), h2
+
+    (hT, cT), ys = jax.lax.scan(step, (h0, c0), x)
+    return ys, hT, cT
+
+
+@_register("LSTM")
+def _lstm(a, i):
+    x, w, r, b, direction, hidden, dirs, t, bsz = \
+        _rnn_common(a, i, 4)
+    h0 = (i[5] if len(i) > 5 and i[5] is not None
+          else jnp.zeros((dirs, bsz, hidden), x.dtype))
+    c0 = (i[6] if len(i) > 6 and i[6] is not None
+          else jnp.zeros((dirs, bsz, hidden), x.dtype))
+    outs = []
+    for d in range(dirs):
+        xd = x[::-1] if (direction == "reverse" or d == 1) else x
+        ys, hT, cT = _lstm_dir(xd, w[d], r[d], b[d], h0[d], c0[d],
+                               hidden)
+        if direction == "reverse" or d == 1:
+            ys = ys[::-1]
+        outs.append((ys, hT, cT))
+    y = jnp.stack([o[0] for o in outs], axis=1)   # (T, D, B, H)
+    y_h = jnp.stack([o[1] for o in outs], axis=0)
+    y_c = jnp.stack([o[2] for o in outs], axis=0)
+    return y, y_h, y_c
+
+
+@_register("GRU")
+def _gru(a, i):
+    x, w, r, b, direction, hidden, dirs, t, bsz = \
+        _rnn_common(a, i, 3)
+    lbr = int(a.get("linear_before_reset", 0))
+    h0 = (i[5] if len(i) > 5 and i[5] is not None
+          else jnp.zeros((dirs, bsz, hidden), x.dtype))
+
+    def gru_dir(xd, wd, rd, bd, h_init):
+        wb, rb = bd[: 3 * hidden], bd[3 * hidden:]
+        wz, wr_, wh = jnp.split(wd, 3, axis=0)
+        rz, rr, rh = jnp.split(rd, 3, axis=0)
+        wbz, wbr, wbh = jnp.split(wb, 3)
+        rbz, rbr, rbh = jnp.split(rb, 3)
+
+        def step(h, xt):
+            z = jax.nn.sigmoid(xt @ wz.T + h @ rz.T + wbz + rbz)
+            rt = jax.nn.sigmoid(xt @ wr_.T + h @ rr.T + wbr + rbr)
+            if lbr:
+                hh = jnp.tanh(xt @ wh.T + wbh + rt * (h @ rh.T + rbh))
+            else:
+                hh = jnp.tanh(xt @ wh.T + wbh + (rt * h) @ rh.T + rbh)
+            h2 = (1 - z) * hh + z * h
+            return h2, h2
+
+        hT, ys = jax.lax.scan(step, h_init, xd)
+        return ys, hT
+
+    outs = []
+    for d in range(dirs):
+        xd = x[::-1] if (direction == "reverse" or d == 1) else x
+        ys, hT = gru_dir(xd, w[d], r[d], b[d], h0[d])
+        if direction == "reverse" or d == 1:
+            ys = ys[::-1]
+        outs.append((ys, hT))
+    y = jnp.stack([o[0] for o in outs], axis=1)
+    y_h = jnp.stack([o[1] for o in outs], axis=0)
+    return y, y_h
+
+
+@_register("DepthToSpace")
+def _depth_to_space(a, i):
+    x = i[0]
+    b, c, h, w = x.shape
+    bs = int(a["blocksize"])
+    mode = a.get("mode", "DCR")
+    mode = mode.decode() if isinstance(mode, bytes) else mode
+    if mode == "DCR":
+        y = x.reshape(b, bs, bs, c // (bs * bs), h, w)
+        y = y.transpose(0, 3, 4, 1, 5, 2)
+    else:  # CRD
+        y = x.reshape(b, c // (bs * bs), bs, bs, h, w)
+        y = y.transpose(0, 1, 4, 2, 5, 3)
+    return y.reshape(b, c // (bs * bs), h * bs, w * bs)
+
+
+@_register("SpaceToDepth")
+def _space_to_depth(a, i):
+    x = i[0]
+    b, c, h, w = x.shape
+    bs = int(a["blocksize"])
+    y = x.reshape(b, c, h // bs, bs, w // bs, bs)
+    y = y.transpose(0, 3, 5, 1, 2, 4)
+    return y.reshape(b, c * bs * bs, h // bs, w // bs)
+
+
+@_register("OneHot")
+def _onehot(a, i):
+    indices, depth, values = i
+    d = int(_static(depth).reshape(()))
+    axis = int(a.get("axis", -1))
+    off_v, on_v = _static(values)
+    idx = jnp.asarray(indices)
+    idx = jnp.where(idx < 0, idx + d, idx)   # ONNX negative wrap
+    vdt = jnp.asarray(i[2]).dtype   # spec: output type = values type
+    oh = jax.nn.one_hot(idx, d, axis=axis, dtype=vdt)
+    return (oh * (on_v - off_v) + off_v).astype(vdt)
+
+
+@_register("Trilu")
+def _trilu(a, i):
+    x = i[0]
+    k = int(_static(i[1]).reshape(())) if len(i) > 1 and \
+        i[1] is not None else 0
+    if a.get("upper", 1):
+        return jnp.triu(x, k)
+    return jnp.tril(x, k)
+
+
 @_register("Einsum")
 def _einsum(a, i):
     eq = a["equation"]

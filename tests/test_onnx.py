@@ -732,3 +732,126 @@ def test_einsum_topk_cumsum(rng):
     (out,) = run_node(node, [x, np.array(1, np.int64)])
     ref = np.flip(np.cumsum(np.flip(x, 1), 1), 1) - x
     assert_close(out, ref)
+
+
+def test_space_depth_onehot_trilu(rng):
+    import torch
+
+    x = rng.randn(2, 8, 4, 6).astype(np.float32)
+    node = helper.make_node("DepthToSpace", ["x"], ["y"], blocksize=2,
+                            mode="DCR")
+    (out,) = run_node(node, [x])
+    ref = torch.nn.functional.pixel_shuffle(_t(x), 2).numpy()
+    # DCR equals tf.nn.depth_to_space (independent oracle)
+    tf = pytest.importorskip("tensorflow")
+    want = tf.nn.depth_to_space(
+        np.transpose(x, (0, 2, 3, 1)), 2).numpy()
+    assert_close(out, np.transpose(want, (0, 3, 1, 2)))
+    node = helper.make_node("DepthToSpace", ["x"], ["y"], blocksize=2,
+                            mode="CRD")
+    (out,) = run_node(node, [x])
+    assert_close(out, ref)
+
+    node = helper.make_node("SpaceToDepth", ["x"], ["y"], blocksize=2)
+    (out,) = run_node(node, [x])
+    want = tf.nn.space_to_depth(
+        np.transpose(x, (0, 2, 3, 1)), 2).numpy()
+    assert_close(out, np.transpose(want, (0, 3, 1, 2)))
+    # SpaceToDepth then DCR DepthToSpace round-trips
+    node2 = helper.make_node("DepthToSpace", ["y"], ["z"], blocksize=2,
+                             mode="DCR")
+    (back,) = run_node(node2, [np.asarray(out)])
+    assert_close(back, x)
+
+    idx = np.array([[0, 2, -1]], np.int64)
+    node = helper.make_node("OneHot", ["i", "d", "v"], ["y"], axis=-1)
+    (out,) = run_node(node, [idx, np.array(3, np.int64),
+                             np.array([0.5, 2.0], np.float32)])
+    ref = np.full((1, 3, 3), 0.5, np.float32)
+    ref[0, 0, 0] = ref[0, 1, 2] = ref[0, 2, 2] = 2.0
+    assert_close(out, ref)
+    # output dtype follows the values tensor (spec: T3)
+    (oi,) = run_node(node, [idx, np.array(3, np.int64),
+                            np.array([0, 7], np.int32)])
+    assert np.asarray(oi).dtype == np.int32
+    assert np.asarray(oi)[0, 0, 0] == 7
+
+    m = rng.randn(4, 4).astype(np.float32)
+    node = helper.make_node("Trilu", ["x"], ["y"], upper=0)
+    (out,) = run_node(node, [m])
+    assert_close(out, np.tril(m))
+    node = helper.make_node("Trilu", ["x", "k"], ["y"])
+    (out,) = run_node(node, [m, np.array(1, np.int64)])
+    assert_close(out, np.triu(m, 1))
+
+
+def _np_lstm_ref(x, w, r, b, h0, c0):
+    """Spec-literal numpy LSTM (gate order i, o, f, c)."""
+    H = r.shape[-1]
+    hs = []
+    h, c = h0.copy(), c0.copy()
+    sig = lambda v: 1 / (1 + np.exp(-v))  # noqa: E731
+    for xt in x:
+        g = xt @ w.T + h @ r.T + b[:4 * H] + b[4 * H:]
+        i_, o_, f_, c_ = np.split(g, 4, axis=-1)
+        c = sig(f_) * c + sig(i_) * np.tanh(c_)
+        h = sig(o_) * np.tanh(c)
+        hs.append(h)
+    return np.stack(hs), h, c
+
+
+def test_onnx_lstm_forward_and_bidirectional(rng):
+    t, bsz, inp, hid = 5, 2, 3, 4
+    x = rng.randn(t, bsz, inp).astype(np.float32)
+    mk = lambda *s: rng.randn(*s).astype(np.float32) * 0.4  # noqa: E731
+    w1, r1, b1 = mk(1, 4 * hid, inp), mk(1, 4 * hid, hid), \
+        mk(1, 8 * hid)
+    node = helper.make_node("LSTM", ["x", "w", "r", "b"],
+                            ["y", "yh", "yc"], hidden_size=hid)
+    y, yh, yc = run_node(node, [x, w1, r1, b1])
+    ys, hT, cT = _np_lstm_ref(x, w1[0], r1[0], b1[0],
+                              np.zeros((bsz, hid), np.float32),
+                              np.zeros((bsz, hid), np.float32))
+    assert_close(y, ys[:, None], atol=1e-5)
+    assert_close(yh, hT[None], atol=1e-5)
+    assert_close(yc, cT[None], atol=1e-5)
+
+    # bidirectional: forward lane matches the fwd ref; reverse lane
+    # matches the ref over the reversed sequence, re-reversed
+    w2, r2, b2 = mk(2, 4 * hid, inp), mk(2, 4 * hid, hid), \
+        mk(2, 8 * hid)
+    node = helper.make_node("LSTM", ["x", "w", "r", "b"],
+                            ["y", "yh", "yc"], hidden_size=hid,
+                            direction="bidirectional")
+    y, yh, yc = run_node(node, [x, w2, r2, b2])
+    z = np.zeros((bsz, hid), np.float32)
+    f_ys, f_h, _ = _np_lstm_ref(x, w2[0], r2[0], b2[0], z, z)
+    r_ys, r_h, _ = _np_lstm_ref(x[::-1], w2[1], r2[1], b2[1], z, z)
+    assert_close(y[:, 0], f_ys, atol=1e-5)
+    assert_close(y[:, 1], r_ys[::-1], atol=1e-5)
+    assert_close(yh, np.stack([f_h, r_h]), atol=1e-5)
+
+
+def test_onnx_gru_matches_torch(rng):
+    """ONNX GRU with linear_before_reset=1 is exactly torch's GRU
+    (zrh gate order, torch layout rzn -> onnx zrn reorder)."""
+    import torch
+
+    t, bsz, inp, hid = 5, 2, 3, 4
+    tg = torch.nn.GRU(inp, hid)
+    x = rng.randn(t, bsz, inp).astype(np.float32)
+    with torch.no_grad():
+        want, wh = tg(torch.from_numpy(x))
+    # torch weight_ih_l0: (3H, I) gate order r, z, n; ONNX wants z, r, h
+    def reorder(m):
+        r_, z_, n_ = np.split(m, 3, axis=0)
+        return np.concatenate([z_, r_, n_], axis=0)
+    w = reorder(tg.weight_ih_l0.detach().numpy())[None]
+    r = reorder(tg.weight_hh_l0.detach().numpy())[None]
+    b = np.concatenate([reorder(tg.bias_ih_l0.detach().numpy()),
+                        reorder(tg.bias_hh_l0.detach().numpy())])[None]
+    node = helper.make_node("GRU", ["x", "w", "r", "b"], ["y", "yh"],
+                            hidden_size=hid, linear_before_reset=1)
+    y, yh = run_node(node, [x, w, r, b])
+    assert_close(y[:, 0], want.numpy(), atol=1e-5)
+    assert_close(yh, wh.detach().numpy(), atol=1e-5)
