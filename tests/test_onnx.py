@@ -855,3 +855,40 @@ def test_onnx_gru_matches_torch(rng):
     y, yh = run_node(node, [x, w, r, b])
     assert_close(y[:, 0], want.numpy(), atol=1e-5)
     assert_close(yh, wh.detach().numpy(), atol=1e-5)
+
+
+def test_graph_level_lstm_model(rng, tmp_path):
+    """A full ONNX graph with a multi-output LSTM node (only Y_h
+    consumed; Y and Y_c dead), Squeeze, and Gemm loads through
+    OnnxLoader and matches the composed reference — the last-hidden
+    classifier export shape."""
+    t, bsz, inp, hid, out_d = 4, 2, 3, 5, 2
+    mk = lambda *s: rng.randn(*s).astype(np.float32) * 0.4  # noqa: E731
+    w, r, b = mk(1, 4 * hid, inp), mk(1, 4 * hid, hid), mk(1, 8 * hid)
+    gw, gb = mk(out_d, hid), mk(out_d)
+    nodes = [
+        helper.make_node("LSTM", ["x", "w", "r", "b"],
+                         ["ys", "yh", "yc"], hidden_size=hid),
+        helper.make_node("Squeeze", ["yh"], ["h"], axes=[0]),
+        helper.make_node("Gemm", ["h", "gw", "gb"], ["y"], transB=1),
+    ]
+    graph = helper.make_graph(
+        nodes, "lstm_g",
+        [helper.make_tensor_value_info("x", TensorProto.FLOAT,
+                                       [t, bsz, inp])],
+        [helper.make_tensor_value_info("y", TensorProto.FLOAT,
+                                       [bsz, out_d])],
+        [helper.make_tensor(n, v) for n, v in
+         (("w", w), ("r", r), ("b", b), ("gw", gw), ("gb", gb))])
+    path = str(tmp_path / "lstm.onnx")
+    onnx_pb.save_model(helper.make_model(graph), path)
+    net = OnnxLoader.load_model(path)
+
+    x = rng.randn(t, bsz, inp).astype(np.float32)
+    _, h, _ = _np_lstm_ref(x, w[0], r[0], b[0],
+                           np.zeros((bsz, hid), np.float32),
+                           np.zeros((bsz, hid), np.float32))
+    want = h @ gw.T + gb
+    params = net.init_params()
+    got = np.asarray(net.call(params, x))
+    assert_close(got, want, atol=1e-5)
