@@ -327,6 +327,50 @@ class Max(KerasLayer):
         return tuple(shape)
 
 
+def align_corners_resize(x, sizes, method: str = "linear"):
+    """Corner-aligned resize to `sizes` (full-rank tuple): output
+    pixel i samples input at i*(in-1)/(out-1) — torch/ONNX
+    align_corners semantics, no half-pixel shift, point sampling on
+    downscale (antialias off). Shared by ResizeBilinear and the ONNX
+    Resize op. Degenerate axes: in==1 replicates the single pixel;
+    out==1 samples corner 0. "nearest" uses exact integer gathers
+    (scale_and_translate rejects nearest)."""
+    sizes = tuple(int(v) for v in sizes)
+    if method == "nearest":
+        for ax, (insz, outsz) in enumerate(zip(x.shape, sizes)):
+            if insz == outsz:
+                continue
+            pos = jnp.arange(outsz) * ((insz - 1) /
+                                       max(outsz - 1, 1))
+            idx = jnp.clip(jnp.round(pos).astype(jnp.int32), 0,
+                           insz - 1)
+            x = jnp.take(x, idx, axis=ax)
+        return x
+    axes, scales, trans, bcast = [], [], [], []
+    for ax, (insz, outsz) in enumerate(zip(x.shape, sizes)):
+        if insz == outsz:
+            continue
+        if insz == 1:
+            bcast.append(ax)      # replicate after the resampling
+            continue
+        axes.append(ax)
+        k = (outsz - 1) / (insz - 1) if outsz > 1 else 1.0
+        scales.append(k)
+        trans.append(0.5 - 0.5 * k)
+    if axes:
+        mid = list(x.shape)
+        for ax in axes:
+            mid[ax] = sizes[ax]
+        x = jax.image.scale_and_translate(
+            x, tuple(mid), tuple(axes),
+            jnp.asarray(scales, jnp.float32),
+            jnp.asarray(trans, jnp.float32), method=method,
+            antialias=False)
+    for ax in bcast:
+        x = jnp.repeat(x, sizes[ax], axis=ax)
+    return x
+
+
 class ResizeBilinear(KerasLayer):
     """Bilinear spatial resize (reference `layers/ResizeBilinear.scala`).
 
@@ -355,16 +399,7 @@ class ResizeBilinear(KerasLayer):
             sp = (2, 3)
         if not self.align_corners:
             return jax.image.resize(x, out_shape, method="bilinear")
-        # corner-aligned: output pixel i samples input at i*(in-1)/(out-1).
-        # scale_and_translate uses half-pixel centers
-        # (in = (i+0.5)/scale - t/scale - 0.5), so with scale s =
-        # (out-1)/(in-1) the required translation is t = 0.5 - 0.5*s.
-        scale = jnp.array(
-            [max(out_shape[d] - 1, 1) / max(x.shape[d] - 1, 1)
-             for d in sp], jnp.float32)
-        return jax.image.scale_and_translate(
-            x, out_shape, sp, scale, 0.5 - 0.5 * scale,
-            method="linear", antialias=False)
+        return align_corners_resize(x, out_shape, method="linear")
 
     def compute_output_shape(self, input_shape: Shape) -> Shape:
         h, w = self.output_height, self.output_width

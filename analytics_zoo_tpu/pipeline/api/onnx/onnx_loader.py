@@ -884,15 +884,19 @@ def _resize_impl(a, i, ct, default_nearest="round_prefer_floor"):
             src = np.clip(src.astype(np.int64), 0, insz - 1)
             out = jnp.take(out, jnp.asarray(src), axis=axis)
         return out
+    method = {"nearest": "nearest", "linear": "linear",
+              "cubic": "cubic"}[mode]
+    if ct == "align_corners":
+        from analytics_zoo_tpu.pipeline.api.keras.layers.elementwise \
+            import align_corners_resize
+        return align_corners_resize(x, sizes, method=method)
     if ct not in ("half_pixel", "pytorch_half_pixel"):
         # silently falling back to half-pixel shifts pixels for
         # asymmetric/align_corners exports (ADVICE r1)
         raise NotImplementedError(
             f"Resize coordinate_transformation_mode={ct!r} with "
-            f"mode={mode!r}: only half_pixel(/pytorch_half_pixel), or "
-            "nearest+asymmetric, are supported")
-    method = {"nearest": "nearest", "linear": "linear",
-              "cubic": "cubic"}[mode]
+            f"mode={mode!r}: only half_pixel(/pytorch_half_pixel), "
+            "align_corners, or nearest+asymmetric, are supported")
     return jax.image.resize(x, sizes, method=method)
 
 

@@ -892,3 +892,52 @@ def test_graph_level_lstm_model(rng, tmp_path):
     params = net.init_params()
     got = np.asarray(net.call(params, x))
     assert_close(got, want, atol=1e-5)
+
+
+def test_resize_align_corners_vs_torch(rng):
+    """Resize linear + align_corners matches torch's
+    F.interpolate(align_corners=True) (segmentation-model exports)."""
+    x = rng.randn(2, 3, 5, 7).astype(np.float32)
+    node = helper.make_node(
+        "Resize", ["x", "roi", "scales", "sizes"], ["y"],
+        mode="linear", coordinate_transformation_mode="align_corners")
+    sizes = np.array([2, 3, 10, 14], np.int64)
+    (out,) = run_node(node, [x, None, None, sizes])
+    ref = F.interpolate(_t(x), size=(10, 14), mode="bilinear",
+                        align_corners=True).numpy()
+    assert_close(out, ref, atol=1e-4)
+    # downscale too
+    sizes = np.array([2, 3, 3, 4], np.int64)
+    (out,) = run_node(node, [x, None, None, sizes])
+    ref = F.interpolate(_t(x), size=(3, 4), mode="bilinear",
+                        align_corners=True).numpy()
+    assert_close(out, ref, atol=1e-4)
+
+
+def test_resize_align_corners_edge_cases(rng):
+    """Degenerate axes replicate (in==1) or sample corner 0 (out==1);
+    nearest+align_corners gathers exactly like torch."""
+    x = rng.randn(1, 3, 1, 7).astype(np.float32)
+    node = helper.make_node(
+        "Resize", ["x", "roi", "scales", "sizes"], ["y"],
+        mode="linear", coordinate_transformation_mode="align_corners")
+    (out,) = run_node(node, [x, None, None,
+                             np.array([1, 3, 4, 14], np.int64)])
+    ref = F.interpolate(_t(x), size=(4, 14), mode="bilinear",
+                        align_corners=True).numpy()
+    assert_close(out, ref, atol=1e-4)   # row replication, not zeros
+
+    node = helper.make_node(
+        "Resize", ["x", "roi", "scales", "sizes"], ["y"],
+        mode="nearest", coordinate_transformation_mode="align_corners")
+    x2 = rng.randn(1, 2, 5, 5).astype(np.float32)
+    (out,) = run_node(node, [x2, None, None,
+                             np.array([1, 2, 9, 3], np.int64)])
+    ref = F.interpolate(_t(x2), size=(9, 3), mode="nearest-exact",
+                        align_corners=None).numpy()
+    # torch nearest-exact uses half-pixel; build the align-corners
+    # gather reference manually instead
+    iy = np.clip(np.round(np.arange(9) * (4 / 8)).astype(int), 0, 4)
+    ix = np.clip(np.round(np.arange(3) * (4 / 2)).astype(int), 0, 4)
+    man = x2[:, :, iy][:, :, :, ix]
+    assert_close(out, man)
