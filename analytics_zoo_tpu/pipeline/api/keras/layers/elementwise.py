@@ -327,7 +327,8 @@ class Max(KerasLayer):
         return tuple(shape)
 
 
-def align_corners_resize(x, sizes, method: str = "linear"):
+def align_corners_resize(x, sizes, method: str = "linear",
+                         nearest_mode: str = "round_prefer_floor"):
     """Corner-aligned resize to `sizes` (full-rank tuple): output
     pixel i samples input at i*(in-1)/(out-1) — torch/ONNX
     align_corners semantics, no half-pixel shift, point sampling on
@@ -337,15 +338,29 @@ def align_corners_resize(x, sizes, method: str = "linear"):
     (scale_and_translate rejects nearest)."""
     sizes = tuple(int(v) for v in sizes)
     if method == "nearest":
+        import numpy as _np
         for ax, (insz, outsz) in enumerate(zip(x.shape, sizes)):
             if insz == outsz:
                 continue
-            pos = jnp.arange(outsz) * ((insz - 1) /
+            pos = _np.arange(outsz) * ((insz - 1) /
                                        max(outsz - 1, 1))
-            idx = jnp.clip(jnp.round(pos).astype(jnp.int32), 0,
-                           insz - 1)
-            x = jnp.take(x, idx, axis=ax)
+            if nearest_mode == "floor":
+                src = _np.floor(pos)
+            elif nearest_mode == "ceil":
+                src = _np.ceil(pos)
+            elif nearest_mode == "round_prefer_ceil":
+                src = _np.floor(pos + 0.5)
+            else:  # round_prefer_floor (the ONNX default)
+                src = _np.ceil(pos - 0.5)
+            idx = _np.clip(src.astype(_np.int32), 0, insz - 1)
+            x = jnp.take(x, jnp.asarray(idx), axis=ax)
         return x
+    if method not in ("linear",):
+        # jax's cubic kernel is Keys a=-0.5; ONNX defaults
+        # cubic_coeff_a=-0.75 — silently wrong values, so refuse
+        raise NotImplementedError(
+            f"align_corners resize supports linear/nearest, not "
+            f"{method!r} (cubic coefficient mismatch vs ONNX)")
     axes, scales, trans, bcast = [], [], [], []
     for ax, (insz, outsz) in enumerate(zip(x.shape, sizes)):
         if insz == outsz:

@@ -740,6 +740,41 @@ def _gru(a, i):
     return y, y_h
 
 
+@_register("GatherND")
+def _gather_nd(a, i):
+    x, idx = i[0], jnp.asarray(i[1])
+    b = int(a.get("batch_dims", 0))
+
+    def one(xb, ib):
+        coords = tuple(jnp.moveaxis(ib, -1, 0))
+        return xb[coords]
+
+    fn = one
+    for _ in range(b):
+        fn = jax.vmap(fn)
+    return fn(x, idx)
+
+
+@_register("ScatterND")
+def _scatter_nd(a, i):
+    x, idx, upd = i[0], jnp.asarray(i[1]), i[2]
+    red = a.get("reduction", "none")
+    red = red.decode() if isinstance(red, bytes) else red
+    coords = tuple(jnp.moveaxis(idx, -1, 0))
+    at = jnp.asarray(x).at[coords]
+    if red == "none":
+        return at.set(upd)
+    if red == "add":
+        return at.add(upd)
+    if red == "mul":
+        return at.multiply(upd)
+    if red == "max":
+        return at.max(upd)
+    if red == "min":
+        return at.min(upd)
+    raise NotImplementedError(f"ScatterND reduction {red!r}")
+
+
 @_register("DepthToSpace")
 def _depth_to_space(a, i):
     x = i[0]
@@ -889,7 +924,10 @@ def _resize_impl(a, i, ct, default_nearest="round_prefer_floor"):
     if ct == "align_corners":
         from analytics_zoo_tpu.pipeline.api.keras.layers.elementwise \
             import align_corners_resize
-        return align_corners_resize(x, sizes, method=method)
+        nm = a.get("nearest_mode", "round_prefer_floor")
+        nm = nm.decode() if isinstance(nm, bytes) else nm
+        return align_corners_resize(x, sizes, method=method,
+                                    nearest_mode=nm)
     if ct not in ("half_pixel", "pytorch_half_pixel"):
         # silently falling back to half-pixel shifts pixels for
         # asymmetric/align_corners exports (ADVICE r1)

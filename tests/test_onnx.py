@@ -935,9 +935,56 @@ def test_resize_align_corners_edge_cases(rng):
                              np.array([1, 2, 9, 3], np.int64)])
     ref = F.interpolate(_t(x2), size=(9, 3), mode="nearest-exact",
                         align_corners=None).numpy()
-    # torch nearest-exact uses half-pixel; build the align-corners
-    # gather reference manually instead
-    iy = np.clip(np.round(np.arange(9) * (4 / 8)).astype(int), 0, 4)
-    ix = np.clip(np.round(np.arange(3) * (4 / 2)).astype(int), 0, 4)
+    # align-corners gather reference with the ONNX default
+    # round_prefer_floor (ceil(pos - 0.5))
+    iy = np.clip(np.ceil(np.arange(9) * (4 / 8) - 0.5).astype(int),
+                 0, 4)
+    ix = np.clip(np.ceil(np.arange(3) * (4 / 2) - 0.5).astype(int),
+                 0, 4)
     man = x2[:, :, iy][:, :, :, ix]
     assert_close(out, man)
+    # cubic + align_corners refuses (kernel coefficient mismatch)
+    nodec = helper.make_node(
+        "Resize", ["x", "roi", "scales", "sizes"], ["y"], mode="cubic",
+        coordinate_transformation_mode="align_corners")
+    with pytest.raises(NotImplementedError, match="cubic"):
+        run_node(nodec, [x2, None, None,
+                         np.array([1, 2, 9, 3], np.int64)])
+
+
+def test_gather_scatter_nd(rng):
+    x = rng.randn(4, 5, 6).astype(np.float32)
+    # GatherND k=2 -> gathers rows of the last axis
+    idx = np.array([[0, 1], [3, 4], [2, 0]], np.int64)
+    node = helper.make_node("GatherND", ["x", "i"], ["y"])
+    (out,) = run_node(node, [x, idx])
+    assert_close(out, np.stack([x[0, 1], x[3, 4], x[2, 0]]))
+    # full-depth k=3 -> scalars
+    idx3 = np.array([[0, 1, 2], [3, 4, 5]], np.int64)
+    (out,) = run_node(node, [x, idx3])
+    assert_close(out, np.array([x[0, 1, 2], x[3, 4, 5]]))
+    # batch_dims=1
+    idxb = np.array([[[1]], [[0]], [[4]], [[2]]], np.int64)  # (4,1,1)
+    node = helper.make_node("GatherND", ["x", "i"], ["y"],
+                            batch_dims=1)
+    (out,) = run_node(node, [x, idxb])
+    assert_close(out, np.stack([x[0, 1], x[1, 0], x[2, 4],
+                                x[3, 2]])[:, None])
+
+    # ScatterND set and add
+    data = np.zeros((4, 3), np.float32)
+    sidx = np.array([[1], [3]], np.int64)
+    upd = rng.randn(2, 3).astype(np.float32)
+    node = helper.make_node("ScatterND", ["x", "i", "u"], ["y"])
+    (out,) = run_node(node, [data, sidx, upd])
+    ref = data.copy()
+    ref[1], ref[3] = upd[0], upd[1]
+    assert_close(out, ref)
+    node = helper.make_node("ScatterND", ["x", "i", "u"], ["y"],
+                            reduction="add")
+    base = rng.randn(4, 3).astype(np.float32)
+    (out,) = run_node(node, [base, sidx, upd])
+    ref = base.copy()
+    ref[1] += upd[0]
+    ref[3] += upd[1]
+    assert_close(out, ref)
