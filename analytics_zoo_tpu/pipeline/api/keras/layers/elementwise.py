@@ -327,6 +327,21 @@ class Max(KerasLayer):
         return tuple(shape)
 
 
+def nearest_round(pos, mode: str):
+    """ONNX Resize nearest_mode rounding (one source of truth for the
+    align-corners and asymmetric paths); unknown modes raise."""
+    import numpy as _np
+    if mode == "floor":
+        return _np.floor(pos)
+    if mode == "ceil":
+        return _np.ceil(pos)
+    if mode == "round_prefer_ceil":
+        return _np.floor(_np.asarray(pos) + 0.5)
+    if mode == "round_prefer_floor":
+        return _np.ceil(_np.asarray(pos) - 0.5)
+    raise NotImplementedError(f"Resize nearest_mode {mode!r}")
+
+
 def align_corners_resize(x, sizes, method: str = "linear",
                          nearest_mode: str = "round_prefer_floor"):
     """Corner-aligned resize to `sizes` (full-rank tuple): output
@@ -344,14 +359,7 @@ def align_corners_resize(x, sizes, method: str = "linear",
                 continue
             pos = _np.arange(outsz) * ((insz - 1) /
                                        max(outsz - 1, 1))
-            if nearest_mode == "floor":
-                src = _np.floor(pos)
-            elif nearest_mode == "ceil":
-                src = _np.ceil(pos)
-            elif nearest_mode == "round_prefer_ceil":
-                src = _np.floor(pos + 0.5)
-            else:  # round_prefer_floor (the ONNX default)
-                src = _np.ceil(pos - 0.5)
+            src = nearest_round(pos, nearest_mode)
             idx = _np.clip(src.astype(_np.int32), 0, insz - 1)
             x = jnp.take(x, jnp.asarray(idx), axis=ax)
         return x

@@ -740,6 +740,60 @@ def _gru(a, i):
     return y, y_h
 
 
+@_register("ScatterElements", "Scatter")
+def _scatter_elements(a, i):
+    x, idx, upd = jnp.asarray(i[0]), jnp.asarray(i[1]), \
+        jnp.asarray(i[2])
+    axis = int(a.get("axis", 0)) % x.ndim
+    red = a.get("reduction", "none")
+    red = red.decode() if isinstance(red, bytes) else red
+    idx = jnp.where(idx < 0, idx + x.shape[axis], idx)
+    # build full coordinates: every dim indexes itself except `axis`,
+    # which uses idx (jnp.put_along_axis has no reduction modes)
+    coords = list(jnp.meshgrid(
+        *[jnp.arange(n) for n in idx.shape], indexing="ij"))
+    coords[axis] = idx
+    at = x.at[tuple(coords)]
+    ops = {"none": at.set, "add": at.add, "mul": at.multiply,
+           "max": at.max, "min": at.min}
+    if red not in ops:
+        raise NotImplementedError(f"ScatterElements reduction {red!r}")
+    return ops[red](upd)
+
+
+_register("HardSwish")(lambda a, i: i[0] * jnp.clip(
+    i[0] / 6.0 + 0.5, 0.0, 1.0))
+_register("Mish")(lambda a, i: i[0] * jnp.tanh(jax.nn.softplus(i[0])))
+_register("IsNaN")(lambda a, i: jnp.isnan(i[0]))
+
+
+@_register("IsInf")
+def _isinf(a, i):
+    x = i[0]
+    pos = jnp.isposinf(x) if a.get("detect_positive", 1) else \
+        jnp.zeros_like(x, bool)
+    neg = jnp.isneginf(x) if a.get("detect_negative", 1) else \
+        jnp.zeros_like(x, bool)
+    return jnp.logical_or(pos, neg)
+
+
+@_register("Mod")
+def _mod(a, i):
+    if a.get("fmod", 0):
+        return jnp.fmod(i[0], i[1])
+    return jnp.mod(i[0], i[1])
+
+
+@_register("Shrink")
+def _shrink(a, i):
+    x = i[0]
+    lambd = a.get("lambd", 0.5)
+    bias = a.get("bias", 0.0)
+    return jnp.where(x < -lambd, x + bias,
+                     jnp.where(x > lambd, x - bias,
+                               jnp.zeros_like(x)))
+
+
 @_register("GatherND")
 def _gather_nd(a, i):
     x, idx = i[0], jnp.asarray(i[1])
@@ -902,20 +956,17 @@ def _resize_impl(a, i, ct, default_nearest="round_prefer_floor"):
     if mode == "nearest" and ct == "asymmetric":
         # exact opset-10 Upsample / torch Upsample semantics:
         # src = f(dst / scale) per axis via integer gathers
+        from analytics_zoo_tpu.pipeline.api.keras.layers. \
+            elementwise import nearest_round
         nearest = a.get("nearest_mode", default_nearest)
+        nearest = nearest.decode() if isinstance(nearest, bytes) \
+            else nearest
         out = x
         for axis, (insz, outsz) in enumerate(zip(x.shape, sizes)):
             if insz == outsz:
                 continue
             pos = np.arange(outsz) * (insz / outsz)
-            if nearest == "floor":
-                src = np.floor(pos)
-            elif nearest == "ceil":
-                src = np.ceil(pos)
-            elif nearest == "round_prefer_floor":
-                src = np.ceil(pos - 0.5)
-            else:  # round_prefer_ceil
-                src = np.floor(pos + 0.5)
+            src = nearest_round(pos, nearest)
             src = np.clip(src.astype(np.int64), 0, insz - 1)
             out = jnp.take(out, jnp.asarray(src), axis=axis)
         return out
@@ -924,7 +975,7 @@ def _resize_impl(a, i, ct, default_nearest="round_prefer_floor"):
     if ct == "align_corners":
         from analytics_zoo_tpu.pipeline.api.keras.layers.elementwise \
             import align_corners_resize
-        nm = a.get("nearest_mode", "round_prefer_floor")
+        nm = a.get("nearest_mode", default_nearest)
         nm = nm.decode() if isinstance(nm, bytes) else nm
         return align_corners_resize(x, sizes, method=method,
                                     nearest_mode=nm)

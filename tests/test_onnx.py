@@ -933,8 +933,7 @@ def test_resize_align_corners_edge_cases(rng):
     x2 = rng.randn(1, 2, 5, 5).astype(np.float32)
     (out,) = run_node(node, [x2, None, None,
                              np.array([1, 2, 9, 3], np.int64)])
-    ref = F.interpolate(_t(x2), size=(9, 3), mode="nearest-exact",
-                        align_corners=None).numpy()
+    # (torch has no align_corners nearest mode to compare against)
     # align-corners gather reference with the ONNX default
     # round_prefer_floor (ceil(pos - 0.5))
     iy = np.clip(np.ceil(np.arange(9) * (4 / 8) - 0.5).astype(int),
@@ -988,3 +987,53 @@ def test_gather_scatter_nd(rng):
     ref[1] += upd[0]
     ref[3] += upd[1]
     assert_close(out, ref)
+
+
+def test_scatter_elements_and_misc_ops(rng):
+    import torch
+
+    x = np.zeros((3, 4), np.float32)
+    idx = np.array([[1, 3]], np.int64)
+    upd = np.array([[5.0, 7.0]], np.float32)
+    node = helper.make_node("ScatterElements", ["x", "i", "u"], ["y"],
+                            axis=1)
+    (out,) = run_node(node, [x, idx, upd])
+    ref = torch.zeros(3, 4).scatter_(
+        1, torch.from_numpy(idx), torch.from_numpy(upd)).numpy()
+    assert_close(out, ref)
+    node = helper.make_node("ScatterElements", ["x", "i", "u"], ["y"],
+                            axis=1, reduction="add")
+    base = rng.randn(3, 4).astype(np.float32)
+    (out,) = run_node(node, [base, idx, upd])
+    ref = torch.from_numpy(base.copy()).scatter_add_(
+        1, torch.from_numpy(idx), torch.from_numpy(upd)).numpy()
+    assert_close(out, ref)
+
+    v = np.array([-3.0, -0.2, 0.0, 0.4, 2.0], np.float32)
+    (out,) = run_node(helper.make_node("HardSwish", ["x"], ["y"]), [v])
+    assert_close(out, torch.nn.functional.hardswish(
+        torch.from_numpy(v)).numpy(), atol=1e-6)
+    (out,) = run_node(helper.make_node("Mish", ["x"], ["y"]), [v])
+    assert_close(out, torch.nn.functional.mish(
+        torch.from_numpy(v)).numpy(), atol=1e-6)
+    (out,) = run_node(helper.make_node("Shrink", ["x"], ["y"],
+                                       lambd=0.5, bias=0.1), [v])
+    ref = np.where(v < -0.5, v + 0.1, np.where(v > 0.5, v - 0.1, 0.0))
+    assert_close(out, ref)
+
+    w = np.array([1.0, np.inf, -np.inf, np.nan], np.float32)
+    (out,) = run_node(helper.make_node("IsNaN", ["x"], ["y"]), [w])
+    np.testing.assert_array_equal(np.asarray(out),
+                                  [False, False, False, True])
+    (out,) = run_node(helper.make_node("IsInf", ["x"], ["y"],
+                                       detect_negative=0), [w])
+    np.testing.assert_array_equal(np.asarray(out),
+                                  [False, True, False, False])
+    (m,) = run_node(helper.make_node("Mod", ["a", "b"], ["y"]),
+                    [np.array([-7, 7], np.int64),
+                     np.array([3, -3], np.int64)])
+    np.testing.assert_array_equal(np.asarray(m), [2, -2])  # py mod
+    (m,) = run_node(helper.make_node("Mod", ["a", "b"], ["y"], fmod=1),
+                    [np.array([-7.5, 7.5], np.float32),
+                     np.array([3.0, -3.0], np.float32)])
+    assert_close(m, np.fmod([-7.5, 7.5], [3.0, -3.0]))
