@@ -745,8 +745,7 @@ def _scatter_elements(a, i):
     x, idx, upd = jnp.asarray(i[0]), jnp.asarray(i[1]), \
         jnp.asarray(i[2])
     axis = int(a.get("axis", 0)) % x.ndim
-    red = a.get("reduction", "none")
-    red = red.decode() if isinstance(red, bytes) else red
+    red = a.get("reduction", "none")   # attribute_value decodes str
     idx = jnp.where(idx < 0, idx + x.shape[axis], idx)
     # build full coordinates: every dim indexes itself except `axis`,
     # which uses idx (jnp.put_along_axis has no reduction modes)
@@ -959,8 +958,6 @@ def _resize_impl(a, i, ct, default_nearest="round_prefer_floor"):
         from analytics_zoo_tpu.pipeline.api.keras.layers. \
             elementwise import nearest_round
         nearest = a.get("nearest_mode", default_nearest)
-        nearest = nearest.decode() if isinstance(nearest, bytes) \
-            else nearest
         out = x
         for axis, (insz, outsz) in enumerate(zip(x.shape, sizes)):
             if insz == outsz:
@@ -975,10 +972,9 @@ def _resize_impl(a, i, ct, default_nearest="round_prefer_floor"):
     if ct == "align_corners":
         from analytics_zoo_tpu.pipeline.api.keras.layers.elementwise \
             import align_corners_resize
-        nm = a.get("nearest_mode", default_nearest)
-        nm = nm.decode() if isinstance(nm, bytes) else nm
-        return align_corners_resize(x, sizes, method=method,
-                                    nearest_mode=nm)
+        return align_corners_resize(
+            x, sizes, method=method,
+            nearest_mode=a.get("nearest_mode", default_nearest))
     if ct not in ("half_pixel", "pytorch_half_pixel"):
         # silently falling back to half-pixel shifts pixels for
         # asymmetric/align_corners exports (ADVICE r1)
@@ -1107,7 +1103,28 @@ class OnnxGraphLayer(KerasLayer):
         env: Dict[str, Any] = dict(self._constants)
         env.update(params.get("w", {}))
         env.update(zip(self.input_names, xs))
-        for k, node in enumerate(self.graph.node):
+        self._run_nodes(self.graph.node, env, training=training,
+                        rng=rng)
+        missing = [n for n in self.output_names if n not in env]
+        if missing:
+            raise ValueError(f"graph outputs never produced: {missing}")
+        return tuple(env[n] for n in self.output_names)
+
+    def _run_nodes(self, nodes, env, *, training, rng):
+        """Interpret a node list into ``env`` (shared by the top graph
+        and If-branch subgraphs, which see the outer scope by name —
+        the ONNX subgraph capture rule)."""
+        for k, node in enumerate(nodes):
+            # fold the rng only for nodes that consume one (a per-node
+            # threefry dispatch would be wasted work eagerly)
+            sub_rng = (jax.random.fold_in(rng, k)
+                       if rng is not None
+                       and node.op_type in ("Dropout", "If")
+                       else None)
+            if node.op_type == "If":
+                self._run_if(node, env, training=training,
+                             rng=sub_rng)
+                continue
             op = _OPS.get(node.op_type)
             if op is None:
                 raise NotImplementedError(
@@ -1118,9 +1135,7 @@ class OnnxGraphLayer(KerasLayer):
             if node.op_type == "Split":
                 attrs.setdefault("num_outputs", len(node.output))
             if node.op_type == "Dropout":
-                sub = (jax.random.fold_in(rng, k)
-                       if rng is not None else None)
-                out = op(attrs, args, training=training, rng=sub)
+                out = op(attrs, args, training=training, rng=sub_rng)
             else:
                 out = op(attrs, args)
             if isinstance(out, tuple):
@@ -1129,10 +1144,38 @@ class OnnxGraphLayer(KerasLayer):
                         env[name] = val
             else:
                 env[node.output[0]] = out
-        missing = [n for n in self.output_names if n not in env]
-        if missing:
-            raise ValueError(f"graph outputs never produced: {missing}")
-        return tuple(env[n] for n in self.output_names)
+
+    def _run_if(self, node, env, *, training, rng):
+        """ONNX If: static conditions pick a branch at trace time
+        (dead branch never interpreted — free of its op requirements);
+        traced conditions lower to ``lax.cond`` with both branches
+        traced (the spec requires matching output shapes)."""
+        attrs = {a.name: a for a in node.attribute}
+        then_g = attribute_value(attrs["then_branch"])
+        else_g = attribute_value(attrs["else_branch"])
+        cond = env[node.input[0]]
+
+        def run_branch(g):
+            benv = dict(env)     # outer scope visible by name
+            for t in g.initializer:
+                benv[t.name] = tensor_to_numpy(t)
+            self._run_nodes(g.node, benv, training=training, rng=rng)
+            return tuple(benv[o.name] for o in g.output)
+
+        if isinstance(cond, (bool, np.bool_, np.ndarray)) or (
+                isinstance(cond, jax.Array)
+                and not isinstance(cond, jax.core.Tracer)):
+            outs = run_branch(
+                then_g if bool(np.asarray(cond).reshape(()))
+                else else_g)
+        else:
+            outs = jax.lax.cond(
+                jnp.asarray(cond).reshape(()),
+                lambda _: run_branch(then_g),
+                lambda _: run_branch(else_g), None)
+        for name, val in zip(node.output, outs):
+            if name:
+                env[name] = val
 
 
 def _vi_shape(vi: onnx_pb.ValueInfoProto) -> tuple:

@@ -1037,3 +1037,58 @@ def test_scatter_elements_and_misc_ops(rng):
                     [np.array([-7.5, 7.5], np.float32),
                      np.array([3.0, -3.0], np.float32)])
     assert_close(m, np.fmod([-7.5, 7.5], [3.0, -3.0]))
+
+
+def test_if_op_static_and_traced(rng):
+    """If: static conditions pick a branch at trace time (the dead
+    branch may even contain unsupported ops); traced conditions lower
+    to lax.cond with outer-scope capture."""
+    x = rng.randn(2, 3).astype(np.float32)
+
+    def mk_model(cond_is_input):
+        then_g = helper.make_graph(
+            [helper.make_node("Relu", ["x"], ["tb"])], "then", [],
+            [helper.make_tensor_value_info("tb", TensorProto.FLOAT,
+                                           [2, 3])], [])
+        else_g = helper.make_graph(
+            [helper.make_node("Neg", ["x"], ["eb"])], "else", [],
+            [helper.make_tensor_value_info("eb", TensorProto.FLOAT,
+                                           [2, 3])], [])
+        nodes = [helper.make_node("If", ["c"], ["y"],
+                                  then_branch=then_g,
+                                  else_branch=else_g)]
+        inputs = [helper.make_tensor_value_info(
+            "x", TensorProto.FLOAT, [2, 3])]
+        inits = []
+        if cond_is_input:
+            inputs.append(helper.make_tensor_value_info(
+                "c", TensorProto.BOOL, []))
+        else:
+            inits.append(helper.make_tensor("c",
+                                            np.array(True)))
+        graph = helper.make_graph(
+            nodes, "ifg", inputs,
+            [helper.make_tensor_value_info("y", TensorProto.FLOAT,
+                                           [2, 3])], inits)
+        return helper.make_model(graph)
+
+    # static initializer condition
+    net = OnnxLoader.load_model(
+        mk_model(False).SerializeToString())
+    params = net.init_params()
+    got = np.asarray(net.call(params, x))
+    assert_close(got, np.maximum(x, 0))
+
+    # traced condition input -> lax.cond under jit
+    net = OnnxLoader.load_model(mk_model(True).SerializeToString())
+    params = net.init_params()
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(c):
+        return net.call(params, [jnp.asarray(x), c])
+
+    assert_close(np.asarray(run(jnp.asarray(True))),
+                 np.maximum(x, 0))
+    assert_close(np.asarray(run(jnp.asarray(False))), -x)
