@@ -740,6 +740,82 @@ def _gru(a, i):
     return y, y_h
 
 
+def _per_axis(vec, ndim, axis):
+    """Broadcast a per-channel scale/zero-point vector to `ndim`
+    dims along `axis`; scalars (incl. an omitted zero point) pass
+    through untouched."""
+    vec = jnp.asarray(vec)
+    if vec.ndim == 1 and vec.shape[0] > 1:
+        shape = [1] * ndim
+        shape[axis] = vec.shape[0]
+        return vec.reshape(shape)
+    return vec
+
+
+@_register("QuantizeLinear")
+def _quantize_linear(a, i):
+    x = jnp.asarray(i[0])
+    axis = int(a.get("axis", 1))
+    scale = _per_axis(i[1], x.ndim, axis)
+    zp = (jnp.asarray(i[2]) if len(i) > 2 and i[2] is not None
+          else jnp.zeros((), jnp.uint8))
+    dt = zp.dtype
+    zp = _per_axis(zp, x.ndim, axis)
+    info = jnp.iinfo(dt)
+    q = jnp.round(x / scale) + zp.astype(jnp.float32)
+    return jnp.clip(q, info.min, info.max).astype(dt)
+
+
+@_register("DequantizeLinear")
+def _dequantize_linear(a, i):
+    x = jnp.asarray(i[0])
+    axis = int(a.get("axis", 1))
+    scale = _per_axis(i[1], x.ndim, axis)
+    zp = (jnp.asarray(i[2]) if len(i) > 2 and i[2] is not None
+          else jnp.zeros((), x.dtype))
+    zp = _per_axis(zp, x.ndim, axis)
+    return (x.astype(jnp.float32) - zp.astype(jnp.float32)) * scale
+
+
+@_register("DynamicQuantizeLinear")
+def _dynamic_quantize_linear(a, i):
+    x = i[0]
+    rmin = jnp.minimum(jnp.min(x), 0.0)
+    rmax = jnp.maximum(jnp.max(x), 0.0)
+    scale = (rmax - rmin) / 255.0
+    # all-zero input: 0/0 would NaN; ORT forces a safe nonzero scale
+    scale = jnp.where(scale == 0, 1.0, scale)
+    zp = jnp.clip(jnp.round(-rmin / scale), 0, 255).astype(jnp.uint8)
+    q = jnp.clip(jnp.round(x / scale) + zp.astype(jnp.float32),
+                 0, 255).astype(jnp.uint8)
+    return q, scale.astype(jnp.float32), zp
+
+
+@_register("QLinearMatMul")
+def _qlinear_matmul(a, i):
+    (xa, a_scale, a_zp, xb, b_scale, b_zp,
+     y_scale, y_zp) = i[:8]
+    xa, xb = jnp.asarray(xa), jnp.asarray(xb)
+    # a-side 1-D scale/zp are per ROW (second-to-last axis): align
+    # them there, not against K via trailing-axis broadcast
+    def a_side(v):
+        v = jnp.asarray(v)
+        if v.ndim == 1 and v.shape[0] > 1:
+            return v.reshape(v.shape + (1,))
+        return v
+    af = xa.astype(jnp.int32) - a_side(a_zp).astype(jnp.int32)
+    bf = xb.astype(jnp.int32) - jnp.asarray(b_zp).astype(jnp.int32)
+    # numpy.matmul batching semantics + int32 MXU accumulation
+    acc = jnp.matmul(af, bf, preferred_element_type=jnp.int32)
+    y = acc.astype(jnp.float32) * (
+        a_side(a_scale) * jnp.asarray(b_scale)
+        / jnp.asarray(y_scale))
+    zp = jnp.asarray(y_zp)
+    info = jnp.iinfo(zp.dtype)
+    return jnp.clip(jnp.round(y) + zp.astype(jnp.float32),
+                    info.min, info.max).astype(zp.dtype)
+
+
 @_register("ScatterElements", "Scatter")
 def _scatter_elements(a, i):
     x, idx, upd = jnp.asarray(i[0]), jnp.asarray(i[1]), \

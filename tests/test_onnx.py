@@ -1092,3 +1092,74 @@ def test_if_op_static_and_traced(rng):
     assert_close(np.asarray(run(jnp.asarray(True))),
                  np.maximum(x, 0))
     assert_close(np.asarray(run(jnp.asarray(False))), -x)
+
+
+def test_quantized_ops(rng):
+    """QuantizeLinear/DequantizeLinear round-trip (per-tensor and
+    per-axis), DynamicQuantizeLinear spec identities, QLinearMatMul
+    int32 accumulation vs the float composition."""
+    x = rng.randn(4, 6).astype(np.float32) * 3
+    scale = np.array(0.05, np.float32)
+    zp = np.array(128, np.uint8)
+    (q,) = run_node(helper.make_node("QuantizeLinear",
+                                     ["x", "s", "z"], ["y"]),
+                    [x, scale, zp])
+    assert np.asarray(q).dtype == np.uint8
+    (dq,) = run_node(helper.make_node("DequantizeLinear",
+                                      ["x", "s", "z"], ["y"]),
+                     [np.asarray(q), scale, zp])
+    assert np.max(np.abs(np.asarray(dq) - np.clip(
+        np.round(x / 0.05) * 0.05, (0 - 128) * 0.05,
+        (255 - 128) * 0.05))) < 1e-5
+
+    # per-axis dequant
+    w = rng.randint(0, 255, (3, 4)).astype(np.uint8)
+    ws = np.array([0.1, 0.2, 0.3], np.float32)
+    wz = np.array([10, 20, 30], np.uint8)
+    (dqa,) = run_node(helper.make_node(
+        "DequantizeLinear", ["x", "s", "z"], ["y"], axis=0),
+        [w, ws, wz])
+    ref = (w.astype(np.float32) - wz[:, None]) * ws[:, None]
+    assert_close(dqa, ref)
+
+    q, s, z = run_node(helper.make_node(
+        "DynamicQuantizeLinear", ["x"], ["y", "ys", "yz"]), [x])
+    back = (np.asarray(q).astype(np.float32)
+            - float(np.asarray(z))) * float(np.asarray(s))
+    assert np.max(np.abs(back - x)) < float(np.asarray(s)) * 0.51 + 1e-6
+
+    # per-axis dequant with OMITTED zero point (the standard
+    # per-channel int8 weight encoding)
+    (dqn,) = run_node(helper.make_node(
+        "DequantizeLinear", ["x", "s"], ["y"], axis=0), [w, ws])
+    assert_close(dqn, w.astype(np.float32) * ws[:, None])
+    # all-zero DynamicQuantizeLinear stays finite
+    qz, sz, zz = run_node(helper.make_node(
+        "DynamicQuantizeLinear", ["x"], ["y", "ys", "yz"]),
+        [np.zeros((3, 3), np.float32)])
+    assert np.all(np.isfinite(np.asarray(sz)))
+    np.testing.assert_array_equal(np.asarray(qz), 0)
+
+    # QLinearMatMul vs dequant->matmul->quant composition
+    a8 = rng.randint(0, 255, (2, 5)).astype(np.uint8)
+    b8 = rng.randint(0, 255, (5, 3)).astype(np.uint8)
+    sa, za = np.array(0.02, np.float32), np.array(120, np.uint8)
+    sb, zb = np.array(0.03, np.float32), np.array(130, np.uint8)
+    sy, zy = np.array(0.1, np.float32), np.array(128, np.uint8)
+    (y8,) = run_node(helper.make_node(
+        "QLinearMatMul",
+        ["a", "sa", "za", "b", "sb", "zb", "sy", "zy"], ["y"]),
+        [a8, sa, za, b8, sb, zb, sy, zy])
+    fa = (a8.astype(np.float32) - 120) * 0.02
+    fb = (b8.astype(np.float32) - 130) * 0.03
+    ref8 = np.clip(np.round((fa @ fb) / 0.1) + 128, 0, 255)
+    np.testing.assert_allclose(np.asarray(y8).astype(np.float32),
+                               ref8, atol=1.0)  # 1-ulp rounding
+    # batched matmul keeps numpy.matmul semantics (no cross-batch)
+    ab = rng.randint(0, 255, (3, 2, 5)).astype(np.uint8)
+    bb = rng.randint(0, 255, (3, 5, 4)).astype(np.uint8)
+    (yb,) = run_node(helper.make_node(
+        "QLinearMatMul",
+        ["a", "sa", "za", "b", "sb", "zb", "sy", "zy"], ["y"]),
+        [ab, sa, za, bb, sb, zb, sy, zy])
+    assert np.asarray(yb).shape == (3, 2, 4)
