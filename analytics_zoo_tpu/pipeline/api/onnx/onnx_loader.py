@@ -209,9 +209,10 @@ _register("MatMul")(lambda a, i: i[0] @ i[1])
 
 
 # convolution
-@_register("Conv")
-def _conv(a, i):
-    x, w = i[0], i[1]
+def _conv_core(a, x, w, preferred=None):
+    """The shared NCHW conv lowering (attrs: kernel/strides/dilations/
+    group/pads, SAME_* auto-pad). ``preferred`` sets the accumulator
+    dtype (int32 for the quantized variants)."""
     n_sp = x.ndim - 2
     kernel = a.get("kernel_shape", list(w.shape[2:]))
     strides = a.get("strides", [1] * n_sp)
@@ -230,13 +231,78 @@ def _conv(a, i):
         raise ValueError(f"Conv with {n_sp} spatial dims unsupported")
     dn = lax.conv_dimension_numbers(
         x.shape, w.shape, ("NC" + sp, "OI" + sp, "NC" + sp))
-    y = lax.conv_general_dilated(
-        x, w.astype(x.dtype), window_strides=strides, padding=padding,
+    return lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=padding,
         rhs_dilation=dilations, dimension_numbers=dn,
-        feature_group_count=group)
+        feature_group_count=group,
+        preferred_element_type=preferred)
+
+
+@_register("Conv")
+def _conv(a, i):
+    x, w = i[0], i[1]
+    y = _conv_core(a, x, w.astype(x.dtype))
     if len(i) > 2 and i[2] is not None:
-        y = y + i[2].reshape((1, -1) + (1,) * n_sp)
+        y = y + i[2].reshape((1, -1) + (1,) * (x.ndim - 2))
     return y
+
+
+def _zp_sub(x, zp, channel_axis=None):
+    """int32 tensor minus its zero point; a 1-D per-channel zp
+    aligns on ``channel_axis``."""
+    x = jnp.asarray(x).astype(jnp.int32)
+    if zp is None:
+        return x
+    zp = jnp.asarray(zp).astype(jnp.int32)
+    if channel_axis is not None:
+        zp = _per_axis(zp, x.ndim, channel_axis)
+    return x - zp
+
+
+def _requantize(y, y_zp):
+    """Round, shift by the output zero point, saturate to its dtype
+    (shared by every QLinear* op)."""
+    zp = jnp.asarray(y_zp)
+    info = jnp.iinfo(zp.dtype)
+    return jnp.clip(jnp.round(y) + zp.astype(jnp.float32),
+                    info.min, info.max).astype(zp.dtype)
+
+
+@_register("ConvInteger")
+def _conv_integer(a, i):
+    x, w = i[0], i[1]
+    xz = i[2] if len(i) > 2 else None
+    wz = i[3] if len(i) > 3 else None
+    return _conv_core(a, _zp_sub(x, xz), _zp_sub(w, wz, 0),
+                      preferred=jnp.int32)
+
+
+@_register("MatMulInteger")
+def _matmul_integer(a, i):
+    x, w = jnp.asarray(i[0]), jnp.asarray(i[1])
+    xz = i[2] if len(i) > 2 else None
+    wz = i[3] if len(i) > 3 else None
+    # a-side 1-D zero point is PER ROW (second-to-last axis)
+    return jnp.matmul(_zp_sub(x, xz, channel_axis=x.ndim - 2),
+                      _zp_sub(w, wz),
+                      preferred_element_type=jnp.int32)
+
+
+@_register("QLinearConv")
+def _qlinear_conv(a, i):
+    (x, x_scale, x_zp, w, w_scale, w_zp,
+     y_scale, y_zp) = i[:8]
+    bias = i[8] if len(i) > 8 and i[8] is not None else None
+    acc = _conv_core(a, _zp_sub(x, x_zp), _zp_sub(w, w_zp, 0),
+                     preferred=jnp.int32)
+    n_sp = jnp.asarray(x).ndim - 2
+    if bias is not None:   # int32 bias at scale x_scale*w_scale
+        acc = acc + jnp.asarray(bias).astype(jnp.int32).reshape(
+            (1, -1) + (1,) * n_sp)
+    ws = _per_axis(w_scale, n_sp + 2, 1)   # per-output-channel
+    y = acc.astype(jnp.float32) * (
+        jnp.asarray(x_scale) * ws / jnp.asarray(y_scale))
+    return _requantize(y, y_zp)
 
 
 @_register("ConvTranspose")
@@ -759,11 +825,8 @@ def _quantize_linear(a, i):
     scale = _per_axis(i[1], x.ndim, axis)
     zp = (jnp.asarray(i[2]) if len(i) > 2 and i[2] is not None
           else jnp.zeros((), jnp.uint8))
-    dt = zp.dtype
     zp = _per_axis(zp, x.ndim, axis)
-    info = jnp.iinfo(dt)
-    q = jnp.round(x / scale) + zp.astype(jnp.float32)
-    return jnp.clip(q, info.min, info.max).astype(dt)
+    return _requantize(x / scale, zp)
 
 
 @_register("DequantizeLinear")
@@ -810,10 +873,7 @@ def _qlinear_matmul(a, i):
     y = acc.astype(jnp.float32) * (
         a_side(a_scale) * jnp.asarray(b_scale)
         / jnp.asarray(y_scale))
-    zp = jnp.asarray(y_zp)
-    info = jnp.iinfo(zp.dtype)
-    return jnp.clip(jnp.round(y) + zp.astype(jnp.float32),
-                    info.min, info.max).astype(zp.dtype)
+    return _requantize(y, y_zp)
 
 
 @_register("ScatterElements", "Scatter")

@@ -1163,3 +1163,57 @@ def test_quantized_ops(rng):
         ["a", "sa", "za", "b", "sb", "zb", "sy", "zy"], ["y"]),
         [ab, sa, za, bb, sb, zb, sy, zy])
     assert np.asarray(yb).shape == (3, 2, 4)
+
+
+def test_integer_conv_matmul(rng):
+    """ConvInteger/MatMulInteger int32 results and QLinearConv vs the
+    dequant->conv->quant composition with per-channel weight scales."""
+    import torch
+    import torch.nn.functional as TF
+
+    x8 = rng.randint(0, 255, (1, 3, 7, 7)).astype(np.uint8)
+    w8 = rng.randint(0, 255, (4, 3, 3, 3)).astype(np.uint8)
+    xz = np.array(120, np.uint8)
+    wz = np.array(128, np.uint8)
+    node = helper.make_node("ConvInteger", ["x", "w", "xz", "wz"],
+                            ["y"], kernel_shape=[3, 3])
+    (out,) = run_node(node, [x8, w8, xz, wz])
+    ref = TF.conv2d(torch.from_numpy(x8.astype(np.int32) - 120).float(),
+                    torch.from_numpy(w8.astype(np.int32) - 128).float())
+    assert np.asarray(out).dtype == np.int32
+    np.testing.assert_array_equal(np.asarray(out), ref.numpy())
+
+    a8 = rng.randint(0, 255, (2, 5)).astype(np.uint8)
+    b8 = rng.randint(0, 255, (5, 3)).astype(np.uint8)
+    node = helper.make_node("MatMulInteger", ["a", "b", "az", "bz"],
+                            ["y"])
+    (out,) = run_node(node, [a8, b8, np.array(7, np.uint8),
+                             np.array(9, np.uint8)])
+    ref = (a8.astype(np.int32) - 7) @ (b8.astype(np.int32) - 9)
+    np.testing.assert_array_equal(np.asarray(out), ref)
+    # per-ROW a_zero_point (1-D length M, M != K)
+    azr = np.array([3, 11], np.uint8)
+    (out,) = run_node(node, [a8, b8, azr, np.array(9, np.uint8)])
+    ref = (a8.astype(np.int32) - azr[:, None]) @ \
+        (b8.astype(np.int32) - 9)
+    np.testing.assert_array_equal(np.asarray(out), ref)
+
+    # QLinearConv with per-output-channel weight scales + int32 bias
+    xs, ys = np.array(0.02, np.float32), np.array(0.2, np.float32)
+    wsv = np.array([0.01, 0.02, 0.03, 0.04], np.float32)
+    yz = np.array(100, np.uint8)
+    b32 = rng.randint(-500, 500, (4,)).astype(np.int32)
+    node = helper.make_node(
+        "QLinearConv",
+        ["x", "xs", "xz", "w", "ws", "wz", "ys", "yz", "b"], ["y"],
+        kernel_shape=[3, 3])
+    (out,) = run_node(node, [x8, xs, xz, w8, wsv, wz, ys, yz, b32])
+    facc = TF.conv2d(
+        torch.from_numpy(x8.astype(np.int32) - 120).float(),
+        torch.from_numpy(w8.astype(np.int32) - 128).float()).numpy()
+    facc = facc + b32.reshape(1, -1, 1, 1)
+    refq = np.clip(np.round(
+        facc * (0.02 * wsv.reshape(1, -1, 1, 1) / 0.2)) + 100,
+        0, 255)
+    np.testing.assert_allclose(np.asarray(out).astype(np.float32),
+                               refq, atol=1.0)
