@@ -2,10 +2,26 @@
 (the reference's per-example spark-submit mains, Net.scala L12 analog).
 """
 
+import ast
 import importlib
+import os
 import sys
 
 from analytics_zoo_tpu.examples import EXAMPLES
+
+
+def _hook(name: str) -> str:
+    """First sentence of the example's docstring, width-capped —
+    source-scanned so the listing never imports jax."""
+    try:
+        path = os.path.join(os.path.dirname(__file__), name + ".py")
+        with open(path) as f:
+            doc = ast.get_docstring(ast.parse(f.read())) or ""
+        first = " ".join(doc.split("\n\n")[0].split())
+        first = first.split(". ")[0].rstrip(".")
+        return first[:52] + ("…" if len(first) > 52 else "")
+    except Exception:
+        return ""
 
 
 def main(argv=None):
@@ -14,13 +30,20 @@ def main(argv=None):
         print("usage: python -m analytics_zoo_tpu.examples "
               "<name> [args...]\n\nexamples:")
         for e in EXAMPLES:
-            print(f"  {e}")
+            print(f"  {e:24s} {_hook(e)}")
         return 0
     name = argv[0].replace("-", "_")
     if name not in EXAMPLES:
         print(f"unknown example {argv[0]!r}; run with 'list' to see "
               "available names", file=sys.stderr)
         return 2
+    # honor JAX_PLATFORMS authoritatively: plugin backends (axon TPU)
+    # register regardless of the env var and can hang device init on
+    # a dead tunnel — the config update is what actually pins it
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+        jax.config.update("jax_platforms",
+                          os.environ["JAX_PLATFORMS"])
     mod = importlib.import_module(f"analytics_zoo_tpu.examples.{name}")
     ret = mod.main(argv[1:])
     # example mains return result payloads (metrics dicts etc.), not

@@ -200,3 +200,12 @@ def test_streaming_inference():
               "--batch-max", "8", "--batch-interval-ms", "50"])
     assert r["records"] == 24
     assert r["batches"] >= 3
+
+
+def test_examples_cli_list_and_dispatch(capsys):
+    from analytics_zoo_tpu.examples.__main__ import main
+    assert main(["list"]) == 0
+    out = capsys.readouterr().out
+    assert "lenet_mnist" in out
+    assert "LeNet training example" in out   # docstring hooks render
+    assert main(["nope"]) == 2
