@@ -20,6 +20,23 @@ Top-level surface (mirrors the capability map in SURVEY.md §1):
 - ``analytics_zoo_tpu.ops``       — losses, metrics, optimizers, pallas kernels
 """
 
+import os as _os
+
+# Honor JAX_PLATFORMS authoritatively at import: plugin backends (the
+# axon TPU tunnel) register regardless of the env var, so without this
+# a documented `JAX_PLATFORMS=cpu python ...` run can hang device init
+# on an unreachable tunnel. No-op when unset; best-effort if a backend
+# is already initialized.
+if _os.environ.get("JAX_PLATFORMS"):
+    import jax as _jax
+    try:
+        _jax.config.update("jax_platforms",
+                           _os.environ["JAX_PLATFORMS"])
+    except Exception as _e:  # pin failed: surface it — a silent miss
+        import warnings as _warnings  # would revive the tunnel hang
+        _warnings.warn(f"could not pin jax_platforms from "
+                       f"JAX_PLATFORMS: {_e}")
+
 from analytics_zoo_tpu.version import __version__
 from analytics_zoo_tpu.common.nncontext import (
     init_nncontext,

@@ -37,13 +37,8 @@ def main(argv=None):
         print(f"unknown example {argv[0]!r}; run with 'list' to see "
               "available names", file=sys.stderr)
         return 2
-    # honor JAX_PLATFORMS authoritatively: plugin backends (axon TPU)
-    # register regardless of the env var and can hang device init on
-    # a dead tunnel — the config update is what actually pins it
-    if os.environ.get("JAX_PLATFORMS"):
-        import jax
-        jax.config.update("jax_platforms",
-                          os.environ["JAX_PLATFORMS"])
+    # (JAX_PLATFORMS is pinned authoritatively by the package
+    # __init__, imported above)
     mod = importlib.import_module(f"analytics_zoo_tpu.examples.{name}")
     ret = mod.main(argv[1:])
     # example mains return result payloads (metrics dicts etc.), not
