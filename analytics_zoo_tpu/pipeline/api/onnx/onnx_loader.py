@@ -896,6 +896,33 @@ def _scatter_elements(a, i):
     return ops[red](upd)
 
 
+_register("Celu")(lambda a, i: jax.nn.celu(i[0],
+                                           a.get("alpha", 1.0)))
+
+
+@_register("LpNormalization")
+def _lp_normalization(a, i):
+    x = i[0]
+    axis = int(a.get("axis", -1))
+    p = int(a.get("p", 2))
+    if p == 1:
+        denom = jnp.sum(jnp.abs(x), axis=axis, keepdims=True)
+    elif p == 2:
+        denom = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=True))
+    else:
+        raise NotImplementedError(f"LpNormalization p={p}")
+    return x / denom
+
+
+@_register("MeanVarianceNormalization")
+def _mvn(a, i):
+    x = i[0]
+    axes = tuple(a.get("axes", [0, 2, 3]))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.mean((x - mean) ** 2, axis=axes, keepdims=True)
+    return (x - mean) / jnp.sqrt(var + 1e-9)
+
+
 _register("HardSwish")(lambda a, i: i[0] * jnp.clip(
     i[0] / 6.0 + 0.5, 0.0, 1.0))
 _register("Mish")(lambda a, i: i[0] * jnp.tanh(jax.nn.softplus(i[0])))

@@ -1217,3 +1217,19 @@ def test_integer_conv_matmul(rng):
         0, 255)
     np.testing.assert_allclose(np.asarray(out).astype(np.float32),
                                refq, atol=1.0)
+
+
+def test_celu_lpnorm_mvn(rng):
+    v = rng.randn(3, 4).astype(np.float32)
+    (out,) = run_node(helper.make_node("Celu", ["x"], ["y"],
+                                       alpha=0.7), [v])
+    assert_close(out, F.celu(_t(v), 0.7).numpy(), atol=1e-6)
+    (out,) = run_node(helper.make_node("LpNormalization", ["x"],
+                                       ["y"], axis=1, p=2), [v])
+    assert_close(out, v / np.linalg.norm(v, axis=1, keepdims=True))
+    x4 = rng.randn(2, 3, 4, 4).astype(np.float32)
+    (out,) = run_node(helper.make_node(
+        "MeanVarianceNormalization", ["x"], ["y"]), [x4])
+    m = x4.mean((0, 2, 3), keepdims=True)
+    s = x4.std((0, 2, 3), keepdims=True)
+    assert_close(out, (x4 - m) / (s + 1e-9), atol=1e-4)
