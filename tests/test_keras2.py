@@ -146,3 +146,18 @@ class TestKeras2Completion:
         x = rng.randn(2, 3, 8, 8, 2).astype(np.float32)
         out = m.predict(x)
         assert out.shape[0] == 2
+
+
+def test_keras2_conv2d_groups_passthrough(rng):
+    """keras2 Conv2D forwards groups to the keras1 base (grouped-conv
+    support reaches both API tiers)."""
+    import jax
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.pipeline.api.keras2.layers import Conv2D
+    lyr = Conv2D(8, 3, padding="same", groups=4,
+                 input_shape=(8, 8, 8))
+    params = lyr.init(jax.random.PRNGKey(0), (8, 8, 8))
+    assert params["kernel"].shape == (3, 3, 2, 8)  # in/g == 2
+    x = jnp.asarray(rng.randn(2, 8, 8, 8).astype(np.float32))
+    assert lyr.call(params, x).shape == (2, 8, 8, 8)
