@@ -22,16 +22,32 @@ Top-level surface (mirrors the capability map in SURVEY.md §1):
 
 import os as _os
 
-# Honor JAX_PLATFORMS authoritatively at import: plugin backends (the
-# axon TPU tunnel) register regardless of the env var, so without this
-# a documented `JAX_PLATFORMS=cpu python ...` run can hang device init
-# on an unreachable tunnel. No-op when unset; best-effort if a backend
-# is already initialized.
+# Honor JAX_PLATFORMS at import: plugin backends (the axon TPU
+# tunnel) clobber the env var's selection with a startup
+# `jax.config.update("jax_platforms", "axon,cpu")` from their
+# sitecustomize, so without this a documented
+# `JAX_PLATFORMS=cpu python ...` run can hang device init on an
+# unreachable tunnel. Restore the env's choice ONLY when the current
+# config value is still that plugin clobber (or already the env
+# value): a program that pinned a platform via jax.config.update
+# AFTER the clobber (e.g. bench.py's dead-tunnel CPU fallback child,
+# running under a driver env of JAX_PLATFORMS=axon) must keep its
+# pin — re-pinning from env here is what hung round 4's fallback on
+# the dead tunnel. No-op when unset.
 if _os.environ.get("JAX_PLATFORMS"):
     import jax as _jax
     try:
-        _jax.config.update("jax_platforms",
-                           _os.environ["JAX_PLATFORMS"])
+        _env_p = _os.environ["JAX_PLATFORMS"]
+        _cur = getattr(_jax.config, "jax_platforms", None)
+        # "plugin clobber" = any selection that merely adds the axon
+        # backend around the host CPU (e.g. "axon,cpu" in any order);
+        # anything else that differs from the env was chosen by the
+        # program and stays.
+        _is_clobber = _cur is not None and set(
+            _cur.split(",")) == {"axon", "cpu"}
+        if _cur in (None, "", _env_p) or _is_clobber:
+            if _cur != _env_p:
+                _jax.config.update("jax_platforms", _env_p)
     except Exception as _e:  # pin failed: surface it — a silent miss
         import warnings as _warnings  # would revive the tunnel hang
         _warnings.warn(f"could not pin jax_platforms from "

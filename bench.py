@@ -1,27 +1,44 @@
 """Headline benchmark: ResNet-50 training throughput on the local chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints JSON lines: {"metric", "value", "unit", "vs_baseline", ...}.
+Every printed JSON line is a SELF-CONTAINED best-so-far artifact; the
+last line is the most complete. The driver may parse any one of them
+and still get real signal.
 
 The BASELINE.json target is the nnframes ResNet-50 ImageNet recipe at
 >=45% MFU (v5e). vs_baseline here = achieved MFU / 0.45, with FLOPs taken
 from XLA's own cost analysis of the compiled train step and peak chip
 FLOPs from ZOO_TPU_PEAK_TFLOPS (default 197, TPU v5e bf16).
 
-Round-2 hardening (VERDICT.md "What's weak" #1): round 1 timed out with
-no JSON emitted (rc=124, parsed: null). Now:
-  * a hard watchdog ALWAYS prints a JSON line and exits before
-    ZOO_TPU_BENCH_BUDGET_S (default 480s) — a hanging backend init or a
-    slow compile can no longer produce zero signal;
-  * the train step is compiled exactly ONCE (one lax.scan chain; round 1
-    compiled three program variants before printing anything);
-  * platform/backend init time is measured and reported separately in
-    the diagnostic stderr line, so a slow 'axon' init is visible.
+Round-5 hardening (VERDICT r4 next-round #1 — twice-failed artifact):
+  * ROOT CAUSE of the r4 465s-kill found and fixed: the driver env sets
+    JAX_PLATFORMS=axon, and analytics_zoo_tpu's import-time env pin
+    re-clobbered the fallback child's programmatic cpu pin back to
+    axon; the first array op then initialized the axon backend and hung
+    on the dead tunnel (the plugin's sitecustomize clobbers the env
+    var's own selection with jax_platforms="axon,cpu" at interpreter
+    startup, so env-only pins never work either). The package pin now
+    respects programmatic pins (analytics_zoo_tpu/__init__.py).
+  * The supervisor runs each fallback workload in its OWN subprocess
+    with its OWN deadline (probe <=90s, then NCF / BERT / conformance /
+    small-ResNet each stage-capped), merging records and re-emitting
+    the full JSON line after EVERY stage: a kill at any point can no
+    longer erase banked signal.
+  * The live child's watchdog budget is handed down by the supervisor
+    (ZOO_TPU_BENCH_CHILD_BUDGET_S) so it fires BEFORE the supervisor's
+    kill — in r4 the probe's 90s was not subtracted and the child was
+    killed 25s before its own watchdog would have emitted.
+  * The live child emits a best-so-far line after every measured
+    variant, so a tunnel death mid-A/B (r4's one live window) still
+    delivers the already-banked unfused number even if a C-level hang
+    starves the watchdog thread.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import signal
 import sys
 import threading
 import time
@@ -42,7 +59,7 @@ _result = {
 
 
 def _emit(final: bool = False) -> bool:
-    """Print the (single) JSON line; idempotent across threads.
+    """Print the final JSON line; idempotent across threads.
     Returns True iff this call did the printing."""
     global _emitted
     with _emit_lock:
@@ -54,6 +71,16 @@ def _emit(final: bool = False) -> bool:
             out.pop("diag", None)
         print(json.dumps(out), flush=True)
         return True
+
+
+def _emit_progress() -> None:
+    """Print the current best-so-far snapshot WITHOUT consuming the
+    final emission: each line is a valid, self-contained artifact, so
+    a later kill cannot erase what is already on stdout."""
+    with _emit_lock:
+        if _emitted:
+            return
+        print(json.dumps(_result), flush=True)
 
 
 def _watchdog(budget_s: float) -> None:
@@ -91,48 +118,153 @@ def _probe_main():
           flush=True)
 
 
-def _fallback_metrics(extra: list) -> None:
-    """Dead-backend path: spend the budget on clearly-labeled
-    NON-CHIP signal instead of a bare 0.0 — interpret-mode kernel
-    conformance plus the NCF workload on CPU."""
+# ---------------------------------------------------------------------------
+# CPU fallback stages: each runs in its own subprocess (own deadline,
+# own interpreter) and prints ONE JSON record line. Each pins the CPU
+# platform FIRST — both the config (authoritative over the axon
+# plugin's sitecustomize startup clobber) and the env var (so
+# analytics_zoo_tpu's import-time pin agrees instead of reverting it).
+# ---------------------------------------------------------------------------
+
+def _pin_cpu():
+    os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
+    jax.config.update("jax_platforms", "cpu")
+    return jax
+
+
+def _stage_ncf_main():
+    _pin_cpu()
+    from bench_ncf import measure
+    rec = measure(
+        batch=int(os.environ.get("ZOO_TPU_BENCH_NCF_BATCH", "1024")),
+        steps=int(os.environ.get("ZOO_TPU_BENCH_STEPS", "5")),
+        metric="ncf_train_samples_per_sec_CPU_FALLBACK")
+    print(json.dumps(rec), flush=True)
+
+
+def _stage_bert_main():
+    _pin_cpu()
+    from bench_bert import measure
+    rec = measure(
+        batch=int(os.environ.get("ZOO_TPU_BENCH_FB_BERT_BATCH", "8")),
+        steps=3, seq_len=128,
+        hidden=int(os.environ.get("ZOO_TPU_BENCH_FB_BERT_HIDDEN",
+                                  "256")),
+        blocks=2,
+        metric="bert_finetune_samples_per_sec_CPU_FALLBACK")
+    print(json.dumps(rec), flush=True)
+
+
+def _stage_conformance_main():
+    """Interpret-mode Pallas kernel conformance: non-chip evidence the
+    fused path computes the right numbers."""
+    _pin_cpu()
     import jax.numpy as jnp
 
-    _result["diag"] = _result.get("diag", "") + " [conformance A/B]"
-    try:
-        from analytics_zoo_tpu.ops import conv_bn
-        rs = np.random.RandomState(0)
-        x = jnp.asarray(rs.randn(256, 128), jnp.float32)
-        w = jnp.asarray(rs.randn(128, 128), jnp.float32)
-        y, s, q = conv_bn.matmul_bn(x, w, interpret=True)
-        y_ref = x.astype(jnp.float32) @ w
-        err = float(jnp.max(jnp.abs(y - y_ref)))
-        err = max(err, float(jnp.max(jnp.abs(
-            s - jnp.sum(y_ref, axis=0)))) / x.shape[0])
-        extra.append({"metric": "conv_bn_conformance_max_abs_err",
+    from analytics_zoo_tpu.ops import conv_bn
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(256, 128), jnp.float32)
+    w = jnp.asarray(rs.randn(128, 128), jnp.float32)
+    y, s, q = conv_bn.matmul_bn(x, w, interpret=True)
+    y_ref = x.astype(jnp.float32) @ w
+    err = float(jnp.max(jnp.abs(y - y_ref)))
+    err = max(err, float(jnp.max(jnp.abs(
+        s - jnp.sum(y_ref, axis=0)))) / x.shape[0])
+    print(json.dumps({"metric": "conv_bn_conformance_max_abs_err",
                       "value": err, "unit": "abs_err (CPU interpret)",
-                      "vs_baseline": None})
-    except Exception as e:
-        print(f"# [fallback conformance] FAILED: "
-              f"{type(e).__name__}: {e}", file=sys.stderr, flush=True)
-    try:
-        from bench_ncf import measure as ncf_measure
-        extra.append(ncf_measure(
-            batch=int(os.environ.get("ZOO_TPU_BENCH_NCF_BATCH",
-                                     "1024")),
-            steps=int(os.environ.get("ZOO_TPU_BENCH_STEPS", "5")),
-            metric="ncf_train_samples_per_sec_CPU_FALLBACK"))
-    except Exception as e:
-        print(f"# [fallback ncf] FAILED: {type(e).__name__}: {e}",
-              file=sys.stderr, flush=True)
+                      "vs_baseline": None}), flush=True)
+
+
+def _resnet_train_chain(model, tx, loss_fn, steps):
+    """The ONE training-semantics definition every ResNet measurement
+    uses (chip variants and CPU fallback alike — methodology must not
+    diverge): returns ``(train_step, run)`` where ``run`` is a
+    ``steps``-long ``lax.scan`` chain of ``train_step`` over a fixed
+    batch (one dispatch + one scalar fetch per measurement)."""
+    import jax
+    import optax
+
+    from analytics_zoo_tpu.pipeline.estimator import Estimator
+
+    def train_step(params, opt_state, x, y):
+        def compute_loss(p):
+            out, upd = model.apply(p, x, training=True)
+            return loss_fn(y, out), upd
+
+        (loss, upd), grads = jax.value_and_grad(
+            compute_loss, has_aux=True)(params)
+        updates, opt_state2 = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        params = Estimator._merge_updates(params, upd)
+        return params, opt_state2, loss
+
+    def run(params, opt_state, x, y):
+        def body(carry, _):
+            p, o = carry
+            p, o, loss = train_step(p, o, x, y)
+            return (p, o), loss
+        (p, o), losses_seq = jax.lax.scan(
+            body, (params, opt_state), None, length=steps)
+        return p, o, losses_seq[-1]
+
+    return train_step, run
+
+
+def _stage_resnet_cpu_main():
+    """Small-config ResNet-50 train throughput on host CPU: keeps the
+    headline metric non-zero (clearly labeled) when the chip is
+    unreachable."""
+    jax = _pin_cpu()
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu import init_nncontext
+    from analytics_zoo_tpu.models.image.imageclassification import (
+        resnet50)
+    from analytics_zoo_tpu.ops import losses, optimizers
+
+    batch = int(os.environ.get("ZOO_TPU_BENCH_FB_BATCH", "4"))
+    image = int(os.environ.get("ZOO_TPU_BENCH_FB_IMAGE", "96"))
+    steps = int(os.environ.get("ZOO_TPU_BENCH_FB_STEPS", "2"))
+
+    init_nncontext(tpu_mesh={"data": 1}, devices=jax.devices()[:1],
+                   log_level="WARNING")
+    model = resnet50(input_shape=(image, image, 3), classes=1000,
+                     space_to_depth=True, fused=False)
+    params = model.init_params(jax.random.PRNGKey(0), device="host")
+    tx = optimizers.SGD(lr=0.1, momentum=0.9).to_optax()
+    opt_state = tx.init(params)
+
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(batch, image, image, 3), jnp.bfloat16)
+    y = jnp.asarray(rs.randint(0, 1000, size=(batch, 1)), jnp.int32)
+
+    _, run = _resnet_train_chain(
+        model, tx, losses.softmax_cross_entropy, steps)
+    compiled = jax.jit(run).lower(params, opt_state, x, y).compile()
+    from bench_common import time_chain
+    dt, loss = time_chain(compiled, (params, opt_state, x, y), reps=2)
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_CPU_FALLBACK",
+        "value": round(batch * steps / dt, 2), "unit": "images/sec",
+        "vs_baseline": None,
+        "config": f"batch={batch} image={image} steps={steps} bf16 "
+                  f"host-CPU (chip unreachable)",
+        "loss": round(float(loss), 4)}), flush=True)
 
 
 def main():
-    # fire before the parent supervisor's kill (budget-15s) so the
-    # stage diagnostic reaches the driver when the hang is in
-    # GIL-releasing code; the supervisor covers GIL-holding hangs
-    raw = float(os.environ.get("ZOO_TPU_BENCH_BUDGET_S", "480"))
-    budget = max(raw - 40.0, 0.5 * raw)
+    # fire before the parent supervisor's kill so the stage diagnostic
+    # reaches the driver when the hang is in GIL-releasing code; the
+    # supervisor covers GIL-holding hangs
+    child_b = os.environ.get("ZOO_TPU_BENCH_CHILD_BUDGET_S")
+    if child_b:
+        # the supervisor computed our true remaining time (its own
+        # deadline minus probe time minus margin) — use it directly
+        budget = max(float(child_b) - 10.0, 20.0)
+    else:
+        raw = float(os.environ.get("ZOO_TPU_BENCH_BUDGET_S", "480"))
+        budget = max(raw - 40.0, 0.5 * raw)
     threading.Thread(target=_watchdog, args=(budget,),
                      daemon=True).start()
 
@@ -144,7 +276,6 @@ def main():
     _result["diag"] = "importing jax"
     import jax
     import jax.numpy as jnp
-    import optax
 
     # persistent compile cache: repeat runs (driver reruns, perf
     # iteration) skip the ~25s ResNet-50 compile
@@ -162,20 +293,8 @@ def main():
     # plugin from hanging device init; the config update does.
     plat = os.environ.get("ZOO_TPU_BENCH_PLATFORM")
     if plat:
+        os.environ["JAX_PLATFORMS"] = plat
         jax.config.update("jax_platforms", plat)
-
-    if os.environ.get("ZOO_TPU_BENCH_FALLBACK") == "1":
-        # supervisor's health probe found the backend dead: emit the
-        # diag-bearing 0.0 headline fast, with labeled non-chip signal
-        jax.config.update("jax_platforms", "cpu")
-        _result["diag"] = os.environ.get(
-            "ZOO_TPU_BENCH_FALLBACK_REASON",
-            "backend dead; CPU fallback")
-        extra: list = []
-        _result["extra_metrics"] = extra
-        _fallback_metrics(extra)
-        _emit()          # non-final: the diag must reach the artifact
-        return
 
     _result["diag"] = "backend init (jax.devices)"
     t0 = time.perf_counter()
@@ -188,7 +307,6 @@ def main():
     from analytics_zoo_tpu import init_nncontext
     from analytics_zoo_tpu.models.image.imageclassification import resnet50
     from analytics_zoo_tpu.ops import losses, optimizers
-    from analytics_zoo_tpu.pipeline.estimator import Estimator
 
     init_nncontext(tpu_mesh={"data": 1}, devices=devices[:1],
                    log_level="WARNING")
@@ -200,20 +318,6 @@ def main():
     fused_mode = os.environ.get("ZOO_TPU_BENCH_FUSED", "auto")
     loss_fn = losses.softmax_cross_entropy
     tx = optimizers.SGD(lr=0.1, momentum=0.9).to_optax()
-
-    def make_train_step(mdl):
-        def train_step(params, opt_state, x, y):
-            def compute_loss(p):
-                out, upd = mdl.apply(p, x, training=True)
-                return loss_fn(y, out), upd
-
-            (loss, upd), grads = jax.value_and_grad(
-                compute_loss, has_aux=True)(params)
-            updates, opt_state2 = tx.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            params = Estimator._merge_updates(params, upd)
-            return params, opt_state2, loss
-        return train_step
 
     rs = np.random.RandomState(0)
     # bf16 inputs: layers compute in input dtype, params stay f32
@@ -251,8 +355,10 @@ def main():
     # no second backend compile).
     ref_flops_holder = {}
     # unfused 20-step loss: the numeric-sanity reference for the
-    # fused/defer variants (same data, same step count; init RNGs
-    # differ so the band is deliberately loose)
+    # fused/defer variants (all variants now init from the SAME
+    # PRNGKey(0) and see identical data, so a >2x divergence after
+    # `steps` steps is real numerical trouble, not init noise —
+    # ADVICE r4 #3)
     ref_loss_holder = {}
 
     VARIANT_TAGS = {False: "unfused", True: "fused",
@@ -262,8 +368,10 @@ def main():
         """Host-CPU param + opt init (one device transfer later beats
         ~270 per-op tunnel round trips). ``init_params(device="host")``
         returns CPU-committed leaves, so the eager ``tx.init`` zeros
-        follow them onto the CPU automatically."""
-        params = model.init_params(device="host")
+        follow them onto the CPU automatically. Fixed PRNGKey: every
+        variant starts from identical weights."""
+        params = model.init_params(jax.random.PRNGKey(0),
+                                   device="host")
         return params, tx.init(params)
 
     def measure_variant(fused):
@@ -281,19 +389,10 @@ def main():
         print(f"# [{tag}] host init+transfer="
               f"{time.perf_counter() - t0:.1f}s", file=sys.stderr,
               flush=True)
-        train_step = make_train_step(model)
-
         # ONE compiled program: a lax.scan chain of `steps` train
         # steps — one dispatch + one scalar fetch over the remote
         # transport; the constant round-trip overhead is subtracted.
-        def run(params, opt_state, x, y):
-            def body(carry, _):
-                p, o = carry
-                p, o, loss = train_step(p, o, x, y)
-                return (p, o), loss
-            (p, o), losses_seq = jax.lax.scan(
-                body, (params, opt_state), None, length=steps)
-            return p, o, losses_seq[-1]
+        _, run = _resnet_train_chain(model, tx, loss_fn, steps)
 
         _result["diag"] = f"compiling {tag} train step"
         t0 = time.perf_counter()
@@ -309,9 +408,10 @@ def main():
             # host-side init: lowering only needs avals, and eager
             # init on the remote device is the RTT storm (see above)
             rp, ro = _host_init(ref_model)
+            ref_step, _ = _resnet_train_chain(
+                ref_model, tx, loss_fn, steps)
             ref_flops_holder["flops"] = _cost_flops(
-                jax.jit(make_train_step(ref_model)).lower(
-                    rp, ro, x, y))
+                jax.jit(ref_step).lower(rp, ro, x, y))
         compiled = lowered.compile()
         t_compile = time.perf_counter() - t0
         print(f"# [{tag}] compile={t_compile:.1f}s", file=sys.stderr,
@@ -402,6 +502,10 @@ def main():
         try:
             measure_variant(fused)
             succeeded += 1
+            if len(variants) > 1:
+                # bank the number on stdout NOW: a mid-A/B tunnel
+                # death (r4's live window) must not erase it
+                _emit_progress()
         except Exception as e:
             # one variant failing must not cost the round's number
             print(f"# [{VARIANT_TAGS[fused]}] FAILED: "
@@ -425,35 +529,42 @@ def main():
                     batch=int(os.environ.get("ZOO_TPU_BENCH_NCF_BATCH",
                                              "8192")),
                     steps=steps))
+            _emit_progress()
         except Exception as e:
             print(f"# [ncf] FAILED: {type(e).__name__}: {e}",
                   file=sys.stderr, flush=True)
-    # third BASELINE workload (config #5, BERT fine-tune) — budget-
-    # aware: "auto" runs it only when enough budget remains after the
-    # headline + NCF; "1" forces, "0" skips
+    # third BASELINE workload (config #5, BERT fine-tune) — guaranteed
+    # on a live chip (VERDICT r4 next-round #4): full config when the
+    # budget allows, a reduced labeled config when it is tight, skip
+    # only when the watchdog is imminent. On CPU backends the
+    # supervisor's fallback stage owns the (labeled) BERT record.
     bert_mode = os.environ.get("ZOO_TPU_BENCH_BERT", "auto")
     remaining = budget - (time.perf_counter() - _t_start)
     skip_why = None
+    bert_kw = dict(
+        batch=int(os.environ.get("ZOO_TPU_BENCH_BERT_BATCH", "32")),
+        steps=min(steps, 10),
+        hidden=int(os.environ.get("ZOO_TPU_BENCH_BERT_HIDDEN", "768")),
+        blocks=int(os.environ.get("ZOO_TPU_BENCH_BERT_BLOCKS", "4")))
     if bert_mode == "auto" and jax.default_backend() not in (
             "tpu", "axon"):
-        bert_mode, skip_why = "0", "non-TPU backend (base-width " \
-            "BERT is minutes on CPU; ZOO_TPU_BENCH_BERT=1 forces)"
-    elif bert_mode == "auto" and remaining <= 150:
+        bert_mode, skip_why = "0", "non-TPU backend (the supervisor's " \
+            "CPU fallback stage owns the labeled BERT record; " \
+            "ZOO_TPU_BENCH_BERT=1 forces)"
+    elif bert_mode == "auto" and remaining <= 45:
         bert_mode, skip_why = "0", \
-            f"{remaining:.0f}s budget left (<150s)"
+            f"{remaining:.0f}s budget left (<45s; watchdog imminent)"
+    elif bert_mode == "auto" and remaining <= 150:
+        # reduced config still banks a real chip number
+        bert_kw.update(batch=8, steps=3, hidden=256, blocks=2)
+        print(f"# [bert] reduced config ({remaining:.0f}s left)",
+              file=sys.stderr, flush=True)
     if bert_mode in ("1", "auto"):
         _result["diag"] = "bert tertiary"
         try:
             from bench_bert import measure as bert_measure
             _result.setdefault("extra_metrics", []).append(
-                bert_measure(
-                    batch=int(os.environ.get(
-                        "ZOO_TPU_BENCH_BERT_BATCH", "32")),
-                    steps=min(steps, 10),
-                    hidden=int(os.environ.get(
-                        "ZOO_TPU_BENCH_BERT_HIDDEN", "768")),
-                    blocks=int(os.environ.get(
-                        "ZOO_TPU_BENCH_BERT_BLOCKS", "4"))))
+                bert_measure(**bert_kw))
         except Exception as e:
             print(f"# [bert] FAILED: {type(e).__name__}: {e}",
                   file=sys.stderr, flush=True)
@@ -466,24 +577,62 @@ def main():
           file=sys.stderr)
 
 
-def _supervise(budget_s: float) -> None:
-    """Run the measurement in a child process; the parent never imports
-    jax, so a C-level hang holding the GIL in the child (the round-1
-    axon-init failure mode) cannot starve this timeout. The parent
-    relays the child's output and prints the fallback JSON itself if
-    the child produces no JSON line in time.
+# ---------------------------------------------------------------------------
+# Supervisor: never imports jax (a C-level hang in a child cannot
+# starve it), stages every unit of work in its own subprocess with its
+# own deadline, and re-prints the merged best-so-far JSON line after
+# every stage.
+# ---------------------------------------------------------------------------
 
-    Before committing the budget, a `--probe` child must prove the
-    backend alive within ZOO_TPU_BENCH_PROBE_S (default 90s — backend
-    init is ~10s when healthy); a dead axon tunnel is detected in
-    seconds instead of consuming the round's whole budget inside
-    `jax.devices()` (the BENCH_r03 failure), and the budget goes to
-    the labeled CPU fallback instead."""
+_STAGE_FLAGS = {
+    "ncf": ("--stage-ncf", 130.0),
+    "bert": ("--stage-bert", 130.0),
+    "conformance": ("--stage-conformance", 90.0),
+    "resnet": ("--stage-resnet-cpu", 180.0),
+}
+
+
+def _last_json_line(text: str):
+    for line in reversed((text or "").splitlines()):
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+    return None
+
+
+def _supervise(budget_s: float) -> None:
+    """Probe the backend (<=ZOO_TPU_BENCH_PROBE_S), then either run the
+    full chip bench in a child (budget handed down so its watchdog
+    fires before our kill), or spend the budget on stage-capped,
+    individually-subprocessed CPU fallback workloads — re-emitting the
+    merged JSON artifact after every stage."""
     import subprocess
 
     deadline = _t_start + budget_s
+    merged = dict(_result)
+    merged["extra_metrics"] = []
+    state = {"printed_any": False}
+
+    def emit_merged():
+        state["printed_any"] = True
+        print(json.dumps(merged), flush=True)
+
+    def on_term(signum, frame):
+        # driver killed us: make sure SOMETHING is on stdout
+        if not state["printed_any"]:
+            merged["diag"] = (merged.get("diag", "") +
+                              " [supervisor SIGTERM]").strip()
+            emit_merged()
+        sys.stdout.flush()
+        os._exit(1)
+    try:
+        signal.signal(signal.SIGTERM, on_term)
+    except ValueError:
+        pass  # non-main thread (tests importing us)
+
     probe_s = float(os.environ.get("ZOO_TPU_BENCH_PROBE_S", "90"))
-    env = dict(os.environ)
     try:
         p = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--probe"],
@@ -495,47 +644,113 @@ def _supervise(budget_s: float) -> None:
         probe_msg = (p.stdout or "").strip() or f"rc={p.returncode}"
     except subprocess.TimeoutExpired:
         probe_ok, probe_msg = False, f"no response in {probe_s:.0f}s"
-    if not probe_ok:
-        reason = (f"backend probe failed ({probe_msg}) — dead "
-                  "tunnel?; CPU fallback metrics in extra_metrics")
-        print(f"# PROBE FAILED: {reason}", file=sys.stderr, flush=True)
-        env["ZOO_TPU_BENCH_FALLBACK"] = "1"
-        env["ZOO_TPU_BENCH_FALLBACK_REASON"] = reason
-    else:
+
+    if probe_ok:
         print(f"# probe: {probe_msg} "
               f"[{time.perf_counter() - _t_start:.1f}s]",
               file=sys.stderr, flush=True)
-    proc = subprocess.Popen(
-        [sys.executable, os.path.abspath(__file__), "--child"],
-        stdout=subprocess.PIPE, text=True, env=env)
-    json_line = None
-    try:
-        out, _ = proc.communicate(
-            timeout=max(deadline - time.perf_counter(), 1.0))
-        for line in out.splitlines():
-            if line.startswith("{"):
-                json_line = line
-            else:
-                print(line)
-    except subprocess.TimeoutExpired:
-        proc.kill()
-        out = proc.communicate()[0] or ""
-        for line in out.splitlines():
-            if line.startswith("{"):
-                json_line = line
-    if json_line is not None:
-        print(json_line, flush=True)
+        env = dict(os.environ)
+        env["ZOO_TPU_BENCH_CHILD_BUDGET_S"] = str(
+            max(deadline - time.perf_counter() - 10.0, 20.0))
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            stdout=subprocess.PIPE, text=True, env=env)
+        last_json = [None]
+
+        def relay():
+            for line in proc.stdout:
+                line = line.rstrip("\n")
+                if line.startswith("{"):
+                    last_json[0] = line
+                    state["printed_any"] = True
+                    print(line, flush=True)  # incremental: bank it NOW
+                else:
+                    print(line)
+        t = threading.Thread(target=relay, daemon=True)
+        t.start()
+        try:
+            proc.wait(timeout=max(deadline - time.perf_counter(), 1.0))
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+        t.join(timeout=10.0)
+        if last_json[0] is not None:
+            sys.exit(0)
+        # live child died silently — fall through to CPU stages with
+        # whatever budget remains
+        merged["diag"] = (f"chip child produced no JSON "
+                          f"(rc={proc.returncode}); CPU fallback "
+                          f"metrics in extra_metrics")
     else:
-        _result["diag"] = (
-            f"supervisor: child produced no JSON within {budget_s:.0f}s "
-            f"(rc={proc.returncode})")
-        _emit()
-    sys.exit(0 if json_line is not None else 1 if proc.returncode else 0)
+        merged["diag"] = (f"backend probe failed ({probe_msg}) — dead "
+                          "tunnel?; CPU fallback metrics in "
+                          "extra_metrics")
+        print(f"# PROBE FAILED: {probe_msg}", file=sys.stderr,
+              flush=True)
+
+    # --- CPU fallback: one subprocess per workload, each with its own
+    # deadline; merged artifact re-emitted after every stage ---------
+    stage_names = os.environ.get(
+        "ZOO_TPU_BENCH_FB_STAGES", "ncf,bert,conformance,resnet")
+    for name in [s.strip() for s in stage_names.split(",") if s.strip()]:
+        if name not in _STAGE_FLAGS:
+            merged.setdefault("stage_errors", []).append(
+                f"{name}: unknown stage (valid: "
+                f"{','.join(_STAGE_FLAGS)})")
+            continue
+        flag, cap = _STAGE_FLAGS[name]
+        remaining = deadline - time.perf_counter()
+        if remaining < 25.0:
+            merged.setdefault("stage_errors", []).append(
+                f"{name}: skipped ({remaining:.0f}s left)")
+            continue
+        t_stage = min(cap, remaining - 5.0)
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"  # stages never touch the tunnel
+        try:
+            p = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), flag],
+                timeout=t_stage, stdout=subprocess.PIPE, text=True,
+                env=env)
+            rec = _last_json_line(p.stdout)
+            err = None if rec else f"{name}: no JSON (rc={p.returncode})"
+        except subprocess.TimeoutExpired as te:
+            # salvage: the stage may have printed its record and then
+            # hung in teardown — a banked line must never be erased
+            rec = _last_json_line(
+                te.stdout.decode() if isinstance(te.stdout, bytes)
+                else (te.stdout or ""))
+            err = None if rec else f"{name}: no result in {t_stage:.0f}s"
+        if rec is not None:
+            merged["extra_metrics"].append(rec)
+            if name == "resnet":
+                # keep the headline non-zero (clearly labeled): the
+                # value is a host-CPU measurement, not a chip claim
+                merged["value"] = rec["value"]
+                merged["vs_baseline"] = 0.0
+                merged["fallback"] = rec.get("config", "cpu")
+        else:
+            merged.setdefault("stage_errors", []).append(err)
+        emit_merged()
+    if not state["printed_any"]:
+        emit_merged()
+    # rc contract: 0 only when real signal was banked — a dead run
+    # whose every stage failed must not look like success to
+    # `bench.py && publish`-style automation
+    sys.exit(0 if merged["extra_metrics"] else 1)
 
 
 if __name__ == "__main__":
     if "--probe" in sys.argv:
         _probe_main()
+    elif "--stage-ncf" in sys.argv:
+        _stage_ncf_main()
+    elif "--stage-bert" in sys.argv:
+        _stage_bert_main()
+    elif "--stage-conformance" in sys.argv:
+        _stage_conformance_main()
+    elif "--stage-resnet-cpu" in sys.argv:
+        _stage_resnet_cpu_main()
     elif "--child" in sys.argv:
         try:
             main()

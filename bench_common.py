@@ -40,7 +40,11 @@ def time_chain(compiled, args, reps: int = 3):
         t0 = time.perf_counter()
         out = compiled(*args)
         loss = out[-1] if isinstance(out, (list, tuple)) else out
-        return time.perf_counter() - t0, float(np.asarray(loss))
+        # the host fetch IS the sync point — it must complete before
+        # the clock stops (a `return elapsed, fetch()` tuple evaluates
+        # the elapsed time first and times only the async dispatch)
+        loss_val = float(np.asarray(loss))
+        return time.perf_counter() - t0, loss_val
 
     timed()                                   # warmup run
     overhead = dispatch_overhead()

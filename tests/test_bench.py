@@ -1,6 +1,7 @@
-"""Bench driver-contract tests: the scripts must always print one
-well-formed JSON line. Runs on CPU with tiny sizes; the measured TPU
-numbers live in PERF.md."""
+"""Bench driver-contract tests: the scripts must always print
+well-formed, self-contained JSON artifact lines — incrementally, so a
+kill at any point leaves real signal on stdout. Runs on CPU with tiny
+sizes; the measured TPU numbers live in PERF.md."""
 
 import json
 import os
@@ -11,32 +12,91 @@ import time
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def test_bench_dead_backend_fallback_is_fast():
-    # VERDICT r3 weak #3: a dead tunnel must be detected in seconds,
-    # the diag emitted immediately, and the remaining budget spent on
-    # labeled non-chip signal — not 440s inside jax.devices()
+def _json_lines(stdout: str):
+    recs = []
+    for line in stdout.splitlines():
+        if line.startswith("{"):
+            recs.append(json.loads(line))  # every line must parse
+    return recs
+
+
+def test_bench_dead_backend_fallback_is_staged():
+    # VERDICT r4 next-round #1: a dead tunnel must be detected in
+    # seconds and the budget spent on stage-capped, individually-
+    # subprocessed CPU workloads, with the merged artifact re-emitted
+    # after EVERY stage (a kill can never erase banked signal).
     env = dict(os.environ,
                ZOO_TPU_BENCH_SIMULATE_DEAD="1",
                ZOO_TPU_BENCH_PROBE_S="5",
-               ZOO_TPU_BENCH_BUDGET_S="120",
+               ZOO_TPU_BENCH_BUDGET_S="150",
                ZOO_TPU_BENCH_NCF_BATCH="64",
-               ZOO_TPU_BENCH_STEPS="2")
+               ZOO_TPU_BENCH_STEPS="2",
+               ZOO_TPU_BENCH_FB_STAGES="ncf,conformance")
     t0 = time.time()
     out = subprocess.run(
         [sys.executable, os.path.join(_ROOT, "bench.py")],
-        capture_output=True, text=True, timeout=90, env=env)
+        capture_output=True, text=True, timeout=140, env=env)
     elapsed = time.time() - t0
     assert out.returncode == 0, out.stderr[-2000:]
-    assert elapsed < 60, f"fallback took {elapsed:.0f}s"
-    lines = [l for l in out.stdout.splitlines() if l.startswith("{")]
-    assert len(lines) == 1
-    rec = json.loads(lines[0])
-    assert rec["value"] == 0.0
-    assert "probe failed" in rec["diag"]
-    extras = {m["metric"]: m for m in rec["extra_metrics"]}
+    assert elapsed < 120, f"fallback took {elapsed:.0f}s"
+    recs = _json_lines(out.stdout)
+    # one merged artifact line per completed stage
+    assert len(recs) >= 2, out.stdout
+    first, last = recs[0], recs[-1]
+    # the FIRST emitted line must already carry banked signal: the
+    # NCF record lands before any later stage can blow the budget
+    extras0 = {m["metric"]: m for m in first["extra_metrics"]}
+    assert extras0["ncf_train_samples_per_sec_CPU_FALLBACK"][
+        "value"] > 0
+    assert "probe failed" in last["diag"]
+    extras = {m["metric"]: m for m in last["extra_metrics"]}
     assert extras["ncf_train_samples_per_sec_CPU_FALLBACK"][
         "value"] > 0
     assert extras["conv_bn_conformance_max_abs_err"]["value"] < 1e-3
+
+
+def test_bench_stage_resnet_cpu_emits_labeled_record():
+    # the small-ResNet stage keeps the headline metric non-zero when
+    # the chip is unreachable — value must be real (synced) wall time
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               ZOO_TPU_BENCH_FB_BATCH="2",
+               ZOO_TPU_BENCH_FB_IMAGE="64",
+               ZOO_TPU_BENCH_FB_STEPS="2")
+    out = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "bench.py"),
+         "--stage-resnet-cpu"],
+        capture_output=True, text=True, timeout=280, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    recs = _json_lines(out.stdout)
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["metric"] == "resnet50_train_images_per_sec_CPU_FALLBACK"
+    assert rec["value"] > 0
+    assert "host-CPU" in rec["config"]
+    # one-core sanity ceiling: a dispatch-only (unsynced) timing bug
+    # reports physically-impossible throughput (bench_common r4 bug:
+    # the elapsed time was computed BEFORE the blocking loss fetch)
+    assert rec["value"] < 2000, \
+        f"{rec['value']} img/s at 64px is not a synced measurement"
+
+
+def test_bench_stage_bert_cpu_emits_labeled_record():
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               ZOO_TPU_BENCH_FB_BERT_BATCH="2",
+               ZOO_TPU_BENCH_FB_BERT_HIDDEN="128")
+    out = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "bench.py"),
+         "--stage-bert"],
+        capture_output=True, text=True, timeout=200, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    recs = _json_lines(out.stdout)
+    assert len(recs) == 1
+    assert recs[0]["metric"] == \
+        "bert_finetune_samples_per_sec_CPU_FALLBACK"
+    assert recs[0]["value"] > 0
+    assert "hidden=128" in recs[0]["config"]
 
 
 def test_bench_live_carries_both_workloads_and_model_mfu():
@@ -54,9 +114,9 @@ def test_bench_live_carries_both_workloads_and_model_mfu():
         [sys.executable, os.path.join(_ROOT, "bench.py")],
         capture_output=True, text=True, timeout=420, env=env)
     assert out.returncode == 0, out.stderr[-2000:]
-    lines = [l for l in out.stdout.splitlines() if l.startswith("{")]
-    assert len(lines) == 1
-    rec = json.loads(lines[0])
+    recs = _json_lines(out.stdout)
+    assert len(recs) >= 1
+    rec = recs[-1]
     assert rec["value"] > 0
     assert rec["mfu_model_flops"] > 0
     assert rec["mfu_xla_flops"] > 0
@@ -74,10 +134,85 @@ def test_bench_ncf_emits_json_line():
         [sys.executable, os.path.join(_ROOT, "bench_ncf.py")],
         capture_output=True, text=True, timeout=300, env=env)
     assert out.returncode == 0, out.stderr[-2000:]
-    lines = [l for l in out.stdout.splitlines() if l.startswith("{")]
-    assert len(lines) == 1
-    rec = json.loads(lines[0])
+    recs = _json_lines(out.stdout)
+    assert len(recs) == 1
+    rec = recs[0]
     assert rec["metric"] == "ncf_train_samples_per_sec_per_chip"
     assert rec["unit"] == "samples/sec"
     assert rec["value"] > 0
     assert rec["vs_baseline"] is None
+
+
+def test_time_chain_counts_execution_not_just_dispatch():
+    # bench_common r4 regression: `return elapsed, fetch()` evaluated
+    # the elapsed time BEFORE the blocking fetch, timing only the
+    # async dispatch (~ms) of a multi-second program. The measured dt
+    # must be within a factor of the fully-blocked wall time.
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bench_common import time_chain
+
+    def step(p, _):
+        g = jnp.tanh(p @ p.T) @ p
+        return p - 1e-3 * g, jnp.sum(g)
+
+    def run(p):
+        pf, ls = jax.lax.scan(step, p, None, length=4)
+        return pf, ls[-1]
+
+    p = jnp.asarray(np.random.RandomState(0).randn(800, 800),
+                    jnp.float32)
+    compiled = jax.jit(run).lower(p).compile()
+    jax.block_until_ready(compiled(p))  # warm
+    t0 = time.perf_counter()
+    jax.block_until_ready(compiled(p))
+    wall = time.perf_counter() - t0
+    dt, loss = time_chain(compiled, (p,), reps=2)
+    assert np.isfinite(loss)
+    assert dt > 0.3 * wall, \
+        f"time_chain measured {dt:.4f}s vs blocked wall {wall:.4f}s"
+
+
+def test_package_import_keeps_programmatic_platform_pin():
+    # VERDICT r4's bench killer: with JAX_PLATFORMS=axon in the env
+    # (driver setup), importing analytics_zoo_tpu used to re-pin
+    # jax_platforms back to the env value, reverting a program's
+    # explicit cpu pin and hanging the first array op on the dead
+    # tunnel. The import must keep the programmatic pin.
+    code = (
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import analytics_zoo_tpu\n"
+        "import jax.numpy as jnp\n"
+        "import jax._src.xla_bridge as xb\n"
+        "x = float(jnp.zeros(()) + 1)\n"
+        "assert list(xb._backends.keys()) == ['cpu'], xb._backends\n"
+        "print('PIN_HELD', getattr(jax.config, 'jax_platforms', None))\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="axon")
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=120, env=env, cwd=_ROOT)
+    assert out.returncode == 0, (out.stdout + out.stderr)[-2000:]
+    assert "PIN_HELD cpu" in out.stdout
+
+
+def test_package_import_restores_env_pin_over_plugin_clobber():
+    # the documented `JAX_PLATFORMS=cpu python app.py` workflow: the
+    # axon sitecustomize clobbers the env selection with "axon,cpu"
+    # at startup; the package import must restore the env's cpu
+    # choice when nothing was pinned programmatically.
+    code = (
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'axon,cpu')\n"
+        "import analytics_zoo_tpu\n"
+        "print('PIN', getattr(jax.config, 'jax_platforms', None))\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=120, env=env, cwd=_ROOT)
+    assert out.returncode == 0, (out.stdout + out.stderr)[-2000:]
+    assert "PIN cpu" in out.stdout
