@@ -348,8 +348,12 @@ class TransformerLayer(KerasLayer):
         # are microbatch-independent and go to every stage whole
         margs, bargs = [], []
         if mask is not None:
-            per_sample = mask.ndim == 4 and \
-                mask.shape[0] == h0.shape[0]
+            # a (1,1,T,T) broadcast mask with batch==1 must not be
+            # classified per-sample (it would be split over
+            # microbatches); only a >1 leading dim matching the batch
+            # is genuinely per-sample
+            per_sample = (mask.ndim == 4 and mask.shape[0] > 1
+                          and mask.shape[0] == h0.shape[0])
             (margs if per_sample else bargs).append(mask)
 
         def stage(sp, h, mb_idx, *rest):

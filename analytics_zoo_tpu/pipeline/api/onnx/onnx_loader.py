@@ -812,8 +812,12 @@ def _per_axis(vec, ndim, axis):
     through untouched."""
     vec = jnp.asarray(vec)
     if vec.ndim == 1 and vec.shape[0] > 1:
+        if not -ndim <= axis < ndim:
+            raise ValueError(
+                f"per-channel quantization axis {axis} out of range "
+                f"for rank-{ndim} input")
         shape = [1] * ndim
-        shape[axis] = vec.shape[0]
+        shape[axis % ndim] = vec.shape[0]
         return vec.reshape(shape)
     return vec
 

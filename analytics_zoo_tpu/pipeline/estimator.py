@@ -335,10 +335,25 @@ class Estimator:
         # default: bf16 activations on TPU (the MXU-native dtype,
         # PERF.md), exact f32 elsewhere (golden tests, CPU parity);
         # explicit arg > env > backend default
-        dtype_policy = dtype_policy or os.environ.get(
-            "ZOO_TPU_DTYPE_POLICY") or (
-            "mixed_bfloat16"
-            if jax.default_backend() in ("tpu", "axon") else "float32")
+        if dtype_policy is None and not os.environ.get(
+                "ZOO_TPU_DTYPE_POLICY"):
+            dtype_policy = ("mixed_bfloat16"
+                            if jax.default_backend() in ("tpu", "axon")
+                            else "float32")
+            if dtype_policy == "mixed_bfloat16":
+                # one-time signal: callers who never chose a policy get
+                # changed numerics on TPU — make that traceable
+                if not getattr(Estimator,
+                               "_warned_bf16_default", False):
+                    Estimator._warned_bf16_default = True
+                    logger.info(
+                        "Estimator defaulting to mixed_bfloat16 on "
+                        "%s backend (pass dtype_policy='float32' or "
+                        "set ZOO_TPU_DTYPE_POLICY to override)",
+                        jax.default_backend())
+        else:
+            dtype_policy = dtype_policy or os.environ.get(
+                "ZOO_TPU_DTYPE_POLICY")
         if dtype_policy not in ("float32", "mixed_bfloat16"):
             raise ValueError(
                 "dtype_policy must be float32|mixed_bfloat16")

@@ -260,8 +260,9 @@ def main():
     child_b = os.environ.get("ZOO_TPU_BENCH_CHILD_BUDGET_S")
     if child_b:
         # the supervisor computed our true remaining time (its own
-        # deadline minus probe time minus margin) — use it directly
-        budget = max(float(child_b) - 10.0, 20.0)
+        # deadline minus probe time minus margin) — use it directly;
+        # the supervisor waits strictly longer before killing
+        budget = max(float(child_b), 10.0)
     else:
         raw = float(os.environ.get("ZOO_TPU_BENCH_BUDGET_S", "480"))
         budget = max(raw - 40.0, 0.5 * raw)
@@ -650,8 +651,11 @@ def _supervise(budget_s: float) -> None:
               f"[{time.perf_counter() - _t_start:.1f}s]",
               file=sys.stderr, flush=True)
         env = dict(os.environ)
-        env["ZOO_TPU_BENCH_CHILD_BUDGET_S"] = str(
-            max(deadline - time.perf_counter() - 10.0, 20.0))
+        remaining = max(deadline - time.perf_counter(), 12.0)
+        # child watchdog deadline < our kill deadline, always: the
+        # child must get to emit its best-so-far line first
+        child_budget = max(remaining - 12.0, 8.0)
+        env["ZOO_TPU_BENCH_CHILD_BUDGET_S"] = str(child_budget)
         proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--child"],
             stdout=subprocess.PIPE, text=True, env=env)
@@ -669,18 +673,29 @@ def _supervise(budget_s: float) -> None:
         t = threading.Thread(target=relay, daemon=True)
         t.start()
         try:
-            proc.wait(timeout=max(deadline - time.perf_counter(), 1.0))
+            proc.wait(timeout=min(
+                max(deadline - time.perf_counter(), 1.0),
+                child_budget + 8.0))
         except subprocess.TimeoutExpired:
             proc.kill()
             proc.wait()
         t.join(timeout=10.0)
-        if last_json[0] is not None:
-            sys.exit(0)
-        # live child died silently — fall through to CPU stages with
-        # whatever budget remains
-        merged["diag"] = (f"chip child produced no JSON "
-                          f"(rc={proc.returncode}); CPU fallback "
-                          f"metrics in extra_metrics")
+        try:
+            child_rec = (json.loads(last_json[0])
+                         if last_json[0] is not None else None)
+        except ValueError:  # truncated mid-line by the kill
+            child_rec = None
+        if child_rec is not None and (
+                child_rec.get("value", 0) > 0
+                or child_rec.get("extra_metrics")):
+            sys.exit(0)  # real signal banked by the chip child
+        # child died silently OR emitted only a zero-signal error
+        # line — fall through to CPU stages with whatever remains
+        merged["diag"] = (
+            f"chip child banked no signal "
+            f"(rc={proc.returncode}, "
+            f"child_diag={child_rec.get('diag') if child_rec else None!r});"
+            f" CPU fallback metrics in extra_metrics")
     else:
         merged["diag"] = (f"backend probe failed ({probe_msg}) — dead "
                           "tunnel?; CPU fallback metrics in "

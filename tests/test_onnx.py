@@ -1133,6 +1133,19 @@ def test_quantized_ops(rng):
     (dqn,) = run_node(helper.make_node(
         "DequantizeLinear", ["x", "s"], ["y"], axis=0), [w, ws])
     assert_close(dqn, w.astype(np.float32) * ws[:, None])
+
+    # negative axis normalizes (axis=-1 == last dim)
+    ws4 = np.array([0.1, 0.2, 0.3, 0.4], np.float32)
+    (dqneg,) = run_node(helper.make_node(
+        "DequantizeLinear", ["x", "s"], ["y"], axis=-1), [w, ws4])
+    assert_close(dqneg, w.astype(np.float32) * ws4[None, :])
+
+    # rank-1 input + per-channel scale + out-of-range default axis=1:
+    # must raise a descriptive error, not IndexError (ADVICE r4 #1)
+    v = rng.randint(0, 255, (3,)).astype(np.uint8)
+    with pytest.raises(Exception, match="axis 1 out of range"):
+        run_node(helper.make_node(
+            "DequantizeLinear", ["x", "s"], ["y"]), [v, ws])
     # all-zero DynamicQuantizeLinear stays finite
     qz, sz, zz = run_node(helper.make_node(
         "DynamicQuantizeLinear", ["x"], ["y", "ys", "yz"]),

@@ -200,6 +200,18 @@ class TestTransformerPipeline:
             np.testing.assert_allclose(np.asarray(y_pp),
                                        np.asarray(y_seq), rtol=2e-5,
                                        atol=2e-5)
+        # batch == 1 with a (1,1,T,T) broadcast mask: the leading dim
+        # coincidentally equals the batch — it must still be routed
+        # as broadcastable, not split over microbatches (ADVICE r4 #4)
+        pp1 = self._mk(rng, pipeline_parallel_axis="pipe",
+                       pipeline_microbatches=1)
+        x1 = x[:1]
+        mask1 = jnp.ones((1, 1, 8, 8))
+        y_seq1 = seq.call(params, x1, training=False, mask=mask1)
+        y_pp1 = pp1.call(params, x1, training=False, mask=mask1)
+        np.testing.assert_allclose(np.asarray(y_pp1),
+                                   np.asarray(y_seq1), rtol=2e-5,
+                                   atol=2e-5)
 
     def test_bert_pipelined_matches_sequential(self, rng):
         """BERT(pipeline_parallel_axis=..., output_all_block=False):

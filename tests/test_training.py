@@ -517,7 +517,28 @@ def test_dtype_policy_resolution(monkeypatch):
     monkeypatch.delenv("ZOO_TPU_DTYPE_POLICY", raising=False)
     assert mk().dtype_policy == "float32"          # cpu backend
     monkeypatch.setattr(est_mod.jax, "default_backend", lambda: "tpu")
-    assert mk().dtype_policy == "mixed_bfloat16"   # tpu default
+    # the backend-derived bf16 default must announce itself once
+    # (ADVICE r4 #2: changed numerics need a runtime signal)
+    est_mod.Estimator._warned_bf16_default = False
+    import logging
+
+    class _Cap(logging.Handler):
+        def __init__(self):
+            super().__init__()
+            self.msgs = []
+
+        def emit(self, record):
+            self.msgs.append(record.getMessage())
+    cap = _Cap()
+    zlog = logging.getLogger("analytics_zoo_tpu")
+    zlog.addHandler(cap)
+    try:
+        assert mk().dtype_policy == "mixed_bfloat16"  # tpu default
+        assert mk().dtype_policy == "mixed_bfloat16"  # again: no dup
+    finally:
+        zlog.removeHandler(cap)
+    bf16_msgs = [m for m in cap.msgs if "mixed_bfloat16" in m]
+    assert len(bf16_msgs) == 1, bf16_msgs
     monkeypatch.setenv("ZOO_TPU_DTYPE_POLICY", "float32")
     assert mk().dtype_policy == "float32"          # env beats backend
     assert mk(dtype_policy="mixed_bfloat16").dtype_policy \
