@@ -1089,27 +1089,61 @@ def _conv3_vjp_bwd(relu_in, affine_in, stride, interpret, res, cots):
     xp = jnp.maximum(xa, 0.0) if relu_in else xa
     cd = x.dtype
 
-    def conv(l, r):
-        # f32 operands throughout: the conv transpose rule rebuilds a
-        # conv between the cotangent and the other operand and
-        # requires all three dtypes EQUAL — bf16 operands with a
-        # promoted-f32 output (round 3's form) crash it, and bf16
-        # operands without promotion round the gradients to bf16.
-        # Casting INSIDE keeps the transposed computation f32 end to
-        # end (the cast transposes through convert_element_type);
-        # precision beats the matmul backward's bf16-operand dots at
-        # some conv-backward MXU rate — revisit if the profile shows
-        # these two convs hot.
-        return jax.lax.conv_general_dilated(
-            l.astype(f32), r.astype(f32),
-            window_strides=(stride, stride), padding="SAME",
-            dimension_numbers=("NHWC", "HWIO", "NHWC"))
-
     xpc = xp.astype(cd)
     wc = w.astype(cd)
-    dw = jax.linear_transpose(lambda ww: conv(xpc, ww), wc)(g)[0]
-    dxp = jax.linear_transpose(lambda xx: conv(xx, wc), xpc)(g)[0]
-    dxp = dxp.astype(f32)
+    if os.environ.get("ZOO_TPU_CONV3_BWD_F32") == "1":
+        # escape hatch: the round-4 f32-operand backward (for A/B and
+        # numerics debugging)
+        def conv(l, r):
+            return jax.lax.conv_general_dilated(
+                l.astype(f32), r.astype(f32),
+                window_strides=(stride, stride), padding="SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        dw = jax.linear_transpose(lambda ww: conv(xpc, ww), wc)(g)[0]
+        dxp = jax.linear_transpose(
+            lambda xx: conv(xx, wc), xpc)(g)[0].astype(f32)
+    else:
+        # bf16-operand backward convs with f32 accumulation
+        # (preferred_element_type) — full MXU rate, the standard
+        # mixed-precision recipe (VERDICT r4 next-round #3). These are
+        # jax's own conv transpose formulations written explicitly:
+        # `linear_transpose` can't be used because the transpose rule
+        # rebuilds a conv between the cotangent and the saved operand
+        # and conv_general_dilated requires equal operand dtypes —
+        # with f32 cotangents and bf16 residuals it crashes, and
+        # casting the operands up (round 4) halves backward MXU
+        # throughput. Padding algebra below is the SAME-padding k=3
+        # specialization of jax's _conv_general_vjp_{lhs,rhs}_padding.
+        gc = g.astype(cd)
+        hh, ww_ = xp.shape[1], xp.shape[2]
+
+        def _pads(sz):
+            ho = -(-sz // stride)               # SAME output extent
+            total = max((ho - 1) * stride + 3 - sz, 0)
+            lo = total // 2
+            return lo, 1 + (ho - 1) * stride    # lo, dilated out size
+
+        lo_h, od_h = _pads(hh)
+        lo_w, od_w = _pads(ww_)
+        # dXp: conv of the (stride-dilated) cotangent with the
+        # spatially-reversed, I/O-swapped kernel
+        dx_pad = ((2 - lo_h, (hh + 2) - od_h - (2 - lo_h)),
+                  (2 - lo_w, (ww_ + 2) - od_w - (2 - lo_w)))
+        dxp = jax.lax.conv_general_dilated(
+            gc, jax.lax.rev(wc, (0, 1)),
+            window_strides=(1, 1), padding=dx_pad,
+            lhs_dilation=(stride, stride), rhs_dilation=(1, 1),
+            dimension_numbers=("NHWC", "HWOI", "NHWC"),
+            preferred_element_type=f32)
+        # dW: contract over batch — x' as ("CHWN") against the
+        # stride-dilated cotangent as ("IHWO"), producing ("HWNC")
+        dw_pad = ((lo_h, (od_h - hh) + (2 - lo_h)),
+                  (lo_w, (od_w - ww_) + (2 - lo_w)))
+        dw = jax.lax.conv_general_dilated(
+            xpc, gc, window_strides=(1, 1), padding=dw_pad,
+            lhs_dilation=(1, 1), rhs_dilation=(stride, stride),
+            dimension_numbers=("CHWN", "IHWO", "HWNC"),
+            preferred_element_type=f32)
     if relu_in:
         dxp = jnp.where(xa > 0.0, dxp, 0.0)
     if affine_in:
@@ -1141,9 +1175,11 @@ def conv3x3_bn(x: jnp.ndarray, w: jnp.ndarray,
     XLA reference path). Prologue/epilogue and returns exactly like
     :func:`matmul_bn`; ``stat_shift`` must be non-differentiated (pass
     the BN's moving mean stop-gradded — its cotangent is defined as
-    zero, like matmul_bn's). Backward runs as XLA `linear_transpose`
-    convs. Planes too large for a one-image VMEM tile fall back to the
-    XLA reference expression."""
+    zero, like matmul_bn's). Backward runs as two explicit XLA
+    transpose convs with compute-dtype (bf16) operands and f32
+    accumulation (`ZOO_TPU_CONV3_BWD_F32=1` selects the f32-operand
+    `linear_transpose` form instead). Planes too large for a
+    one-image VMEM tile fall back to the XLA reference expression."""
     global invocations
     invocations += 1
     if w.shape[:2] != (3, 3):

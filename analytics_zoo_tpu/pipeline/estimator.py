@@ -335,22 +335,13 @@ class Estimator:
         # default: bf16 activations on TPU (the MXU-native dtype,
         # PERF.md), exact f32 elsewhere (golden tests, CPU parity);
         # explicit arg > env > backend default
+        announce_bf16_default = False
         if dtype_policy is None and not os.environ.get(
                 "ZOO_TPU_DTYPE_POLICY"):
             dtype_policy = ("mixed_bfloat16"
                             if jax.default_backend() in ("tpu", "axon")
                             else "float32")
-            if dtype_policy == "mixed_bfloat16":
-                # one-time signal: callers who never chose a policy get
-                # changed numerics on TPU — make that traceable
-                if not getattr(Estimator,
-                               "_warned_bf16_default", False):
-                    Estimator._warned_bf16_default = True
-                    logger.info(
-                        "Estimator defaulting to mixed_bfloat16 on "
-                        "%s backend (pass dtype_policy='float32' or "
-                        "set ZOO_TPU_DTYPE_POLICY to override)",
-                        jax.default_backend())
+            announce_bf16_default = dtype_policy == "mixed_bfloat16"
         else:
             dtype_policy = dtype_policy or os.environ.get(
                 "ZOO_TPU_DTYPE_POLICY")
@@ -364,6 +355,19 @@ class Estimator:
         self.augment = augment  # train-only on-device augmentation
         self.model = model
         self.ctx = ctx or get_nncontext()
+        if announce_bf16_default and not getattr(
+                Estimator, "_warned_bf16_default", False):
+            # one-time signal: callers who never chose a policy get
+            # changed numerics on TPU — make that traceable. Emitted
+            # AFTER ctx resolution: get_nncontext() configures the
+            # package logger, so an INFO fired earlier in a fresh
+            # process would be dropped at the root WARNING level.
+            Estimator._warned_bf16_default = True
+            logger.info(
+                "Estimator defaulting to mixed_bfloat16 on "
+                "%s backend (pass dtype_policy='float32' or "
+                "set ZOO_TPU_DTYPE_POLICY to override)",
+                jax.default_backend())
         self.parallel_mode = parallel_mode
         self.loss_fn = losses_lib.get(loss)
         self.metrics = [metrics_lib.get(m) for m in (metrics or [])]

@@ -261,8 +261,10 @@ def main():
     if child_b:
         # the supervisor computed our true remaining time (its own
         # deadline minus probe time minus margin) — use it directly;
-        # the supervisor waits strictly longer before killing
-        budget = max(float(child_b), 10.0)
+        # the supervisor waits child_budget+8s before killing, so any
+        # clamp here must match its floor exactly or the watchdog
+        # fires after the kill
+        budget = max(float(child_b), 5.0)
     else:
         raw = float(os.environ.get("ZOO_TPU_BENCH_BUDGET_S", "480"))
         budget = max(raw - 40.0, 0.5 * raw)
@@ -651,10 +653,14 @@ def _supervise(budget_s: float) -> None:
               f"[{time.perf_counter() - _t_start:.1f}s]",
               file=sys.stderr, flush=True)
         env = dict(os.environ)
-        remaining = max(deadline - time.perf_counter(), 12.0)
-        # child watchdog deadline < our kill deadline, always: the
-        # child must get to emit its best-so-far line first
-        child_budget = max(remaining - 12.0, 8.0)
+        remaining = deadline - time.perf_counter()
+        # child watchdog deadline < our kill deadline, ALWAYS: the
+        # child must get to emit its best-so-far line first. The wait
+        # below is child_budget+8 (not min'd with the real deadline —
+        # in the pathological sub-10s case that overruns by a few
+        # seconds, well inside _supervise's 15s driver margin), and
+        # the child's own floor matches ours.
+        child_budget = max(remaining - 12.0, 5.0)
         env["ZOO_TPU_BENCH_CHILD_BUDGET_S"] = str(child_budget)
         proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--child"],
@@ -673,9 +679,7 @@ def _supervise(budget_s: float) -> None:
         t = threading.Thread(target=relay, daemon=True)
         t.start()
         try:
-            proc.wait(timeout=min(
-                max(deadline - time.perf_counter(), 1.0),
-                child_budget + 8.0))
+            proc.wait(timeout=child_budget + 8.0)
         except subprocess.TimeoutExpired:
             proc.kill()
             proc.wait()
