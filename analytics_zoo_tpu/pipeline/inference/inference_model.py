@@ -19,7 +19,9 @@ TPU-native redesign:
 
 from __future__ import annotations
 
+import json
 import threading
+import zipfile
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import jax
@@ -28,28 +30,81 @@ import numpy as np
 from analytics_zoo_tpu.common.nncontext import get_nncontext, logger
 from analytics_zoo_tpu.native import make_serving_queue
 
+_ARTIFACT_VERSION = 1
+
+
+def _tree_spec(skel) -> dict:
+    """JSON-able structure spec of a pytree SKELETON (leaves are
+    ints). The artifact stores this instead of pickled PyTreeDefs so
+    the tree metadata adds no unpickling surface of its own. NOTE the
+    executable blob itself still deserializes through jax's
+    pickle-based loader — see the trust-model note on
+    :meth:`InferenceModel.load_compiled`."""
+    if isinstance(skel, tuple):
+        return {"t": "tuple", "c": [_tree_spec(c) for c in skel]}
+    if isinstance(skel, list):
+        return {"t": "list", "c": [_tree_spec(c) for c in skel]}
+    if isinstance(skel, dict):
+        keys = sorted(skel)
+        return {"t": "dict", "k": keys,
+                "c": [_tree_spec(skel[k]) for k in keys]}
+    if skel is None:
+        return {"t": "none"}
+    return {"t": "leaf"}
+
+
+def _tree_from_spec(spec: dict):
+    t = spec["t"]
+    if t == "tuple":
+        return tuple(_tree_from_spec(c) for c in spec["c"])
+    if t == "list":
+        return [_tree_from_spec(c) for c in spec["c"]]
+    if t == "dict":
+        return {k: _tree_from_spec(c)
+                for k, c in zip(spec["k"], spec["c"])}
+    if t == "none":
+        return None
+    return 0
+
 
 class InferenceModel:
     def __init__(self, supported_concurrent_num: int = 1):
         self.supported_concurrent_num = int(supported_concurrent_num)
         self._queue = make_serving_queue()
         self._predict_fn: Optional[Callable] = None
+        self._export_src: Optional[Tuple] = None
         self._compiled = False
         self._lock = threading.Lock()
         self.quantized = None  # QuantizedModel when loaded with int8
 
     # -- loaders ------------------------------------------------------------
     def _install(self, predict_fn: Callable,
-                 example_inputs: Optional[Sequence[np.ndarray]] = None):
+                 example_inputs: Optional[Sequence[np.ndarray]] = None,
+                 export_state: Optional[Tuple] = None):
         import jax
         fn = jax.jit(predict_fn)
         if example_inputs is not None:
             # AOT-compile for the declared shapes (the OpenVINO-IR role)
             fn = fn.lower(*example_inputs).compile()
         self._predict_fn = fn
+        # kept for export_compiled: ``(params_pytree, pure_fn)`` —
+        # the pure form lets export re-commit the weights to ONE
+        # device and stage a single-device artifact program,
+        # independent of this process's mesh (a serving process is
+        # one chip; a program lowered against mesh-committed params
+        # would demand the exporter's device count from every loader)
+        self._export_src = (export_state, example_inputs)
+        self._fill_slots()
+        self._compiled = example_inputs is not None
+
+    def _fill_slots(self):
+        """(Re)stock the pool to exactly supported_concurrent_num:
+        re-loading into a live InferenceModel must not inflate the
+        concurrency contract with leftover slots."""
+        while self._queue.size() > 0 and self._queue.take(0) >= 0:
+            pass
         for slot in range(self.supported_concurrent_num):
             self._queue.put(slot)
-        self._compiled = example_inputs is not None
 
     def load(self, model_path: str,
              example_inputs: Optional[Sequence] = None,
@@ -95,6 +150,7 @@ class InferenceModel:
 
             def predict_fn(*xs):
                 return qm.forward(xs[0] if len(xs) == 1 else list(xs))
+            export_state = None  # int8 tables live inside qm
         else:
             self.quantized = None
 
@@ -102,9 +158,15 @@ class InferenceModel:
                 x = list(xs) if len(xs) > 1 else xs[0]
                 return net.forward(params, x, training=False)
 
+            def pure_fn(p, *xs):
+                x = list(xs) if len(xs) > 1 else xs[0]
+                return net.forward(p, x, training=False)
+            export_state = (params, pure_fn)
+
         self._install(predict_fn,
                       None if example_inputs is None
-                      else [np.asarray(e) for e in example_inputs])
+                      else [np.asarray(e) for e in example_inputs],
+                      export_state=export_state)
         return self
 
     def load_tf(self, saved_model_path: str,
@@ -124,11 +186,172 @@ class InferenceModel:
                       else [np.asarray(e) for e in example_inputs])
         return self
 
-    def load_openvino(self, *args, **kwargs):
-        raise NotImplementedError(
-            "OpenVINO's role (ahead-of-time compiled serving) is played "
-            "by XLA AOT here: use load/load_tf with example_inputs to "
-            "pre-compile")
+    def load_openvino(self, model_path: str, weight_path=None,
+                      **kwargs):
+        """Deprecated delegating shim (reference
+        `InferenceModel.scala:69-120` `doLoadOpenVINO`): the
+        OpenVINO-IR role — an on-disk ahead-of-time compiled serving
+        artifact any process can load — is played by
+        :meth:`export_compiled` / :meth:`load_compiled` XLA bundles.
+        ``model_path`` must point at an ``export_compiled`` artifact;
+        ``weight_path`` is ignored (weights are embedded)."""
+        import warnings
+        warnings.warn(
+            "load_openvino is deprecated on the TPU-native stack; "
+            "pass an export_compiled() artifact (delegating to "
+            "load_compiled)", DeprecationWarning, stacklevel=2)
+        return self.load_compiled(model_path)
+
+    # -- serialized AOT artifact (the OpenVINO-IR role) ---------------------
+    def export_compiled(self, path: str) -> str:
+        """Write the AOT-compiled serving program to ``path`` (a zip
+        bundle) that another process loads with :meth:`load_compiled`
+        and serves WITHOUT recompiling — the on-disk-IR property of
+        the reference's OpenVINO backend
+        (`OpenVinoInferenceSupportive.scala:69-155`).
+
+        The bundle carries two encodings:
+        - ``executable.bin``: the serialized XLA executable (weights
+          embedded as program constants) — loads with zero
+          compilation on a machine/backend matching the exporter;
+        - ``export.bin``: the portable ``jax.export`` StableHLO blob —
+          the cross-machine fallback, compiled once at load time
+          (still no Python model code or retracing needed).
+
+        Requires a model loaded with ``example_inputs`` (AOT)."""
+        from jax.experimental import serialize_executable as se
+
+        if not self._compiled or self._export_src is None or \
+                self._export_src[1] is None:
+            raise RuntimeError(
+                "export_compiled needs a model loaded with "
+                "example_inputs (the AOT pre-compile path)")
+        export_state, examples = self._export_src
+        if export_state is None:
+            raise NotImplementedError(
+                "export_compiled supports load/load_keras_net models "
+                "(quantized and call_tf-bridged programs embed state "
+                "the exporter cannot re-stage single-device yet)")
+        params, pure_fn = export_state
+        # the ARTIFACT program is staged single-device: a serving
+        # process is one chip, and a program lowered against this
+        # process's mesh (training params are often replicated across
+        # it) would demand the same device count from every loader.
+        # Re-committing the weights to one device is what makes the
+        # lowering single-device; the in-memory pool (_predict_fn)
+        # keeps its mesh-aware form.
+        dev = jax.devices()[0]
+        p1 = jax.device_put(
+            params, jax.sharding.SingleDeviceSharding(dev))
+
+        def fn1(*xs):
+            return pure_fn(p1, *xs)
+
+        sjit = jax.jit(fn1)
+        with jax.default_device(dev):
+            payload, in_tree, out_tree = se.serialize(
+                sjit.lower(*examples).compile())
+        in_skel = jax.tree_util.tree_unflatten(
+            in_tree, list(range(in_tree.num_leaves)))
+        out_skel = jax.tree_util.tree_unflatten(
+            out_tree, list(range(out_tree.num_leaves)))
+        from jax import export as jexport
+        # the portable blob is lowered for the exporter's platform
+        # AND cpu, so a cpu serving box can still load a TPU-exported
+        # artifact (the axon tunnel backend lowers as tpu)
+        backend = jax.default_backend()
+        plats = list(dict.fromkeys(
+            ["tpu" if backend == "axon" else backend, "cpu"]))
+        try:
+            exported = jexport.export(sjit, platforms=plats)(*examples)
+        except Exception:  # multi-platform lowering unsupported here
+            plats = [backend]
+            exported = jexport.export(sjit)(*examples)
+        export_blob = exported.serialize()
+        meta = {
+            "version": _ARTIFACT_VERSION,
+            "platform": jax.default_backend(),
+            "export_platforms": plats,
+            "jax_version": jax.__version__,
+            "n_devices": 1,
+            "in_spec": _tree_spec(in_skel),
+            "out_spec": _tree_spec(out_skel),
+            "inputs": [{"shape": list(np.shape(e)),
+                        "dtype": str(np.asarray(e).dtype)}
+                       for e in examples],
+        }
+        with zipfile.ZipFile(path, "w") as z:
+            z.writestr("meta.json", json.dumps(meta))
+            z.writestr("executable.bin", payload)
+            z.writestr("export.bin", export_blob)
+        logger.info("exported compiled serving artifact -> %s "
+                    "(%d inputs, platform=%s)", path,
+                    len(meta["inputs"]), meta["platform"])
+        return path
+
+    def load_compiled(self, path: str):
+        """Load an :meth:`export_compiled` bundle and serve it. On a
+        matching machine/backend the serialized executable loads
+        directly — NO compilation, no tracing, no model code; on a
+        different one the portable ``jax.export`` blob is compiled
+        once for the declared shapes (lowered at export for the
+        exporter's platform and cpu).
+
+        TRUST MODEL: like any executable format (an OpenVINO IR, a
+        shared library), a bundle runs with the loader's privileges —
+        the executable blob deserializes through jax's pickle-based
+        loader. Load artifacts only from sources you trust."""
+        from jax.experimental import serialize_executable as se
+
+        with zipfile.ZipFile(path, "r") as z:
+            meta = json.loads(z.read("meta.json").decode())
+            exec_blob = z.read("executable.bin")
+            export_blob = z.read("export.bin")
+        if meta.get("version", 0) > _ARTIFACT_VERSION:
+            raise ValueError(
+                f"artifact version {meta.get('version')} is newer "
+                f"than this runtime's {_ARTIFACT_VERSION}")
+        in_tree = jax.tree_util.tree_structure(
+            _tree_from_spec(meta["in_spec"]))
+        out_tree = jax.tree_util.tree_structure(
+            _tree_from_spec(meta["out_spec"]))
+        n_dev = int(meta.get("n_devices", 1))
+        try:
+            # execution_devices defaults to ALL of the backend's
+            # devices — a single-device artifact must load onto
+            # exactly the device count it was compiled for
+            fn = se.deserialize_and_load(
+                exec_blob, in_tree, out_tree,
+                execution_devices=jax.devices()[:n_dev])
+            mode = "aot"
+        except Exception as e:
+            backend = jax.default_backend()
+            cur = "tpu" if backend == "axon" else backend
+            plats = meta.get("export_platforms", [meta["platform"]])
+            if cur not in plats:
+                raise ValueError(
+                    f"artifact was exported for platform(s) {plats}; "
+                    f"this process runs {backend} — re-export on a "
+                    f"matching backend") from e
+            logger.warning(
+                "serialized executable not loadable here (%s: %s); "
+                "compiling the portable export blob once",
+                type(e).__name__, e)
+            from jax import export as jexport
+            exp = jexport.deserialize(export_blob)
+            args = [jax.ShapeDtypeStruct(tuple(i["shape"]),
+                                         np.dtype(i["dtype"]))
+                    for i in meta["inputs"]]
+            fn = jax.jit(exp.call).lower(*args).compile()
+            mode = "export"
+        self._predict_fn = fn
+        self._export_src = None   # re-export needs a source model
+        self.quantized = None     # any prior int8 load is replaced
+        self._fill_slots()
+        self._compiled = True
+        logger.info("loaded compiled serving artifact %s (mode=%s)",
+                    path, mode)
+        return self
 
     # -- predict ------------------------------------------------------------
     def predict(self, inputs, timeout_ms: int = -1):
