@@ -77,7 +77,7 @@ class FeatureSet:
     """
 
     def __init__(self, x_columns: "list[np.ndarray]",
-                 y_column: Optional[np.ndarray],
+                 y_column=None,
                  memory_type: "str | MemoryType" = MemoryType.DRAM,
                  shard_index: int = 0, num_shards: int = 1,
                  pmem_path: Optional[str] = None):
@@ -86,35 +86,52 @@ class FeatureSet:
         for c in x_columns:
             if c.shape[0] != n:
                 raise ValueError("inconsistent column lengths")
-        if y_column is not None and y_column.shape[0] != n:
-            raise ValueError("label column length mismatch")
+        # ``y_column``: one label array, or a list/tuple of them
+        # (multi-output training — the reference's nested TensorMeta
+        # label contract)
+        self._multi_y = isinstance(y_column, (list, tuple))
+        y_cols = (list(y_column) if self._multi_y
+                  else [y_column] if y_column is not None else [])
+        for c in y_cols:
+            if c.shape[0] != n:
+                raise ValueError("label column length mismatch")
         # multi-host sharding: this host keeps rows [lo, hi)
         if not (0 <= shard_index < num_shards):
             raise ValueError("bad shard spec")
         lo = shard_index * n // num_shards
         hi = (shard_index + 1) * n // num_shards
         x_columns = [c[lo:hi] for c in x_columns]
-        y_column = None if y_column is None else y_column[lo:hi]
+        y_cols = [c[lo:hi] for c in y_cols]
 
         if self.memory_type == MemoryType.PMEM:
-            cols = x_columns + ([y_column] if y_column is not None else [])
-            store = _MemmapStore(cols, pmem_path)
+            store = _MemmapStore(x_columns + y_cols, pmem_path)
             stored = store.columns
             self._x = stored[:len(x_columns)]
-            self._y = stored[len(x_columns)] if y_column is not None \
-                else None
+            y_cols = stored[len(x_columns):]
             self._store = store
         else:
             self._x = x_columns
-            self._y = y_column
+        self._y_cols = y_cols
         self._n = self._x[0].shape[0]
+
+    @property
+    def _y(self):
+        """Back-compat single-label view (None / array / list)."""
+        if not self._y_cols:
+            return None
+        return list(self._y_cols) if self._multi_y else self._y_cols[0]
 
     # -- constructors (reference FeatureSet.rdd/array factories) -----------
     @staticmethod
     def array(x, y=None, memory_type="dram", **kw) -> "FeatureSet":
         xs = x if isinstance(x, (list, tuple)) else [x]
         xs = [np.asarray(a) for a in xs]
-        yy = None if y is None else np.asarray(y)
+        if y is None:
+            yy = None
+        elif isinstance(y, (list, tuple)):
+            yy = [np.asarray(a) for a in y]
+        else:
+            yy = np.asarray(y)
         return FeatureSet(xs, yy, memory_type=memory_type, **kw)
 
     @staticmethod
@@ -124,8 +141,9 @@ class FeatureSet:
         RDD[Sample] ingest path, cached like
         `CachedDistributedFeatureSet`)."""
         feats: "list[list[np.ndarray]]" = []
-        labels: "list[np.ndarray]" = []
+        labels: "list[list[np.ndarray]]" = []
         has_label = None
+        multi_label = False
         for s in samples:
             arrays = s.feature_arrays()
             if not feats:
@@ -134,12 +152,26 @@ class FeatureSet:
                 col.append(a)
             if has_label is None:
                 has_label = s.label is not None
+                multi_label = isinstance(s.label, (list, tuple))
+                if has_label:
+                    labels = [[] for _ in
+                              (s.label if multi_label else [s.label])]
             if has_label:
-                labels.append(np.asarray(s.label))
+                lab = s.label if multi_label else [s.label]
+                for col, a in zip(labels, lab):
+                    col.append(np.asarray(a))
         if not feats:
             raise ValueError("empty sample stream")
         x_cols = [_stack_column(c) for c in feats]
-        y_col = _stack_column(labels) if has_label else None
+        if not has_label:
+            y_col = None
+        elif multi_label:
+            # keep multi-output label columns separate (a bare
+            # np.asarray over the pairs would silently stack
+            # same-shaped outputs into one bogus column)
+            y_col = [_stack_column(c) for c in labels]
+        else:
+            y_col = _stack_column(labels[0])
         return FeatureSet(x_cols, y_col, memory_type=memory_type, **kw)
 
     @staticmethod
@@ -195,8 +227,14 @@ class FeatureSet:
     def _iter_samples(self) -> Iterator[Sample]:
         for i in range(self._n):
             feats = [c[i] for c in self._x]
+            if not self._y_cols:
+                label = None
+            elif self._multi_y:
+                label = [c[i] for c in self._y_cols]
+            else:
+                label = self._y_cols[0][i]
             yield Sample(feature=feats if len(feats) > 1 else feats[0],
-                         label=None if self._y is None else self._y[i])
+                         label=label)
 
     # -- Estimator data protocol -------------------------------------------
     @property
@@ -218,7 +256,12 @@ class FeatureSet:
                 idx[start:start + batch_size]
             xb = [np.asarray(c[sel]) for c in self._x]
             xb = xb[0] if len(xb) == 1 else xb
-            yb = None if self._y is None else np.asarray(self._y[sel])
+            if not self._y_cols:
+                yb = None
+            elif self._multi_y:
+                yb = [np.asarray(c[sel]) for c in self._y_cols]
+            else:
+                yb = np.asarray(self._y_cols[0][sel])
             yield xb, yb
 
     def __len__(self):

@@ -185,13 +185,22 @@ class ArrayDataset:
     def __init__(self, x, y=None):
         self.x = x if isinstance(x, (list, tuple)) else [x]
         self.x = [np.asarray(a) for a in self.x]
-        self.y = None if y is None else np.asarray(y)
+        # y: one label array, or a list/tuple of them (multi-output)
+        self._multi_y = isinstance(y, (list, tuple))
+        if y is None:
+            self.y = None
+        elif self._multi_y:
+            self.y = [np.asarray(a) for a in y]
+        else:
+            self.y = np.asarray(y)
         n = self.x[0].shape[0]
         for a in self.x:
             if a.shape[0] != n:
                 raise ValueError("inconsistent sample counts in x")
-        if self.y is not None and self.y.shape[0] != n:
-            raise ValueError("x and y sample counts differ")
+        for a in (self.y if self._multi_y else
+                  [self.y] if self.y is not None else []):
+            if a.shape[0] != n:
+                raise ValueError("x and y sample counts differ")
         self._n = n
 
     @property
@@ -208,7 +217,12 @@ class ArrayDataset:
             sel = idx[start:start + batch_size]
             xb = [a[sel] for a in self.x]
             xb = xb[0] if len(xb) == 1 else xb
-            yb = None if self.y is None else self.y[sel]
+            if self.y is None:
+                yb = None
+            elif self._multi_y:
+                yb = [a[sel] for a in self.y]
+            else:
+                yb = self.y[sel]
             yield xb, yb
 
 
@@ -296,6 +310,33 @@ def _prefetch_depth() -> int:
         return 2
 
 
+def _apply_loss(loss_fn, y, out):
+    """Keras multi-output semantics: a list/tuple of model outputs
+    against a list/tuple of label columns sums per-output losses
+    (``loss`` may itself be a list, one fn per output — the
+    reference's nested-TensorMeta TFPark contract)."""
+    if isinstance(out, (list, tuple)) and isinstance(y, (list, tuple)):
+        fns = (list(loss_fn) if isinstance(loss_fn, (list, tuple))
+               else [loss_fn] * len(out))
+        if not (len(fns) == len(out) == len(y)):
+            raise ValueError(
+                f"multi-output mismatch: {len(out)} outputs, "
+                f"{len(y)} label columns, {len(fns)} losses")
+        total = fns[0](y[0], out[0])
+        for f, t, o in zip(fns[1:], y[1:], out[1:]):
+            total = total + f(t, o)
+        return total
+    if isinstance(loss_fn, (list, tuple)):
+        raise ValueError(
+            f"a list of {len(loss_fn)} losses needs a multi-output "
+            f"model AND a list of label columns (outputs are "
+            f"{type(out).__name__}, labels {type(y).__name__})")
+    # mixed structures (list outputs + one packed label array, or the
+    # reverse) pass through to the single loss fn: custom joint losses
+    # legitimately unpack them (e.g. tfpark IntentEntity)
+    return loss_fn(y, out)
+
+
 def _cast_floats(x, dtype):
     """Cast floating leaves of an input (array or list of arrays);
     ints (ids/labels) pass through."""
@@ -369,7 +410,11 @@ class Estimator:
                 "set ZOO_TPU_DTYPE_POLICY to override)",
                 jax.default_backend())
         self.parallel_mode = parallel_mode
-        self.loss_fn = losses_lib.get(loss)
+        # a list of losses = one per model output (multi-output
+        # training; _apply_loss sums them)
+        self.loss_fn = ([losses_lib.get(l) for l in loss]
+                        if isinstance(loss, (list, tuple))
+                        else losses_lib.get(loss))
         self.metrics = [metrics_lib.get(m) for m in (metrics or [])]
         self._base_tx = optim_lib.get(optimizer)
         self._clip: Optional[optax.GradientTransformation] = None
@@ -562,7 +607,7 @@ class Estimator:
                 out, state_upd = model.apply(p, x, training=True, rng=rng)
                 if mixed:  # loss in f32 for numeric stability
                     out = _cast_floats(out, jnp.float32)
-                loss = loss_fn(y, out)
+                loss = _apply_loss(loss_fn, y, out)
                 loss = loss + model.regularization_loss(p)
                 return loss, state_upd
 
@@ -609,11 +654,23 @@ class Estimator:
             else:
                 # per-sample losses so padding samples (w=0) drop out;
                 # each sample is evaluated as a batch of 1 so loss fns
-                # keep their batch-mean semantics
+                # keep their batch-mean semantics (tree_map: y/out may
+                # be multi-output lists)
+                _b1 = lambda tree: jax.tree_util.tree_map(
+                    lambda a: a[None], tree)
                 per = jax.vmap(
-                    lambda t, p: loss_fn(t[None], p[None]))(y, out)
+                    lambda t, p: _apply_loss(
+                        loss_fn, _b1(t), _b1(p)))(y, out)
                 loss_sum, count = jnp.sum(per * w), jnp.sum(w)
             stats = {"loss": {"loss_sum": loss_sum, "count": count}}
+            if metrics and isinstance(out, (list, tuple)):
+                # built-in metrics assume single arrays; fail at trace
+                # time with the real reason, not a TypeError deep in
+                # the arithmetic
+                raise ValueError(
+                    "metrics are not supported with multi-output "
+                    "models yet — evaluate with metrics=[] (the "
+                    "summed multi-output loss is still reported)")
             for m in metrics:
                 if _accepts_mask(m):
                     stats[m.name] = m.batch_stats(y, out, mask=w)

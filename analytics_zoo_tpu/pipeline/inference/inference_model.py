@@ -98,13 +98,16 @@ class InferenceModel:
         self._compiled = example_inputs is not None
 
     def _fill_slots(self):
-        """(Re)stock the pool to exactly supported_concurrent_num:
-        re-loading into a live InferenceModel must not inflate the
-        concurrency contract with leftover slots."""
-        while self._queue.size() > 0 and self._queue.take(0) >= 0:
-            pass
-        for slot in range(self.supported_concurrent_num):
-            self._queue.put(slot)
+        """(Re)stock the pool to exactly supported_concurrent_num by
+        REPLACING the queue: draining could not reclaim slots held by
+        in-flight predicts, whose returns would then inflate the pool.
+        predict() captures its queue reference at take time, so a
+        stale slot lands in the retired queue and is forgotten."""
+        with self._lock:
+            q = make_serving_queue()
+            for slot in range(self.supported_concurrent_num):
+                q.put(slot)
+            self._queue = q
 
     def load(self, model_path: str,
              example_inputs: Optional[Sequence] = None,
@@ -265,7 +268,7 @@ class InferenceModel:
         try:
             exported = jexport.export(sjit, platforms=plats)(*examples)
         except Exception:  # multi-platform lowering unsupported here
-            plats = [backend]
+            plats = [plats[0]]  # the canonical (axon->tpu) name
             exported = jexport.export(sjit)(*examples)
         export_blob = exported.serialize()
         meta = {
@@ -359,7 +362,11 @@ class InferenceModel:
         `doPredict` contract)."""
         if self._predict_fn is None:
             raise RuntimeError("no model loaded")
-        slot = self._queue.take(timeout_ms)
+        # capture the queue: if a reload replaces the pool while this
+        # predict is in flight, the slot returns to the RETIRED queue
+        # (discarded) instead of inflating the new one
+        queue = self._queue
+        slot = queue.take(timeout_ms)
         if slot < 0:
             raise TimeoutError(
                 f"no free model slot within {timeout_ms}ms "
@@ -380,7 +387,7 @@ class InferenceModel:
                 return [np.asarray(o) for o in out]
             return np.asarray(out)
         finally:
-            self._queue.put(slot)
+            queue.put(slot)
 
     @property
     def concurrent_slots_free(self) -> int:

@@ -72,6 +72,32 @@ def test_featureset_multi_input():
     assert xb_[0].shape == (5, 2) and xb_[1].shape == (5, 3)
 
 
+def test_featureset_multi_output_labels(tmp_path):
+    # multi-output label columns (the reference's nested TensorMeta
+    # label contract): y as a list of arrays, kept row-aligned with x
+    # through shuffling, and surviving the PMEM tier
+    x = np.arange(20, dtype=np.float32)[:, None]
+    ya = x * 2
+    yb = x + 1
+    for kw in ({}, {"memory_type": "pmem",
+                    "pmem_path": str(tmp_path)}):
+        fs = FeatureSet.array(x, [ya, yb], **kw)
+        xb, yl = next(iter(fs.iter_batches(8, shuffle=True, seed=3)))
+        assert isinstance(yl, list) and len(yl) == 2
+        np.testing.assert_allclose(yl[0], xb * 2)
+        np.testing.assert_allclose(yl[1], xb + 1)
+    # samples iterate with list labels too
+    s = next(fs._iter_samples())
+    assert isinstance(s.label, list) and len(s.label) == 2
+    # and the sample-ingest path (transform/from_iterable) keeps the
+    # label columns separate instead of stacking same-shaped outputs
+    fs2 = FeatureSet.sample_rdd(fs._iter_samples())
+    xb2, yl2 = next(iter(fs2.iter_batches(8, shuffle=False)))
+    assert isinstance(yl2, list) and len(yl2) == 2
+    np.testing.assert_allclose(yl2[0], xb2 * 2)
+    np.testing.assert_allclose(yl2[1], xb2 + 1)
+
+
 def test_featureset_trains_with_estimator():
     from analytics_zoo_tpu import init_nncontext
     from analytics_zoo_tpu.pipeline.api.keras import Sequential, layers as L
