@@ -67,6 +67,28 @@ class _MemmapStore:
             self.columns.append(mm)
 
 
+def normalize_labels(y):
+    """The ONE place deciding how user-supplied labels are read:
+    returns ``(y_cols, multi)`` where ``y_cols`` is a list of numpy
+    label columns (empty = unlabeled) and ``multi`` says whether they
+    are separate output columns.
+
+    Multi-output means a list/tuple of ARRAY-LIKES (objects with
+    ``ndim >= 1`` — numpy/jax arrays): ``[ya, yb]`` stays two
+    columns. A plain Python list of per-sample scalars or rows
+    (``[0, 1, 0, 1]`` or ``[[0], [1]]``) is ONE label array, as it
+    always was."""
+    if y is None:
+        return [], False
+    if isinstance(y, (list, tuple)):
+        if len(y) == 0:
+            raise ValueError(
+                "empty label list — pass None for unlabeled data")
+        if all(getattr(c, "ndim", 0) >= 1 for c in y):
+            return [np.asarray(c) for c in y], True
+    return [np.asarray(y)], False
+
+
 class FeatureSet:
     """Cached, shardable dataset implementing the Estimator data protocol
     (`num_samples`, `iter_batches`).
@@ -88,13 +110,14 @@ class FeatureSet:
                 raise ValueError("inconsistent column lengths")
         # ``y_column``: one label array, or a list/tuple of them
         # (multi-output training — the reference's nested TensorMeta
-        # label contract)
-        self._multi_y = isinstance(y_column, (list, tuple))
-        y_cols = (list(y_column) if self._multi_y
-                  else [y_column] if y_column is not None else [])
+        # label contract); normalize_labels is the single decision
+        # point for which is which
+        y_cols, self._multi_y = normalize_labels(y_column)
         for c in y_cols:
-            if c.shape[0] != n:
-                raise ValueError("label column length mismatch")
+            if c.ndim == 0 or c.shape[0] != n:
+                raise ValueError(
+                    f"label column shape {c.shape} does not match "
+                    f"{n} samples")
         # multi-host sharding: this host keeps rows [lo, hi)
         if not (0 <= shard_index < num_shards):
             raise ValueError("bad shard spec")
@@ -126,13 +149,7 @@ class FeatureSet:
     def array(x, y=None, memory_type="dram", **kw) -> "FeatureSet":
         xs = x if isinstance(x, (list, tuple)) else [x]
         xs = [np.asarray(a) for a in xs]
-        if y is None:
-            yy = None
-        elif isinstance(y, (list, tuple)):
-            yy = [np.asarray(a) for a in y]
-        else:
-            yy = np.asarray(y)
-        return FeatureSet(xs, yy, memory_type=memory_type, **kw)
+        return FeatureSet(xs, y, memory_type=memory_type, **kw)
 
     @staticmethod
     def sample_rdd(samples: Iterable[Sample], memory_type="dram",

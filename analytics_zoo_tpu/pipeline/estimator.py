@@ -183,22 +183,20 @@ class ArrayDataset:
     """
 
     def __init__(self, x, y=None):
+        from analytics_zoo_tpu.feature.feature_set import \
+            normalize_labels
         self.x = x if isinstance(x, (list, tuple)) else [x]
         self.x = [np.asarray(a) for a in self.x]
-        # y: one label array, or a list/tuple of them (multi-output)
-        self._multi_y = isinstance(y, (list, tuple))
-        if y is None:
-            self.y = None
-        elif self._multi_y:
-            self.y = [np.asarray(a) for a in y]
-        else:
-            self.y = np.asarray(y)
+        # normalize_labels is the one decision point for single-array
+        # vs multi-output label lists (scalar lists stay one array)
+        y_cols, self._multi_y = normalize_labels(y)
+        self.y = (y_cols if self._multi_y
+                  else y_cols[0] if y_cols else None)
         n = self.x[0].shape[0]
         for a in self.x:
             if a.shape[0] != n:
                 raise ValueError("inconsistent sample counts in x")
-        for a in (self.y if self._multi_y else
-                  [self.y] if self.y is not None else []):
+        for a in y_cols:
             if a.shape[0] != n:
                 raise ValueError("x and y sample counts differ")
         self._n = n
@@ -412,9 +410,19 @@ class Estimator:
         self.parallel_mode = parallel_mode
         # a list of losses = one per model output (multi-output
         # training; _apply_loss sums them)
-        self.loss_fn = ([losses_lib.get(l) for l in loss]
-                        if isinstance(loss, (list, tuple))
-                        else losses_lib.get(loss))
+        if isinstance(loss, (list, tuple)):
+            self.loss_fn = [losses_lib.get(l) for l in loss]
+            for f in self.loss_fn:
+                base = getattr(f, "func", f)
+                if base is losses_lib.rank_hinge or getattr(
+                        base, "__name__", "") == "rank_hinge":
+                    # pairwise losses need the whole-batch eval path,
+                    # which the per-output vmap decomposition bypasses
+                    raise ValueError(
+                        "rank_hinge is pairwise and not supported "
+                        "inside a multi-output loss list")
+        else:
+            self.loss_fn = losses_lib.get(loss)
         self.metrics = [metrics_lib.get(m) for m in (metrics or [])]
         self._base_tx = optim_lib.get(optimizer)
         self._clip: Optional[optax.GradientTransformation] = None

@@ -86,27 +86,33 @@ class InferenceModel:
         if example_inputs is not None:
             # AOT-compile for the declared shapes (the OpenVINO-IR role)
             fn = fn.lower(*example_inputs).compile()
-        self._predict_fn = fn
         # kept for export_compiled: ``(params_pytree, pure_fn)`` —
         # the pure form lets export re-commit the weights to ONE
         # device and stage a single-device artifact program,
         # independent of this process's mesh (a serving process is
         # one chip; a program lowered against mesh-committed params
         # would demand the exporter's device count from every loader)
-        self._export_src = (export_state, example_inputs)
-        self._fill_slots()
-        self._compiled = example_inputs is not None
+        self._swap_model(fn, compiled=example_inputs is not None,
+                         export_src=(export_state, example_inputs))
 
-    def _fill_slots(self):
-        """(Re)stock the pool to exactly supported_concurrent_num by
-        REPLACING the queue: draining could not reclaim slots held by
-        in-flight predicts, whose returns would then inflate the pool.
-        predict() captures its queue reference at take time, so a
-        stale slot lands in the retired queue and is forgotten."""
+    def _swap_model(self, fn, compiled: bool, export_src):
+        """Atomically install (fn, compiled-flag, fresh slot pool):
+        predict() snapshots all three under the same lock, so a
+        reload can never pair a new executable with a stale
+        conversion flag. The queue is REPLACED, not drained —
+        draining could not reclaim slots held by in-flight predicts,
+        whose returns would then inflate the pool; a stale slot lands
+        in the retired queue and is forgotten. (Predicts that took a
+        slot from the retired queue finish against the old fn; for
+        one reload window total concurrency may transiently exceed
+        the contract by those stragglers.)"""
+        q = make_serving_queue()
+        for slot in range(self.supported_concurrent_num):
+            q.put(slot)
         with self._lock:
-            q = make_serving_queue()
-            for slot in range(self.supported_concurrent_num):
-                q.put(slot)
+            self._predict_fn = fn
+            self._compiled = compiled
+            self._export_src = export_src
             self._queue = q
 
     def load(self, model_path: str,
@@ -347,11 +353,9 @@ class InferenceModel:
                     for i in meta["inputs"]]
             fn = jax.jit(exp.call).lower(*args).compile()
             mode = "export"
-        self._predict_fn = fn
-        self._export_src = None   # re-export needs a source model
         self.quantized = None     # any prior int8 load is replaced
-        self._fill_slots()
-        self._compiled = True
+        # export_src None: re-export needs a source model
+        self._swap_model(fn, compiled=True, export_src=None)
         logger.info("loaded compiled serving artifact %s (mode=%s)",
                     path, mode)
         return self
@@ -360,12 +364,14 @@ class InferenceModel:
     def predict(self, inputs, timeout_ms: int = -1):
         """Take a slot from the pool, run, return the slot (reference
         `doPredict` contract)."""
-        if self._predict_fn is None:
+        # consistent snapshot (fn, conversion flag, queue): a reload
+        # mid-predict must not mix generations (see _swap_model)
+        with self._lock:
+            predict_fn = self._predict_fn
+            compiled = self._compiled
+            queue = self._queue
+        if predict_fn is None:
             raise RuntimeError("no model loaded")
-        # capture the queue: if a reload replaces the pool while this
-        # predict is in flight, the slot returns to the RETIRED queue
-        # (discarded) instead of inflating the new one
-        queue = self._queue
         slot = queue.take(timeout_ms)
         if slot < 0:
             raise TimeoutError(
@@ -380,9 +386,9 @@ class InferenceModel:
             # executable pins the example arrays' layout, which a
             # committed/sharded caller array need not match.
             xs = [x if isinstance(x, jax.Array)
-                  and not self._compiled else np.asarray(x)
+                  and not compiled else np.asarray(x)
                   for x in xs]
-            out = self._predict_fn(*xs)
+            out = predict_fn(*xs)
             if isinstance(out, (list, tuple)):
                 return [np.asarray(o) for o in out]
             return np.asarray(out)

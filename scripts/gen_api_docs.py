@@ -1,0 +1,165 @@
+#!/usr/bin/env python
+"""Generate the per-module API reference (docs/APIGuide/) from the
+package's ``__all__`` exports and docstrings.
+
+Run from the repo root:
+
+    JAX_PLATFORMS=cpu python scripts/gen_api_docs.py
+
+Every module listed in ``MODULES`` gets one markdown page with a
+signature + docstring entry per public name; ``index.md`` links them
+all. ``tests/test_docs.py`` asserts every ``__all__`` name appears in
+the committed pages, so regenerate after adding exports.
+"""
+
+from __future__ import annotations
+
+import importlib
+import inspect
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(ROOT, "docs", "APIGuide")
+if ROOT not in sys.path:  # `python scripts/gen_api_docs.py` from root
+    sys.path.insert(0, ROOT)
+
+# module path -> page title (one page per documented module)
+MODULES = [
+    ("analytics_zoo_tpu", "Top level"),
+    ("analytics_zoo_tpu.common", "common — context & config"),
+    ("analytics_zoo_tpu.feature", "feature — FeatureSet & ingest"),
+    ("analytics_zoo_tpu.feature.image", "feature.image — ImageSet"),
+    ("analytics_zoo_tpu.feature.image3d", "feature.image3d"),
+    ("analytics_zoo_tpu.feature.text", "feature.text — TextSet"),
+    ("analytics_zoo_tpu.pipeline.api.autograd",
+     "pipeline.api.autograd"),
+    ("analytics_zoo_tpu.pipeline.api.keras",
+     "pipeline.api.keras — models & topology"),
+    ("analytics_zoo_tpu.pipeline.api.keras.layers",
+     "pipeline.api.keras.layers — the 116-layer vocabulary"),
+    ("analytics_zoo_tpu.pipeline.api.keras2",
+     "pipeline.api.keras2"),
+    ("analytics_zoo_tpu.pipeline.api.keras2.layers",
+     "pipeline.api.keras2.layers — tf.keras-compatible vocabulary"),
+    ("analytics_zoo_tpu.pipeline.api.onnx",
+     "pipeline.api.onnx — ONNX importer"),
+    ("analytics_zoo_tpu.pipeline.estimator",
+     "pipeline.estimator — training runtime"),
+    ("analytics_zoo_tpu.pipeline.inference",
+     "pipeline.inference — serving"),
+    ("analytics_zoo_tpu.pipeline.nnframes",
+     "pipeline.nnframes — DataFrame ML pipeline"),
+    ("analytics_zoo_tpu.models", "models — the zoo"),
+    ("analytics_zoo_tpu.models.image.imageclassification",
+     "models.image.imageclassification"),
+    ("analytics_zoo_tpu.models.image.objectdetection",
+     "models.image.objectdetection"),
+    ("analytics_zoo_tpu.models.recommendation",
+     "models.recommendation"),
+    ("analytics_zoo_tpu.models.textclassification",
+     "models.textclassification"),
+    ("analytics_zoo_tpu.models.textmatching",
+     "models.textmatching"),
+    ("analytics_zoo_tpu.models.anomalydetection",
+     "models.anomalydetection"),
+    ("analytics_zoo_tpu.models.seq2seq", "models.seq2seq"),
+    ("analytics_zoo_tpu.parallel",
+     "parallel — meshes, sharding, collectives"),
+    ("analytics_zoo_tpu.ops.losses", "ops.losses"),
+    ("analytics_zoo_tpu.ops.metrics", "ops.metrics"),
+    ("analytics_zoo_tpu.ops.optimizers", "ops.optimizers"),
+    ("analytics_zoo_tpu.tfpark", "tfpark — TF integration"),
+    ("analytics_zoo_tpu.tfpark.text", "tfpark.text"),
+]
+
+
+def _public_names(mod) -> list:
+    names = getattr(mod, "__all__", None)
+    if names is None:
+        names = [n for n in vars(mod) if not n.startswith("_")]
+    return list(names)
+
+
+def _sig(obj) -> str:
+    try:
+        return str(inspect.signature(obj))
+    except (TypeError, ValueError):
+        return ""
+
+
+def _first_para(doc: str) -> str:
+    if not doc:
+        return "*(undocumented)*"
+    doc = inspect.cleandoc(doc)
+    return doc.split("\n\n")[0].replace("\n", " ")
+
+
+def _entry(name: str, obj) -> str:
+    lines = []
+    if inspect.isclass(obj):
+        lines.append(f"### `{name}{_sig(obj)}`\n")
+        lines.append(_first_para(obj.__doc__) + "\n")
+        methods = []
+        for mn, m in sorted(vars(obj).items()):
+            if mn.startswith("_"):
+                continue
+            # unwrap BEFORE the callable check: raw classmethod
+            # descriptors are not callable, so checking first silently
+            # drops every classmethod (e.g. ZooModel loaders)
+            f = m.__func__ if isinstance(
+                m, (staticmethod, classmethod)) else m
+            if not (inspect.isfunction(f) or inspect.ismethod(f)):
+                continue
+            methods.append(
+                f"- `{mn}{_sig(f)}` — {_first_para(f.__doc__)}")
+        if methods:
+            lines.append("\n".join(methods) + "\n")
+    elif callable(obj):
+        lines.append(f"### `{name}{_sig(obj)}`\n")
+        lines.append(_first_para(getattr(obj, "__doc__", "")) + "\n")
+    else:
+        lines.append(f"### `{name}`\n")
+        lines.append(f"Constant/value: `{obj!r}`\n")
+    return "\n".join(lines)
+
+
+def main() -> int:
+    os.makedirs(OUT, exist_ok=True)
+    index = [
+        "# API reference\n",
+        "Generated from docstrings by `scripts/gen_api_docs.py` — "
+        "do not edit these pages by hand; regenerate after changing "
+        "`__all__` exports.\n",
+    ]
+    for mod_path, title in MODULES:
+        mod = importlib.import_module(mod_path)
+        page = [f"# {title}\n", f"`import {mod_path}`\n"]
+        mod_doc = _first_para(mod.__doc__)
+        if mod_doc != "*(undocumented)*":
+            page.append(mod_doc + "\n")
+        for name in _public_names(mod):
+            try:
+                obj = getattr(mod, name)
+            except AttributeError:
+                print(f"!! {mod_path}.{name} in __all__ but missing",
+                      file=sys.stderr)
+                continue
+            page.append(_entry(name, obj))
+        fname = mod_path.replace("analytics_zoo_tpu", "zoo").replace(
+            ".", "_") + ".md"
+        with open(os.path.join(OUT, fname), "w") as f:
+            f.write("\n".join(page))
+        index.append(f"- [{title}]({fname}) — "
+                     f"{len(_public_names(mod))} public names")
+    with open(os.path.join(OUT, "index.md"), "w") as f:
+        f.write("\n".join(index) + "\n")
+    print(f"wrote {len(MODULES) + 1} pages -> {OUT}")
+    return 0
+
+
+if __name__ == "__main__":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    sys.exit(main())

@@ -1,0 +1,88 @@
+"""API-reference honesty: every public name exported via ``__all__``
+in a documented module appears in the committed generated docs
+(VERDICT r4 next-round #8 — "every public class in __all__s appears
+in rendered docs")."""
+
+import importlib
+import os
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_API = os.path.join(_ROOT, "docs", "APIGuide")
+
+
+def _gen_modules():
+    import sys
+    sys.path.insert(0, os.path.join(_ROOT, "scripts"))
+    try:
+        import gen_api_docs
+    finally:
+        sys.path.pop(0)
+    return gen_api_docs.MODULES
+
+
+def test_api_docs_exist_and_indexed():
+    assert os.path.isdir(_API), "run scripts/gen_api_docs.py"
+    index = open(os.path.join(_API, "index.md")).read()
+    for mod_path, title in _gen_modules():
+        fname = mod_path.replace("analytics_zoo_tpu", "zoo").replace(
+            ".", "_") + ".md"
+        assert os.path.exists(os.path.join(_API, fname)), fname
+        assert fname in index
+
+
+@pytest.mark.parametrize("mod_path,title", _gen_modules())
+def test_every_public_name_documented(mod_path, title):
+    mod = importlib.import_module(mod_path)
+    fname = mod_path.replace("analytics_zoo_tpu", "zoo").replace(
+        ".", "_") + ".md"
+    page = open(os.path.join(_API, fname)).read()
+    missing = [n for n in getattr(mod, "__all__", [])
+               if f"`{n}" not in page]
+    assert not missing, (
+        f"{mod_path}.__all__ names missing from docs/APIGuide/{fname} "
+        f"(regenerate with scripts/gen_api_docs.py): {missing}")
+
+
+def test_docs_cover_all_all_modules():
+    # every package module that declares __all__ is either documented
+    # or explicitly known-internal here
+    documented = {m for m, _ in _gen_modules()}
+    internal_ok = {
+        # datasets and onnx internals are reachable through their
+        # documented parents
+        "analytics_zoo_tpu.pipeline.api.keras.datasets",
+        "analytics_zoo_tpu.pipeline.api.onnx.helper",
+        "analytics_zoo_tpu.pipeline.api.onnx.onnx_loader",
+    }
+    undocumented = []
+    for dirpath, _dirs, files in os.walk(
+            os.path.join(_ROOT, "analytics_zoo_tpu")):
+        for f in files:
+            if not f.endswith(".py"):
+                continue
+            p = os.path.join(dirpath, f)
+            if "__all__" not in open(p, errors="ignore").read():
+                continue
+            rel = os.path.relpath(p, _ROOT)
+            mod = rel[:-3].replace(os.sep, ".")
+            if mod.endswith(".__init__"):
+                mod = mod[: -len(".__init__")]
+            if mod not in documented and mod not in internal_ok:
+                undocumented.append(mod)
+    assert not undocumented, (
+        f"modules with __all__ missing from scripts/gen_api_docs.py "
+        f"MODULES: {undocumented}")
+
+
+def test_keras1_layer_vocabulary_documented():
+    # the headline 116-layer vocabulary gets its own page with every
+    # name present (spot check beyond the generic parametrized test)
+    mod = importlib.import_module(
+        "analytics_zoo_tpu.pipeline.api.keras.layers")
+    page = open(os.path.join(
+        _API, "zoo_pipeline_api_keras_layers.md")).read()
+    missing = [n for n in mod.__all__ if f"`{n}" not in page]
+    assert not missing, missing
+    assert len(mod.__all__) >= 116

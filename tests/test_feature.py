@@ -98,6 +98,21 @@ def test_featureset_multi_output_labels(tmp_path):
     np.testing.assert_allclose(yl2[1], xb2 + 1)
 
 
+def test_featureset_scalar_list_labels_stay_single_column():
+    # regression: y as a plain Python list of per-sample scalars (or
+    # rows) predates multi-output support and must stay ONE label
+    # array, not be misread as N single-sample output columns
+    x = np.zeros((4, 2), np.float32)
+    fs = FeatureSet.array(x, [0, 1, 0, 1])
+    _, yb = next(iter(fs.iter_batches(4, shuffle=False)))
+    assert isinstance(yb, np.ndarray) and yb.shape == (4,)
+    fs2 = FeatureSet.array(x, [[0], [1], [0], [1]])
+    _, yb2 = next(iter(fs2.iter_batches(4, shuffle=False)))
+    assert isinstance(yb2, np.ndarray) and yb2.shape == (4, 1)
+    with pytest.raises(ValueError, match="empty label list"):
+        FeatureSet.array(x, [])
+
+
 def test_featureset_trains_with_estimator():
     from analytics_zoo_tpu import init_nncontext
     from analytics_zoo_tpu.pipeline.api.keras import Sequential, layers as L

@@ -545,6 +545,20 @@ def test_dtype_policy_resolution(monkeypatch):
         == "mixed_bfloat16"                        # arg beats env
 
 
+def test_rank_hinge_rejected_in_multi_output_loss_list():
+    # pairwise losses need whole-batch evaluation; the per-output
+    # decomposition can't provide it, so fail at construction
+    from analytics_zoo_tpu.pipeline import estimator as est_mod
+    from analytics_zoo_tpu.pipeline.api.keras import layers as L
+    from analytics_zoo_tpu.pipeline.api.keras.models import Sequential
+
+    m = Sequential()
+    m.add(L.Dense(1, input_shape=(2,)))
+    with pytest.raises(ValueError, match="rank_hinge"):
+        est_mod.Estimator(m, optimizer="sgd",
+                          loss=["rank_hinge", "mse"])
+
+
 def test_async_checkpoint_write(tmp_path, monkeypatch):
     """ZOO_TPU_ASYNC_CKPT=1: writes land on a background thread, are
     durable by train() return, and resume identically to sync."""
