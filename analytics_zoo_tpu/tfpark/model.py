@@ -170,6 +170,12 @@ class KerasModel:
             validation_data=None, distributed: bool = True, **kwargs):
         del distributed  # always mesh-parallel
         data, labels = self._unpack(x, y)
+        if (isinstance(validation_data, (tuple, list))
+                and len(validation_data) == 2):
+            # validation features/labels follow the same named-IO
+            # unpacking as the training data
+            validation_data = tuple(
+                self._unpack(*validation_data))
         result = self.estimator.train(
             data, labels, batch_size=batch_size, nb_epoch=epochs,
             validation_data=validation_data, **kwargs)
@@ -189,11 +195,31 @@ class KerasModel:
         data, _ = self._unpack(x, None)
         return self.estimator.predict(data, batch_size=batch_size)
 
-    @staticmethod
-    def _unpack(x, y):
+    def _unpack(self, x, y):
         from analytics_zoo_tpu.pipeline.api.net import TFDataset
         if isinstance(x, TFDataset):
             return x.feature_set, None
+        if isinstance(x, dict):
+            # dict features keyed by input-layer name (the tf.keras
+            # named-input contract / the reference's nested
+            # TensorMeta): reorder to the model's positional inputs
+            names = [t.name.split(":")[0] for t in self.model.inputs]
+            missing = [n for n in names if n not in x]
+            if missing:
+                raise KeyError(
+                    f"dict features missing model input(s) {missing}; "
+                    f"have {sorted(x)}")
+            x = [x[n] for n in names]
+        if isinstance(y, dict):
+            # dict labels keyed by output name, reordered to the
+            # model's positional outputs (multi-output training)
+            out_names = list(getattr(self.model, "output_names", []))
+            missing = [n for n in out_names if n not in y]
+            if not out_names or missing:
+                raise KeyError(
+                    f"dict labels must name every model output "
+                    f"{out_names or '?'}; have {sorted(y)}")
+            y = [y[n] for n in out_names]
         return x, y
 
     def _assign_back(self):

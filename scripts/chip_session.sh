@@ -35,6 +35,14 @@ done
 #    session before the profile step
 run timeout 420 python bench_bert.py
 
+# 4b. round-5 lever A/B: bf16-operand backward convs (the default)
+#     vs the round-4 f32-operand form — quantifies the recovered
+#     backward MXU rate on the fused path
+run env ZOO_TPU_BENCH_FUSED=1 ZOO_TPU_BENCH_NCF=0 \
+  ZOO_TPU_BENCH_BERT=0 python bench.py
+run env ZOO_TPU_CONV3_BWD_F32=1 ZOO_TPU_BENCH_FUSED=1 \
+  ZOO_TPU_BENCH_NCF=0 ZOO_TPU_BENCH_BERT=0 python bench.py
+
 # 5. profile capture of both variants for PERF.md
 ZOO_TPU_BENCH_PROFILE_DIR=/tmp/zoo_r4_profile ZOO_TPU_BENCH_NCF=0 run python bench.py
 

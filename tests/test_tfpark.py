@@ -179,6 +179,59 @@ def test_keras_model_two_input_two_output_fit(rng):
     assert isinstance(preds, (list, tuple)) and len(preds) == 2
 
 
+def test_keras_model_dict_features_by_input_name(rng):
+    """Dict features keyed by tf.keras input names route to the right
+    positional inputs (order-independent), completing the nested
+    TensorMeta contract alongside tuple features."""
+    from analytics_zoo_tpu import init_nncontext
+    from analytics_zoo_tpu.tfpark import KerasModel
+    init_nncontext(tpu_mesh={"data": 1}, devices=jax.devices("cpu")[:1])
+
+    ia = tf.keras.Input((4,), name="wide")
+    ib = tf.keras.Input((3,), name="deep")
+    out = tf.keras.layers.Dense(1, use_bias=False)(
+        tf.keras.layers.Concatenate()([ia, ib]))
+    model = tf.keras.Model([ia, ib], out)
+    km = KerasModel(model, optimizer="sgd", loss="mse")
+
+    xa = rng.randn(32, 4).astype(np.float32)
+    xb = rng.randn(32, 3).astype(np.float32)
+    y = (xa.sum(1, keepdims=True) - xb.sum(1, keepdims=True)
+         ).astype(np.float32)
+    # key order in the dict is NOT the input order — names decide;
+    # dict-shaped validation_data goes through the same unpacking
+    km.fit({"deep": xb, "wide": xa}, y, batch_size=16, epochs=2,
+           validation_data=({"deep": xb[:16], "wide": xa[:16]},
+                            y[:16]))
+    p_dict = km.predict({"deep": xb, "wide": xa}, batch_size=16)
+    p_list = km.predict([xa, xb], batch_size=16)
+    np.testing.assert_allclose(p_dict, p_list, rtol=1e-6)
+    with pytest.raises(KeyError, match="missing model input"):
+        km.predict({"wide": xa}, batch_size=16)
+
+
+def test_keras_model_dict_labels_by_output_name(rng):
+    from analytics_zoo_tpu import init_nncontext
+    from analytics_zoo_tpu.tfpark import KerasModel
+    init_nncontext(tpu_mesh={"data": 1}, devices=jax.devices("cpu")[:1])
+
+    ia = tf.keras.Input((4,))
+    ib = tf.keras.Input((3,))
+    oa = tf.keras.layers.Dense(1, use_bias=False, name="head_a")(ia)
+    ob = tf.keras.layers.Dense(1, use_bias=False, name="head_b")(ib)
+    model = tf.keras.Model([ia, ib], [oa, ob])
+    km = KerasModel(model, optimizer="sgd", loss=["mse", "mse"])
+    xa = rng.randn(32, 4).astype(np.float32)
+    xb = rng.randn(32, 3).astype(np.float32)
+    ya = xa.sum(1, keepdims=True).astype(np.float32)
+    yb = xb.sum(1, keepdims=True).astype(np.float32)
+    out_names = list(model.output_names)
+    km.fit([xa, xb], {out_names[1]: yb, out_names[0]: ya},
+           batch_size=16, epochs=1)
+    with pytest.raises(KeyError, match="dict labels"):
+        km.fit([xa, xb], {out_names[0]: ya}, batch_size=16, epochs=1)
+
+
 def test_keras_model_batchnorm_moving_stats_update(rng):
     # VERDICT r2 weak #4: BN moving averages must update through the
     # bridge like the reference's all-variables round-trip
