@@ -100,21 +100,23 @@ def measure(batch: int = 32, steps: int = 10, seq_len: int = 128,
     t_compile = time.perf_counter() - t0
 
     from bench_common import time_chain
-    dt, loss = time_chain(compiled, (params, opt_state, rngk))
+    dt, loss, rtt_bound = time_chain(
+        compiled, (params, opt_state, rngk), with_quality=True)
     samples_per_sec = batch * steps / dt
     print(f"# [bert] batch={batch} T={seq_len} hidden={hidden} "
           f"blocks={blocks} steps={steps} "
           f"step_time={dt / steps * 1000:.1f}ms loss={loss:.3f} "
-          f"compile={t_compile:.1f}s",
+          f"compile={t_compile:.1f}s rtt_bound={rtt_bound}",
           file=sys.stderr, flush=True)
-    return {
+    from bench_common import flag_rtt_bound
+    return flag_rtt_bound({
         "metric": metric,
         "value": round(samples_per_sec, 1),
         "unit": "samples/sec",
         "vs_baseline": None,
         "config": f"hidden={hidden} blocks={blocks} T={seq_len} "
                   f"batch={batch} bf16",
-    }
+    }, rtt_bound)
 
 
 def main():

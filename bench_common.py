@@ -16,6 +16,19 @@ import time
 import numpy as np
 
 
+RTT_BOUND_NOTE = ("rtt_bound: the constant dispatch round-trip "
+                  "dominates this chain; treat as a lower-confidence "
+                  "number")
+
+
+def flag_rtt_bound(rec: dict, rtt_bound: bool) -> dict:
+    """Attach the shared quality note to a metric record when the
+    measurement was round-trip-dominated (see time_chain)."""
+    if rtt_bound:
+        rec["quality"] = RTT_BOUND_NOTE
+    return rec
+
+
 def dispatch_overhead(samples: int = 5) -> float:
     """Constant per-dispatch round-trip cost, min over ``samples``."""
     import jax
@@ -32,10 +45,17 @@ def dispatch_overhead(samples: int = 5) -> float:
     return overhead
 
 
-def time_chain(compiled, args, reps: int = 3):
+def time_chain(compiled, args, reps: int = 3,
+               with_quality: bool = False):
     """Best wall time of ``compiled(*args)`` (last output = scalar
     loss fetched to host as the sync point) minus the dispatch
-    overhead. Returns ``(dt_seconds, last_loss)``."""
+    overhead. Returns ``(dt_seconds, last_loss)`` — or with
+    ``with_quality=True``, ``(dt, loss, rtt_bound)`` where
+    ``rtt_bound`` flags a measurement the constant round-trip
+    overhead dominates (dt after subtraction is under half the raw
+    wall time — e.g. a sub-10ms chain over the ~66ms axon tunnel):
+    such numbers are jitter, not throughput, and callers should
+    label them or lengthen the chain."""
     def timed():
         t0 = time.perf_counter()
         out = compiled(*args)
@@ -52,4 +72,7 @@ def time_chain(compiled, args, reps: int = 3):
     for _ in range(reps):
         dt_i, loss = timed()
         best_dt = dt_i if best_dt is None else min(best_dt, dt_i)
-    return max(best_dt - overhead, 1e-9), loss
+    dt = max(best_dt - overhead, 1e-9)
+    if with_quality:
+        return dt, loss, dt < 0.5 * best_dt
+    return dt, loss

@@ -92,18 +92,20 @@ def measure(batch: int = 8192, steps: int = 20,
     t_compile = time.perf_counter() - t0
 
     from bench_common import time_chain
-    dt, loss = time_chain(compiled, (params, opt_state, x, y))
+    dt, loss, rtt_bound = time_chain(
+        compiled, (params, opt_state, x, y), with_quality=True)
     samples_per_sec = batch * steps / dt
     print(f"# [ncf] batch={batch} steps={steps} "
           f"step_time={dt / steps * 1e6:.0f}us loss={loss:.3f} "
-          f"compile={t_compile:.1f}s",
+          f"compile={t_compile:.1f}s rtt_bound={rtt_bound}",
           file=sys.stderr, flush=True)
-    return {
+    from bench_common import flag_rtt_bound
+    return flag_rtt_bound({
         "metric": metric,
         "value": round(samples_per_sec, 1),
         "unit": "samples/sec",
         "vs_baseline": None,
-    }
+    }, rtt_bound)
 
 
 def main():
