@@ -5,7 +5,7 @@
 
 IMAGE ?= analytics-zoo-tpu
 
-.PHONY: test docker-build docker-test docker-test-spark dist docs
+.PHONY: test docker-build docker-test docker-test-spark dist docs lint
 
 test:
 	python -m pytest tests/ -x -q
@@ -32,3 +32,6 @@ docs:
 
 dist:
 	bash scripts/make-dist.sh
+
+lint:
+	python scripts/lint.py

@@ -22,7 +22,8 @@ _ENV_PREFIX = "ZOO_TPU_"
 
 @dataclass(frozen=True)
 class ZooBuildInfo:
-    """Build/version info (analog of `ZooBuildInfo`, NNContext.scala:78-118)."""
+    """Build/version info (analog of `ZooBuildInfo`,
+    NNContext.scala:78-118)."""
 
     version: str
     python_version: str = field(

@@ -14,7 +14,7 @@ from __future__ import annotations
 import glob as _glob
 import os
 import shutil
-from typing import List, Optional
+from typing import List
 
 from analytics_zoo_tpu.common.nncontext import logger
 

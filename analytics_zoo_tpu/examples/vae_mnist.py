@@ -1,5 +1,6 @@
 """Variational autoencoder example (reference app
-`apps/variational-autoencoder/using_variational_autoencoder_to_generate_digital_numbers.ipynb`,
+`apps/variational-autoencoder/
+using_variational_autoencoder_to_generate_digital_numbers.ipynb`,
 which builds VAE from BigDL `GaussianSampler`/`KLDCriterion`).
 
 TPU-first redesign: the reparameterization trick and the ELBO are

@@ -13,8 +13,7 @@ TensorToSample()``.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Callable, Iterable, Iterator, List, Optional, \
-    Sequence, Tuple
+from typing import Any, Callable, Iterable, Iterator, Optional, Sequence
 
 import numpy as np
 

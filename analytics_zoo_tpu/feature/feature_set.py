@@ -23,15 +23,12 @@ The native C arena allocator behind the PMEM tier lives in
 from __future__ import annotations
 
 import enum
-import math
 import os
 import tempfile
-from typing import Any, Callable, Iterable, Iterator, List, Optional, \
-    Sequence, Tuple, Union
+from typing import Any, Iterable, Iterator, Optional, Tuple
 
 import numpy as np
 
-from analytics_zoo_tpu.common.nncontext import logger
 from analytics_zoo_tpu.feature.common import Preprocessing, Sample
 
 

@@ -29,7 +29,7 @@ derived: appending ops preserves earlier ops' randomness).
 
 from __future__ import annotations
 
-from typing import Callable, Optional, Sequence, Tuple
+from typing import Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp

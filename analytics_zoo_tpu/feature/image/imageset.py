@@ -11,8 +11,7 @@ from __future__ import annotations
 
 import io
 import logging
-import os
-from typing import Any, Callable, Iterable, Iterator, List, Optional
+from typing import Optional
 
 import numpy as np
 

@@ -8,7 +8,7 @@ anything per-batch and differentiable can instead run on-device in JAX.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Sequence
 
 import numpy as np
 

@@ -18,7 +18,7 @@ executors' CPUs too; TPU time is reserved for the model).
 from __future__ import annotations
 
 import math
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Sequence
 
 import numpy as np
 

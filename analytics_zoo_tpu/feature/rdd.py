@@ -27,13 +27,10 @@ cross-host traffic beyond what Spark itself does.
 from __future__ import annotations
 
 import itertools
-from typing import Any, Callable, Iterable, Iterator, List, Optional, \
-    Sequence, Tuple
+from typing import Any, Callable, Iterable, Iterator, Optional
 
-import numpy as np
 
 from analytics_zoo_tpu.common.nncontext import logger
-from analytics_zoo_tpu.feature.common import Preprocessing, Sample
 
 
 def process_shard_spec() -> "tuple[int, int]":

@@ -7,7 +7,6 @@ from __future__ import annotations
 
 import csv
 from dataclasses import dataclass
-from typing import Iterator, List, Optional
 
 import numpy as np
 
@@ -45,7 +44,8 @@ class Relations:
 
     @staticmethod
     def generate_relation_pairs(relations: "list[Relation]",
-                                seed: int = 0) -> "list[tuple[Relation, Relation]]":
+                                seed: int = 0
+                                ) -> "list[tuple[Relation, Relation]]":
         """(positive, negative) pairs per id1 — the training layout for
         `rank_hinge` loss (reference `TextSet.fromRelationPairs`)."""
         rng = np.random.RandomState(seed)

@@ -4,9 +4,8 @@ pipeline."""
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Optional
 
-import numpy as np
 
 
 class TextFeature(dict):

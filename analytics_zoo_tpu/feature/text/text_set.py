@@ -9,13 +9,13 @@ from __future__ import annotations
 import csv
 import os
 from collections import Counter
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, Optional, Sequence
 
 import numpy as np
 
-from analytics_zoo_tpu.feature.common import Sample
 from analytics_zoo_tpu.feature.feature_set import FeatureSet
-from analytics_zoo_tpu.feature.text.relations import Relation, Relations
+from analytics_zoo_tpu.feature.text.relations import (Relation,
+                                                      Relations)
 from analytics_zoo_tpu.feature.text.text_feature import TextFeature
 from analytics_zoo_tpu.feature.text.transforms import (
     Normalizer, SequenceShaper, TextFeatureToSample, Tokenizer,
@@ -169,9 +169,10 @@ class TextSet:
         return (np.asarray(rows1, np.int32), np.asarray(rows2, np.int32))
 
     @staticmethod
-    def from_relation_lists(relations: "list[Relation]",
-                            corpus1: "TextSet", corpus2: "TextSet"
-                            ) -> "tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]":
+    def from_relation_lists(
+            relations: "list[Relation]", corpus1: "TextSet",
+            corpus2: "TextSet"
+    ) -> "tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]":
         """→ (x1, x2, labels, group_ids) flattened candidate lists for
         NDCG/MAP evaluation (reference `TextSet.fromRelationLists:502`)."""
         t1 = {f[TextFeature.URI]: f.indices for f in corpus1.features}

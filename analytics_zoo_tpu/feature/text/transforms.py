@@ -4,8 +4,6 @@ WordIndexer,SequenceShaper,TextFeatureToSample}.scala`)."""
 from __future__ import annotations
 
 import re
-import string
-from typing import Dict, Optional, Sequence
 
 import numpy as np
 

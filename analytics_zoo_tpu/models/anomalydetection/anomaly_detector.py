@@ -6,7 +6,7 @@ threshold-based `detect_anomalies`."""
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import List, Optional, Sequence, Tuple
+from typing import Sequence
 
 import numpy as np
 

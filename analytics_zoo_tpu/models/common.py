@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import os
 import pickle
-from typing import Any, Callable, List, Optional, Sequence, Tuple
+from typing import Optional
 
 import numpy as np
 

@@ -11,7 +11,6 @@ TPU-first choices:
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -21,8 +20,9 @@ from analytics_zoo_tpu.ops import initializers
 from analytics_zoo_tpu.pipeline.api.keras.engine import Input, KerasLayer
 from analytics_zoo_tpu.pipeline.api.keras.models import Model
 from analytics_zoo_tpu.pipeline.api.keras.layers import (
-    Activation, AveragePooling2D, BatchNormalization, Convolution2D, Dense,
-    Flatten, GlobalAveragePooling2D, Add, MaxPooling2D, ZeroPadding2D)
+    Activation, BatchNormalization, Convolution2D, Dense,
+    GlobalAveragePooling2D, Add, MaxPooling2D,
+)
 
 
 def conv_bn(x, filters, kernel, stride=1, activation="relu",

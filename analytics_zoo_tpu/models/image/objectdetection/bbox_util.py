@@ -11,11 +11,10 @@ Box format: (x_min, y_min, x_max, y_max), normalized [0, 1].
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 # SSD/Caffe variance defaults (BboxUtil encode/decode variances)
 DEFAULT_VARIANCES = (0.1, 0.1, 0.2, 0.2)

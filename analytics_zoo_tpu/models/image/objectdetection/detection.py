@@ -8,7 +8,7 @@ and `Visualizer.scala:29` (draw labeled boxes on images).
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import List, Optional, Sequence
+from typing import Sequence
 
 import numpy as np
 

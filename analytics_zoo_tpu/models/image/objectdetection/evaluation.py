@@ -7,7 +7,6 @@ MeanAveragePrecision.scala:31` and `PascalVocEvaluator.scala:33`
 
 from __future__ import annotations
 
-from typing import Dict, List, Sequence, Tuple
 
 import numpy as np
 

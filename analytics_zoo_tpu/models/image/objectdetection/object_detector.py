@@ -12,14 +12,15 @@ from __future__ import annotations
 import json
 import os
 import xml.etree.ElementTree as ET
-from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Tuple
+from dataclasses import dataclass
+from typing import Optional, Sequence
 
 import numpy as np
 
 from analytics_zoo_tpu.models.common import ZooModel
 from analytics_zoo_tpu.models.image.objectdetection.detection import (
-    Detection, DetectionOutput)
+    DetectionOutput,
+)
 from analytics_zoo_tpu.models.image.objectdetection.multibox_loss \
     import MultiBoxLoss
 from analytics_zoo_tpu.models.image.objectdetection.ssd import SSDVGG

@@ -11,9 +11,8 @@ the Estimator's single-output loss contract applies
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Tuple
+from typing import Tuple
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -22,7 +21,8 @@ from analytics_zoo_tpu.pipeline.api.keras.engine import (
     Input, KerasLayer, Shape)
 from analytics_zoo_tpu.pipeline.api.keras.models import Model
 from analytics_zoo_tpu.pipeline.api.keras.layers import (
-    Concatenate, Convolution2D, MaxPooling2D, ZeroPadding2D)
+    Concatenate, Convolution2D, MaxPooling2D,
+)
 from analytics_zoo_tpu.models.image.objectdetection.prior_box import (
     SSD300_SPECS, generate_ssd_priors, num_priors_per_cell)
 

@@ -12,7 +12,7 @@ samples/sec headline number in BASELINE.json benches this model.
 
 from __future__ import annotations
 
-from typing import List, Sequence
+from typing import Sequence
 
 from analytics_zoo_tpu.models.recommendation.recommender import Recommender
 from analytics_zoo_tpu.pipeline.api.keras.engine import Input

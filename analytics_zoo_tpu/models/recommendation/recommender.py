@@ -6,7 +6,7 @@ features)."""
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Iterable, List, Optional
+from typing import Any
 
 import numpy as np
 

@@ -18,7 +18,7 @@ Output: log-probabilities over `num_classes` (LogSoftMax parity).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import List, Sequence
+from typing import Sequence
 
 from analytics_zoo_tpu.models.recommendation.recommender import Recommender
 from analytics_zoo_tpu.pipeline.api.keras.engine import Input

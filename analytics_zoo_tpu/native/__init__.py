@@ -1,4 +1,5 @@
-"""ctypes bindings for the native runtime (`analytics_zoo_tpu/native/src/*.cpp`).
+"""ctypes bindings for the native runtime
+(`analytics_zoo_tpu/native/src/*.cpp`).
 
 The reference ships native code as JNI `.so`s in `zoo-core-dist-all`
 (SURVEY.md §2.11); here the C++ ships as package data (`native/src/`) and is

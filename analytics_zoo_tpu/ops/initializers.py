@@ -7,7 +7,7 @@ functions. (reference: `Z/pipeline/api/keras/layers/Dense.scala` `init` arg.)
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Callable
 
 import jax
 import jax.numpy as jnp

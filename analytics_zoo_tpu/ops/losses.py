@@ -11,7 +11,7 @@ batch compiles to a cross-device all-reduce automatically.
 
 from __future__ import annotations
 
-from typing import Callable, Union
+from typing import Callable
 
 import jax
 import jax.numpy as jnp

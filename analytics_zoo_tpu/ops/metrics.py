@@ -13,7 +13,7 @@ BigDL's ValidationMethod accumulates `ValidationResult`s.
 
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Optional, Union
+from typing import Callable
 
 import jax
 import jax.numpy as jnp

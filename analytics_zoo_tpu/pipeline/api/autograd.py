@@ -16,7 +16,7 @@ dims. Reducing over the batch axis inside a graph is not supported.
 
 from __future__ import annotations
 
-from typing import Any, Callable, List, Optional, Sequence, Union
+from typing import Callable, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp

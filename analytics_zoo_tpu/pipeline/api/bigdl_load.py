@@ -24,7 +24,6 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from analytics_zoo_tpu.common.nncontext import logger
 from analytics_zoo_tpu.pipeline.api import bigdl_pb as pb
 
 

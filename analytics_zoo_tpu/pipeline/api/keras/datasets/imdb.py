@@ -10,7 +10,6 @@ follow the reference's truncation contract (`imdb.py:40-76`).
 from __future__ import annotations
 
 import os
-import pickle
 
 import numpy as np
 

@@ -14,7 +14,8 @@ import os
 import numpy as np
 
 from analytics_zoo_tpu.pipeline.api.keras.datasets._base import (
-    DEFAULT_DIR, cache_path, synthetic_notice)
+    DEFAULT_DIR, synthetic_notice,
+)
 
 TRAIN_MEAN = 0.13066047740239506 * 255
 TRAIN_STD = 0.3081078 * 255

@@ -26,7 +26,7 @@ from __future__ import annotations
 
 import itertools
 import threading
-from typing import Any, Callable, List, Optional, Sequence, Tuple, Union
+from typing import List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp

@@ -14,15 +14,13 @@ All convs lower to `lax.conv_general_dilated`, which XLA maps onto the MXU.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from analytics_zoo_tpu.ops import activations, initializers, regularizers
-from analytics_zoo_tpu.pipeline.api.keras.engine import (
-    KerasLayer, Shape, as_shape)
+from analytics_zoo_tpu.pipeline.api.keras.engine import KerasLayer, Shape
 
 
 def _norm_tuple(v, n, name):

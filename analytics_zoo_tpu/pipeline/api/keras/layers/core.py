@@ -8,16 +8,13 @@ ops fuse into neighbors.
 
 from __future__ import annotations
 
-import math
-from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from analytics_zoo_tpu.ops import activations, initializers, regularizers
-from analytics_zoo_tpu.pipeline.api.keras.engine import (
-    KerasLayer, Shape, ShapeLike, as_shape)
+from analytics_zoo_tpu.pipeline.api.keras.engine import KerasLayer, Shape
 
 
 class Dense(KerasLayer):

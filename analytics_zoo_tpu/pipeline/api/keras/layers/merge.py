@@ -7,7 +7,6 @@ Average/Maximum/Minimum/Concatenate aliases.
 
 from __future__ import annotations
 
-from typing import List, Optional
 
 import jax.numpy as jnp
 

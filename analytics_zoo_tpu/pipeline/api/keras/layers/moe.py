@@ -25,7 +25,6 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from analytics_zoo_tpu.ops import activations, initializers
 from analytics_zoo_tpu.pipeline.api.keras.engine import KerasLayer, Shape

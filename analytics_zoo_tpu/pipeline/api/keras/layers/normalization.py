@@ -15,7 +15,6 @@ their slice), and is strictly more accurate (syncBN semantics).
 
 from __future__ import annotations
 
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -8,7 +8,6 @@ fuses with adjacent convs.
 
 from __future__ import annotations
 
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -13,7 +13,6 @@ T small ones. No Python loops are traced.
 from __future__ import annotations
 
 import copy
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

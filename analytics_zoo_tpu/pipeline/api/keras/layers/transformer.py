@@ -20,12 +20,10 @@ TPU-first redesign:
 
 from __future__ import annotations
 
-import math
-from typing import List, Optional, Sequence, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from analytics_zoo_tpu.ops.attention import (dot_product_attention,
                                              resolve_attention_impl)

@@ -8,23 +8,15 @@ init with Keras-style shape-inference chaining, pure forward, summary.
 
 from __future__ import annotations
 
-from typing import Any, List, Optional, Sequence, Union
+from typing import Any, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from analytics_zoo_tpu.pipeline.api.keras.engine import (
-    KerasLayer,
-    Shape,
-    ShapeLike,
-    Variable,
-    _InputLayer,
-    as_shape,
-    collect_layers,
-    is_multi_shape,
-    topological_order,
-    unique_name,
+    KerasLayer, ShapeLike, Variable, _InputLayer,
+    collect_layers, topological_order, unique_name,
 )
 
 

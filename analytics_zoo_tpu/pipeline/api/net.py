@@ -27,11 +27,11 @@ exports its frozen TF graph straight to XLA HLO"):
 
 from __future__ import annotations
 
-from typing import Any, Callable, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Optional, Sequence
 
 import numpy as np
 
-from analytics_zoo_tpu.common.nncontext import get_nncontext, logger
+from analytics_zoo_tpu.common.nncontext import get_nncontext
 from analytics_zoo_tpu.feature.feature_set import FeatureSet
 
 

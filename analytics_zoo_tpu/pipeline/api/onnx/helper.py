@@ -7,7 +7,7 @@ graphs. Mirrors the surface the reference's ONNX backend tests rely on
 
 from __future__ import annotations
 
-from typing import Any, Dict, Iterable, List, Optional, Sequence
+from typing import Any, Optional, Sequence
 
 import numpy as np
 

@@ -15,7 +15,7 @@ read and write, which the test-suite uses to fabricate golden models.
 from __future__ import annotations
 
 import struct
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, Tuple
 
 import numpy as np
 

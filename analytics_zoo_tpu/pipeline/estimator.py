@@ -18,14 +18,12 @@ gradient clipping mirror the reference's training features (SURVEY.md §5).
 
 from __future__ import annotations
 
-import contextlib
 import logging
 import os
 import pickle
 import time
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple, \
-    Union
+from typing import Any, Callable, List, Optional
 
 import jax
 import jax.numpy as jnp

@@ -22,12 +22,12 @@ from __future__ import annotations
 import json
 import threading
 import zipfile
-from typing import Any, Callable, List, Optional, Sequence, Tuple
+from typing import Callable, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 
-from analytics_zoo_tpu.common.nncontext import get_nncontext, logger
+from analytics_zoo_tpu.common.nncontext import logger
 from analytics_zoo_tpu.native import make_serving_queue
 
 _ARTIFACT_VERSION = 1

@@ -31,7 +31,7 @@ TPU-honest equivalents are bf16 serving + int8 Dense layers.
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List
 
 import jax
 import jax.numpy as jnp

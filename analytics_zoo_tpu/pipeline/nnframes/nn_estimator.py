@@ -17,15 +17,13 @@ from __future__ import annotations
 
 import os
 import pickle
-from typing import Any, Callable, List, Optional, Sequence
+from typing import Callable, Optional
 
 import numpy as np
 import pandas as pd
 
 from analytics_zoo_tpu.common.nncontext import get_nncontext
-from analytics_zoo_tpu.feature.common import (
-    ChainedPreprocessing, FeatureLabelPreprocessing, Preprocessing,
-    Sample, SeqToTensor)
+from analytics_zoo_tpu.feature.common import Preprocessing, Sample
 from analytics_zoo_tpu.feature.feature_set import FeatureSet
 from analytics_zoo_tpu.pipeline.estimator import Estimator, Trigger
 

@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import io
 import logging
-from typing import List, Optional
+from typing import List
 
 import numpy as np
 import pandas as pd

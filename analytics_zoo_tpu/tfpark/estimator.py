@@ -12,7 +12,7 @@ is just the model's last output) maps to an identity loss function.
 
 from __future__ import annotations
 
-from typing import Any, Callable, List, Optional, Sequence
+from typing import Callable, Optional
 
 import numpy as np
 

@@ -20,7 +20,7 @@ updates are dropped with a warning.
 
 from __future__ import annotations
 
-from typing import Any, List, Optional, Sequence
+from typing import List, Optional
 
 import numpy as np
 

@@ -28,7 +28,7 @@ Rewrite steps (see `make_explicit_fn`):
 
 from __future__ import annotations
 
-from typing import Any, Callable, List, Optional, Sequence, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 

@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Dependency-free style gate (reference analog:
+`pyzoo/dev/lint-python` / scalastyle — SURVEY.md §4.9). The image
+ships no flake8/ruff, so this covers the high-signal subset with
+stdlib ast:
+
+- files must parse (syntax);
+- no tabs in indentation, no trailing whitespace;
+- line length <= 79 (reference pep8 default); URLs and noqa exempt;
+- unused `import x` / `from x import y` at module top level
+  (skipped in `__init__.py` re-export hubs, for names in `__all__`,
+  and on lines carrying a `# noqa` comment).
+
+Run: `python scripts/lint.py` (exit 1 on findings). `make lint`.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TARGETS = ["analytics_zoo_tpu", "tests", "scripts", "apps",
+           "bench.py", "bench_ncf.py", "bench_bert.py",
+           "bench_common.py", "__graft_entry__.py"]
+MAX_LEN = 79
+
+
+def _py_files():
+    for t in TARGETS:
+        p = os.path.join(ROOT, t)
+        if os.path.isfile(p):
+            yield p
+        else:
+            for dirpath, _dirs, files in os.walk(p):
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(dirpath, f)
+
+
+def _used_names(tree: ast.AST) -> set:
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            base = node
+            while isinstance(base, ast.Attribute):
+                base = base.value
+            if isinstance(base, ast.Name):
+                used.add(base.id)
+    return used
+
+
+def _string_mentions(tree: ast.AST) -> set:
+    """Names referenced from string ANNOTATIONS and ``__all__``
+    entries only — mining every string constant would whitelist any
+    identifier a docstring happens to mention and mask genuinely
+    unused imports."""
+    out = set()
+
+    def mine(value: str):
+        for tok in (value.replace(".", " ").replace("[", " ")
+                    .replace("]", " ").replace(",", " ").split()):
+            if tok.isidentifier():
+                out.add(tok)
+
+    def mine_ann(ann):
+        if ann is None:
+            return
+        for node in ast.walk(ann):
+            if isinstance(node, ast.Constant) and isinstance(
+                    node.value, str):
+                mine(node.value)
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            mine_ann(node.returns)
+            a = node.args
+            for arg in (a.posonlyargs + a.args + a.kwonlyargs
+                        + ([a.vararg] if a.vararg else [])
+                        + ([a.kwarg] if a.kwarg else [])):
+                mine_ann(arg.annotation)
+        elif isinstance(node, ast.AnnAssign):
+            mine_ann(node.annotation)
+        elif isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "__all__" \
+                        and isinstance(node.value,
+                                       (ast.List, ast.Tuple)):
+                    for el in node.value.elts:
+                        if isinstance(el, ast.Constant) and \
+                                isinstance(el.value, str):
+                            out.add(el.value)
+    return out
+
+
+def check_file(path: str) -> list:
+    rel = os.path.relpath(path, ROOT)
+    try:
+        src = open(path, encoding="utf-8").read()
+    except UnicodeDecodeError:
+        return [f"{rel}: not utf-8"]
+    problems = []
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [f"{rel}:{e.lineno}: syntax error: {e.msg}"]
+    for i, line in enumerate(src.splitlines(), 1):
+        if line != line.rstrip():
+            problems.append(f"{rel}:{i}: trailing whitespace")
+        if "\t" in line:
+            problems.append(f"{rel}:{i}: tab character")
+        if (len(line) > MAX_LEN and "noqa" not in line
+                and "http://" not in line and "https://" not in line):
+            problems.append(
+                f"{rel}:{i}: line too long ({len(line)} > {MAX_LEN})")
+    if os.path.basename(path) != "__init__.py":
+        used = _used_names(tree) | _string_mentions(tree)
+        lines = src.splitlines()
+        for node in tree.body:  # top level only: locals are fine
+            names = []
+            if isinstance(node, ast.Import):
+                names = [(a.asname or a.name.split(".")[0], a.name)
+                         for a in node.names]
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "__future__" or any(
+                        a.name == "*" for a in node.names):
+                    continue
+                names = [(a.asname or a.name, a.name)
+                         for a in node.names]
+            for bound, orig in names:
+                line = lines[node.lineno - 1] if \
+                    node.lineno <= len(lines) else ""
+                if "noqa" in line:
+                    continue
+                if bound not in used:
+                    problems.append(
+                        f"{rel}:{node.lineno}: unused import "
+                        f"'{orig}' (as '{bound}')")
+    return problems
+
+
+def main() -> int:
+    all_problems = []
+    n = 0
+    for path in _py_files():
+        n += 1
+        all_problems.extend(check_file(path))
+    for p in all_problems:
+        print(p)
+    print(f"# linted {n} files: "
+          f"{'OK' if not all_problems else f'{len(all_problems)} problems'}",
+          file=sys.stderr)
+    return 1 if all_problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

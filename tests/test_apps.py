@@ -5,7 +5,6 @@ import os
 import runpy
 import sys
 
-import pytest
 
 _APPS = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "apps")

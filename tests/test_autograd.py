@@ -4,7 +4,7 @@ import numpy as np
 import pytest
 
 from analytics_zoo_tpu.pipeline.api import autograd as A
-from analytics_zoo_tpu.pipeline.api.keras import Input, Model, layers as L
+from analytics_zoo_tpu.pipeline.api.keras import Input, Model
 
 
 def _model(inputs, outputs):

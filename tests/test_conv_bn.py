@@ -967,9 +967,11 @@ def test_conv3x3_bn_bf16_backward_runs_bf16_operands(stride, rng):
         e for e in convs
         if all(v.aval.dtype == jnp.bfloat16 for v in e.invars)
         and e.params.get("preferred_element_type") == jnp.float32]
+    conv_summary = [
+        (tuple(str(v.aval.dtype) for v in e.invars),
+         e.params.get("preferred_element_type")) for e in convs]
     assert len(bf16_to_f32) >= 2, \
-        f"backward convs not bf16-operand/f32-acc: " \
-        f"{[(tuple(str(v.aval.dtype) for v in e.invars), e.params.get('preferred_element_type')) for e in convs]}"
+        f"backward convs not bf16-operand/f32-acc: {conv_summary}"
 
 
 @pytest.mark.parametrize("stride", [1, 2])

@@ -3,7 +3,6 @@ example mains exercised in CI, SURVEY.md §2.12 L12)."""
 
 import importlib.util
 import os
-import sys
 
 import numpy as np
 import pytest

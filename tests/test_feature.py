@@ -8,8 +8,9 @@ import numpy as np
 import pytest
 
 from analytics_zoo_tpu.feature import (
-    ArrayToTensor, FeatureSet, MemoryType, Sample, ScalarToTensor,
-    SeqToTensor, TensorToSample, FeatureLabelPreprocessing)
+    FeatureSet, MemoryType, Sample, ScalarToTensor, SeqToTensor,
+    TensorToSample, FeatureLabelPreprocessing,
+)
 from analytics_zoo_tpu.feature.image import (
     ImageCenterCrop, ImageChannelNormalize, ImageFeature, ImageHFlip,
     ImageMatToTensor, ImageRandomCrop, ImageResize, ImageSet,

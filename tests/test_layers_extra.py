@@ -6,7 +6,6 @@ with torch/numpy as the oracle)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 import torch
 import torch.nn.functional as F
 

@@ -5,13 +5,12 @@ the golden role since TF/keras is not in the image). Tolerance 1e-5 f32.
 """
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 import torch
 import torch.nn.functional as F
 
-from analytics_zoo_tpu.pipeline.api.keras import Sequential, layers as L
+from analytics_zoo_tpu.pipeline.api.keras import layers as L
 
 RTOL, ATOL = 1e-5, 1e-5
 

@@ -1,0 +1,16 @@
+"""Style gate (reference analog: `pyzoo/dev/lint-python` +
+scalastyle — SURVEY.md §4.9): the dependency-free linter must pass
+over the whole repo."""
+
+import os
+import subprocess
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_lint_clean():
+    out = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "scripts", "lint.py")],
+        capture_output=True, text=True, timeout=300, cwd=_ROOT)
+    assert out.returncode == 0, out.stdout[-4000:]

@@ -8,8 +8,7 @@ import pytest
 from analytics_zoo_tpu import init_nncontext
 from analytics_zoo_tpu.models.anomalydetection import AnomalyDetector
 from analytics_zoo_tpu.models.common import Ranker, ZooModel
-from analytics_zoo_tpu.models.image.imageclassification import (
-    ImageClassifier, lenet5)
+from analytics_zoo_tpu.models.image.imageclassification import ImageClassifier
 from analytics_zoo_tpu.models.recommendation import (
     ColumnFeatureInfo, NeuralCF, UserItemFeature, WideAndDeep)
 from analytics_zoo_tpu.models.seq2seq import (

@@ -6,9 +6,10 @@ import pytest
 
 from analytics_zoo_tpu import init_nncontext
 from analytics_zoo_tpu.models.image.objectdetection import (
-    DetectionOutput, MeanAveragePrecision, MultiBoxLoss, PriorBoxSpec,
-    SSDVGG, clip_boxes, decode_boxes, encode_boxes, generate_ssd_priors,
-    iou_matrix, match_priors, nms)
+    DetectionOutput, MeanAveragePrecision, MultiBoxLoss,
+    PriorBoxSpec, decode_boxes, encode_boxes, generate_ssd_priors,
+    iou_matrix, match_priors, nms,
+)
 from analytics_zoo_tpu.models.image.objectdetection.detection import (
     Detection, Visualizer)
 from analytics_zoo_tpu.models.image.objectdetection.prior_box import (

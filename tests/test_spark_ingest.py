@@ -25,7 +25,7 @@ from analytics_zoo_tpu import init_nncontext
 from analytics_zoo_tpu.feature import (FeatureSet, LocalRdd, Sample,
                                        collect_shard, is_rdd_like)
 from analytics_zoo_tpu.pipeline.api.keras import Sequential, layers as L
-from analytics_zoo_tpu.pipeline.nnframes import NNClassifier, NNEstimator
+from analytics_zoo_tpu.pipeline.nnframes import NNClassifier
 
 
 def _small_model(in_dim=4, classes=3):

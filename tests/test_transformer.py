@@ -5,7 +5,6 @@ attention through the full layer."""
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from analytics_zoo_tpu import init_nncontext
 from analytics_zoo_tpu.pipeline.api.keras import Sequential, layers as L
