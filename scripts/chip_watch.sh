@@ -7,14 +7,25 @@ cd "$(dirname "$0")/.." || exit 1
 exec 9>/tmp/chip_session.lock
 flock -n 9 || { echo "another chip_watch holds the lock"; exit 1; }
 HIST=/tmp/chip_probe_history.log
+# keep watching after a session: the tunnel flaps, and a later live
+# window can re-bank or extend what a half-completed session got.
+# 30-min cooldown between sessions so a stable chip doesn't loop the
+# same measurements forever.
+LAST_SESSION=0
 while true; do
   if timeout 150 python bench.py --probe >/tmp/chip_probe.out 2>&1 \
       && grep -q PROBE_OK /tmp/chip_probe.out; then
-    echo "$(date +%H:%M:%S) PROBE_OK — starting chip session" >> "$HIST"
-    bash scripts/chip_session.sh
-    echo "$(date +%H:%M:%S) chip session finished rc=$?" >> "$HIST"
-    exit 0
+    NOW=$(date +%s)
+    if [ $((NOW - LAST_SESSION)) -ge 1800 ]; then
+      echo "$(date +%H:%M:%S) PROBE_OK — starting chip session" >> "$HIST"
+      bash scripts/chip_session.sh
+      echo "$(date +%H:%M:%S) chip session finished rc=$?" >> "$HIST"
+      LAST_SESSION=$(date +%s)
+    else
+      echo "$(date +%H:%M:%S) PROBE_OK (cooldown)" >> "$HIST"
+    fi
+  else
+    echo "$(date +%H:%M:%S) probe failed" >> "$HIST"
   fi
-  echo "$(date +%H:%M:%S) probe failed" >> "$HIST"
   sleep 170
 done
