@@ -5,10 +5,16 @@
 
 IMAGE ?= analytics-zoo-tpu
 
-.PHONY: test docker-build docker-test docker-test-spark dist docs lint
+.PHONY: test docker-build docker-test docker-test-spark dist docs \
+    lint obs-smoke
 
 test:
 	python -m pytest tests/ -x -q
+
+# telemetry end-to-end: 2 train steps + 1 served request, then assert
+# the /metrics exposition carries every layer (docs/observability.md)
+obs-smoke:
+	JAX_PLATFORMS=cpu python scripts/obs_smoke.py
 
 docker-build:
 	docker build -t $(IMAGE) -f docker/Dockerfile .

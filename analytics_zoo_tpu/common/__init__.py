@@ -5,8 +5,21 @@ from analytics_zoo_tpu.common.nncontext import (
     ZooTpuConf,
 )
 from analytics_zoo_tpu.common.config import ZooBuildInfo
-from analytics_zoo_tpu.common import dictionary, safe_pickle, utils
+from analytics_zoo_tpu.common import (
+    dictionary, observability, safe_pickle, utils)
 from analytics_zoo_tpu.common.dictionary import ZooDictionary
+from analytics_zoo_tpu.common.observability import (
+    MetricsRegistry,
+    counter,
+    gauge,
+    histogram,
+    span,
+    event,
+    snapshot,
+    to_prometheus,
+    get_registry,
+    reset_metrics,
+)
 from analytics_zoo_tpu.common.safe_pickle import checked_load
 
 __all__ = [
@@ -16,8 +29,19 @@ __all__ = [
     "ZooTpuConf",
     "ZooBuildInfo",
     "ZooDictionary",
+    "MetricsRegistry",
+    "counter",
+    "gauge",
+    "histogram",
+    "span",
+    "event",
+    "snapshot",
+    "to_prometheus",
+    "get_registry",
+    "reset_metrics",
     "checked_load",
     "dictionary",
+    "observability",
     "safe_pickle",
     "utils",
 ]

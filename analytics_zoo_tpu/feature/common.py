@@ -32,6 +32,21 @@ class Sample:
                                         else [f])]
 
 
+def _count_ingest(stage: str, records: int, nbytes: int = 0):
+    """Per-stage ingest telemetry (docs/observability.md). One
+    chunked increment per stream, not per record — the counters must
+    not put a lock acquisition in the per-record path."""
+    from analytics_zoo_tpu.common.observability import counter
+    if records:
+        counter("zoo_tpu_ingest_records_total",
+                help="records emitted per ingest stage",
+                labels={"stage": stage}).inc(records)
+    if nbytes:
+        counter("zoo_tpu_ingest_bytes_total",
+                help="bytes ingested per ingest stage",
+                labels={"stage": stage}).inc(nbytes)
+
+
 class Preprocessing:
     """Composable transformer; subclass and implement
     :meth:`apply` (single record) or override :meth:`transform`
@@ -41,10 +56,15 @@ class Preprocessing:
         raise NotImplementedError
 
     def transform(self, records: Iterable[Any]) -> Iterator[Any]:
-        for r in records:
-            out = self.apply(r)
-            if out is not None:
-                yield out
+        n = 0
+        try:
+            for r in records:
+                out = self.apply(r)
+                if out is not None:
+                    n += 1
+                    yield out
+        finally:
+            _count_ingest(type(self).__name__, n)
 
     def __rshift__(self, other: "Preprocessing") -> "ChainedPreprocessing":
         return ChainedPreprocessing([self, other])

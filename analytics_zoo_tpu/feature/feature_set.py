@@ -133,6 +133,10 @@ class FeatureSet:
             self._x = x_columns
         self._y_cols = y_cols
         self._n = self._x[0].shape[0]
+        from analytics_zoo_tpu.feature.common import _count_ingest
+        _count_ingest("feature_set", self._n,
+                      sum(int(c.nbytes)
+                          for c in list(self._x) + list(y_cols)))
 
     @property
     def _y(self):

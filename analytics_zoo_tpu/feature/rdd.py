@@ -92,10 +92,21 @@ def iter_shard(rdd: Any, shard_index: Optional[int] = None,
         owned = rdd.mapPartitionsWithIndex(
             _partition_filter(shard_index, num_shards))
     tli = getattr(owned, "toLocalIterator", None)
-    if callable(tli):
-        yield from tli()
-    else:
-        yield from owned.collect()
+    src = tli() if callable(tli) else owned.collect()
+    # driver-side record count (the executor-shipped closures above
+    # stay stdlib-only); ONE chunked increment per stream, no lock in
+    # the per-record path
+    n = 0
+    try:
+        for rec in src:
+            n += 1
+            yield rec
+    finally:
+        from analytics_zoo_tpu.common.observability import counter
+        if n:
+            counter("zoo_tpu_ingest_records_total",
+                    help="records emitted per ingest stage",
+                    labels={"stage": "rdd"}).inc(n)
 
 
 def collect_shard(rdd: Any, shard_index: Optional[int] = None,

@@ -53,7 +53,8 @@ struct Server {
     std::mutex mu;
     std::condition_variable cv;
     std::deque<Request> queue;
-    std::map<long, int> pending;  // req_id -> connection fd
+    // req_id -> (connection fd, wants text/plain i.e. GET /metrics)
+    std::map<long, std::pair<int, bool>> pending;
     long next_id = 1;
     std::string health = "{\"status\": \"ok\"}";
 };
@@ -67,13 +68,14 @@ void write_all(int fd, const char* p, size_t n) {
     }
 }
 
-void send_response(int fd, int status, const std::string& body) {
+void send_response(int fd, int status, const std::string& body,
+                   const char* ctype = "application/json") {
     const char* reason = status == 200 ? "OK" : status == 400
         ? "Bad Request" : status == 404 ? "Not Found"
         : status == 413 ? "Payload Too Large" : status == 503
         ? "Service Unavailable" : "Error";
     std::string head = "HTTP/1.1 " + std::to_string(status) + " " +
-        reason + "\r\nContent-Type: application/json\r\n"
+        reason + "\r\nContent-Type: " + ctype + "\r\n"
         "Content-Length: " + std::to_string(body.size()) +
         "\r\nConnection: close\r\n\r\n";
     write_all(fd, head.data(), head.size());
@@ -139,6 +141,9 @@ bool read_request(Server* s, int fd, std::string* method,
 void handle_conn(Server* s, int fd) {
     std::string method, path, body;
     if (read_request(s, fd, &method, &path, &body)) {
+        // GET /metrics rides the worker queue: Python owns the
+        // metrics registry, so only it can render the exposition
+        bool is_metrics = method == "GET" && path == "/metrics";
         if (method == "GET" && path == "/health") {
             std::string payload;
             {
@@ -147,7 +152,7 @@ void handle_conn(Server* s, int fd) {
             }
             send_response(fd, 200, payload);
             ::close(fd);
-        } else if (method != "POST") {
+        } else if (method != "POST" && !is_metrics) {
             send_response(fd, 404, "{\"error\": \"POST only\"}");
             ::close(fd);
         } else {
@@ -158,7 +163,7 @@ void handle_conn(Server* s, int fd) {
                 req.path = path;
                 req.body = std::move(body);
                 req.fd = fd;
-                s->pending[req.id] = fd;
+                s->pending[req.id] = {fd, is_metrics};
                 s->queue.push_back(std::move(req));
             }
             s->cv.notify_one();
@@ -279,15 +284,19 @@ int zoo_http_respond(void* h, long req_id, int status,
                      const char* body, long len) {
     auto* s = static_cast<Server*>(h);
     int fd = -1;
+    bool is_metrics = false;
     {
         std::lock_guard<std::mutex> g(s->mu);
         auto it = s->pending.find(req_id);
         if (it == s->pending.end()) return -1;
-        fd = it->second;
+        fd = it->second.first;
+        is_metrics = it->second.second;
         s->pending.erase(it);
     }
-    send_response(fd, status, std::string(body,
-                                          static_cast<size_t>(len)));
+    send_response(fd, status,
+                  std::string(body, static_cast<size_t>(len)),
+                  is_metrics ? "text/plain; version=0.0.4"
+                             : "application/json");
     ::close(fd);
     return 0;
 }
@@ -309,7 +318,7 @@ void zoo_http_destroy(void* h) {
     if (s->conn_threads.load() > 0) return;
     {
         std::lock_guard<std::mutex> g(s->mu);
-        for (auto& kv : s->pending) ::close(kv.second);
+        for (auto& kv : s->pending) ::close(kv.second.first);
     }
     delete s;
 }

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from analytics_zoo_tpu.common import observability as obs
 from analytics_zoo_tpu.common.nncontext import NNContext, get_nncontext, \
     logger
 from analytics_zoo_tpu.ops import losses as losses_lib
@@ -439,6 +440,9 @@ class Estimator:
         self.tensorboard_dir: Optional[str] = None
         self.tensorboard_app: str = "zoo_tpu"
         self._tb_writer = None
+        # True only for writers _tb() opened itself — train() must not
+        # close a caller-injected writer (duck-typed fakes/adapters)
+        self._tb_owns_writer = False
         self._summary_triggers: "Dict[str, Trigger]" = {}
         # jax.profiler trace capture (SURVEY §5: the TPU analog of the
         # reference's TrainSummary observability)
@@ -485,15 +489,31 @@ class Estimator:
         return self
 
     def set_summary_trigger(self, name: str, trigger: Trigger):
-        """Enable extra TensorBoard summaries on a trigger (BigDL
+        """Enable extra summaries on a trigger (BigDL
         `TrainSummary.setSummaryTrigger`). Supported: "Parameters" —
         per-layer weight histograms (device fetch per firing; keep the
-        trigger sparse on remote transports)."""
-        if name != "Parameters":
+        trigger sparse on remote transports) — and "LearningRate" —
+        the current schedule value, written to TensorBoard at firing
+        time and mirrored to the ``zoo_tpu_learning_rate`` gauge."""
+        if name not in ("Parameters", "LearningRate"):
             raise ValueError(
-                f"unsupported summary {name!r}; supported: Parameters")
+                f"unsupported summary {name!r}; supported: "
+                f"Parameters, LearningRate")
         self._summary_triggers[name] = trigger
         return self
+
+    def _record_lr(self, tb, step: int) -> float:
+        """Schedule value at ``step`` → the ``zoo_tpu_learning_rate``
+        gauge, plus the TensorBoard ``LearningRate`` scalar when a
+        writer is passed (the "LearningRate" summary-trigger path)."""
+        lr = float(self._lr_fn(step))
+        if lr == lr:  # not NaN (a ZooOptimizer schedule is attached)
+            obs.gauge("zoo_tpu_learning_rate",
+                      help="current learning-rate schedule value"
+                      ).set(lr)
+            if tb is not None:
+                tb.add_scalar("LearningRate", lr, step)
+        return lr
 
     def _write_param_histograms(self, tb, step: int):
         # ONE whole-tree fetch (per-leaf device_get would be a
@@ -537,6 +557,7 @@ class Estimator:
             from torch.utils.tensorboard import SummaryWriter
             self._tb_writer = SummaryWriter(
                 os.path.join(self.tensorboard_dir, self.tensorboard_app))
+            self._tb_owns_writer = True
         return self._tb_writer
 
     def _place_params(self, params):
@@ -739,120 +760,191 @@ class Estimator:
         # be far along from a previous train() call)
         p_start = self.step + self._profile_start
         p_end = self.step + self._profile_end
+        # telemetry (docs/observability.md): per-step host wall time is
+        # dispatch-to-dispatch — under queue backpressure it converges
+        # to device step time without forcing a per-step sync
+        step_hist = obs.histogram(
+            "zoo_tpu_train_step_seconds",
+            help="host wall time per training step "
+                 "(dispatch-to-dispatch)")
+        steps_total = obs.counter("zoo_tpu_train_steps_total",
+                                  help="training steps dispatched")
+        examples_total = obs.counter(
+            "zoo_tpu_train_examples_total",
+            help="training examples consumed")
+        first_step = True
 
-        for epoch in range(1, nb_epoch + 1):
-            t0 = time.time()
-            n_records = 0
-            # keep losses on-device during the epoch: fetching per step
-            # would stall the dispatch pipeline (expensive over remote
-            # device transports)
-            pending: "list[tuple[int, Any]]" = []
-            mesh = self.ctx.mesh
+        try:
+            for epoch in range(1, nb_epoch + 1):
+                n_records = 0
+                # keep losses on-device during the epoch: fetching per
+                # step would stall the dispatch pipeline (expensive
+                # over remote device transports)
+                pending: "list[tuple[int, Any]]" = []
+                mesh = self.ctx.mesh
 
-            def _place(batch, mesh=mesh):
-                xb, yb = batch
-                return (shard_batch(xb, mesh), shard_batch(yb, mesh))
+                def _place(batch, mesh=mesh):
+                    xb, yb = batch
+                    return (shard_batch(xb, mesh),
+                            shard_batch(yb, mesh))
 
-            # closing(): break/exception must stop the worker thread
-            # NOW, not at GC — a retained traceback would otherwise pin
-            # depth+1 device-resident batches (notebook OOM-retry trap)
-            batches = _prefetch_iter(
-                ds.iter_batches(batch_size, shuffle=True, seed=epoch),
-                _place, _prefetch_depth())
-            try:
-                for xb, yb in batches:
-                    rng = jax.random.fold_in(base_rng, self.step)
-                    if self._profile_dir and not self._profiling and \
-                            self.step + 1 >= p_start:
-                        jax.profiler.start_trace(self._profile_dir)
-                        self._profiling = True
-                    self.params, self.opt_state, loss = \
-                        self._train_step(self.params, self.opt_state,
-                                         rng, xb, yb)
-                    self.step += 1
-                    if self._profiling and self.step >= p_end:
-                        jax.block_until_ready(loss)  # device time
-                        jax.profiler.stop_trace()
-                        self._profiling = False
-                        self._profile_dir = None
-                    n_records += batch_size
-                    pending.append((self.step, loss))
-                    if tb is not None and self._summary_triggers:
-                        trig = self._summary_triggers.get("Parameters")
-                        if trig is not None and trig(
-                                epoch, self.step, False):
-                            self._write_param_histograms(tb, self.step)
-                    if self.checkpoint_path and self.checkpoint_trigger(
-                            epoch, self.step, False):
-                        self.save_checkpoint()
-                    if end_trigger is not None and end_trigger(
-                            epoch - 1, self.step, False):
-                        stop = True
-                        break
-            finally:
-                # break/exception must stop the worker thread NOW, not
-                # at GC — a retained traceback would otherwise pin
-                # depth+1 device-resident batches (notebook OOM-retry
-                # trap)
-                batches.close()
+                # closing(): break/exception must stop the worker
+                # thread NOW, not at GC — a retained traceback would
+                # otherwise pin depth+1 device-resident batches
+                # (notebook OOM-retry trap)
+                batches = _prefetch_iter(
+                    ds.iter_batches(batch_size, shuffle=True,
+                                    seed=epoch),
+                    _place, _prefetch_depth())
+                ep_span = obs.span("train/epoch", epoch=epoch,
+                                   step=self.step)
+                with ep_span:
+                    try:
+                        t_prev = time.perf_counter()
+                        for xb, yb in batches:
+                            rng = jax.random.fold_in(base_rng,
+                                                     self.step)
+                            if self._profile_dir and \
+                                    not self._profiling and \
+                                    self.step + 1 >= p_start:
+                                jax.profiler.start_trace(
+                                    self._profile_dir)
+                                self._profiling = True
+                            self.params, self.opt_state, loss = \
+                                self._train_step(
+                                    self.params, self.opt_state,
+                                    rng, xb, yb)
+                            self.step += 1
+                            if first_step:
+                                # includes XLA compile when this call
+                                # traced a fresh step fn; the one-time
+                                # sync is noise next to the compile
+                                jax.block_until_ready(loss)
+                                obs.gauge(
+                                    "zoo_tpu_train_first_step_seconds",
+                                    help="first-step wall time of the "
+                                         "latest run (incl. compile)"
+                                ).set(time.perf_counter() - t_prev)
+                                first_step = False
+                            if self._profiling and self.step >= p_end:
+                                jax.block_until_ready(loss)
+                                jax.profiler.stop_trace()
+                                self._profiling = False
+                                self._profile_dir = None
+                            now = time.perf_counter()
+                            step_hist.observe(now - t_prev)
+                            t_prev = now
+                            steps_total.inc()
+                            examples_total.inc(batch_size)
+                            n_records += batch_size
+                            pending.append((self.step, loss))
+                            if self._summary_triggers:
+                                trig = self._summary_triggers.get(
+                                    "Parameters")
+                                if tb is not None and trig is not None \
+                                        and trig(epoch, self.step,
+                                                 False):
+                                    self._write_param_histograms(
+                                        tb, self.step)
+                                trig = self._summary_triggers.get(
+                                    "LearningRate")
+                                if trig is not None and trig(
+                                        epoch, self.step, False):
+                                    self._record_lr(tb, self.step)
+                            if self.checkpoint_path and \
+                                    self.checkpoint_trigger(
+                                        epoch, self.step, False):
+                                self.save_checkpoint()
+                            if end_trigger is not None and end_trigger(
+                                    epoch - 1, self.step, False):
+                                stop = True
+                                break
+                    finally:
+                        # break/exception must stop the worker thread
+                        # NOW, not at GC — a retained traceback would
+                        # otherwise pin depth+1 device-resident
+                        # batches (notebook OOM-retry trap)
+                        batches.close()
 
-            losses_np = ([float(v) for v in
-                          jax.device_get([v for _, v in pending])]
-                         if pending else [])
-            dt = max(time.time() - t0, 1e-9)
-            if tb is not None:
-                for (s, _), lf in zip(pending, losses_np):
-                    tb.add_scalar("Loss", lf, s)
-                    lr = self._lr_fn(s)
-                    if lr == lr:  # not NaN
-                        tb.add_scalar("LearningRate", lr, s)
-            epoch_batches = len(pending)
-            epoch_loss = float(np.sum(losses_np))
-            throughput = n_records / dt
-            entry = {"epoch": epoch,
-                     "loss": epoch_loss / max(epoch_batches, 1),
-                     "throughput": throughput, "step": self.step}
-            if tb is not None:
-                tb.add_scalar("Throughput", throughput, self.step)
-            if validation_data is not None and validation_trigger(
-                    epoch, self.step, True):
-                # keras-style (x_val, y_val) tuples are (data, labels),
-                # not a two-input feature list
-                if isinstance(validation_data, tuple) and \
-                        len(validation_data) == 2 and not hasattr(
-                            validation_data, "iter_batches"):
-                    val = self.evaluate(validation_data[0],
-                                        validation_data[1],
-                                        batch_size=batch_size)
-                else:
-                    val = self.evaluate(validation_data,
-                                        batch_size=batch_size)
-                entry.update({f"val_{k}": v for k, v in val.items()})
+                    losses_np = ([float(v) for v in
+                                  jax.device_get(
+                                      [v for _, v in pending])]
+                                 if pending else [])
+                dt = max(ep_span.elapsed, 1e-9)
                 if tb is not None:
-                    for k, v in val.items():
-                        tb.add_scalar(f"Validation/{k}", v, self.step)
-            if self.checkpoint_path and self.checkpoint_trigger(
-                    epoch, self.step, True):
-                self.save_checkpoint()
-            if tb is not None and self._summary_triggers:
-                trig = self._summary_triggers.get("Parameters")
-                if trig is not None and trig(epoch, self.step, True):
-                    # epoch-end firing (EveryEpoch-style triggers)
-                    self._write_param_histograms(tb, self.step)
-            history.append(entry)
-            logger.info("epoch %d: %s", epoch, entry)
-            if stop or (end_trigger is not None and end_trigger(
-                    epoch, self.step, True,
-                    loss=entry.get("loss"),
-                    val_metrics={k[4:]: v for k, v in entry.items()
-                                 if k.startswith("val_")})):
-                break
-        if self._profiling:  # short run ended inside the trace window
-            jax.profiler.stop_trace()
-            self._profiling = False
-            self._profile_dir = None
-        if tb is not None:
-            tb.flush()
+                    for (s, _), lf in zip(pending, losses_np):
+                        tb.add_scalar("Loss", lf, s)
+                        lr = self._lr_fn(s)
+                        if lr == lr:  # not NaN
+                            tb.add_scalar("LearningRate", lr, s)
+                epoch_batches = len(pending)
+                epoch_loss = float(np.sum(losses_np))
+                throughput = n_records / dt
+                obs.gauge(
+                    "zoo_tpu_train_throughput_examples_per_sec",
+                    help="epoch training throughput").set(throughput)
+                self._record_lr(None, self.step)  # gauge refresh
+                entry = {"epoch": epoch,
+                         "loss": epoch_loss / max(epoch_batches, 1),
+                         "throughput": throughput, "step": self.step}
+                if tb is not None:
+                    tb.add_scalar("Throughput", throughput, self.step)
+                if validation_data is not None and validation_trigger(
+                        epoch, self.step, True):
+                    # keras-style (x_val, y_val) tuples are
+                    # (data, labels), not a two-input feature list
+                    if isinstance(validation_data, tuple) and \
+                            len(validation_data) == 2 and not hasattr(
+                                validation_data, "iter_batches"):
+                        val = self.evaluate(validation_data[0],
+                                            validation_data[1],
+                                            batch_size=batch_size)
+                    else:
+                        val = self.evaluate(validation_data,
+                                            batch_size=batch_size)
+                    entry.update(
+                        {f"val_{k}": v for k, v in val.items()})
+                    if tb is not None:
+                        for k, v in val.items():
+                            tb.add_scalar(f"Validation/{k}", v,
+                                          self.step)
+                if self.checkpoint_path and self.checkpoint_trigger(
+                        epoch, self.step, True):
+                    self.save_checkpoint()
+                if self._summary_triggers:
+                    trig = self._summary_triggers.get("Parameters")
+                    if tb is not None and trig is not None and trig(
+                            epoch, self.step, True):
+                        # epoch-end firing (EveryEpoch-style triggers)
+                        self._write_param_histograms(tb, self.step)
+                    trig = self._summary_triggers.get("LearningRate")
+                    if trig is not None and trig(
+                            epoch, self.step, True):
+                        self._record_lr(tb, self.step)
+                history.append(entry)
+                logger.info("epoch %d: %s", epoch, entry)
+                if stop or (end_trigger is not None and end_trigger(
+                        epoch, self.step, True,
+                        loss=entry.get("loss"),
+                        val_metrics={k[4:]: v for k, v in entry.items()
+                                     if k.startswith("val_")})):
+                    break
+        finally:
+            if self._profiling:  # run ended inside the trace window
+                jax.profiler.stop_trace()
+                self._profiling = False
+                self._profile_dir = None
+            if self._tb_writer is not None:
+                self._tb_writer.flush()
+                if self._tb_owns_writer:
+                    # per-fit lifecycle for writers _tb() opened:
+                    # close on every exit path (incl. exceptions) — a
+                    # writer leaked across runs keeps its event file
+                    # growing and holds the fd until GC. Injected
+                    # writers stay attached: the caller owns them.
+                    self._tb_writer.close()
+                    self._tb_writer = None
+                    self._tb_owns_writer = False
         # durable on return: join any in-flight async checkpoint write
         self.wait_for_checkpoint()
         return TrainResult(history, self.params, self.opt_state, self.step)
@@ -889,13 +981,15 @@ class Estimator:
                             drop_last=False),
             _place, _prefetch_depth())
         try:
-            for xb, yb, wb in batches:
-                stats = jax.device_get(
-                    self._eval_step(self.params, xb, yb, wb))
-                for mname, mstats in stats.items():
-                    acc = totals.setdefault(mname, {})
-                    for k, v in mstats.items():
-                        acc[k] = acc.get(k, 0) + np.asarray(v)
+            with obs.span("train/eval", step=self.step,
+                          n=ds.num_samples):
+                for xb, yb, wb in batches:
+                    stats = jax.device_get(
+                        self._eval_step(self.params, xb, yb, wb))
+                    for mname, mstats in stats.items():
+                        acc = totals.setdefault(mname, {})
+                        for k, v in mstats.items():
+                            acc[k] = acc.get(k, 0) + np.asarray(v)
         finally:
             batches.close()  # deterministic worker shutdown
         out = {}
@@ -970,14 +1064,15 @@ class Estimator:
         step = self.step
 
         def write():
-            tmp = os.path.join(path, f".tmp_ckpt_{step}")
-            with open(tmp, "wb") as f:
-                pickle.dump(state, f)
-            final = os.path.join(path, f"ckpt_{step}.pkl")
-            os.replace(tmp, final)
-            latest = os.path.join(path, "LATEST")
-            with open(latest, "w") as f:
-                f.write(os.path.basename(final))
+            with obs.span("train/checkpoint", step=step):
+                tmp = os.path.join(path, f".tmp_ckpt_{step}")
+                with open(tmp, "wb") as f:
+                    pickle.dump(state, f)
+                final = os.path.join(path, f"ckpt_{step}.pkl")
+                os.replace(tmp, final)
+                latest = os.path.join(path, "LATEST")
+                with open(latest, "w") as f:
+                    f.write(os.path.basename(final))
             return final
 
         if block:

@@ -27,6 +27,7 @@ from typing import Callable, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from analytics_zoo_tpu.common import observability as obs
 from analytics_zoo_tpu.common.nncontext import logger
 from analytics_zoo_tpu.native import make_serving_queue
 
@@ -374,6 +375,9 @@ class InferenceModel:
             raise RuntimeError("no model loaded")
         slot = queue.take(timeout_ms)
         if slot < 0:
+            obs.counter("zoo_tpu_serving_errors_total",
+                        help="serving errors by kind",
+                        labels={"kind": "slot_timeout"}).inc()
             raise TimeoutError(
                 f"no free model slot within {timeout_ms}ms "
                 f"(concurrency={self.supported_concurrent_num})")
@@ -388,10 +392,16 @@ class InferenceModel:
             xs = [x if isinstance(x, jax.Array)
                   and not compiled else np.asarray(x)
                   for x in xs]
-            out = predict_fn(*xs)
-            if isinstance(out, (list, tuple)):
-                return [np.asarray(o) for o in out]
-            return np.asarray(out)
+            bdim = np.shape(xs[0])
+            obs.histogram("zoo_tpu_serving_batch_size",
+                          help="predict batch size (leading dim)",
+                          buckets=obs.SIZE_BUCKETS).observe(
+                bdim[0] if bdim else 1)
+            with obs.span("serving/predict"):
+                out = predict_fn(*xs)
+                if isinstance(out, (list, tuple)):
+                    return [np.asarray(o) for o in out]
+                return np.asarray(out)
         finally:
             queue.put(slot)
 

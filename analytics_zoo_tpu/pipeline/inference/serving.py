@@ -8,6 +8,12 @@ services, here a stdlib HTTP/JSON endpoint (no framework deps).
 
 POST /predict  {"inputs": [[...], ...]}  →  {"outputs": [[...], ...]}
 GET  /health   →  {"status": "ok", "free_slots": N}
+GET  /metrics  →  Prometheus text exposition (docs/observability.md)
+
+Errors are structured JSON — ``{"error": {"code": N, "message": ...}}``
+— with real status codes: 404 for unknown paths, 400 for malformed
+JSON / missing "inputs", and each increments
+``zoo_tpu_serving_errors_total{kind=...}``.
 """
 
 from __future__ import annotations
@@ -16,20 +22,58 @@ import json
 import time
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Optional, Tuple
 
 import numpy as np
 
+from analytics_zoo_tpu.common import observability as obs
 from analytics_zoo_tpu.pipeline.inference.inference_model import (
     InferenceModel)
 
 
-def handle_predict(model: InferenceModel, body: bytes):
+def _error_body(code: int, message: str, **extra) -> dict:
+    err = {"code": code, "message": message}
+    err.update(extra)
+    return {"error": err}
+
+
+def _count_error(kind: str):
+    obs.counter("zoo_tpu_serving_errors_total",
+                help="serving errors by kind",
+                labels={"kind": kind}).inc()
+
+
+def _record_request(path: str, status: int, dt: float):
+    """Shared per-request telemetry for both HTTP front-ends."""
+    obs.counter("zoo_tpu_serving_requests_total",
+                help="HTTP requests served",
+                labels={"path": path, "status": str(status)}).inc()
+    obs.histogram("zoo_tpu_serving_request_seconds",
+                  help="request latency (handler wall time)",
+                  labels={"path": path}).observe(dt)
+
+
+def _in_flight() -> "obs.Gauge":
+    return obs.gauge("zoo_tpu_serving_in_flight",
+                     help="requests currently being handled")
+
+
+def handle_predict(model: InferenceModel, body: bytes
+                   ) -> "Tuple[int, dict]":
     """The /predict contract, shared by the stdlib and native
     front-ends: JSON body → (http_status, payload_dict)."""
     try:
         req = json.loads(body)
+    except (ValueError, UnicodeDecodeError) as e:
+        _count_error("bad_json")
+        return 400, _error_body(400, f"malformed JSON body: {e}")
+    try:
         inputs = req["inputs"]
+    except (KeyError, TypeError):
+        _count_error("bad_request")
+        return 400, _error_body(
+            400, 'request must be a JSON object with an "inputs" key')
+    try:
         if isinstance(inputs, list) and inputs and \
                 isinstance(inputs[0], dict):
             xs = [np.asarray(i["data"], np.float32) for i in inputs]
@@ -40,7 +84,8 @@ def handle_predict(model: InferenceModel, body: bytes):
             return 200, {"outputs": [o.tolist() for o in out]}
         return 200, {"outputs": out.tolist()}
     except Exception as e:  # serving boundary: report, not die
-        return 400, {"error": str(e)}
+        _count_error("predict_error")
+        return 400, _error_body(400, str(e))
 
 
 class InferenceServer:
@@ -55,32 +100,75 @@ class InferenceServer:
 
             def _reply(self, code: int, payload: dict):
                 body = json.dumps(payload).encode()
+                self._reply_raw(code, body, "application/json")
+
+            def _reply_raw(self, code: int, body: bytes,
+                           ctype: str):
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
 
             def do_GET(self):
-                if self.path == "/health":
-                    self._reply(200, {
-                        "status": "ok",
-                        "free_slots":
-                            server.model.concurrent_slots_free})
+                t0 = time.perf_counter()
+                _in_flight().inc()
+                status = 0
+                payload = None  # None == /metrics (rendered below)
+                try:
+                    if self.path == "/health":
+                        status = 200
+                        payload = {
+                            "status": "ok",
+                            "free_slots":
+                                server.model.concurrent_slots_free}
+                    elif self.path == "/metrics":
+                        status = 200
+                    else:
+                        status = 404
+                        _count_error("not_found")
+                        payload = _error_body(
+                            404, "not found", path=self.path)
+                finally:
+                    # account BEFORE replying: a client that scrapes
+                    # /metrics right after a response must see its own
+                    # request already counted (and in-flight back at 0)
+                    _in_flight().dec()
+                    _record_request(self.path, status,
+                                    time.perf_counter() - t0)
+                if payload is None:
+                    self._reply_raw(
+                        status, obs.to_prometheus().encode(),
+                        "text/plain; version=0.0.4")
                 else:
-                    self._reply(404, {"error": "not found"})
+                    self._reply(status, payload)
 
             def do_POST(self):
-                if self.path != "/predict":
-                    self._reply(404, {"error": "not found"})
-                    return
+                t0 = time.perf_counter()
+                _in_flight().inc()
+                status = 0
                 try:
-                    n = int(self.headers.get("Content-Length", 0))
-                    body = self.rfile.read(n)
-                except Exception as e:  # bad header / client dropped
-                    self._reply(400, {"error": str(e)})
-                    return
-                status, payload = handle_predict(server.model, body)
+                    if self.path != "/predict":
+                        status = 404
+                        _count_error("not_found")
+                        payload = _error_body(
+                            404, "not found", path=self.path)
+                    else:
+                        try:
+                            n = int(self.headers.get(
+                                "Content-Length", 0))
+                            body = self.rfile.read(n)
+                        except Exception as e:  # client gone
+                            status = 400
+                            _count_error("bad_request")
+                            payload = _error_body(400, str(e))
+                        else:
+                            status, payload = handle_predict(
+                                server.model, body)
+                finally:
+                    _in_flight().dec()
+                    _record_request(self.path, status,
+                                    time.perf_counter() - t0)
                 self._reply(status, payload)
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
@@ -114,7 +202,8 @@ class NativeInferenceServer:
 
     Worker threads (= model concurrency) pull raw request bytes over
     the C ABI, run `InferenceModel.predict`, and post response bytes
-    back.
+    back. ``GET /metrics`` routes through the worker (Python owns the
+    registry); /health stays native.
     """
 
     def __init__(self, model: InferenceModel, port: int = 0,
@@ -131,26 +220,42 @@ class NativeInferenceServer:
         return self._srv.port
 
     def _serve_one(self, rid: int, path: str, body: bytes):
+        t0 = time.perf_counter()
+        _in_flight().inc()
+        status = 0
+        out = b""
         try:
-            if path != "/predict":
-                self._srv.respond(rid, 404,
-                                  b'{"error": "not found"}')
-                return
-            status, payload = handle_predict(self.model, body)
-            self._srv.respond(rid, status,
-                              json.dumps(payload).encode())
-        except Exception as e:  # respond() itself failed
-            try:
-                self._srv.respond(
-                    rid, 400, json.dumps({"error": str(e)}).encode())
-            except Exception:
-                pass
+            if path == "/metrics":
+                status = 200
+                out = None  # rendered after accounting, below
+            elif path != "/predict":
+                status = 404
+                _count_error("not_found")
+                out = json.dumps(
+                    _error_body(404, "not found", path=path)).encode()
+            else:
+                status, payload = handle_predict(self.model, body)
+                out = json.dumps(payload).encode()
+        except Exception as e:
+            status = 400
+            out = json.dumps(_error_body(400, str(e))).encode()
         finally:
-            # refresh the C++-cached health AFTER the slot freed, so
-            # /health reflects post-request capacity
-            self._srv.set_health(json.dumps({
-                "status": "ok",
-                "free_slots": self.model.concurrent_slots_free}))
+            # account BEFORE responding: a client that scrapes
+            # /metrics right after its response must see this request
+            # already counted (and in-flight back at 0)
+            _in_flight().dec()
+            _record_request(path, status, time.perf_counter() - t0)
+        if out is None:
+            out = obs.to_prometheus().encode()
+        try:
+            self._srv.respond(rid, status, out)
+        except Exception:
+            pass  # client gone — nothing to tell it
+        # refresh the C++-cached health AFTER the slot freed, so
+        # /health reflects post-request capacity
+        self._srv.set_health(json.dumps({
+            "status": "ok",
+            "free_slots": self.model.concurrent_slots_free}))
 
     def _loop(self):
         from analytics_zoo_tpu.common.nncontext import logger

@@ -620,6 +620,11 @@ def _supervise(budget_s: float) -> None:
 
     def emit_merged():
         state["printed_any"] = True
+        try:  # refresh per emit: telemetry accrues across stages
+            from bench_common import attach_metrics_snapshot
+            attach_metrics_snapshot(merged)
+        except Exception:
+            pass  # the artifact must go out even if telemetry fails
         print(json.dumps(merged), flush=True)
 
     def on_term(signum, frame):

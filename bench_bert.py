@@ -120,12 +120,13 @@ def measure(batch: int = 32, steps: int = 10, seq_len: int = 128,
 
 
 def main():
+    from bench_common import attach_metrics_snapshot
     rec = measure(
         batch=int(os.environ.get("ZOO_TPU_BENCH_BERT_BATCH", "32")),
         steps=int(os.environ.get("ZOO_TPU_BENCH_STEPS", "10")),
         hidden=int(os.environ.get("ZOO_TPU_BENCH_BERT_HIDDEN", "768")),
         blocks=int(os.environ.get("ZOO_TPU_BENCH_BERT_BLOCKS", "4")))
-    print(json.dumps(rec), flush=True)
+    print(json.dumps(attach_metrics_snapshot(rec)), flush=True)
 
 
 if __name__ == "__main__":

@@ -29,6 +29,19 @@ def flag_rtt_bound(rec: dict, rtt_bound: bool) -> dict:
     return rec
 
 
+def attach_metrics_snapshot(rec: dict) -> dict:
+    """Embed the process-global telemetry snapshot
+    (`common/observability.py`) in a bench JSON artifact under
+    ``"telemetry"`` — so a bench run's step/ingest/serving metrics
+    ride along with its headline number. No-op when nothing was
+    recorded (raw jit chains bypass the instrumented layers)."""
+    from analytics_zoo_tpu.common.observability import snapshot
+    snap = snapshot()
+    if snap:
+        rec["telemetry"] = snap
+    return rec
+
+
 def dispatch_overhead(samples: int = 5) -> float:
     """Constant per-dispatch round-trip cost, min over ``samples``."""
     import jax

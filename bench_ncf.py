@@ -132,7 +132,8 @@ def main():
     print(f"# backend={devices[0].platform} n_devices={len(devices)} "
           f"init={t_init:.1f}s", file=sys.stderr, flush=True)
 
-    rec = measure(batch=batch, steps=steps)
+    from bench_common import attach_metrics_snapshot
+    rec = attach_metrics_snapshot(measure(batch=batch, steps=steps))
     print(json.dumps(rec), flush=True)
     print(f"# total={time.perf_counter() - _t_start:.1f}s",
           file=sys.stderr)

@@ -28,6 +28,8 @@ if ROOT not in sys.path:  # `python scripts/gen_api_docs.py` from root
 MODULES = [
     ("analytics_zoo_tpu", "Top level"),
     ("analytics_zoo_tpu.common", "common — context & config"),
+    ("analytics_zoo_tpu.common.observability",
+     "observability — metrics, spans, event log"),
     ("analytics_zoo_tpu.feature", "feature — FeatureSet & ingest"),
     ("analytics_zoo_tpu.feature.image", "feature.image — ImageSet"),
     ("analytics_zoo_tpu.feature.image3d", "feature.image3d"),
