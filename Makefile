@@ -6,10 +6,18 @@
 IMAGE ?= analytics-zoo-tpu
 
 .PHONY: test docker-build docker-test docker-test-spark dist docs \
-    lint obs-smoke
+    lint obs-smoke fused-conformance
 
 test:
 	python -m pytest tests/ -x -q
+
+# conv+BN (+ residual-epilogue) conformance: the exact Pallas kernel
+# code paths the fused ResNet runs on chip, exercised under the
+# interpreter on the host CPU — values, gradients (Pallas vs XLA
+# backward), moving state, bf16, padded grids, DP sharding. Tier-1
+# safe; documented next to the MFU roofline in PERF.md.
+fused-conformance:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_conv_bn.py -q
 
 # telemetry end-to-end: 2 train steps + 1 served request, then assert
 # the /metrics exposition carries every layer (docs/observability.md)

@@ -39,15 +39,27 @@ if _os.environ.get("JAX_PLATFORMS"):
     try:
         _env_p = _os.environ["JAX_PLATFORMS"]
         _cur = getattr(_jax.config, "jax_platforms", None)
-        # "plugin clobber" = any selection that merely adds the axon
-        # backend around the host CPU (e.g. "axon,cpu" in any order);
-        # anything else that differs from the env was chosen by the
+        # "plugin clobber" = any current selection that contains the
+        # axon backend while the env selection does NOT — the plugin's
+        # sitecustomize inserted it (whatever it packed around it:
+        # "axon,cpu", "axon", future "axon,tpu,cpu", ...); a selection
+        # without axon that differs from the env was chosen by the
         # program and stays.
-        _is_clobber = _cur is not None and set(
-            _cur.split(",")) == {"axon", "cpu"}
+        _is_clobber = bool(_cur) and \
+            "axon" in _cur.split(",") and \
+            "axon" not in _env_p.split(",")
         if _cur in (None, "", _env_p) or _is_clobber:
             if _cur != _env_p:
                 _jax.config.update("jax_platforms", _env_p)
+        elif _cur != _env_p:
+            # programmatic pin kept — say so, because a user staring
+            # at JAX_PLATFORMS=cpu while devices init on another
+            # backend otherwise has nothing to go on
+            import logging as _logging
+            _logging.getLogger(__name__).info(
+                "JAX_PLATFORMS=%r not re-pinned: jax_platforms=%r "
+                "was set programmatically (not an axon plugin "
+                "clobber) and takes precedence", _env_p, _cur)
     except Exception as _e:  # pin failed: surface it — a silent miss
         import warnings as _warnings  # would revive the tunnel hang
         _warnings.warn(f"could not pin jax_platforms from "

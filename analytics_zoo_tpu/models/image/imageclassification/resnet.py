@@ -363,7 +363,7 @@ class ResNet:
         """``fused=True`` uses :class:`FusedBottleneck` (the Pallas
         matmul+BN kernel on the 1×1 convs) — same math, less HBM
         traffic; ``fused="defer"`` additionally runs each stage as
-        one :class:`FusedStage` with the alternating deferred-apply
+        one :class:`FusedStage` with the chained deferred-apply
         scheme. Weights are per-conv/per-BN in every layout
         (`convert_resnet_params` maps between them)."""
         if fused not in (False, True, "defer"):
@@ -407,7 +407,7 @@ class ResNet:
 class FusedStage(KerasLayer):
     """One ResNet stage as a SINGLE layer running its
     `FusedBottleneck` blocks through `fused_stage_forward` (the
-    alternating deferred-apply scheme — `resnet50(fused="defer")`).
+    chained deferred-apply scheme — `resnet50(fused="defer")`).
     Params nest per block: ``{"b0": <FusedBottleneck params>, ...}``,
     so `convert_resnet_params` maps them to/from the other layouts by
     name."""
@@ -457,19 +457,22 @@ class FusedStage(KerasLayer):
 
 
 def fused_stage_forward(blocks, params_list, x, training=True):
-    """Run a stage of `FusedBottleneck` blocks with ALTERNATING
-    deferred apply (the round-5 HBM-traffic lever, exercised here for
+    """Run a stage of `FusedBottleneck` blocks with CHAINED deferred
+    apply (the round-5/6 HBM-traffic lever, exercised here for
     conformance ahead of the on-chip measurement that decides whether
     the ResNet builder adopts it):
 
-    an eligible block (stride-1 identity shortcut, not the last)
+    EVERY eligible block (stride-1 identity shortcut, not the last)
     defers its final bn3+residual+ReLU pass; the NEXT block consumes
     the pending ``(y3, scale3, shift3, sc)`` in its c1 kernel
-    prologue (`matmul_bn(in_residual=)`) and re-derives its own
-    shortcut as a fused elementwise — per deferred pair, one
-    whole-tensor write (and its read-back) of the stage's widest
-    tensor disappears. Same math as running the blocks sequentially;
-    eval mode just chains the (already optimal) eval folds.
+    prologue (`matmul_bn(in_residual=)`), re-derives its own shortcut
+    as a fused elementwise, and — when itself eligible — defers its
+    own tail in turn. Per deferred block, one whole-tensor write (and
+    its read-back) of the stage's widest tensor disappears; in a
+    stage of B blocks all B−1 interior tails ride their successor's
+    kernel (the round-5 scheme alternated, saving only ⌊(B−1)/2⌋).
+    Same math as running the blocks sequentially; eval mode just
+    chains the (already optimal) eval folds.
 
     ``blocks``/``params_list``: the stage's `FusedBottleneck` layers
     and their param dicts. Returns ``(out, updates_per_block)``."""
@@ -486,7 +489,9 @@ def fused_stage_forward(blocks, params_list, x, training=True):
     pending = None
     for i, (blk, p) in enumerate(zip(blocks, params_list)):
         eligible = (blk.stride == 1 and not blk.downsample)
-        defer = (eligible and pending is None
+        # chain: a block consuming a pending may defer its own tail
+        # too — only the next block's ability to CONSUME gates it
+        defer = (eligible
                  and i + 1 < len(blocks)
                  and blocks[i + 1].stride == 1
                  and not blocks[i + 1].downsample)

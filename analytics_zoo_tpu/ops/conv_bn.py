@@ -41,6 +41,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax ≥0.5 renamed TPUCompilerParams → CompilerParams; bind whichever
+# this jax ships so the kernels compile on both sides of the rename
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+
 # test observability, like ops.flash_attention.invocations
 invocations = 0
 
@@ -193,7 +198,7 @@ def _matmul_bn_fwd_pallas(x, w, s, t, sh, r, relu_in, affine_in,
             jax.ShapeDtypeStruct((1, n), jnp.float32),
         ],
         scratch_shapes=[pltpu.VMEM((bm, n), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret,
     )(*operands)
@@ -239,17 +244,18 @@ def _matmul_bn_vjp_fwd(x, w, s, t, sh, r, relu_in, affine_in,
 def _matmul_bn_vjp_bwd(relu_in, affine_in, interpret, res, cots):
     x, w, s, t, sh, r, y = res
     dy, dsum, dsq = cots
-    if r is None:
-        if os.environ.get("ZOO_TPU_CONV_BN_PALLAS_BWD", "1") == "1":
-            return _bwd_pallas(x, w, s, t, sh, y, dy, dsum, dsq,
-                               relu_in, affine_in, interpret) + (None,)
-        return _bwd_jax(x, w, s, t, sh, y, dy, dsum, dsq,
-                        relu_in, affine_in) + (None,)
-    # residual prologue: the XLA backward (the Pallas bwd kernels
-    # don't carry the extra r tile yet — extend when the
-    # deferred-apply lever is measured worth it)
-    return _bwd_jax(x, w, s, t, sh, y, dy, dsum, dsq,
-                    relu_in, affine_in, r=r)
+    # with a residual the Pallas dx kernel recomputes the ReLU/
+    # residual VJP in VMEM and emits the residual cotangent through
+    # the same epilogue (dr = masked g@Wᵀ) — the augmented cotangent
+    # never exists in HBM on either path
+    if os.environ.get("ZOO_TPU_CONV_BN_PALLAS_BWD", "1") == "1":
+        out = _bwd_pallas(x, w, s, t, sh, y, dy, dsum, dsq,
+                          relu_in, affine_in, interpret, r=r)
+    else:
+        out = _bwd_jax(x, w, s, t, sh, y, dy, dsum, dsq,
+                       relu_in, affine_in, r=r)
+    # custom_vjp wants a 6-tuple; no residual input → cotangent None
+    return out if r is not None else out + (None,)
 
 
 def _bwd_jax(x, w, s, t, sh, y, dy, dsum, dsq, relu_in, affine_in,
@@ -308,11 +314,21 @@ def _g_tile(dy, y, sh_row, dsum_row, dsq_row):
 
 
 def _dx_kernel(dy_ref, y_ref, x_ref, w_ref, s_ref, t_ref, sh_ref,
-               dsum_ref, dsq_ref, dx_ref, ds_ref, dt_ref, *,
-               relu_in: bool, affine_in: bool, out_dtype):
+               dsum_ref, dsq_ref, *rest,
+               relu_in: bool, affine_in: bool, has_res: bool,
+               out_dtype, res_dtype=None):
     """Grid (mi,): dx tile = prologue'(x) ⊙ (g @ Wᵀ); ds/dt accumulate
     across mi. g is recomputed from dy/y in VMEM — it never exists in
-    HBM (the XLA path materialises it as both matmuls' operand)."""
+    HBM (the XLA path materialises it as both matmuls' operand). With
+    ``has_res`` the prologue recomputation includes the residual tile
+    (xa = x·s+t+r) and the residual cotangent dr = masked g@Wᵀ leaves
+    through an extra output in the same epilogue — the deferred
+    block's elementwise-tail VJP never touches HBM either."""
+    if has_res:
+        r_ref, dx_ref, ds_ref, dt_ref, dr_ref = rest
+    else:
+        r_ref = dr_ref = None
+        dx_ref, ds_ref, dt_ref = rest
     mi = pl.program_id(0)
     g = _g_tile(dy_ref[...], y_ref[...], sh_ref[0, :][None, :],
                 dsum_ref[0, :][None, :], dsq_ref[0, :][None, :])
@@ -324,8 +340,12 @@ def _dx_kernel(dy_ref, y_ref, x_ref, w_ref, s_ref, t_ref, sh_ref,
         xa = xf * s_ref[0, :][None, :] + t_ref[0, :][None, :]
     else:
         xa = xf
+    if has_res:
+        xa = xa + r_ref[...].astype(jnp.float32)
     if relu_in:
         dxp = jnp.where(xa > 0.0, dxp, 0.0)
+    if has_res:
+        dr_ref[...] = dxp.astype(res_dtype)
     if affine_in:
         dx_ref[...] = (dxp * s_ref[0, :][None, :]).astype(out_dtype)
         ds_new = jnp.sum(dxp * xf, axis=0, keepdims=True)
@@ -347,10 +367,18 @@ def _dx_kernel(dy_ref, y_ref, x_ref, w_ref, s_ref, t_ref, sh_ref,
 
 
 def _dw_kernel(dy_ref, y_ref, x_ref, s_ref, t_ref, sh_ref,
-               dsum_ref, dsq_ref, dw_ref, acc_ref, *,
-               n_m: int, relu_in: bool, affine_in: bool):
+               dsum_ref, dsq_ref, *rest,
+               n_m: int, relu_in: bool, affine_in: bool,
+               has_res: bool):
     """Grid (ni, mi): dW[:, ni] += prologue(x)ᵀ @ g, accumulated over
-    mi in a VMEM scratch, written at the last mi."""
+    mi in a VMEM scratch, written at the last mi. ``has_res``: the
+    prologue recomputation includes the residual tile, like
+    `_dx_kernel`."""
+    if has_res:
+        r_ref, dw_ref, acc_ref = rest
+    else:
+        r_ref = None
+        dw_ref, acc_ref = rest
     mi = pl.program_id(1)
 
     @pl.when(mi == 0)
@@ -362,6 +390,8 @@ def _dw_kernel(dy_ref, y_ref, x_ref, s_ref, t_ref, sh_ref,
     xf = x_ref[...].astype(jnp.float32)
     if affine_in:
         xf = xf * s_ref[0, :][None, :] + t_ref[0, :][None, :]
+    if has_res:
+        xf = xf + r_ref[...].astype(jnp.float32)
     if relu_in:
         xf = jnp.maximum(xf, 0.0)
     cd = x_ref.dtype
@@ -375,32 +405,35 @@ def _dw_kernel(dy_ref, y_ref, x_ref, s_ref, t_ref, sh_ref,
 
 
 def _bwd_pallas(x, w, s, t, sh, y, dy, dsum, dsq, relu_in, affine_in,
-                interpret):
+                interpret, r=None):
     m, k = x.shape
     n = w.shape[1]
     f32 = jnp.float32
+    has_res = r is not None
     x_isz = jnp.dtype(x.dtype).itemsize
     w_isz = jnp.dtype(w.dtype).itemsize
+    r_isz = jnp.dtype(r.dtype).itemsize if has_res else 0
     if k * n * w_isz >= 8 * 2 ** 20:
         # the dx kernel keeps the whole (K, N) weight resident; beyond
         # ~8MB that cannot fit VMEM with the row tiles — use the XLA
         # backward (ResNet's largest is 1024x2048 bf16 = 4MB)
         return _bwd_jax(x, w, s, t, sh, y, dy, dsum, dsq,
-                        relu_in, affine_in)
+                        relu_in, affine_in, r=r)
     # dW scratch + output block are (K, bn_w) f32: bound K·bn_w, not
     # K·N; no qualifying column tile (extreme K) → XLA backward
     bn_w = next((b for b in (2048, 1024, 512, 256, 128, 64)
                  if n % b == 0 and k * b * 4 <= 4 * 2 ** 20), None)
     if bn_w is None:
         return _bwd_jax(x, w, s, t, sh, y, dy, dsum, dsq,
-                        relu_in, affine_in)
+                        relu_in, affine_in, r=r)
     dsum2 = dsum.astype(f32).reshape(1, n)
     dsq2 = dsq.astype(f32).reshape(1, n)
     # block rows: bound VMEM by the fattest resident set, INCLUDING
-    # the (K, N) weight tile the dx kernel holds
+    # the (K, N) weight tile the dx kernel holds (a residual adds an
+    # r input tile and a dr output tile, both (bm, K))
     def _resident(bm):
         return bm * 2 * n * x_isz + bm * k * x_isz + \
-            bm * k * 4 + k * n * w_isz
+            bm * k * 4 + k * n * w_isz + bm * k * 2 * r_isz
     bm = 512
     while bm > 128 and _resident(bm) > 8 * 2 ** 20:
         bm //= 2
@@ -408,78 +441,105 @@ def _bwd_pallas(x, w, s, t, sh, y, dy, dsum, dsq, relu_in, affine_in,
         # even the smallest row tile busts VMEM (f32 at large K·N):
         # fall back rather than fail Mosaic allocation on chip
         return _bwd_jax(x, w, s, t, sh, y, dy, dsum, dsq,
-                        relu_in, affine_in)
+                        relu_in, affine_in, r=r)
     if m % bm:
         pad = bm - m % bm
         # zero-padded rows: g_pad = dsum (nonzero!) but relu'/affine
         # masks make dx rows garbage we slice off; for ds/dt the
         # padded rows contribute dxp_pad·0 (xf=0) to ds and dxp_pad to
         # dt — correct dt exactly below. dW pads xp rows as
-        # prologue(0) like the forward — corrected below too.
+        # prologue(0) like the forward — corrected below too. The
+        # residual pads with ZEROS, so xa_pad stays prologue(0) and
+        # every correction below is unchanged; dr pad rows slice off.
         x_p = jnp.pad(x, ((0, pad), (0, 0)))
         dy_p = jnp.pad(dy, ((0, pad), (0, 0)))
         y_p = jnp.pad(y, ((0, pad), (0, 0)))
+        r_p = jnp.pad(r, ((0, pad), (0, 0))) if has_res else None
     else:
         pad = 0
-        x_p, dy_p, y_p = x, dy, y
+        x_p, dy_p, y_p, r_p = x, dy, y, r
     mp = m + pad
     n_m = mp // bm
 
-    dx, ds, dt = pl.pallas_call(
+    dx_specs = [
+        pl.BlockSpec((bm, n), lambda mi: (mi, 0)),    # dy
+        pl.BlockSpec((bm, n), lambda mi: (mi, 0)),    # y
+        pl.BlockSpec((bm, k), lambda mi: (mi, 0)),    # x
+        pl.BlockSpec((k, n), lambda mi: (0, 0)),      # w
+        pl.BlockSpec((1, k), lambda mi: (0, 0)),      # s
+        pl.BlockSpec((1, k), lambda mi: (0, 0)),      # t
+        pl.BlockSpec((1, n), lambda mi: (0, 0)),      # sh
+        pl.BlockSpec((1, n), lambda mi: (0, 0)),      # dsum
+        pl.BlockSpec((1, n), lambda mi: (0, 0)),      # dsq
+    ]
+    dx_ops = [dy_p, y_p, x_p, w, s, t, sh, dsum2, dsq2]
+    dx_out_specs = [
+        pl.BlockSpec((bm, k), lambda mi: (mi, 0)),
+        pl.BlockSpec((1, k), lambda mi: (0, 0)),
+        pl.BlockSpec((1, k), lambda mi: (0, 0)),
+    ]
+    dx_out_shape = [
+        jax.ShapeDtypeStruct((mp, k), x.dtype),
+        jax.ShapeDtypeStruct((1, k), f32),
+        jax.ShapeDtypeStruct((1, k), f32),
+    ]
+    if has_res:
+        dx_specs.append(pl.BlockSpec((bm, k), lambda mi: (mi, 0)))
+        dx_ops.append(r_p)
+        # dr leaves through the same epilogue as dx
+        dx_out_specs.append(pl.BlockSpec((bm, k), lambda mi: (mi, 0)))
+        dx_out_shape.append(jax.ShapeDtypeStruct((mp, k), r.dtype))
+    outs = pl.pallas_call(
         functools.partial(_dx_kernel, relu_in=relu_in,
-                          affine_in=affine_in,
-                          out_dtype=jnp.dtype(x.dtype)),
+                          affine_in=affine_in, has_res=has_res,
+                          out_dtype=jnp.dtype(x.dtype),
+                          res_dtype=jnp.dtype(r.dtype) if has_res
+                          else None),
         grid=(n_m,),
-        in_specs=[
-            pl.BlockSpec((bm, n), lambda mi: (mi, 0)),    # dy
-            pl.BlockSpec((bm, n), lambda mi: (mi, 0)),    # y
-            pl.BlockSpec((bm, k), lambda mi: (mi, 0)),    # x
-            pl.BlockSpec((k, n), lambda mi: (0, 0)),      # w
-            pl.BlockSpec((1, k), lambda mi: (0, 0)),      # s
-            pl.BlockSpec((1, k), lambda mi: (0, 0)),      # t
-            pl.BlockSpec((1, n), lambda mi: (0, 0)),      # sh
-            pl.BlockSpec((1, n), lambda mi: (0, 0)),      # dsum
-            pl.BlockSpec((1, n), lambda mi: (0, 0)),      # dsq
-        ],
-        out_specs=[
-            pl.BlockSpec((bm, k), lambda mi: (mi, 0)),
-            pl.BlockSpec((1, k), lambda mi: (0, 0)),
-            pl.BlockSpec((1, k), lambda mi: (0, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((mp, k), x.dtype),
-            jax.ShapeDtypeStruct((1, k), f32),
-            jax.ShapeDtypeStruct((1, k), f32),
-        ],
-        compiler_params=pltpu.CompilerParams(
+        in_specs=dx_specs,
+        out_specs=dx_out_specs,
+        out_shape=dx_out_shape,
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
-    )(dy_p, y_p, x_p, w, s, t, sh, dsum2, dsq2)
+    )(*dx_ops)
+    if has_res:
+        dx, ds, dt, dr = outs
+    else:
+        (dx, ds, dt), dr = outs, None
 
+    dw_specs = [
+        pl.BlockSpec((bm, bn_w), lambda ni, mi: (mi, ni)),  # dy
+        pl.BlockSpec((bm, bn_w), lambda ni, mi: (mi, ni)),  # y
+        pl.BlockSpec((bm, k), lambda ni, mi: (mi, 0)),      # x
+        pl.BlockSpec((1, k), lambda ni, mi: (0, 0)),        # s
+        pl.BlockSpec((1, k), lambda ni, mi: (0, 0)),        # t
+        pl.BlockSpec((1, bn_w), lambda ni, mi: (0, ni)),    # sh
+        pl.BlockSpec((1, bn_w), lambda ni, mi: (0, ni)),    # dsum
+        pl.BlockSpec((1, bn_w), lambda ni, mi: (0, ni)),    # dsq
+    ]
+    dw_ops = [dy_p, y_p, x_p, s, t, sh, dsum2, dsq2]
+    if has_res:
+        dw_specs.append(pl.BlockSpec((bm, k),
+                                     lambda ni, mi: (mi, 0)))
+        dw_ops.append(r_p)
     dw = pl.pallas_call(
         functools.partial(_dw_kernel, n_m=n_m, relu_in=relu_in,
-                          affine_in=affine_in),
+                          affine_in=affine_in, has_res=has_res),
         grid=(n // bn_w, n_m),
-        in_specs=[
-            pl.BlockSpec((bm, bn_w), lambda ni, mi: (mi, ni)),  # dy
-            pl.BlockSpec((bm, bn_w), lambda ni, mi: (mi, ni)),  # y
-            pl.BlockSpec((bm, k), lambda ni, mi: (mi, 0)),      # x
-            pl.BlockSpec((1, k), lambda ni, mi: (0, 0)),        # s
-            pl.BlockSpec((1, k), lambda ni, mi: (0, 0)),        # t
-            pl.BlockSpec((1, bn_w), lambda ni, mi: (0, ni)),    # sh
-            pl.BlockSpec((1, bn_w), lambda ni, mi: (0, ni)),    # dsum
-            pl.BlockSpec((1, bn_w), lambda ni, mi: (0, ni)),    # dsq
-        ],
+        in_specs=dw_specs,
         out_specs=pl.BlockSpec((k, bn_w), lambda ni, mi: (0, ni)),
         out_shape=jax.ShapeDtypeStruct((k, n), f32),
         scratch_shapes=[pltpu.VMEM((k, bn_w), f32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret,
-    )(dy_p, y_p, x_p, s, t, sh, dsum2, dsq2)
+    )(*dw_ops)
 
     if pad:
         dx = dx[:m]
+        if has_res:
+            dr = dr[:m]
         if affine_in:
             # padded-row corrections (exact; dy=y=x=0 on those rows):
             # g_pad = dsum − 2·sh·dsq, xp_pad = prologue(0) = relu(t)
@@ -505,8 +565,11 @@ def _bwd_pallas(x, w, s, t, sh, y, dy, dsum, dsq, relu_in, affine_in,
     if not affine_in:
         ds = jnp.zeros((1, k), f32)
         dt = jnp.zeros((1, k), f32)
-    return (dx, dw.astype(w.dtype), ds.astype(s.dtype),
+    base = (dx, dw.astype(w.dtype), ds.astype(s.dtype),
             dt.astype(t.dtype), jnp.zeros_like(sh))
+    # 5-tuple without r, 6-tuple with the residual cotangent —
+    # matching _bwd_jax
+    return base if not has_res else base + (dr,)
 
 
 _matmul_bn.defvjp(_matmul_bn_vjp_fwd, _matmul_bn_vjp_bwd)
@@ -535,8 +598,12 @@ def matmul_bn(x: jnp.ndarray, w: jnp.ndarray,
     before the ReLU — the shape of a DEFERRED bottleneck output
     ``relu(y3·scale3+shift3 + shortcut)`` consumed here instead of
     being materialized by its own whole-tensor pass (the round-5
-    deferred-apply lever; with a residual the backward runs the XLA
-    path). Differentiable in x, w, in_scale, in_shift, in_residual.
+    deferred-apply lever). The backward recomputes the ReLU/residual
+    VJP in VMEM inside the Pallas dx kernel and emits the residual
+    cotangent through the same epilogue — it never exists in HBM
+    (``ZOO_TPU_CONV_BN_PALLAS_BWD=0`` selects the XLA reference
+    backward). Differentiable in x, w, in_scale, in_shift,
+    in_residual.
     """
     global invocations
     invocations += 1
@@ -658,7 +725,7 @@ def _matmul_apply(x, w, s, t, os_, ot, res, relu_in, affine_in,
         out_specs=pl.BlockSpec((bm, n), lambda mi, ki: (mi, 0)),
         out_shape=jax.ShapeDtypeStruct((mp, n), x.dtype),
         scratch_shapes=[pltpu.VMEM((bm, n), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret,
     )(*operands)
@@ -923,7 +990,7 @@ def _conv3_apply(x, w, s, t, os_, ot, relu_in, affine_in, relu_out,
         out_specs=pl.BlockSpec((bb, ho, wo, cout),
                                lambda bi: (bi, 0, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((b, ho, wo, cout), x.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(x, w.astype(x.dtype), s, t, os_, ot)
@@ -1049,7 +1116,7 @@ def _conv3_fwd_pallas(x, w, s, t, sh, relu_in, affine_in, stride,
             jax.ShapeDtypeStruct((1, cout), f32),
             jax.ShapeDtypeStruct((1, cout), f32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(x, w.astype(x.dtype), s, t, sh)

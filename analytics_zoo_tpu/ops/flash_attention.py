@@ -32,6 +32,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax ≥0.5 renamed TPUCompilerParams → CompilerParams; bind whichever
+# this jax ships so the kernels compile on both sides of the rename
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+
 _NEG_INF = -1e30
 
 # Incremented (at trace time) on every flash_attention /
@@ -186,7 +191,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
@@ -390,7 +395,7 @@ def _flash_vjp_bwd(scale, causal, block_q, block_k, interpret, res, g):
     row = lambda bs, im: pl.BlockSpec((1, 1, bs, 128), im)
     common = dict(scale=scale, causal=causal, block_q=block_q,
                   block_k=block_k, causal_offset=off)
-    params = pltpu.CompilerParams(
+    params = _CompilerParams(
         dimension_semantics=("parallel", "parallel", "parallel",
                              "arbitrary"))
     km8 = _kmask8(key_mask, tk) if masked else None
@@ -555,7 +560,7 @@ def _block_partials(qt, kt, vt, qk_offset, causal, scale,
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,

@@ -204,12 +204,22 @@ class InferenceModel:
         artifact any process can load — is played by
         :meth:`export_compiled` / :meth:`load_compiled` XLA bundles.
         ``model_path`` must point at an ``export_compiled`` artifact;
-        ``weight_path`` is ignored (weights are embedded)."""
+        ``weight_path`` is ignored (weights are embedded).
+
+        TRUST MODEL: migrated call sites must know the error surface
+        changed — an OpenVINO IR load fails safely on a bad file, but
+        this shim delegates to :meth:`load_compiled`, whose
+        executable blob deserializes through jax's pickle-based
+        loader and runs with the loader's privileges. Load artifacts
+        only from sources you trust."""
         import warnings
         warnings.warn(
             "load_openvino is deprecated on the TPU-native stack; "
             "pass an export_compiled() artifact (delegating to "
-            "load_compiled)", DeprecationWarning, stacklevel=2)
+            "load_compiled — which deserializes the executable blob "
+            "through jax's pickle-based loader: load artifacts only "
+            "from sources you trust)", DeprecationWarning,
+            stacklevel=2)
         return self.load_compiled(model_path)
 
     # -- serialized AOT artifact (the OpenVINO-IR role) ---------------------

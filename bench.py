@@ -315,9 +315,10 @@ def main():
                    log_level="WARNING")
     s2d = os.environ.get("ZOO_TPU_BENCH_S2D", "1") == "1"
     # ZOO_TPU_BENCH_FUSED: "auto" (default) measures the unfused XLA
-    # graph, the Pallas fused-bottleneck variant AND the alternating
-    # deferred-apply variant, reporting the fastest sane one;
-    # "0"/"1"/"defer" pin a single variant.
+    # graph, the Pallas fused-bottleneck variant AND the chained
+    # deferred-apply variant (every interior block tail + residual
+    # epilogue riding its successor's kernel), reporting the fastest
+    # sane one; "0"/"1"/"defer" pin a single variant.
     fused_mode = os.environ.get("ZOO_TPU_BENCH_FUSED", "auto")
     loss_fn = losses.softmax_cross_entropy
     tx = optimizers.SGD(lr=0.1, momentum=0.9).to_optax()
@@ -605,6 +606,17 @@ def _last_json_line(text: str):
     return None
 
 
+def _child_banked_signal(rec) -> bool:
+    """True iff a relayed chip-child JSON line carries real signal
+    (a positive headline value or any extra metric). Null-safe on
+    "value": a line in the fallback schema (``"value": null`` +
+    ``cpu_fallback_value``) must not TypeError-crash the supervisor
+    before its own CPU stages get to run."""
+    if rec is None:
+        return False
+    return (rec.get("value") or 0) > 0 or bool(rec.get("extra_metrics"))
+
+
 def _supervise(budget_s: float) -> None:
     """Probe the backend (<=ZOO_TPU_BENCH_PROBE_S), then either run the
     full chip bench in a child (budget handed down so its watchdog
@@ -694,9 +706,7 @@ def _supervise(budget_s: float) -> None:
                          if last_json[0] is not None else None)
         except ValueError:  # truncated mid-line by the kill
             child_rec = None
-        if child_rec is not None and (
-                child_rec.get("value", 0) > 0
-                or child_rec.get("extra_metrics")):
+        if _child_banked_signal(child_rec):
             sys.exit(0)  # real signal banked by the chip child
         # child died silently OR emitted only a zero-signal error
         # line — fall through to CPU stages with whatever remains
@@ -711,6 +721,11 @@ def _supervise(budget_s: float) -> None:
                           "extra_metrics")
         print(f"# PROBE FAILED: {probe_msg}", file=sys.stderr,
               flush=True)
+    # chip unreachable from here on: the headline is explicitly null
+    # so no consumer mistakes a host-CPU img/s for chip perf — the
+    # CPU number rides in cpu_fallback_value instead (VERDICT #8)
+    merged["value"] = None
+    merged["vs_baseline"] = None
 
     # --- CPU fallback: one subprocess per workload, each with its own
     # deadline; merged artifact re-emitted after every stage ---------
@@ -748,10 +763,10 @@ def _supervise(budget_s: float) -> None:
         if rec is not None:
             merged["extra_metrics"].append(rec)
             if name == "resnet":
-                # keep the headline non-zero (clearly labeled): the
-                # value is a host-CPU measurement, not a chip claim
-                merged["value"] = rec["value"]
-                merged["vs_baseline"] = 0.0
+                # the headline stays null (chip unreachable); the
+                # host-CPU measurement is banked under its own
+                # unambiguous key
+                merged["cpu_fallback_value"] = rec["value"]
                 merged["fallback"] = rec.get("config", "cpu")
         else:
             merged.setdefault("stage_errors", []).append(err)

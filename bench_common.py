@@ -7,6 +7,30 @@ workload, one scalar host fetch per run, the constant dispatch/round-
 trip overhead (min of 5 tiny-jit samples — a single transient RPC
 spike must not inflate throughput) subtracted from the best of
 ``reps`` runs.
+
+Artifact schema (the JSON lines bench.py prints; each line is a
+self-contained best-so-far record — the last is the most complete):
+
+- ``metric``/``unit``: what the headline measures
+  (``resnet50_train_images_per_sec_per_chip``, images/sec).
+- ``value``: the CHIP headline. ``null`` whenever the chip was
+  unreachable (dead tunnel / zero-signal child) — a null headline can
+  never be mistaken for chip perf. While a live run is in flight it
+  is the best-so-far chip number (0.0 until the first measurement).
+- ``vs_baseline``: achieved model-MFU / 0.45; ``null`` with a null
+  headline.
+- ``cpu_fallback_value``: host-CPU img/s from the fallback resnet
+  stage — present ONLY when the chip was unreachable; explicitly
+  NOT chip perf (``fallback`` carries its config label).
+- ``extra_metrics``: list of per-stage/per-workload records
+  (ncf/bert/conformance/resnet fallback stages, each with its own
+  metric/value/unit).
+- ``diag``/``stage_errors``: what went wrong, per stage.
+- ``telemetry``: process-global metrics snapshot
+  (`attach_metrics_snapshot`).
+
+Exit code 0 iff real signal was banked (chip headline or at least one
+fallback stage record).
 """
 
 from __future__ import annotations

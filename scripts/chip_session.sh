@@ -1,9 +1,13 @@
 #!/bin/bash
-# Round-4 chip session: the full measurement sequence for the moment
-# the axon tunnel returns, appending everything to chip_session.log.
+# Chip session: the full measurement sequence for the moment the axon
+# tunnel returns, appending everything to chip_session.log.
 # Safe to re-run; each phase is independent. Serialize against other
 # chip jobs (axon contention corrupts timings — PERF.md).
 cd "$(dirname "$0")/.." || exit 1
+# Profiles land in a date-stamped dir by default so later sessions
+# don't overwrite or mislabel an earlier capture; override with
+# ZOO_TPU_PROFILE_DIR.
+PROFILE_DIR="${ZOO_TPU_PROFILE_DIR:-/tmp/zoo_profile_$(date +%Y%m%d)}"
 set -o pipefail   # run() pipes through tee: the probe gate below must
                   # see the COMMAND's status, not tee's
 LOG=chip_session.log
@@ -46,10 +50,10 @@ run env ZOO_TPU_CONV3_BWD_F32=1 ZOO_TPU_BENCH_FUSED=1 \
   ZOO_TPU_BENCH_NCF=0 ZOO_TPU_BENCH_BERT=0 python bench.py
 
 # 5. profile capture of both variants for PERF.md
-ZOO_TPU_BENCH_PROFILE_DIR=/tmp/zoo_r4_profile ZOO_TPU_BENCH_NCF=0 run python bench.py
+ZOO_TPU_BENCH_PROFILE_DIR="$PROFILE_DIR" ZOO_TPU_BENCH_NCF=0 run python bench.py
 
 {
-  echo "### done — results in $LOG; profiles in /tmp/zoo_r4_profile"
+  echo "### done — results in $LOG; profiles in $PROFILE_DIR"
   echo "### if fused won: flip MEASURED_WIN=True in ops/conv_bn.py (the"
   echo "### 'auto' default then routes fused on TPU) and update PERF.md"
 } | tee -a "$LOG"

@@ -145,6 +145,68 @@ def main():
                   f"({gtu / gtf:4.2f}x)", flush=True)
 
     if not args.skip_micro:
+        # residual-epilogue A/B (round-6 lever): a deferred block
+        # tail (prev bn3 folded apply + residual add + ReLU) riding
+        # the consuming c1's matmul_bn prologue — vs the same tail as
+        # unfused XLA ops feeding a plain matmul+stats. The c1
+        # block-boundary shapes are exactly where the chained
+        # deferred stage runs; fwd+bwd also times the dx kernel's
+        # in-VMEM ReLU/residual VJP + dr epilogue.
+        from analytics_zoo_tpu.ops.conv_bn import matmul_bn as _mm
+        res_shapes = [(512, 128, 256), (256, 256, 128)] if args.tiny \
+            else [
+                (128 * 56 * 56, 256, 64),     # s0 interior c1
+                (128 * 28 * 28, 512, 128),    # s1 interior c1
+                (128 * 14 * 14, 1024, 256),   # s2 interior c1
+                (128 * 7 * 7, 2048, 512),     # s3 interior c1
+            ]
+        print("# micro: residual-epilogue matmul_bn(in_residual=) "
+              "vs unfused XLA tail", flush=True)
+        for m, k, n in res_shapes:
+            x = jnp.asarray(rs.randn(m, k), jnp.bfloat16)
+            w = jnp.asarray(rs.randn(k, n) * 0.05, jnp.bfloat16)
+            r = jnp.asarray(rs.randn(m, k), jnp.bfloat16)
+            s = jnp.asarray(rs.rand(k) + 0.5, jnp.float32)
+            t = jnp.asarray(rs.randn(k) * 0.1, jnp.float32)
+            sh = jnp.asarray(rs.randn(n) * 0.1, jnp.float32)
+
+            def fused_r(x, w, r):
+                y, sm, sq = _mm(x, w, in_scale=s, in_shift=t,
+                                relu_in=True, stat_shift=sh,
+                                in_residual=r)
+                y = y + (sm + sq)[None, :].astype(y.dtype) * 0
+                return y[:, :x.shape[1]] if n >= x.shape[1] else \
+                    jnp.pad(y, ((0, 0), (0, x.shape[1] - n)))
+
+            def unfused_r(x, w, r):
+                xp = jnp.maximum(
+                    x * s[None, :].astype(x.dtype) +
+                    t[None, :].astype(x.dtype) + r, 0)
+                y = xp @ w
+                d = y.astype(jnp.float32) - sh[None, :]
+                sm, sq = jnp.sum(d, 0), jnp.sum(d * d, 0)
+                y = y + (sm + sq)[None, :].astype(y.dtype) * 0
+                return y[:, :x.shape[1]] if n >= x.shape[1] else \
+                    jnp.pad(y, ((0, 0), (0, x.shape[1] - n)))
+
+            def grad_r(fn):
+                def loss(x, w, r):
+                    return jnp.sum(fn(x, w, r).astype(jnp.float32))
+                # grad wrt x AND r: the backward must produce the
+                # residual cotangent, that's the lever being timed
+                g = jax.grad(loss, argnums=(0, 2))
+                return lambda x, w, r: g(x, w, r)[0]
+
+            tf_ = chain_time(fused_r, x, w, r)
+            tu = chain_time(unfused_r, x, w, r)
+            gtf = chain_time(grad_r(fused_r), x, w, r)
+            gtu = chain_time(grad_r(unfused_r), x, w, r)
+            print(f"M={m:9d} K={k:4d} N={n:4d} +res  "
+                  f"fwd {tu:7.3f}->{tf_:7.3f} ms ({tu / tf_:4.2f}x)  "
+                  f"fwd+bwd {gtu:7.3f}->{gtf:7.3f} ms "
+                  f"({gtu / gtf:4.2f}x)", flush=True)
+
+    if not args.skip_micro:
         # 3×3 kernel A/B (fwd only: the carry-chain trick needs
         # matching in/out channels, so conv shapes time one call per
         # scan step with Cin==Cout): stride 1 and the round-4 stride-2
@@ -238,10 +300,10 @@ def main():
         elif values.get("0", 0.0) > 0.0:
             print("# fused does not beat unfused at this config — "
                   "keep MEASURED_WIN=False; still-open levers: "
-                  "deferred-apply restructure (fold block-k's final "
-                  "bn3+residual pass into block-k+1's c1 prologue, "
-                  "both training-mode), channel-padding audit via "
-                  "--xla_dump_to, batch re-sweep", flush=True)
+                  "channel-padding audit via --xla_dump_to, batch "
+                  "re-sweep (the chained deferred-apply + residual "
+                  "epilogue now rides the fused path — see the "
+                  "PERF.md roofline)", flush=True)
 
 
 if __name__ == "__main__":

@@ -769,8 +769,85 @@ def test_matmul_bn_in_residual_grads_match(rng):
                                    err_msg=f"d{name}")
 
 
+@pytest.mark.parametrize("m,affine,relu", [
+    (512, True, True),      # the deferred-block form, single tile
+    (300, True, True),      # padded rows (r pads with ZEROS, so the
+                            # existing dW/dt pad corrections stay
+                            # exact and dr's pad rows slice off)
+    (300, False, True),     # relu over x+r without the affine
+    (300, False, False),    # raw matmul + residual, padded
+    (1100, True, True),     # multi-tile grid (n_m=3) + padding
+])
+def test_pallas_backward_residual_matches_jax_backward(
+        m, affine, relu, rng, monkeypatch):
+    # residual-epilogue backward: the dx kernel recomputes the ReLU/
+    # residual VJP in VMEM and routes the residual cotangent out
+    # through the same epilogue (dr is never materialised separately
+    # in HBM) — it must agree with the XLA-expressed backward in all
+    # operands including dr
+    k, n = 128, 256
+    x = jnp.asarray(rng.randn(m, k), jnp.float32)
+    w = jnp.asarray(rng.randn(k, n) * 0.1, jnp.float32)
+    r = jnp.asarray(rng.randn(m, k), jnp.float32)
+    s = jnp.asarray(rng.rand(k) + 0.5, jnp.float32) if affine else None
+    t = jnp.asarray(rng.randn(k), jnp.float32) if affine else None
+    sh = jnp.asarray(rng.randn(n), jnp.float32)
+
+    def loss(x, w, r, *aff):
+        kw = dict(relu_in=relu, stat_shift=sh, in_residual=r)
+        if affine:
+            kw.update(in_scale=aff[0], in_shift=aff[1])
+        y, sm, sq = matmul_bn(x, w, **kw)
+        return (jnp.sum(y.astype(jnp.float32) * 0.3) +
+                jnp.sum(jnp.sin(sm)) + jnp.sum(jnp.sqrt(sq + 1.0)))
+
+    args = (x, w, r) + ((s, t) if affine else ())
+    argnums = tuple(range(len(args)))
+    monkeypatch.setenv("ZOO_TPU_CONV_BN_PALLAS_BWD", "1")
+    gp = jax.grad(loss, argnums=argnums)(*args)
+    monkeypatch.setenv("ZOO_TPU_CONV_BN_PALLAS_BWD", "0")
+    gj = jax.grad(loss, argnums=argnums)(*args)
+    for name, a, b in zip("x w r s t".split(), gp, gj):
+        a, b = np.asarray(a), np.asarray(b)
+        tol = 2e-3 * max(float(np.abs(b).max()), 1.0)
+        np.testing.assert_allclose(a, b, rtol=2e-3, atol=tol,
+                                   err_msg=f"d{name} (m={m})")
+
+
+def test_pallas_backward_residual_bf16_padded(rng, monkeypatch):
+    # production dtype for the deferred chain: bf16 x/w/r with padded
+    # rows — exercises the r_ref astype paths and the dr output dtype
+    m, k, n = 700, 128, 256    # bm splits → tiles + padded rows
+    x = jnp.asarray(rng.randn(m, k), jnp.bfloat16)
+    w = jnp.asarray(rng.randn(k, n) * 0.1, jnp.bfloat16)
+    r = jnp.asarray(rng.randn(m, k), jnp.bfloat16)
+    s = jnp.asarray(rng.rand(k) + 0.5, jnp.float32)
+    t = jnp.asarray(rng.randn(k), jnp.float32)
+    sh = jnp.asarray(rng.randn(n) * 0.1, jnp.float32)
+
+    def loss(x, w, r, s, t):
+        y, sm, sq = matmul_bn(x, w, in_scale=s, in_shift=t,
+                              relu_in=True, stat_shift=sh,
+                              in_residual=r)
+        return (jnp.sum(y.astype(jnp.float32) * 0.3) +
+                jnp.sum(jnp.sin(sm * 0.01)) +
+                jnp.sum(jnp.sqrt(sq * 1e-4 + 1.0)))
+
+    monkeypatch.setenv("ZOO_TPU_CONV_BN_PALLAS_BWD", "1")
+    gp = jax.grad(loss, argnums=(0, 1, 2, 3, 4))(x, w, r, s, t)
+    monkeypatch.setenv("ZOO_TPU_CONV_BN_PALLAS_BWD", "0")
+    gj = jax.grad(loss, argnums=(0, 1, 2, 3, 4))(x, w, r, s, t)
+    assert gp[2].dtype == jnp.bfloat16   # dr comes back in r's dtype
+    for name, a, b in zip("x w r s t".split(), gp, gj):
+        a = np.asarray(a, np.float32)
+        b = np.asarray(b, np.float32)
+        tol = 2e-2 * max(float(np.abs(b).max()), 1.0)
+        np.testing.assert_allclose(a, b, rtol=2e-2, atol=tol,
+                                   err_msg=f"d{name}")
+
+
 def test_fused_stage_forward_matches_sequential(rng):
-    # the alternating deferred-apply stage (round-5 lever groundwork)
+    # the chained deferred-apply stage (round-5 lever groundwork)
     # must match running the same blocks sequentially — outputs,
     # BN-state updates, and gradients
     from analytics_zoo_tpu.models.image.imageclassification.resnet \
@@ -835,6 +912,84 @@ def test_fused_stage_forward_matches_sequential(rng):
     tol = 2e-3 * max(float(jnp.abs(g2).max()), 1.0)
     np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
                                rtol=2e-3, atol=tol)
+
+
+def test_fused_stage_chain_pallas_backward_matches_xla(rng,
+                                                       monkeypatch):
+    # end-to-end over the CHAINED deferred stage (every interior
+    # block's tail rides its successor's kernel): gradients with the
+    # Pallas backward — residual cotangents recomputed in VMEM and
+    # routed back through each dx kernel's epilogue — must match the
+    # XLA-expressed backward of the identical chain
+    from analytics_zoo_tpu.models.image.imageclassification.resnet \
+        import FusedBottleneck, fused_stage_forward
+    blocks = [FusedBottleneck(64, stride=1, downsample=True,
+                              input_shape=(4, 4, 128), name="c0")]
+    for i in range(1, 4):
+        blocks.append(FusedBottleneck(64, stride=1, downsample=False,
+                                      name=f"c{i}"))
+    shapes = [(4, 4, 128)] + [(4, 4, 256)] * 3
+    params = [blk.build(jax.random.PRNGKey(i), shp)
+              for i, (blk, shp) in enumerate(zip(blocks, shapes))]
+    for p in params:
+        for bn in ("bn1", "bn2", "bn3", "bnd"):
+            if bn not in p:
+                continue
+            c = p[bn]["gamma"].shape[0]
+            p[bn]["gamma"] = jnp.asarray(rng.rand(c) + 0.5,
+                                         jnp.float32)
+            p[bn]["beta"] = jnp.asarray(rng.randn(c) * 0.1,
+                                        jnp.float32)
+    x = jnp.asarray(rng.randn(2, 4, 4, 128), jnp.float32)
+
+    def loss(a):
+        out, _ = fused_stage_forward(blocks, params, a,
+                                     training=True)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    monkeypatch.setenv("ZOO_TPU_CONV_BN_PALLAS_BWD", "1")
+    gp = jax.grad(loss)(x)
+    monkeypatch.setenv("ZOO_TPU_CONV_BN_PALLAS_BWD", "0")
+    gj = jax.grad(loss)(x)
+    tol = 2e-3 * max(float(jnp.abs(gj).max()), 1.0)
+    np.testing.assert_allclose(np.asarray(gp), np.asarray(gj),
+                               rtol=2e-3, atol=tol)
+
+
+def test_fused_stage_chain_dp_sharded_matches_single(rng):
+    # the chained deferred stage under GSPMD batch sharding: outputs
+    # and BN moving-state updates must match the unsharded run (the
+    # deferred Σy/Σy² epilogues are global-batch reductions)
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from analytics_zoo_tpu.models.image.imageclassification.resnet \
+        import FusedBottleneck, fused_stage_forward
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the multi-device CPU mesh")
+    blocks = [FusedBottleneck(64, stride=1, downsample=True,
+                              input_shape=(4, 4, 128), name="d0")]
+    for i in range(1, 3):
+        blocks.append(FusedBottleneck(64, stride=1, downsample=False,
+                                      name=f"d{i}"))
+    shapes = [(4, 4, 128)] + [(4, 4, 256)] * 2
+    params = [blk.build(jax.random.PRNGKey(i), shp)
+              for i, (blk, shp) in enumerate(zip(blocks, shapes))]
+    x = jnp.asarray(rng.randn(16, 4, 4, 128), jnp.float32)
+
+    def step(ps, a):
+        out, upds = fused_stage_forward(blocks, ps, a, training=True)
+        return (jnp.mean(out.astype(jnp.float32)),
+                upds[1]["bn3"]["_state"]["moving_mean"])
+
+    l1, mm1 = jax.jit(step)(params, x)
+    nd = len(jax.devices())
+    mesh = Mesh(np.array(jax.devices()).reshape(nd), ("data",))
+    xs = jax.device_put(x, NamedSharding(mesh, P("data")))
+    ps = jax.device_put(params, NamedSharding(mesh, P()))
+    l2, mm2 = jax.jit(step)(ps, xs)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(mm1), np.asarray(mm2),
+                               atol=1e-5)
 
 
 def test_fused_stage_layer_matches_per_block(rng):
