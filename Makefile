@@ -6,7 +6,7 @@
 IMAGE ?= analytics-zoo-tpu
 
 .PHONY: test docker-build docker-test docker-test-spark dist docs \
-    lint obs-smoke fused-conformance
+    lint obs-smoke fused-conformance flops-audit
 
 test:
 	python -m pytest tests/ -x -q
@@ -23,6 +23,11 @@ fused-conformance:
 # the /metrics exposition carries every layer (docs/observability.md)
 obs-smoke:
 	JAX_PLATFORMS=cpu python scripts/obs_smoke.py
+
+# executed-FLOPs audit of the ResNet-50 train step, phase backward
+# off vs on (lowering only — CPU-safe, no chip; docs/perf_flags.md)
+flops-audit:
+	JAX_PLATFORMS=cpu python scripts/flops_audit.py --image 96
 
 docker-build:
 	docker build -t $(IMAGE) -f docker/Dockerfile .

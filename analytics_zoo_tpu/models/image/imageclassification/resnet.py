@@ -27,6 +27,12 @@ from analytics_zoo_tpu.pipeline.api.keras.layers import (
 
 def conv_bn(x, filters, kernel, stride=1, activation="relu",
              name=None):
+    # strided convs (the stem 7x7 s2, stage-transition 3x3 s2 and
+    # 1x1 s2 shortcuts of the unfused graph) inherit the gated
+    # phase-decomposed backward through Convolution2D._convolve
+    # (ops.conv_grad, ZOO_TPU_PHASE_BWD) — their input-dilated
+    # transpose-rule dx is the executed-FLOPs excess PERF.md round 6
+    # pinned
     x = Convolution2D(filters, kernel, kernel, subsample=stride,
                       border_mode="same", bias=False, name=name)(x)
     x = BatchNormalization(name=None if name is None else name + "_bn")(x)
@@ -277,6 +283,11 @@ class FusedBottleneck(KerasLayer):
             updates["bn3"] = upd3
 
         if self.downsample:
+            # the strided 1x1 shortcut slices x[::2, ::2] BEFORE the
+            # matmul (conv1x1_bn), so its backward is a cheap
+            # zero-pad — it never had the input-dilated conv the
+            # phase backward (ops.conv_grad) removes from the
+            # stage-transition 3x3 above and from the unfused graph
             ysc, sd, qd = conv1x1_bn(x, params["down"],
                                      stride=self.stride,
                                      stat_shift=mm("bnd"))
@@ -379,6 +390,8 @@ class ResNet:
             x = Activation("relu")(x)
         else:
             x = conv_bn(inp, 64, 7, stride=2, name="stem")
+        # stem maxpool backward: mask/count distribution instead of
+        # select_and_scatter (ops.pool_grad, ZOO_TPU_MAXPOOL_MASK_BWD)
         x = MaxPooling2D(pool_size=3, strides=2, border_mode="same")(x)
         filters = 64
         for stage, n_blocks in enumerate(blocks):

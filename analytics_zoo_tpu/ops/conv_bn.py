@@ -41,6 +41,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from analytics_zoo_tpu.ops import conv_grad
+
 # jax ≥0.5 renamed TPUCompilerParams → CompilerParams; bind whichever
 # this jax ships so the kernels compile on both sides of the rename
 _CompilerParams = getattr(pltpu, "CompilerParams",
@@ -849,11 +851,13 @@ def _conv3_ref(x, w, s, t, sh, relu_in, affine_in, stride=1):
         xf = jnp.maximum(xf, 0.0)
     # compute-dtype conv without a promoted output type: the conv
     # transpose rule needs all three dtypes equal, so a promoted-f32
-    # output makes bf16 autodiff through this expression crash
-    y = jax.lax.conv_general_dilated(
+    # output makes bf16 autodiff through this expression crash.
+    # conv_grad.conv2d == the same lax conv forward, but its backward
+    # is gated between the transpose rule and the phase decomposition
+    # (no dilated operand — ZOO_TPU_PHASE_BWD, trace-time)
+    y = conv_grad.conv2d(
         xf.astype(x.dtype), w.astype(x.dtype),
-        window_strides=(stride, stride),
-        padding="SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        stride=(stride, stride), padding="SAME")
     d = y.astype(f32) - sh[None, None, None, :]
     return (y, jnp.sum(d, axis=(0, 1, 2)),
             jnp.sum(d * d, axis=(0, 1, 2)))
@@ -1137,6 +1141,54 @@ def _conv3_vjp_fwd(x, w, s, t, sh, relu_in, affine_in, stride,
     return out, (x, w, s, t, sh, y)
 
 
+def _same_pads_k3(sz, stride):
+    """(lo, hi) SAME padding for the k=3 conv over extent ``sz``."""
+    ho = -(-sz // stride)
+    total = max((ho - 1) * stride + 3 - sz, 0)
+    lo = total // 2
+    return lo, total - lo
+
+
+def _conv3_dilated_bwd(gc, wc, xpc, stride, hh, ww_):
+    """jax's own conv transpose formulations written explicitly (the
+    pre-phase-decomposition backward, kept for the ZOO_TPU_PHASE_BWD
+    A/B): dXp slides the full kernel over the stride-DILATED
+    cotangent, so at stride 2 three quarters of its MACs multiply
+    inserted zeros (the executed-FLOPs excess ops.conv_grad removes).
+    Padding algebra is the SAME-padding k=3 specialization of jax's
+    _conv_general_vjp_{lhs,rhs}_padding."""
+    f32 = jnp.float32
+
+    def _pads(sz):
+        ho = -(-sz // stride)               # SAME output extent
+        total = max((ho - 1) * stride + 3 - sz, 0)
+        lo = total // 2
+        return lo, 1 + (ho - 1) * stride    # lo, dilated out size
+
+    lo_h, od_h = _pads(hh)
+    lo_w, od_w = _pads(ww_)
+    # dXp: conv of the (stride-dilated) cotangent with the
+    # spatially-reversed, I/O-swapped kernel
+    dx_pad = ((2 - lo_h, (hh + 2) - od_h - (2 - lo_h)),
+              (2 - lo_w, (ww_ + 2) - od_w - (2 - lo_w)))
+    dxp = jax.lax.conv_general_dilated(
+        gc, jax.lax.rev(wc, (0, 1)),
+        window_strides=(1, 1), padding=dx_pad,
+        lhs_dilation=(stride, stride), rhs_dilation=(1, 1),
+        dimension_numbers=("NHWC", "HWOI", "NHWC"),
+        preferred_element_type=f32)
+    # dW: contract over batch — x' as ("CHWN") against the
+    # stride-dilated cotangent as ("IHWO"), producing ("HWNC")
+    dw_pad = ((lo_h, (od_h - hh) + (2 - lo_h)),
+              (lo_w, (od_w - ww_) + (2 - lo_w)))
+    dw = jax.lax.conv_general_dilated(
+        xpc, gc, window_strides=(1, 1), padding=dw_pad,
+        lhs_dilation=(1, 1), rhs_dilation=(stride, stride),
+        dimension_numbers=("CHWN", "IHWO", "HWNC"),
+        preferred_element_type=f32)
+    return dxp, dw
+
+
 def _conv3_vjp_bwd(relu_in, affine_in, stride, interpret, res, cots):
     """XLA backward: the conv is linear in each operand, so
     `jax.linear_transpose` gives dW/dxp without re-running the
@@ -1183,34 +1235,22 @@ def _conv3_vjp_bwd(relu_in, affine_in, stride, interpret, res, cots):
         # specialization of jax's _conv_general_vjp_{lhs,rhs}_padding.
         gc = g.astype(cd)
         hh, ww_ = xp.shape[1], xp.shape[2]
-
-        def _pads(sz):
-            ho = -(-sz // stride)               # SAME output extent
-            total = max((ho - 1) * stride + 3 - sz, 0)
-            lo = total // 2
-            return lo, 1 + (ho - 1) * stride    # lo, dilated out size
-
-        lo_h, od_h = _pads(hh)
-        lo_w, od_w = _pads(ww_)
-        # dXp: conv of the (stride-dilated) cotangent with the
-        # spatially-reversed, I/O-swapped kernel
-        dx_pad = ((2 - lo_h, (hh + 2) - od_h - (2 - lo_h)),
-                  (2 - lo_w, (ww_ + 2) - od_w - (2 - lo_w)))
-        dxp = jax.lax.conv_general_dilated(
-            gc, jax.lax.rev(wc, (0, 1)),
-            window_strides=(1, 1), padding=dx_pad,
-            lhs_dilation=(stride, stride), rhs_dilation=(1, 1),
-            dimension_numbers=("NHWC", "HWOI", "NHWC"),
-            preferred_element_type=f32)
-        # dW: contract over batch — x' as ("CHWN") against the
-        # stride-dilated cotangent as ("IHWO"), producing ("HWNC")
-        dw_pad = ((lo_h, (od_h - hh) + (2 - lo_h)),
-                  (lo_w, (od_w - ww_) + (2 - lo_w)))
-        dw = jax.lax.conv_general_dilated(
-            xpc, gc, window_strides=(1, 1), padding=dw_pad,
-            lhs_dilation=(1, 1), rhs_dilation=(stride, stride),
-            dimension_numbers=("CHWN", "IHWO", "HWNC"),
-            preferred_element_type=f32)
+        if stride != 1 and conv_grad.phase_bwd_enabled():
+            # phase-decomposed backward (ops.conv_grad): same sums
+            # reassociated into stride-1 convs over UNDILATED
+            # operands — the executed-FLOPs lever; the dilated
+            # formulation below wastes (s^2-1)/s^2 of its dx MACs on
+            # inserted zeros
+            sp = tuple(_same_pads_k3(sz, stride) for sz in (hh, ww_))
+            dxp = conv_grad.phase_dx(
+                gc, wc, (hh, ww_), (stride, stride), sp,
+                preferred_element_type=f32)
+            dw = conv_grad.phase_dw(
+                xpc, gc, (3, 3), (stride, stride), sp,
+                preferred_element_type=f32)
+        else:
+            dxp, dw = _conv3_dilated_bwd(gc, wc, xpc, stride, hh,
+                                         ww_)
     if relu_in:
         dxp = jnp.where(xa > 0.0, dxp, 0.0)
     if affine_in:

@@ -1,0 +1,2 @@
+"""Performance accounting helpers (FLOPs audit, executed-vs-model
+ratios) shared by bench.py, scripts/flops_audit.py and tests."""

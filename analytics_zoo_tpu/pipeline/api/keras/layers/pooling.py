@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from analytics_zoo_tpu.ops import pool_grad
 from analytics_zoo_tpu.pipeline.api.keras.engine import KerasLayer, Shape
 from analytics_zoo_tpu.pipeline.api.keras.layers.conv import (
     _conv_out_len, _norm_tuple)
@@ -43,6 +44,17 @@ class _PoolND(KerasLayer):
     def call(self, params, x, *, training=False, rng=None):
         window, strides = self._window()
         if self.mode == "max":
+            # NHWC float 2-D max pools route through the mask-based
+            # custom VJP (ops.pool_grad): the select_and_scatter that
+            # jax's transpose rule emits is a sequential window scan
+            # on TPU; the mask backward is dense element-wise work.
+            # ZOO_TPU_MAXPOOL_MASK_BWD=0 reverts (trace-time).
+            if (self.ndim == 2 and self.dim_ordering == "tf"
+                    and jnp.issubdtype(x.dtype, jnp.floating)
+                    and pool_grad.mask_bwd_enabled()):
+                return pool_grad.maxpool2d(
+                    x, self.pool_size, self.strides,
+                    self.border_mode)
             init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
                 else jnp.iinfo(x.dtype).min
             return jax.lax.reduce_window(

@@ -241,7 +241,19 @@ def _stage_resnet_cpu_main():
 
     _, run = _resnet_train_chain(
         model, tx, losses.softmax_cross_entropy, steps)
-    compiled = jax.jit(run).lower(params, opt_state, x, y).compile()
+    lowered = jax.jit(run).lower(params, opt_state, x, y)
+    # same executed-vs-model account as the chip path (see
+    # _measure_variant_inner): 2x because flops_analytic counts MACs
+    flops_ratio = None
+    try:
+        from analytics_zoo_tpu.perf import flops as perf_flops
+        flops_ratio = round(
+            perf_flops.executed_flops(perf_flops.hlo_text(lowered)) /
+            (2.0 * 3 * 4.09e9 * batch * (image / 224.0) ** 2), 4)
+    except Exception as e:
+        print(f"# flops audit failed: {e}", file=sys.stderr,
+              flush=True)
+    compiled = lowered.compile()
     from bench_common import time_chain
     dt, loss = time_chain(compiled, (params, opt_state, x, y), reps=2)
     print(json.dumps({
@@ -250,6 +262,7 @@ def _stage_resnet_cpu_main():
         "vs_baseline": None,
         "config": f"batch={batch} image={image} steps={steps} bf16 "
                   f"host-CPU (chip unreachable)",
+        "flops_ratio_executed_vs_model": flops_ratio,
         "loss": round(float(loss), 4)}), flush=True)
 
 
@@ -366,7 +379,7 @@ def main():
     ref_loss_holder = {}
 
     VARIANT_TAGS = {False: "unfused", True: "fused",
-                    "defer": "defer"}
+                    "defer": "defer", "phase": "phase"}
 
     def _host_init(model):
         """Host-CPU param + opt init (one device transfer later beats
@@ -381,8 +394,21 @@ def main():
     def measure_variant(fused):
         tag = VARIANT_TAGS[fused]
         _result["diag"] = f"building {tag} model"
+        if fused == "phase":
+            # unfused XLA graph + phase-decomposed strided backward
+            # (ops.conv_grad): the flag is read at trace time, so it
+            # must wrap the lower() below; restored in the finally
+            os.environ["ZOO_TPU_PHASE_BWD"] = "1"
+        try:
+            return _measure_variant_inner(fused, tag)
+        finally:
+            if fused == "phase":
+                os.environ.pop("ZOO_TPU_PHASE_BWD", None)
+
+    def _measure_variant_inner(fused, tag):
         model = resnet50(input_shape=(image, image, 3), classes=1000,
-                         space_to_depth=s2d, fused=fused)
+                         space_to_depth=s2d,
+                         fused=False if fused == "phase" else fused)
         # Param/optimizer init is ~270 tiny eager ops; on the remote
         # axon tunnel each one is a compile + RTT (round 3's "building
         # model" watchdog kill). Run them on host CPU, transfer once.
@@ -401,6 +427,22 @@ def main():
         _result["diag"] = f"compiling {tag} train step"
         t0 = time.perf_counter()
         lowered = jax.jit(run).lower(params, opt_state, x, y)
+        if fused in (False, "phase") and \
+                "flops_ratio_executed_vs_model" not in _result:
+            # executed-vs-model FLOPs ratio of the XLA graph actually
+            # measured (perf.flops: dilation zeros count as executed;
+            # HloCostAnalysis discounts them and cannot see the gap).
+            # flops_analytic counts MACs (torchvision's 4.09e9/img);
+            # executed_flops counts 2 FLOPs/MAC — hence the 2x.
+            try:
+                from analytics_zoo_tpu.perf import flops as perf_flops
+                _result["flops_ratio_executed_vs_model"] = round(
+                    perf_flops.executed_flops(
+                        perf_flops.hlo_text(lowered)) /
+                    (2.0 * flops_analytic), 4)
+            except Exception as e:
+                print(f"# [{tag}] flops audit failed: {e}",
+                      file=sys.stderr, flush=True)
         if not fused:
             ref_flops_holder["flops"] = _cost_flops(lowered)
         elif "flops" not in ref_flops_holder:
@@ -496,11 +538,12 @@ def main():
         return images_per_sec
 
     # auto order matters: unfused first BANKS a headline number (the
-    # watchdog emits best-so-far), then the Pallas variants try to
-    # beat it — a budget blowout mid-Mosaic-compile costs nothing
-    variants = {"0": [False], "1": [True],
-                "defer": ["defer"]}.get(fused_mode,
-                                        [False, True, "defer"])
+    # watchdog emits best-so-far), then phase (plain XLA, cheap to
+    # compile) and the Pallas variants try to beat it — a budget
+    # blowout mid-Mosaic-compile costs nothing
+    variants = {"0": [False], "1": [True], "defer": ["defer"],
+                "phase": ["phase"]}.get(
+                    fused_mode, [False, "phase", True, "defer"])
     succeeded, last_err = 0, None
     for fused in variants:
         try:
@@ -516,7 +559,7 @@ def main():
                   f"{type(e).__name__}: {e}", file=sys.stderr,
                   flush=True)
             last_err = e
-            if fused_mode in ("0", "1", "defer"):
+            if fused_mode in ("0", "1", "defer", "phase"):
                 raise
     if not succeeded:
         # both variants failed: surface the error (diag JSON + rc 1)

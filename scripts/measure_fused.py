@@ -253,6 +253,50 @@ def main():
                   f"fwd {tu:7.3f}->{tf_:7.3f} ms ({tu / tf_:4.2f}x)",
                   flush=True)
 
+    if not args.skip_micro:
+        # stride-2 backward A/B (round-7 lever): jax's transpose rule
+        # (lhs-dilated dx conv + rhs-dilated dw conv) vs the
+        # phase-decomposed backward (ops.conv_grad: s^2 dense stride-1
+        # convs + interleave). Times grad wrt (x, w) of one strided
+        # conv at ResNet-50's stage-transition shapes; the chain
+        # carries dx (same shape as x).
+        from analytics_zoo_tpu.ops import conv_grad
+        ph_shapes = [(8, 16, 16, 32, 32, 3), (8, 16, 16, 32, 64, 1)] \
+            if args.tiny else [
+                (8, 56, 56, 128, 128, 3),     # s1 c2 3x3 s2
+                (8, 28, 28, 256, 256, 3),     # s2 c2 3x3 s2
+                (8, 14, 14, 512, 512, 3),     # s3 c2 3x3 s2
+                (8, 56, 56, 256, 512, 1),     # s1 downsample 1x1 s2
+                (8, 28, 28, 512, 1024, 1),    # s2 downsample 1x1 s2
+                (8, 14, 14, 1024, 2048, 1),   # s3 downsample 1x1 s2
+            ]
+        print("# micro: stride-2 backward, transpose-rule (dilated) "
+              "vs phase-decomposed", flush=True)
+        for b, h, wd, ci, co, kk in ph_shapes:
+            xc = jnp.asarray(rs.randn(b, h, wd, ci), jnp.bfloat16)
+            wc = jnp.asarray(rs.randn(kk, kk, ci, co) * 0.05,
+                             jnp.bfloat16)
+
+            def grad_conv(phase):
+                def loss(x, w):
+                    y = conv_grad.conv2d(x, w, stride=(2, 2),
+                                         padding="SAME",
+                                         phase_bwd=phase)
+                    return jnp.sum(y.astype(jnp.float32))
+                g = jax.grad(loss, argnums=(0, 1))
+                def f(x, w):
+                    dx, dw = g(x, w)
+                    # fold dw into the carry so neither grad is DCE'd
+                    return dx + jnp.sum(dw.astype(jnp.float32)
+                                        ).astype(dx.dtype) * 0
+                return f
+
+            td = chain_time(grad_conv(False), xc, wc)
+            tp = chain_time(grad_conv(True), xc, wc)
+            print(f"conv{kk}x{kk} B={b} {h}x{wd} {ci}->{co} s=2  "
+                  f"fwd+bwd {td:7.3f}->{tp:7.3f} ms "
+                  f"({td / tp:4.2f}x)", flush=True)
+
     if not args.skip_model:
         print("# model A/B: ZOO_TPU_BENCH_FUSED 0 vs 1:", flush=True)
         import json

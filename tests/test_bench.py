@@ -128,6 +128,11 @@ def test_bench_stage_resnet_cpu_emits_labeled_record():
     assert rec["metric"] == "resnet50_train_images_per_sec_CPU_FALLBACK"
     assert rec["value"] > 0
     assert "host-CPU" in rec["config"]
+    # executed-vs-model FLOPs ratio rides every ResNet record; >1
+    # because the default transpose-rule backward executes dilated
+    # convs (perf.flops counts them; ZOO_TPU_PHASE_BWD=1 removes
+    # them — docs/perf_flags.md)
+    assert rec["flops_ratio_executed_vs_model"] > 1.0
     # one-core sanity ceiling: a dispatch-only (unsynced) timing bug
     # reports physically-impossible throughput (bench_common r4 bug:
     # the elapsed time was computed BEFORE the blocking loss fetch)
@@ -175,6 +180,10 @@ def test_bench_live_carries_both_workloads_and_model_mfu():
     assert rec["mfu_model_flops"] > 0
     assert rec["mfu_xla_flops"] > 0
     assert rec["vs_baseline_model_flops"] is not None
+    # live-run artifact carries the executed-vs-model FLOPs ratio of
+    # the measured (unfused, transpose-rule-backward) XLA graph; >1
+    # is the round-7 lever's before number (docs/perf_flags.md)
+    assert rec["flops_ratio_executed_vs_model"] > 1.0
     extras = {m["metric"]: m for m in rec["extra_metrics"]}
     assert extras["ncf_train_samples_per_sec_per_chip"]["value"] > 0
 
