@@ -6,7 +6,8 @@
 IMAGE ?= analytics-zoo-tpu
 
 .PHONY: test docker-build docker-test docker-test-spark dist docs \
-    lint obs-smoke fused-conformance flops-audit
+    lint obs-smoke fused-conformance flops-audit serving-smoke \
+    bench-serving
 
 test:
 	python -m pytest tests/ -x -q
@@ -28,6 +29,17 @@ obs-smoke:
 # off vs on (lowering only — CPU-safe, no chip; docs/perf_flags.md)
 flops-audit:
 	JAX_PLATFORMS=cpu python scripts/flops_audit.py --image 96
+
+# dynamic-batching end-to-end: batched server (default front-end),
+# mixed-size concurrent requests, exact outputs, warmed buckets,
+# queue metrics on /metrics (docs/serving.md)
+serving-smoke:
+	JAX_PLATFORMS=cpu python scripts/serving_smoke.py
+
+# batched-vs-unbatched serving throughput on the host CPU backend
+# (the chip headline stays null; see bench_serving.py)
+bench-serving:
+	JAX_PLATFORMS=cpu python bench_serving.py --cpu-fallback
 
 docker-build:
 	docker build -t $(IMAGE) -f docker/Dockerfile .

@@ -1,5 +1,9 @@
+from analytics_zoo_tpu.pipeline.inference.batching import (
+    DynamicBatcher)
 from analytics_zoo_tpu.pipeline.inference.inference_model import (
     InferenceModel)
-from analytics_zoo_tpu.pipeline.inference.serving import InferenceServer
+from analytics_zoo_tpu.pipeline.inference.serving import (
+    InferenceServer, make_inference_server)
 
-__all__ = ["InferenceModel", "InferenceServer"]
+__all__ = ["InferenceModel", "InferenceServer", "DynamicBatcher",
+           "make_inference_server"]

@@ -1,0 +1,544 @@
+"""Dynamic request batching for the serving layer (L9).
+
+The reference platform's serving story is per-request: one POST, one
+forward (`AbstractInferenceModel.java:25-103`, the web-service
+samples). On TPU that shape is pathological twice over — the MXU is
+utilization-starved at batch 1, and every distinct request batch size
+is a distinct XLA program, so a production mix of request sizes
+recompiles forever. This module supplies the two levers Clipper
+(NSDI'17) and ORCA (OSDI'22) establish for the problem:
+
+- **shape-bucketed coalescing** — requests land in a bounded queue; a
+  dispatcher thread drains up to ``max_batch_size`` rows or until
+  ``max_wait_ms`` expires, pads the coalesced batch up to the next
+  size in a bucket ladder (powers of two by default), runs ONE
+  compiled call per bucket shape, and scatters the un-padded result
+  rows back to per-request futures;
+- **admission discipline** — a full queue rejects immediately
+  (:class:`QueueFullError` → HTTP 503 + ``Retry-After``), bounding
+  queue latency instead of letting it grow without limit, and
+  per-request deadlines evict expired entries before dispatch
+  (:class:`DeadlineExpiredError` → HTTP 504).
+
+Every bucket is AOT-lowered-and-compiled up front (server start when
+the model declared ``example_inputs``; first sight of a signature
+otherwise), so steady-state serving performs **zero** compilations
+regardless of the request-size mix.
+
+Configuration: constructor kwargs override the environment —
+``ZOO_TPU_SERVING_BATCH`` (``0`` disables, reverting to the
+per-request path), ``ZOO_TPU_SERVING_MAX_BATCH``,
+``ZOO_TPU_SERVING_MAX_WAIT_MS``, ``ZOO_TPU_SERVING_QUEUE_DEPTH``,
+``ZOO_TPU_SERVING_DEADLINE_MS``, ``ZOO_TPU_SERVING_BUCKETS``
+(comma-separated ladder override). See docs/serving.md for the
+request lifecycle and the tuning guide, docs/perf_flags.md for the
+flag catalog.
+
+Correctness contract: the served forward must be row-wise in eval
+mode (row *i* of the output depends only on row *i* of the inputs) —
+true of every model the zoo serves (inference runs with
+``training=False``, so BatchNorm uses moving statistics). Padding
+rows are zeros and are sliced off before scatter.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from analytics_zoo_tpu.common import observability as obs
+from analytics_zoo_tpu.common.nncontext import logger
+
+__all__ = [
+    "DynamicBatcher",
+    "QueueFullError",
+    "DeadlineExpiredError",
+    "bucket_ladder",
+]
+
+# fill-ratio histogram buckets: rows / bucket capacity in (0, 1]
+_FILL_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
+
+
+class QueueFullError(Exception):
+    """Admission rejected: the batcher queue is at capacity. Carries
+    ``retry_after_s``, an estimate of when capacity frees up (served
+    to clients as HTTP 503 + ``Retry-After``)."""
+
+    def __init__(self, depth: int, retry_after_s: float):
+        super().__init__(
+            f"serving queue full ({depth} requests waiting); "
+            f"retry in ~{retry_after_s:.2f}s")
+        self.retry_after_s = retry_after_s
+
+
+class DeadlineExpiredError(Exception):
+    """The request's deadline elapsed while it waited in the queue
+    (served to clients as HTTP 504)."""
+
+
+def bucket_ladder(max_batch: int,
+                  override: Optional[Sequence[int]] = None
+                  ) -> "Tuple[int, ...]":
+    """The batch sizes the batcher compiles and pads to: powers of
+    two up to ``max_batch`` (with ``max_batch`` itself appended when
+    it is not a power of two), or a validated copy of ``override``."""
+    if override is not None:
+        ladder = sorted({int(b) for b in override})
+        if not ladder or ladder[0] < 1:
+            raise ValueError(f"invalid bucket ladder: {override!r}")
+        return tuple(ladder)
+    ladder = []
+    b = 1
+    while b < max_batch:
+        ladder.append(b)
+        b *= 2
+    ladder.append(max_batch)
+    return tuple(ladder)
+
+
+class _Entry:
+    """One queued request: input arrays, row count, completion
+    future, and the two clocks (enqueue time, absolute deadline)."""
+
+    __slots__ = ("xs", "n", "sig", "future", "t_enq", "deadline")
+
+    def __init__(self, xs, n, sig, deadline):
+        self.xs = xs
+        self.n = n
+        self.sig = sig
+        self.future: "Future" = Future()
+        self.t_enq = time.monotonic()
+        self.deadline = deadline  # absolute monotonic, or None
+
+
+def _signature(xs) -> tuple:
+    """Coalescing key: per-input (row shape, dtype). Requests only
+    merge when every input position agrees on both."""
+    return tuple((tuple(x.shape[1:]), str(x.dtype)) for x in xs)
+
+
+class DynamicBatcher:
+    """Cross-request micro-batching between the HTTP front-ends and
+    :class:`InferenceModel` (module docstring has the design).
+
+    Thread model: any number of handler threads call :meth:`submit`;
+    ONE dispatcher thread drains, pads, executes, and scatters — so
+    device execution is serialized by construction and the model's
+    slot pool is not consumed by the batched path.
+    """
+
+    def __init__(self, model, *,
+                 max_batch_size: Optional[int] = None,
+                 max_wait_ms: Optional[float] = None,
+                 queue_depth: Optional[int] = None,
+                 deadline_ms: Optional[float] = None,
+                 buckets: Optional[Sequence[int]] = None):
+        env = os.environ
+        if max_batch_size is None:
+            max_batch_size = int(env.get(
+                "ZOO_TPU_SERVING_MAX_BATCH", 32))
+        if max_wait_ms is None:
+            max_wait_ms = float(env.get(
+                "ZOO_TPU_SERVING_MAX_WAIT_MS", 5))
+        if queue_depth is None:
+            queue_depth = int(env.get(
+                "ZOO_TPU_SERVING_QUEUE_DEPTH", 256))
+        if deadline_ms is None:
+            deadline_ms = float(env.get(
+                "ZOO_TPU_SERVING_DEADLINE_MS", 0))
+        if buckets is None and env.get("ZOO_TPU_SERVING_BUCKETS"):
+            buckets = [int(b) for b in
+                       env["ZOO_TPU_SERVING_BUCKETS"].split(",")]
+        self.model = model
+        self.buckets = bucket_ladder(int(max_batch_size), buckets)
+        self.max_batch = self.buckets[-1]
+        self.max_wait_s = float(max_wait_ms) / 1e3
+        self.queue_depth = int(queue_depth)
+        self.deadline_s = (float(deadline_ms) / 1e3
+                           if deadline_ms else None)
+
+        self._q: "deque[_Entry]" = deque()
+        self._cond = threading.Condition()
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        # (signature, bucket) -> compiled executable; invalidated
+        # when the model swaps generations (reload)
+        self._compiled: dict = {}
+        self._unlowerable: set = set()  # sigs that failed to warm
+        self._compile_lock = threading.Lock()
+        self._model_gen = getattr(model, "generation", 0)
+        self._ema_batch_s = 0.01  # retry-after estimator seed
+        # touch the gauges so /metrics carries them from the start
+        self._depth_gauge().set(0)
+        self._warmed_gauge().set(0)
+
+    # -- factory ------------------------------------------------------------
+    @classmethod
+    def from_env(cls, model) -> "Optional[DynamicBatcher]":
+        """The servers' default construction path: a batcher with
+        env-derived settings, or ``None`` when
+        ``ZOO_TPU_SERVING_BATCH=0`` reverts to per-request serving."""
+        if os.environ.get("ZOO_TPU_SERVING_BATCH", "1") == "0":
+            return None
+        return cls(model)
+
+    # -- metrics handles ----------------------------------------------------
+    @staticmethod
+    def _depth_gauge():
+        return obs.gauge("zoo_tpu_serving_queue_depth",
+                         help="requests waiting in the batcher queue")
+
+    @staticmethod
+    def _warmed_gauge():
+        return obs.gauge("zoo_tpu_serving_warmed_buckets",
+                         help="bucket executables compiled and ready")
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "DynamicBatcher":
+        """Warm every bucket (when the model declared example inputs)
+        and start the dispatcher thread. Idempotent."""
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self.warm()
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._run, name="zoo-tpu-batcher", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 30.0):
+        """Drain the queue (pending entries execute or expire), then
+        stop the dispatcher."""
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+
+    def warm(self) -> int:
+        """AOT-lower-and-compile the whole bucket ladder for the
+        model's declared example-input signature (the `_install`
+        example-inputs path). Returns the number of warmed buckets;
+        0 when the signature is unknown (warming then happens on
+        first sight of each request signature) or the model cannot
+        re-lower (a `load_compiled` serialized executable)."""
+        specs = getattr(self.model, "example_input_specs", None)
+        if not specs or not getattr(self.model, "can_relower", False):
+            return 0
+        sig = tuple((tuple(shape[1:]), str(np.dtype(dt)))
+                    for shape, dt in specs)
+        try:
+            return self._warm_signature(sig)
+        except Exception as e:
+            with self._compile_lock:
+                self._unlowerable.add(sig)
+            logger.warning(
+                "bucket warm failed at start for declared signature "
+                "%s (%s: %s); serving it unpadded", sig,
+                type(e).__name__, e)
+            return 0
+
+    # -- admission ----------------------------------------------------------
+    def batchable(self, xs: Sequence[np.ndarray]) -> bool:
+        """Whether these inputs can ride the coalescing path: every
+        input has a leading (row) dimension and all agree on it."""
+        if not xs:
+            return False
+        if any(x.ndim < 1 for x in xs):
+            return False
+        n = xs[0].shape[0]
+        return n >= 1 and all(x.shape[0] == n for x in xs)
+
+    def submit(self, xs: Sequence[np.ndarray]) -> "Future":
+        """Enqueue one request (a list of row-aligned input arrays).
+        Returns a future resolving to exactly what
+        ``model.predict`` would return for these inputs (one array,
+        or a list for multi-output models). Raises
+        :class:`QueueFullError` when the queue is at capacity."""
+        xs = [np.asarray(x) for x in xs]
+        if not self.batchable(xs):
+            raise ValueError(
+                "inputs are not row-aligned (every input needs the "
+                "same leading dimension >= 1)")
+        n = xs[0].shape[0]
+        deadline = (time.monotonic() + self.deadline_s
+                    if self.deadline_s else None)
+        entry = _Entry(xs, n, _signature(xs), deadline)
+        with self._cond:
+            if len(self._q) >= self.queue_depth:
+                # ~time for the backlog to drain at current exec rate
+                retry = max(
+                    0.05, len(self._q) * self._ema_batch_s
+                    * max(1.0, n / self.max_batch))
+                obs.counter("zoo_tpu_serving_errors_total",
+                            help="serving errors by kind",
+                            labels={"kind": "queue_full"}).inc()
+                raise QueueFullError(len(self._q), retry)
+            self._q.append(entry)
+            self._depth_gauge().set(len(self._q))
+            self._cond.notify_all()
+        return entry.future
+
+    # -- dispatcher ---------------------------------------------------------
+    def _evict_expired_locked(self):
+        if self.deadline_s is None or not self._q:
+            return
+        now = time.monotonic()
+        kept = deque()
+        for e in self._q:
+            if e.deadline is not None and e.deadline < now:
+                obs.counter("zoo_tpu_serving_errors_total",
+                            help="serving errors by kind",
+                            labels={"kind": "deadline_expired"}).inc()
+                e.future.set_exception(DeadlineExpiredError(
+                    f"request waited past its "
+                    f"{self.deadline_s * 1e3:.0f}ms deadline"))
+            else:
+                kept.append(e)
+        if len(kept) != len(self._q):
+            self._q = kept
+            self._depth_gauge().set(len(self._q))
+
+    def _ready_rows_locked(self) -> int:
+        """Row count of the maximal coalescible prefix (same
+        signature as the head, cumulative rows <= max_batch)."""
+        rows = 0
+        sig = self._q[0].sig
+        for e in self._q:
+            if e.sig != sig or (rows and rows + e.n > self.max_batch):
+                break
+            rows += e.n
+        return rows
+
+    def _take_batch_locked(self) -> "list[_Entry]":
+        batch: "list[_Entry]" = []
+        rows = 0
+        while self._q:
+            e = self._q[0]
+            if batch and (e.sig != batch[0].sig
+                          or rows + e.n > self.max_batch):
+                break
+            batch.append(self._q.popleft())
+            rows += e.n
+            if rows >= self.max_batch:
+                break
+        self._depth_gauge().set(len(self._q))
+        return batch
+
+    def _run(self):
+        while True:
+            with self._cond:
+                while not self._q and not self._stop:
+                    self._cond.wait(timeout=0.1)
+                if not self._q:
+                    if self._stop:
+                        return
+                    continue
+                self._evict_expired_locked()
+                if not self._q:
+                    continue
+                # coalescing window anchored at the head's arrival:
+                # the oldest request never waits past max_wait_ms
+                wait_until = self._q[0].t_enq + self.max_wait_s
+                while (not self._stop
+                       and self._ready_rows_locked() < self.max_batch):
+                    remaining = wait_until - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(timeout=min(remaining, 0.05))
+                    self._evict_expired_locked()
+                    if not self._q:
+                        break
+                if not self._q:
+                    continue
+                batch = self._take_batch_locked()
+            if batch:
+                try:
+                    self._execute(batch)
+                except Exception as e:  # belt & braces: a dispatch
+                    # failure must fail its requests, not the thread
+                    for entry in batch:
+                        if not entry.future.done():
+                            entry.future.set_exception(e)
+                    logger.warning("batcher dispatch error: %s", e)
+
+    # -- execution ----------------------------------------------------------
+    def _execute(self, batch: "list[_Entry]"):
+        now = time.monotonic()
+        wait_h = obs.histogram(
+            "zoo_tpu_serving_queue_wait_seconds",
+            help="time requests spent queued before dispatch")
+        for e in batch:
+            wait_h.observe(now - e.t_enq)
+        sig = batch[0].sig
+        n_inputs = len(batch[0].xs)
+        rows = sum(e.n for e in batch)
+        if len(batch) == 1:
+            xs = batch[0].xs
+        else:
+            xs = [np.concatenate([e.xs[i] for e in batch])
+                  for i in range(n_inputs)]
+        t0 = time.monotonic()
+        try:
+            outs, multi = self._run_rows(sig, xs, rows)
+        except Exception as e:
+            for entry in batch:
+                entry.future.set_exception(e)
+            return
+        self._ema_batch_s = (0.8 * self._ema_batch_s
+                             + 0.2 * (time.monotonic() - t0))
+        off = 0
+        for entry in batch:
+            rows_out = [o[off:off + entry.n] for o in outs]
+            entry.future.set_result(
+                rows_out if multi else rows_out[0])
+            off += entry.n
+
+    def _run_rows(self, sig, xs, rows):
+        """Execute ``rows`` coalesced rows, chunking when a single
+        oversized request exceeds ``max_batch``. Returns ``(outs,
+        multi)``: row-aligned output arrays (one per model output)
+        and whether the model returned a list (so scatter can
+        preserve the per-request output structure)."""
+        if rows <= self.max_batch:
+            return self._pad_and_run(sig, xs, rows)
+        chunks = []
+        multi = False
+        for lo in range(0, rows, self.max_batch):
+            hi = min(lo + self.max_batch, rows)
+            part, multi = self._pad_and_run(
+                sig, [x[lo:hi] for x in xs], hi - lo)
+            chunks.append(part)
+        return [np.concatenate([c[i] for c in chunks])
+                for i in range(len(chunks[0]))], multi
+
+    def _pad_and_run(self, sig, xs, n):
+        bucket = next(b for b in self.buckets if b >= n)
+        fn = self._get_compiled(sig, bucket)
+        obs.histogram("zoo_tpu_serving_batch_size",
+                      help="predict batch size (leading dim)",
+                      buckets=obs.SIZE_BUCKETS).observe(n)
+        obs.histogram("zoo_tpu_serving_batch_fill_ratio",
+                      help="coalesced rows / bucket capacity",
+                      buckets=_FILL_BUCKETS).observe(n / bucket)
+        if fn is None:
+            # model cannot re-lower (serialized executable without a
+            # batch-polymorphic blob): coalesce without padding via
+            # the per-request path — still one call per drained batch
+            with obs.span("serving/predict", rows=n, bucket=0):
+                out = self.model.predict(
+                    list(xs) if len(xs) > 1 else xs[0])
+            multi = isinstance(out, list)
+            outs = out if multi else [out]
+            return [np.asarray(o) for o in outs], multi
+        pad = bucket - n
+        if pad:
+            xs = [np.concatenate(
+                [x, np.zeros((pad,) + x.shape[1:], x.dtype)])
+                for x in xs]
+            obs.counter("zoo_tpu_serving_padding_rows_total",
+                        help="padding rows executed (bucket waste)"
+                        ).inc(pad)
+        obs.counter("zoo_tpu_serving_batch_executions_total",
+                    help="bucket executions",
+                    labels={"bucket": str(bucket)}).inc()
+        with obs.span("serving/predict", rows=n, bucket=bucket):
+            out = fn(*xs)
+        multi = isinstance(out, (list, tuple))
+        outs = list(out) if multi else [out]
+        outs = [np.asarray(o) for o in outs]
+        for o in outs:
+            if o.ndim < 1 or o.shape[0] != bucket:
+                raise ValueError(
+                    "model output is not row-aligned with its input "
+                    f"(expected leading dim {bucket}, got "
+                    f"{o.shape}); dynamic batching requires a "
+                    "row-wise forward")
+        return [o[:n] for o in outs], multi
+
+    # -- bucket executables -------------------------------------------------
+    def _get_compiled(self, sig, bucket: int):
+        gen = getattr(self.model, "generation", 0)
+        with self._compile_lock:
+            if gen != self._model_gen:  # model reloaded underneath us
+                self._compiled.clear()
+                self._unlowerable.clear()
+                self._model_gen = gen
+                self._warmed_gauge().set(0)
+            fn = self._compiled.get((sig, bucket))
+            blocked = sig in self._unlowerable
+        if fn is not None:
+            return fn
+        if blocked or not getattr(self.model, "can_relower", False):
+            return None
+        # first sight of this signature: warm the WHOLE ladder so the
+        # request mix that follows never compiles again
+        try:
+            self._warm_signature(sig)
+        except Exception as e:
+            # e.g. a program that only lowers at its declared shapes
+            # — serve this signature through the un-padded fallback
+            with self._compile_lock:
+                self._unlowerable.add(sig)
+            logger.warning(
+                "bucket warm failed for signature %s (%s: %s); "
+                "serving it unpadded through model.predict",
+                sig, type(e).__name__, e)
+        with self._compile_lock:
+            return self._compiled.get((sig, bucket))
+
+    def _warm_signature(self, sig) -> int:
+        import jax
+        warmed = 0
+        for b in self.buckets:
+            with self._compile_lock:
+                if (sig, b) in self._compiled:
+                    continue
+            args = [jax.ShapeDtypeStruct((b,) + tuple(shape),
+                                         np.dtype(dt))
+                    for shape, dt in sig]
+            with obs.span("serving/bucket_warm", bucket=b):
+                fn = self.model.lower_for(args)
+            obs.counter("zoo_tpu_serving_bucket_compiles_total",
+                        help="bucket executables compiled "
+                        "(warm-up only in steady state)").inc()
+            with self._compile_lock:
+                self._compiled[(sig, b)] = fn
+                self._warmed_gauge().set(len(self._compiled))
+            warmed += 1
+        return warmed
+
+    # -- introspection ------------------------------------------------------
+    @property
+    def warmed_buckets(self) -> int:
+        with self._compile_lock:
+            return len(self._compiled)
+
+    def stats(self) -> dict:
+        """JSON-able summary for ``GET /health``."""
+        with self._cond:
+            depth = len(self._q)
+        return {
+            "enabled": True,
+            "queue_depth": depth,
+            "queue_capacity": self.queue_depth,
+            "buckets": list(self.buckets),
+            "warmed_buckets": self.warmed_buckets,
+            "max_wait_ms": self.max_wait_s * 1e3,
+            "deadline_ms": (self.deadline_s * 1e3
+                            if self.deadline_s else None),
+        }
+
+    def __repr__(self):
+        return (f"DynamicBatcher(buckets={list(self.buckets)}, "
+                f"max_wait_ms={self.max_wait_s * 1e3:g}, "
+                f"queue_depth={self.queue_depth}, "
+                f"warmed={self.warmed_buckets})")

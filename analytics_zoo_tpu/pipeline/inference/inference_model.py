@@ -75,6 +75,9 @@ class InferenceModel:
         self._predict_fn: Optional[Callable] = None
         self._export_src: Optional[Tuple] = None
         self._compiled = False
+        self._trace_fn: Optional[Callable] = None
+        self._example_specs = None  # [(shape, np.dtype)] when known
+        self._generation = 0
         self._lock = threading.Lock()
         self.quantized = None  # QuantizedModel when loaded with int8
 
@@ -83,20 +86,27 @@ class InferenceModel:
                  example_inputs: Optional[Sequence[np.ndarray]] = None,
                  export_state: Optional[Tuple] = None):
         import jax
-        fn = jax.jit(predict_fn)
+        jfn = jax.jit(predict_fn)
+        fn = jfn
         if example_inputs is not None:
             # AOT-compile for the declared shapes (the OpenVINO-IR role)
-            fn = fn.lower(*example_inputs).compile()
+            fn = jfn.lower(*example_inputs).compile()
         # kept for export_compiled: ``(params_pytree, pure_fn)`` —
         # the pure form lets export re-commit the weights to ONE
         # device and stage a single-device artifact program,
         # independent of this process's mesh (a serving process is
         # one chip; a program lowered against mesh-committed params
         # would demand the exporter's device count from every loader)
+        specs = None
+        if example_inputs is not None:
+            specs = [(tuple(np.shape(e)), np.asarray(e).dtype)
+                     for e in example_inputs]
         self._swap_model(fn, compiled=example_inputs is not None,
-                         export_src=(export_state, example_inputs))
+                         export_src=(export_state, example_inputs),
+                         trace_fn=jfn, example_specs=specs)
 
-    def _swap_model(self, fn, compiled: bool, export_src):
+    def _swap_model(self, fn, compiled: bool, export_src,
+                    trace_fn=None, example_specs=None):
         """Atomically install (fn, compiled-flag, fresh slot pool):
         predict() snapshots all three under the same lock, so a
         reload can never pair a new executable with a stale
@@ -114,6 +124,9 @@ class InferenceModel:
             self._predict_fn = fn
             self._compiled = compiled
             self._export_src = export_src
+            self._trace_fn = trace_fn
+            self._example_specs = example_specs
+            self._generation += 1
             self._queue = q
 
     def load(self, model_path: str,
@@ -288,6 +301,23 @@ class InferenceModel:
             plats = [plats[0]]  # the canonical (axon->tpu) name
             exported = jexport.export(sjit)(*examples)
         export_blob = exported.serialize()
+        # batch-polymorphic variant (leading dim symbolic): lets a
+        # loading process re-specialize the program for OTHER batch
+        # sizes — what DynamicBatcher's bucket warming needs from a
+        # load_compiled model. Optional: not every program lowers
+        # under a symbolic batch dim.
+        poly_blob = None
+        try:
+            (b,) = jexport.symbolic_shape("b")
+            pargs = [jax.ShapeDtypeStruct(
+                (b,) + tuple(np.shape(e))[1:],
+                np.asarray(e).dtype) for e in examples]
+            poly_blob = jexport.export(
+                sjit, platforms=plats)(*pargs).serialize()
+        except Exception as e:
+            logger.info("batch-polymorphic export unavailable "
+                        "(%s: %s); artifact serves its declared "
+                        "batch only", type(e).__name__, e)
         meta = {
             "version": _ARTIFACT_VERSION,
             "platform": jax.default_backend(),
@@ -304,6 +334,8 @@ class InferenceModel:
             z.writestr("meta.json", json.dumps(meta))
             z.writestr("executable.bin", payload)
             z.writestr("export.bin", export_blob)
+            if poly_blob is not None:
+                z.writestr("export_poly.bin", poly_blob)
         logger.info("exported compiled serving artifact -> %s "
                     "(%d inputs, platform=%s)", path,
                     len(meta["inputs"]), meta["platform"])
@@ -327,6 +359,9 @@ class InferenceModel:
             meta = json.loads(z.read("meta.json").decode())
             exec_blob = z.read("executable.bin")
             export_blob = z.read("export.bin")
+            poly_blob = (z.read("export_poly.bin")
+                         if "export_poly.bin" in z.namelist()
+                         else None)
         if meta.get("version", 0) > _ARTIFACT_VERSION:
             raise ValueError(
                 f"artifact version {meta.get('version')} is newer "
@@ -336,13 +371,21 @@ class InferenceModel:
         out_tree = jax.tree_util.tree_structure(
             _tree_from_spec(meta["out_spec"]))
         n_dev = int(meta.get("n_devices", 1))
+        trace_fn = None
         try:
-            # execution_devices defaults to ALL of the backend's
-            # devices — a single-device artifact must load onto
-            # exactly the device count it was compiled for
-            fn = se.deserialize_and_load(
-                exec_blob, in_tree, out_tree,
-                execution_devices=jax.devices()[:n_dev])
+            try:
+                # execution_devices defaults to ALL of the backend's
+                # devices — a single-device artifact must load onto
+                # exactly the device count it was compiled for
+                fn = se.deserialize_and_load(
+                    exec_blob, in_tree, out_tree,
+                    execution_devices=jax.devices()[:n_dev])
+            except TypeError:
+                # older jax (<=0.4.x): no execution_devices kwarg —
+                # the payload itself carries the exporter's
+                # single-device assignment
+                fn = se.deserialize_and_load(
+                    exec_blob, in_tree, out_tree)
             mode = "aot"
         except Exception as e:
             backend = jax.default_backend()
@@ -364,9 +407,24 @@ class InferenceModel:
                     for i in meta["inputs"]]
             fn = jax.jit(exp.call).lower(*args).compile()
             mode = "export"
+        if poly_blob is not None:
+            # the batch-polymorphic program re-specializes for other
+            # batch sizes — DynamicBatcher's bucket warming path
+            try:
+                from jax import export as jexport
+                trace_fn = jax.jit(
+                    jexport.deserialize(poly_blob).call)
+            except Exception as e:
+                logger.warning(
+                    "polymorphic export blob unusable here (%s: %s);"
+                    " serving the declared batch size only",
+                    type(e).__name__, e)
         self.quantized = None     # any prior int8 load is replaced
         # export_src None: re-export needs a source model
-        self._swap_model(fn, compiled=True, export_src=None)
+        specs = [(tuple(i["shape"]), np.dtype(i["dtype"]))
+                 for i in meta["inputs"]]
+        self._swap_model(fn, compiled=True, export_src=None,
+                         trace_fn=trace_fn, example_specs=specs)
         logger.info("loaded compiled serving artifact %s (mode=%s)",
                     path, mode)
         return self
@@ -414,6 +472,46 @@ class InferenceModel:
                 return np.asarray(out)
         finally:
             queue.put(slot)
+
+    # -- dynamic-batching hooks (pipeline/inference/batching.py) ------------
+    @property
+    def generation(self) -> int:
+        """Bumped on every model (re)load — lets DynamicBatcher
+        invalidate its per-bucket executable cache on reload."""
+        return self._generation
+
+    @property
+    def can_relower(self) -> bool:
+        """Whether the loaded model keeps a traceable form that can
+        be AOT-lowered for NEW input shapes (bucket warming). False
+        only for ``load_compiled`` artifacts without a
+        batch-polymorphic export blob."""
+        return self._trace_fn is not None
+
+    @property
+    def example_input_specs(self):
+        """``[(shape, np.dtype), ...]`` of the declared example
+        inputs (load-time ``example_inputs`` or a compiled artifact's
+        manifest), or ``None`` when the model was loaded without
+        shape declarations."""
+        with self._lock:
+            specs = self._example_specs
+        return None if specs is None else list(specs)
+
+    def lower_for(self, example_args: Sequence):
+        """AOT-lower-and-compile the loaded forward for exactly the
+        given arguments (arrays or ``jax.ShapeDtypeStruct``) and
+        return the compiled executable — the primitive DynamicBatcher
+        uses to warm its bucket ladder. The executable is NOT
+        installed; :meth:`predict` is unaffected."""
+        with self._lock:
+            fn = self._trace_fn
+        if fn is None:
+            raise RuntimeError(
+                "model cannot be re-lowered for new shapes (a "
+                "load_compiled artifact without a batch-polymorphic "
+                "export blob, or no model loaded)")
+        return fn.lower(*example_args).compile()
 
     @property
     def concurrent_slots_free(self) -> int:

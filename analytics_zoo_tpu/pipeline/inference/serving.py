@@ -7,12 +7,20 @@ POJO + Spring-boot web-service samples (reference
 services, here a stdlib HTTP/JSON endpoint (no framework deps).
 
 POST /predict  {"inputs": [[...], ...]}  →  {"outputs": [[...], ...]}
-GET  /health   →  {"status": "ok", "free_slots": N}
+GET  /health   →  {"status": "ok", "free_slots": N, "batcher": {...}}
 GET  /metrics  →  Prometheus text exposition (docs/observability.md)
+
+Requests route through a :class:`DynamicBatcher`
+(`pipeline/inference/batching.py`, docs/serving.md) by default:
+cross-request coalescing onto AOT-warmed bucket shapes, with
+backpressure. ``ZOO_TPU_SERVING_BATCH=0`` (or ``batcher=None``)
+reverts to the per-request path.
 
 Errors are structured JSON — ``{"error": {"code": N, "message": ...}}``
 — with real status codes: 404 for unknown paths, 400 for malformed
-JSON / missing "inputs", and each increments
+JSON / missing "inputs" / un-coercible inputs, 500 for model and
+runtime failures, 503 (+ ``Retry-After``) when the batcher queue is
+full, 504 when a queued request's deadline expires. Each increments
 ``zoo_tpu_serving_errors_total{kind=...}``.
 """
 
@@ -27,6 +35,8 @@ from typing import Optional, Tuple
 import numpy as np
 
 from analytics_zoo_tpu.common import observability as obs
+from analytics_zoo_tpu.pipeline.inference.batching import (
+    DeadlineExpiredError, DynamicBatcher, QueueFullError)
 from analytics_zoo_tpu.pipeline.inference.inference_model import (
     InferenceModel)
 
@@ -58,10 +68,40 @@ def _in_flight() -> "obs.Gauge":
                      help="requests currently being handled")
 
 
-def handle_predict(model: InferenceModel, body: bytes
+def _coerce_inputs(model: InferenceModel, inputs) -> "list":
+    """JSON inputs → list of arrays, honoring the loaded model's
+    declared example-input dtypes when available (an embedding/NCF
+    model's integer ids must NOT be silently cast to f32); f32 is the
+    fallback for undeclared models. Raises ValueError/TypeError on
+    un-coercible payloads (ragged rows, non-numeric) — a CLIENT
+    error."""
+    specs = model.example_input_specs
+
+    def dtype_for(i: int):
+        if specs is not None and i < len(specs):
+            return specs[i][1]
+        return np.float32
+
+    if isinstance(inputs, list) and inputs and \
+            isinstance(inputs[0], dict):
+        return [np.asarray(d["data"], dtype_for(i))
+                for i, d in enumerate(inputs)]
+    return [np.asarray(inputs, dtype_for(0))]
+
+
+def handle_predict(model: InferenceModel, body: bytes,
+                   batcher: "Optional[DynamicBatcher]" = None
                    ) -> "Tuple[int, dict]":
     """The /predict contract, shared by the stdlib and native
-    front-ends: JSON body → (http_status, payload_dict)."""
+    front-ends: JSON body → (http_status, payload_dict). With a
+    ``batcher``, row-aligned requests ride the coalescing path
+    (docs/serving.md); without one (or for inputs the batcher cannot
+    coalesce) the model runs per-request.
+
+    Status mapping: client mistakes are 400 (malformed JSON, missing
+    "inputs", un-coercible arrays), backpressure is 503 with a
+    ``retry_after_s`` hint, expired deadlines are 504, and model or
+    runtime failures are 500 ``kind="internal"``."""
     try:
         req = json.loads(body)
     except (ValueError, UnicodeDecodeError) as e:
@@ -74,24 +114,60 @@ def handle_predict(model: InferenceModel, body: bytes
         return 400, _error_body(
             400, 'request must be a JSON object with an "inputs" key')
     try:
-        if isinstance(inputs, list) and inputs and \
-                isinstance(inputs[0], dict):
-            xs = [np.asarray(i["data"], np.float32) for i in inputs]
+        xs = _coerce_inputs(model, inputs)
+    except (ValueError, TypeError, KeyError) as e:
+        _count_error("bad_request")
+        return 400, _error_body(
+            400, f"inputs are not coercible to arrays: {e}")
+    try:
+        if batcher is not None and batcher.batchable(xs):
+            out = batcher.submit(xs).result()
         else:
-            xs = np.asarray(inputs, np.float32)
-        out = model.predict(xs)
+            out = model.predict(xs if len(xs) > 1 else xs[0])
         if isinstance(out, list):
+            if len(out) == 1:
+                return 200, {"outputs": out[0].tolist()}
             return 200, {"outputs": [o.tolist() for o in out]}
         return 200, {"outputs": out.tolist()}
+    except QueueFullError as e:
+        # admission control: bounded queueing latency, not unbounded
+        # (the batcher already counted kind="queue_full")
+        return 503, _error_body(
+            503, str(e), retry_after_s=round(e.retry_after_s, 3))
+    except DeadlineExpiredError as e:
+        # the batcher already counted kind="deadline_expired"
+        return 504, _error_body(504, str(e))
     except Exception as e:  # serving boundary: report, not die
-        _count_error("predict_error")
-        return 400, _error_body(400, str(e))
+        _count_error("internal")
+        return 500, _error_body(500, str(e), kind="internal")
+
+
+def _health_payload(model: InferenceModel,
+                    batcher: "Optional[DynamicBatcher]") -> dict:
+    """Shared /health body: model pool capacity plus the batcher's
+    queue/bucket state (docs/serving.md)."""
+    return {
+        "status": "ok",
+        "free_slots": model.concurrent_slots_free,
+        "batcher": (batcher.stats() if batcher is not None
+                    else {"enabled": False}),
+    }
+
+
+def _resolve_batcher(model: InferenceModel, batcher):
+    """``"auto"`` → env-configured batcher (None when
+    ``ZOO_TPU_SERVING_BATCH=0``); explicit ``None`` → per-request
+    serving; a DynamicBatcher instance passes through."""
+    if batcher == "auto":
+        return DynamicBatcher.from_env(model)
+    return batcher
 
 
 class InferenceServer:
     def __init__(self, model: InferenceModel, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, batcher="auto"):
         self.model = model
+        self.batcher = _resolve_batcher(model, batcher)
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -107,6 +183,18 @@ class InferenceServer:
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                if code == 503:
+                    err = {}
+                    try:
+                        err = json.loads(body).get("error", {})
+                    except ValueError:
+                        pass
+                    retry = err.get("retry_after_s")
+                    if retry is not None:
+                        import math
+                        self.send_header(
+                            "Retry-After",
+                            str(max(1, math.ceil(retry))))
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -118,10 +206,8 @@ class InferenceServer:
                 try:
                     if self.path == "/health":
                         status = 200
-                        payload = {
-                            "status": "ok",
-                            "free_slots":
-                                server.model.concurrent_slots_free}
+                        payload = _health_payload(
+                            server.model, server.batcher)
                     elif self.path == "/metrics":
                         status = 200
                     else:
@@ -164,7 +250,8 @@ class InferenceServer:
                             payload = _error_body(400, str(e))
                         else:
                             status, payload = handle_predict(
-                                server.model, body)
+                                server.model, body,
+                                batcher=server.batcher)
                 finally:
                     _in_flight().dec()
                     _record_request(self.path, status,
@@ -179,6 +266,10 @@ class InferenceServer:
         return self._httpd.server_address[1]
 
     def start(self, background: bool = True):
+        # bucket warm-up happens HERE (AOT, before traffic): steady
+        # state then serves any request-size mix with zero compiles
+        if self.batcher is not None:
+            self.batcher.start()
         if background:
             self._thread = threading.Thread(
                 target=self._httpd.serve_forever, daemon=True)
@@ -191,6 +282,8 @@ class InferenceServer:
         self._httpd.shutdown()
         if self._thread:
             self._thread.join(timeout=5)
+        if self.batcher is not None:
+            self.batcher.stop()
 
 
 class NativeInferenceServer:
@@ -207,9 +300,10 @@ class NativeInferenceServer:
     """
 
     def __init__(self, model: InferenceModel, port: int = 0,
-                 workers: Optional[int] = None):
+                 workers: Optional[int] = None, batcher="auto"):
         from analytics_zoo_tpu.native import NativeHttpServer
         self.model = model
+        self.batcher = _resolve_batcher(model, batcher)
         self._srv = NativeHttpServer(port=port)
         self._workers = workers or model.supported_concurrent_num
         self._threads: "list[threading.Thread]" = []
@@ -234,11 +328,13 @@ class NativeInferenceServer:
                 out = json.dumps(
                     _error_body(404, "not found", path=path)).encode()
             else:
-                status, payload = handle_predict(self.model, body)
+                status, payload = handle_predict(
+                    self.model, body, batcher=self.batcher)
                 out = json.dumps(payload).encode()
         except Exception as e:
-            status = 400
-            out = json.dumps(_error_body(400, str(e))).encode()
+            status = 500
+            out = json.dumps(_error_body(
+                500, str(e), kind="internal")).encode()
         finally:
             # account BEFORE responding: a client that scrapes
             # /metrics right after its response must see this request
@@ -252,10 +348,11 @@ class NativeInferenceServer:
         except Exception:
             pass  # client gone — nothing to tell it
         # refresh the C++-cached health AFTER the slot freed, so
-        # /health reflects post-request capacity
-        self._srv.set_health(json.dumps({
-            "status": "ok",
-            "free_slots": self.model.concurrent_slots_free}))
+        # /health reflects post-request capacity (and current
+        # batcher queue state; the native front-end cannot set a
+        # Retry-After header, so 503 bodies carry retry_after_s)
+        self._srv.set_health(json.dumps(
+            _health_payload(self.model, self.batcher)))
 
     def _loop(self):
         from analytics_zoo_tpu.common.nncontext import logger
@@ -274,9 +371,10 @@ class NativeInferenceServer:
             self._serve_one(*got)
 
     def start(self, background: bool = True):
-        self._srv.set_health(json.dumps({
-            "status": "ok",
-            "free_slots": self.model.concurrent_slots_free}))
+        if self.batcher is not None:
+            self.batcher.start()
+        self._srv.set_health(json.dumps(
+            _health_payload(self.model, self.batcher)))
         for _ in range(self._workers):
             t = threading.Thread(target=self._loop, daemon=True)
             t.start()
@@ -296,6 +394,8 @@ class NativeInferenceServer:
         deadline = time.monotonic() + 60.0
         for t in self._threads:
             t.join(timeout=max(deadline - time.monotonic(), 0.1))
+        if self.batcher is not None:
+            self.batcher.stop()
         if any(t.is_alive() for t in self._threads):
             from analytics_zoo_tpu.common.nncontext import logger
             logger.warning(
@@ -307,12 +407,16 @@ class NativeInferenceServer:
 
 
 def make_inference_server(model: InferenceModel, port: int = 0,
-                          prefer_native: bool = True):
+                          prefer_native: bool = True,
+                          batcher="auto"):
     """Native C++ front-end when the toolchain built it, else the
-    stdlib ThreadingHTTPServer — same endpoints either way."""
+    stdlib ThreadingHTTPServer — same endpoints either way.
+    ``batcher``: ``"auto"`` (env-configured dynamic batching),
+    ``None`` (per-request), or a :class:`DynamicBatcher`."""
     if prefer_native:
         try:
-            return NativeInferenceServer(model, port=port)
+            return NativeInferenceServer(model, port=port,
+                                         batcher=batcher)
         except (RuntimeError, OSError):
             pass
-    return InferenceServer(model, port=port)
+    return InferenceServer(model, port=port, batcher=batcher)

@@ -50,6 +50,8 @@ MODULES = [
      "pipeline.estimator — training runtime"),
     ("analytics_zoo_tpu.pipeline.inference",
      "pipeline.inference — serving"),
+    ("analytics_zoo_tpu.pipeline.inference.batching",
+     "pipeline.inference.batching — dynamic request batching"),
     ("analytics_zoo_tpu.pipeline.nnframes",
      "pipeline.nnframes — DataFrame ML pipeline"),
     ("analytics_zoo_tpu.models", "models — the zoo"),

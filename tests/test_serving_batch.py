@@ -1,0 +1,429 @@
+"""Dynamic request batching (pipeline/inference/batching.py):
+coalescing correctness, bucket padding, backpressure (503), deadline
+eviction, the ZOO_TPU_SERVING_BATCH=0 revert, error-code contract,
+dtype-honoring input coercion, and the zero-recompile guarantee
+across a mixed request-size workload. Tier-1 fast."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu import init_nncontext
+from analytics_zoo_tpu.common.observability import (
+    reset_metrics, snapshot)
+from analytics_zoo_tpu.pipeline.api.keras import Sequential, \
+    layers as L
+from analytics_zoo_tpu.pipeline.inference import (
+    DynamicBatcher, InferenceModel, InferenceServer)
+from analytics_zoo_tpu.pipeline.inference.batching import (
+    DeadlineExpiredError, QueueFullError, bucket_ladder)
+from analytics_zoo_tpu.pipeline.inference.serving import (
+    handle_predict)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_metrics():
+    reset_metrics()
+    yield
+    reset_metrics()
+
+
+def _toy_model():
+    init_nncontext(seed=0)
+    m = Sequential()
+    m.add(L.Dense(8, activation="relu", input_shape=(4,)))
+    m.add(L.Dense(2))
+    m.compile(optimizer="sgd", loss="mse")
+    return m
+
+
+def _loaded(example_batch=None, concurrency=2):
+    m = _toy_model()
+    im = InferenceModel(supported_concurrent_num=concurrency)
+    kw = {}
+    if example_batch is not None:
+        rs = np.random.RandomState(1)
+        kw["example_inputs"] = [
+            rs.randn(example_batch, 4).astype(np.float32)]
+    im.load_keras_net(m, **kw)
+    return im, m
+
+
+def _metric_sum(name, snap=None):
+    snap = snap or snapshot()
+    fam = snap.get(name)
+    if fam is None:
+        return 0.0
+    return sum(v["value"] for v in fam["values"])
+
+
+class _StubModel:
+    """Duck-typed InferenceModel stand-in: no relowering, so the
+    batcher's fallback path runs `predict`, which blocks until
+    released — making queue states deterministic in tests."""
+
+    can_relower = False
+    example_input_specs = None
+    generation = 0
+    concurrent_slots_free = 1
+    supported_concurrent_num = 1
+
+    def __init__(self, fail=False):
+        self.started = threading.Event()
+        self.release = threading.Event()
+        self.calls = 0
+        self.fail = fail
+
+    def predict(self, xs):
+        self.started.set()
+        assert self.release.wait(10), "test forgot to release stub"
+        self.calls += 1
+        if self.fail:
+            raise RuntimeError("stub model exploded")
+        x = xs[0] if isinstance(xs, list) else xs
+        return np.asarray(x) * 2.0
+
+
+# -- ladder -----------------------------------------------------------------
+
+def test_bucket_ladder():
+    assert bucket_ladder(32) == (1, 2, 4, 8, 16, 32)
+    assert bucket_ladder(12) == (1, 2, 4, 8, 12)
+    assert bucket_ladder(1) == (1,)
+    assert bucket_ladder(32, [4, 16, 8]) == (4, 8, 16)
+    with pytest.raises(ValueError):
+        bucket_ladder(8, [0, 4])
+
+
+# -- coalescing correctness -------------------------------------------------
+
+def test_concurrent_clients_coalesce_with_exact_outputs():
+    im, m = _loaded()
+    b = DynamicBatcher(im, max_batch_size=16, max_wait_ms=100,
+                       queue_depth=64).start()
+    try:
+        rs = np.random.RandomState(0)
+        warm = rs.randn(2, 4).astype(np.float32)
+        b.submit([warm]).result(timeout=30)  # warms the ladder
+        base = _metric_sum("zoo_tpu_serving_batch_executions_total")
+
+        xs = [rs.randn(1, 4).astype(np.float32) for _ in range(8)]
+        barrier = threading.Barrier(8)
+        outs = [None] * 8
+
+        def client(i):
+            barrier.wait()
+            outs[i] = b.submit([xs[i]]).result(timeout=30)
+
+        ts = [threading.Thread(target=client, args=(i,))
+              for i in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+
+        for i in range(8):
+            ref = np.asarray(im.predict(xs[i]))
+            np.testing.assert_allclose(np.asarray(outs[i]), ref,
+                                       rtol=1e-5, atol=1e-6)
+        execs = (_metric_sum("zoo_tpu_serving_batch_executions_total")
+                 - base)
+        assert execs < 8, (
+            f"8 concurrent single-row requests ran {execs} "
+            f"executions — no coalescing happened")
+    finally:
+        b.stop()
+
+
+def test_bucket_padding_at_ladder_edges():
+    im, m = _loaded()
+    # max_wait 1ms: sequential submits dispatch alone, so padding per
+    # dispatch is deterministic
+    b = DynamicBatcher(im, max_batch_size=8, max_wait_ms=1,
+                       queue_depth=64).start()
+    try:
+        rs = np.random.RandomState(0)
+        pads = {1: 0, 2: 0, 3: 1, 4: 0, 5: 3, 8: 0}
+        for n, pad in sorted(pads.items()):
+            x = rs.randn(n, 4).astype(np.float32)
+            before = _metric_sum(
+                "zoo_tpu_serving_padding_rows_total")
+            out = b.submit([x]).result(timeout=30)
+            assert np.asarray(out).shape == (n, 2)
+            np.testing.assert_allclose(
+                np.asarray(out), np.asarray(im.predict(x)),
+                rtol=1e-5, atol=1e-6)
+            after = _metric_sum("zoo_tpu_serving_padding_rows_total")
+            assert after - before == pad, (n, pad, after - before)
+        # oversize request (rows > max_batch) chunks correctly
+        x = rs.randn(11, 4).astype(np.float32)
+        out = b.submit([x]).result(timeout=30)
+        assert np.asarray(out).shape == (11, 2)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(im.predict(x)),
+            rtol=1e-5, atol=1e-6)
+    finally:
+        b.stop()
+
+
+# -- backpressure & deadlines -----------------------------------------------
+
+def test_queue_full_raises_and_counts():
+    stub = _StubModel()
+    b = DynamicBatcher(stub, max_batch_size=4, max_wait_ms=1,
+                       queue_depth=2).start()
+    try:
+        x = np.ones((1, 4), np.float32)
+        f0 = b.submit([x])          # dispatched, blocks in predict
+        assert stub.started.wait(10)
+        f1 = b.submit([x])          # queued
+        f2 = b.submit([x])          # queued — at capacity
+        with pytest.raises(QueueFullError) as ei:
+            b.submit([x])
+        assert ei.value.retry_after_s > 0
+        snap = snapshot()
+        kinds = {v["labels"]["kind"]: v["value"] for v in
+                 snap["zoo_tpu_serving_errors_total"]["values"]}
+        assert kinds["queue_full"] == 1
+        stub.release.set()
+        for f in (f0, f1, f2):
+            np.testing.assert_allclose(
+                np.asarray(f.result(timeout=30)), x * 2.0)
+    finally:
+        stub.release.set()
+        b.stop()
+
+
+def test_deadline_expiry_evicts_before_dispatch():
+    stub = _StubModel()
+    b = DynamicBatcher(stub, max_batch_size=4, max_wait_ms=1,
+                       queue_depth=8, deadline_ms=50).start()
+    try:
+        x = np.ones((2, 4), np.float32)
+        f0 = b.submit([x])          # dispatched, blocks in predict
+        assert stub.started.wait(10)
+        f1 = b.submit([x])          # queued behind the blocked batch
+        import time
+        time.sleep(0.15)            # f1's 50ms deadline passes
+        stub.release.set()
+        np.testing.assert_allclose(
+            np.asarray(f0.result(timeout=30)), x * 2.0)
+        with pytest.raises(DeadlineExpiredError):
+            f1.result(timeout=30)
+        snap = snapshot()
+        kinds = {v["labels"]["kind"]: v["value"] for v in
+                 snap["zoo_tpu_serving_errors_total"]["values"]}
+        assert kinds["deadline_expired"] == 1
+    finally:
+        stub.release.set()
+        b.stop()
+
+
+def test_http_503_with_retry_after_header():
+    stub = _StubModel()
+    b = DynamicBatcher(stub, max_batch_size=4, max_wait_ms=1,
+                       queue_depth=1)
+    srv = InferenceServer(stub, port=0, batcher=b).start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}/predict"
+        body = json.dumps({"inputs": [[1, 2, 3, 4]]}).encode()
+
+        def post_async():
+            try:
+                urllib.request.urlopen(
+                    urllib.request.Request(url, data=body),
+                    timeout=30)
+            except Exception:
+                pass
+
+        t0 = threading.Thread(target=post_async)  # blocks in stub
+        t0.start()
+        assert stub.started.wait(10)
+        t1 = threading.Thread(target=post_async)  # fills the queue
+        t1.start()
+        import time
+        deadline = time.monotonic() + 5
+        while (b.stats()["queue_depth"] < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert b.stats()["queue_depth"] == 1, \
+            "queue never filled to rejection"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                urllib.request.Request(url, data=body), timeout=30)
+        got = ei.value
+        assert got.code == 503
+        assert got.headers.get("Retry-After") is not None
+        err = json.loads(got.read())["error"]
+        assert err["code"] == 503 and err["retry_after_s"] > 0
+        stub.release.set()
+        t0.join(timeout=30)
+        t1.join(timeout=30)
+    finally:
+        stub.release.set()
+        srv.stop()
+
+
+# -- error-code contract (serving.py satellite) -----------------------------
+
+def test_internal_failure_is_500_client_mistake_is_400():
+    stub = _StubModel(fail=True)
+    stub.release.set()
+    status, payload = handle_predict(
+        stub, json.dumps({"inputs": [[1, 2, 3, 4]]}).encode())
+    assert status == 500
+    assert payload["error"]["kind"] == "internal"
+    # client mistakes keep their 400s
+    status, payload = handle_predict(stub, b"{not json")
+    assert status == 400
+    status, payload = handle_predict(stub, b'{"x": 1}')
+    assert status == 400
+    status, payload = handle_predict(
+        stub, json.dumps({"inputs": [[1, 2], [3]]}).encode())
+    assert status == 400  # ragged rows: client error, not internal
+    snap = snapshot()
+    kinds = {v["labels"]["kind"]: v["value"] for v in
+             snap["zoo_tpu_serving_errors_total"]["values"]}
+    assert kinds["internal"] == 1
+    assert kinds["bad_json"] == 1
+    assert kinds["bad_request"] == 2
+
+
+def test_batched_internal_failure_is_500():
+    stub = _StubModel(fail=True)
+    stub.release.set()
+    b = DynamicBatcher(stub, max_batch_size=4, max_wait_ms=1,
+                       queue_depth=8).start()
+    try:
+        status, payload = handle_predict(
+            stub, json.dumps({"inputs": [[1, 2, 3, 4]]}).encode(),
+            batcher=b)
+        assert status == 500
+        assert payload["error"]["kind"] == "internal"
+    finally:
+        b.stop()
+
+
+# -- dtype coercion (serving.py satellite) ----------------------------------
+
+class _DtypeProbe:
+    """Captures the dtypes handle_predict hands to predict."""
+
+    def __init__(self, specs):
+        self.example_input_specs = specs
+        self.seen = None
+
+    def predict(self, xs):
+        xs = xs if isinstance(xs, list) else [xs]
+        self.seen = [x.dtype for x in xs]
+        return np.zeros((len(np.asarray(xs[0])), 1), np.float32)
+
+
+def test_coercion_honors_model_dtypes():
+    probe = _DtypeProbe([((8, 2), np.dtype(np.int32))])
+    body = json.dumps({"inputs": [[1, 2], [3, 4]]}).encode()
+    status, _ = handle_predict(probe, body)
+    assert status == 200
+    assert probe.seen == [np.dtype(np.int32)]
+    # multi-input dict form follows per-position dtypes
+    probe = _DtypeProbe([((4, 2), np.dtype(np.int64)),
+                         ((4, 3), np.dtype(np.float32))])
+    body = json.dumps({"inputs": [
+        {"data": [[1, 2]]}, {"data": [[0.5, 1.5, 2.5]]}]}).encode()
+    status, _ = handle_predict(probe, body)
+    assert status == 200
+    assert probe.seen == [np.dtype(np.int64), np.dtype(np.float32)]
+    # no declared specs -> f32 fallback (the historical contract)
+    probe = _DtypeProbe(None)
+    status, _ = handle_predict(
+        probe, json.dumps({"inputs": [[1, 2]]}).encode())
+    assert status == 200
+    assert probe.seen == [np.dtype(np.float32)]
+
+
+# -- the A/B revert flag ----------------------------------------------------
+
+def test_batch_flag_zero_reverts_to_per_request(monkeypatch):
+    monkeypatch.setenv("ZOO_TPU_SERVING_BATCH", "0")
+    im, m = _loaded()
+    srv = InferenceServer(im, port=0).start()
+    try:
+        assert srv.batcher is None
+        url = f"http://127.0.0.1:{srv.port}"
+        health = json.loads(urllib.request.urlopen(
+            url + "/health").read())
+        assert health["batcher"] == {"enabled": False}
+        x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        req = urllib.request.Request(
+            url + "/predict",
+            data=json.dumps({"inputs": x.tolist()}).encode())
+        out = json.loads(urllib.request.urlopen(req).read())
+        np.testing.assert_allclose(
+            np.asarray(out["outputs"], np.float32),
+            np.asarray(im.predict(x)), rtol=1e-5, atol=1e-6)
+    finally:
+        srv.stop()
+    assert _metric_sum("zoo_tpu_serving_batch_executions_total") == 0
+
+
+def test_health_reports_batcher_state():
+    im, m = _loaded(example_batch=4)
+    b = DynamicBatcher(im, max_batch_size=8, max_wait_ms=2)
+    srv = InferenceServer(im, port=0, batcher=b).start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}"
+        health = json.loads(urllib.request.urlopen(
+            url + "/health").read())
+        bt = health["batcher"]
+        assert bt["enabled"] is True
+        assert bt["buckets"] == [1, 2, 4, 8]
+        assert bt["warmed_buckets"] == 4  # warmed at server start
+        assert bt["queue_depth"] == 0
+        text = urllib.request.urlopen(url + "/metrics").read().decode()
+        assert "zoo_tpu_serving_queue_depth" in text
+        assert "zoo_tpu_serving_warmed_buckets 4" in text
+        assert "zoo_tpu_serving_bucket_compiles_total 4" in text
+    finally:
+        srv.stop()
+
+
+# -- the headline guarantee: zero compiles after warm-up --------------------
+
+def test_no_recompiles_after_warmup_across_mixed_sizes():
+    from jax import monitoring
+
+    im, m = _loaded(example_batch=4)
+    b = DynamicBatcher(im, max_batch_size=8, max_wait_ms=1,
+                       queue_depth=64)
+    compiles = []
+    armed = [False]
+
+    def listener(name, dur, **kw):
+        if armed[0] and name.endswith("backend_compile_duration"):
+            compiles.append(name)
+
+    monitoring.register_event_duration_secs_listener(listener)
+    try:
+        b.start()  # warm-up: compiles the whole ladder, AOT
+        assert b.warmed_buckets == 4
+        armed[0] = True
+        rs = np.random.RandomState(0)
+        # mixed request-size workload: every size in [1, max_batch],
+        # repeated, plus an oversize chunked one
+        for n in [1, 3, 2, 8, 5, 4, 7, 6, 1, 8, 11]:
+            x = rs.randn(n, 4).astype(np.float32)
+            out = b.submit([x]).result(timeout=30)
+            assert np.asarray(out).shape == (n, 2)
+        armed[0] = False
+        assert compiles == [], (
+            f"steady-state serving compiled {len(compiles)} times "
+            f"across the mixed request-size workload")
+        assert _metric_sum(
+            "zoo_tpu_serving_bucket_compiles_total") == 4
+    finally:
+        armed[0] = False
+        b.stop()
