@@ -7,10 +7,15 @@ IMAGE ?= analytics-zoo-tpu
 
 .PHONY: test docker-build docker-test docker-test-spark dist docs \
     lint obs-smoke fused-conformance flops-audit serving-smoke \
-    bench-serving
+    bench-serving trace-smoke trace-report
 
+# unit tests plus the two end-to-end telemetry smokes (metrics
+# exposition + tracing), so `make test` proves the observability
+# stack, not just the library
 test:
 	python -m pytest tests/ -x -q
+	$(MAKE) obs-smoke
+	$(MAKE) trace-smoke
 
 # conv+BN (+ residual-epilogue) conformance: the exact Pallas kernel
 # code paths the fused ResNet runs on chip, exercised under the
@@ -24,6 +29,17 @@ fused-conformance:
 # the /metrics exposition carries every layer (docs/observability.md)
 obs-smoke:
 	JAX_PLATFORMS=cpu python scripts/obs_smoke.py
+
+# tracing end-to-end: 3 train steps + 1 traced request (X-Zoo-Trace-Id
+# echo, /debug/traces, chrome-trace export) — docs/observability.md
+trace-smoke:
+	JAX_PLATFORMS=cpu python scripts/trace_smoke.py
+
+# offline report over a ZOO_TPU_EVENT_LOG JSONL: per-step timeline,
+# top-N slowest requests, anomaly digest, optional Perfetto export
+EVENTS ?= /tmp/zoo_tpu_trace_smoke.events.jsonl
+trace-report:
+	python scripts/trace_report.py --events $(EVENTS)
 
 # executed-FLOPs audit of the ResNet-50 train step, phase backward
 # off vs on (lowering only — CPU-safe, no chip; docs/perf_flags.md)

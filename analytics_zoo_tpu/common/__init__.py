@@ -6,7 +6,8 @@ from analytics_zoo_tpu.common.nncontext import (
 )
 from analytics_zoo_tpu.common.config import ZooBuildInfo
 from analytics_zoo_tpu.common import (
-    dictionary, observability, safe_pickle, utils)
+    diagnostics, dictionary, observability, safe_pickle, tracing,
+    utils)
 from analytics_zoo_tpu.common.dictionary import ZooDictionary
 from analytics_zoo_tpu.common.observability import (
     MetricsRegistry,
@@ -40,8 +41,10 @@ __all__ = [
     "get_registry",
     "reset_metrics",
     "checked_load",
+    "diagnostics",
     "dictionary",
     "observability",
     "safe_pickle",
+    "tracing",
     "utils",
 ]

@@ -1,0 +1,229 @@
+"""Anomaly detection & device diagnostics (the diagnostics layer,
+L1.5).
+
+Turns the raw telemetry of
+:mod:`~analytics_zoo_tpu.common.observability` into *judgements*:
+"this process is recompiling in a storm", "that step was a
+straggler", "device memory is near its limit". Every detector emits
+one structured ``diagnostics/anomaly`` event plus a
+``zoo_tpu_anomalies_total{kind}`` counter, so alerting needs exactly
+one PromQL expression (see the anomaly catalog in
+docs/observability.md).
+
+Detectors:
+
+- :class:`RecompileMonitor` — listens for XLA ``backend_compile``
+  events via ``jax.monitoring`` (the same signal
+  ``tests/test_serving_batch.py`` uses to prove zero steady-state
+  recompiles) and fires ``kind="recompile_storm"`` when more than
+  ``threshold`` compiles land inside a rolling ``window_s`` window.
+  A warmed serving process or a shape-stable train loop should
+  compile a handful of times and then never again; a storm means a
+  shape/dtype leak is thrashing the compile cache.
+- :class:`StepTimeWatcher` — rolling-median straggler detection:
+  ``kind="step_time_regression"`` when one step exceeds ``factor``
+  × the window median (the first compile-heavy steps are excused by
+  ``min_samples``).
+- :func:`update_device_memory_gauges` — per-device HBM watermarks
+  (``zoo_tpu_device_memory_bytes{device,kind}``) from
+  ``device.memory_stats()``; silently skips backends (CPU) that
+  expose none.
+
+jax is imported lazily so this module stays importable from
+executor-side code that must not drag in the runtime.
+"""
+
+from __future__ import annotations
+
+import os
+import statistics
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from analytics_zoo_tpu.common import observability as obs
+
+__all__ = [
+    "anomaly",
+    "RecompileMonitor",
+    "StepTimeWatcher",
+    "install_recompile_monitor",
+    "get_recompile_monitor",
+    "update_device_memory_gauges",
+]
+
+
+def anomaly(kind: str, **fields):
+    """Record one detected anomaly: bump
+    ``zoo_tpu_anomalies_total{kind}`` and append a structured
+    ``diagnostics/anomaly`` event (fields carry the evidence)."""
+    obs.counter("zoo_tpu_anomalies_total",
+                help="anomalies detected, by kind",
+                labels={"kind": kind}).inc()
+    obs.event("diagnostics/anomaly", kind=kind, **fields)
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class RecompileMonitor:
+    """Rolling-window XLA compile-storm detector.
+
+    :meth:`note` is the pure core (unit-testable with fake clocks);
+    :meth:`install` registers a ``jax.monitoring`` event-duration
+    listener that calls it on every ``backend_compile`` event. At
+    most one anomaly fires per window, so a storm does not itself
+    become an event storm."""
+
+    def __init__(self, threshold: Optional[int] = None,
+                 window_s: Optional[float] = None):
+        if threshold is None:
+            threshold = int(_env_float(
+                "ZOO_TPU_RECOMPILE_THRESHOLD", 5))
+        if window_s is None:
+            window_s = _env_float("ZOO_TPU_RECOMPILE_WINDOW_S", 60.0)
+        self.threshold = max(1, threshold)
+        self.window_s = window_s
+        self.storms = 0
+        self._times: "deque[float]" = deque()
+        self._muted_until = float("-inf")
+        self._lock = threading.Lock()
+        self._installed = False
+
+    def note(self, now: Optional[float] = None) -> bool:
+        """Record one compile at monotonic time ``now`` (defaults to
+        the real clock). Returns True when this compile tips the
+        window over the threshold (and fires the anomaly)."""
+        if now is None:
+            now = time.monotonic()
+        with self._lock:
+            self._times.append(now)
+            cutoff = now - self.window_s
+            while self._times and self._times[0] <= cutoff:
+                self._times.popleft()
+            in_window = len(self._times)
+            storm = (in_window > self.threshold
+                     and now >= self._muted_until)
+            if storm:
+                self._muted_until = now + self.window_s
+                self.storms += 1
+        obs.counter("zoo_tpu_xla_compiles_total",
+                    help="XLA backend_compile events observed").inc()
+        if storm:
+            anomaly("recompile_storm", compiles=in_window,
+                    window_s=self.window_s,
+                    threshold=self.threshold)
+        return storm
+
+    def _listener(self, event_name: str, duration: float, **kw):
+        # jax stamps e.g. ".../jax_backend_compile_duration".
+        if event_name.endswith("backend_compile_duration"):
+            self.note()
+
+    def install(self) -> "RecompileMonitor":
+        """Register the jax.monitoring listener (idempotent; there is
+        no unregister API, so one listener per process)."""
+        with self._lock:
+            if self._installed:
+                return self
+            self._installed = True
+        from jax import monitoring
+        monitoring.register_event_duration_secs_listener(
+            self._listener)
+        return self
+
+
+_monitor_lock = threading.Lock()
+_monitor: Optional[RecompileMonitor] = None
+
+
+def get_recompile_monitor() -> Optional[RecompileMonitor]:
+    return _monitor
+
+
+def install_recompile_monitor() -> RecompileMonitor:
+    """Process-global :class:`RecompileMonitor`, installed once; the
+    Estimator train loop and the DynamicBatcher both call this on
+    start."""
+    global _monitor
+    with _monitor_lock:
+        if _monitor is None:
+            _monitor = RecompileMonitor()
+    return _monitor.install()
+
+
+class StepTimeWatcher:
+    """Straggler / regression detection over a rolling window of step
+    wall times. A step slower than ``factor`` × the window median
+    fires ``kind="step_time_regression"``; after firing, detection
+    mutes for ``cooldown`` observations so a sustained regression
+    (which also drags the median up) reports once, not every step."""
+
+    def __init__(self, window: int = 64, min_samples: int = 16,
+                 factor: Optional[float] = None, cooldown: int = 16):
+        if factor is None:
+            factor = _env_float("ZOO_TPU_STEP_ANOMALY_FACTOR", 3.0)
+        self.window = max(2, window)
+        self.min_samples = max(1, min_samples)
+        self.factor = factor
+        self.cooldown = max(0, cooldown)
+        self.fired = 0
+        self._buf: "deque[float]" = deque(maxlen=self.window)
+        self._mute = 0
+        self._lock = threading.Lock()
+
+    def observe(self, dur_s: float, step: Optional[int] = None
+                ) -> bool:
+        """Feed one step's wall time; returns True when it fired."""
+        dur_s = float(dur_s)
+        fired = False
+        median = 0.0
+        with self._lock:
+            if self._mute > 0:
+                self._mute -= 1
+            elif (len(self._buf) >= self.min_samples
+                  and self.factor > 0):
+                median = statistics.median(self._buf)
+                if median > 0 and dur_s > self.factor * median:
+                    fired = True
+                    self.fired += 1
+                    self._mute = self.cooldown
+            self._buf.append(dur_s)
+        if fired:
+            anomaly("step_time_regression", step=step,
+                    dur_s=round(dur_s, 6),
+                    median_s=round(median, 6), factor=self.factor)
+        return fired
+
+
+def update_device_memory_gauges() -> int:
+    """Refresh ``zoo_tpu_device_memory_bytes{device,kind}`` watermark
+    gauges from each local device's ``memory_stats()``. Returns the
+    number of samples set (0 on backends without memory stats)."""
+    import jax
+
+    n = 0
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        for key, kind in (("bytes_in_use", "in_use"),
+                          ("peak_bytes_in_use", "peak"),
+                          ("bytes_limit", "limit")):
+            v = stats.get(key)
+            if v is None:
+                continue
+            obs.gauge("zoo_tpu_device_memory_bytes",
+                      help="device memory watermarks by kind",
+                      labels={"device": str(d.id),
+                              "kind": kind}).set(v)
+            n += 1
+    return n

@@ -42,6 +42,8 @@ import threading
 import time
 from typing import Any, Dict, Optional, Sequence, Tuple
 
+from analytics_zoo_tpu.common import tracing as _tracing
+
 __all__ = [
     "MetricsRegistry",
     "Counter",
@@ -363,6 +365,45 @@ _event_path: Optional[str] = None
 _event_fh = None
 
 
+def _rotate_locked():
+    """Size-based rotation: when ``ZOO_TPU_EVENT_LOG_MAX_MB`` is set
+    and the sink grew past it, shift ``path.1 → path.2 → ...``
+    (keeping ``ZOO_TPU_EVENT_LOG_KEEP`` rotated files, default 3) and
+    reopen a fresh ``path``. Called with ``_event_lock`` held."""
+    global _event_fh
+    raw = os.environ.get("ZOO_TPU_EVENT_LOG_MAX_MB")
+    if not raw or _event_fh is None:
+        return
+    try:
+        max_bytes = float(raw) * 1024 * 1024
+    except ValueError:
+        return
+    if max_bytes <= 0:
+        return
+    try:
+        if _event_fh.tell() < max_bytes:
+            return
+        _event_fh.close()
+    except (OSError, ValueError):
+        return
+    try:
+        keep = int(os.environ.get("ZOO_TPU_EVENT_LOG_KEEP", "3"))
+    except ValueError:
+        keep = 3
+    try:
+        for i in range(max(keep - 1, 0), 0, -1):
+            src = f"{_event_path}.{i}"
+            if os.path.exists(src):
+                os.replace(src, f"{_event_path}.{i + 1}")
+        if keep >= 1:
+            os.replace(_event_path, _event_path + ".1")
+        else:
+            os.remove(_event_path)
+    except OSError:
+        pass  # rotation is best-effort; keep logging regardless
+    _event_fh = open(_event_path, "a", encoding="utf-8")
+
+
 def _event_sink():
     """Cached append handle for ``ZOO_TPU_EVENT_LOG`` (re-resolved
     per call so tests can repoint the env var)."""
@@ -378,6 +419,7 @@ def _event_sink():
                 pass
         _event_fh = open(path, "a", encoding="utf-8")
         _event_path = path
+    _rotate_locked()
     return _event_fh
 
 
@@ -432,9 +474,15 @@ class Span:
     ``ZOO_TPU_EVENT_LOG`` is set. ``fields`` go to the event log only
     — never to metric labels (unbounded values like step indices must
     not explode label cardinality). ``elapsed`` holds the duration in
-    seconds after exit."""
+    seconds after exit.
 
-    __slots__ = ("name", "fields", "elapsed", "_t0", "_registry")
+    When an ambient trace is open (see
+    :mod:`~analytics_zoo_tpu.common.tracing`) the span also joins it
+    as a child, and the emitted event carries the trace/span ids so
+    the event log stays joinable per trace."""
+
+    __slots__ = ("name", "fields", "elapsed", "_t0", "_registry",
+                 "_trace_tok")
 
     def __init__(self, name: str, registry: MetricsRegistry,
                  fields: Dict[str, Any]):
@@ -443,8 +491,10 @@ class Span:
         self.elapsed = 0.0
         self._t0 = 0.0
         self._registry = registry
+        self._trace_tok = None
 
     def __enter__(self) -> "Span":
+        self._trace_tok = _tracing.span_start(self.name)
         self._t0 = time.perf_counter()
         return self
 
@@ -458,6 +508,14 @@ class Span:
         fields["dur_s"] = round(self.elapsed, 6)
         if exc_type is not None:
             fields["error"] = exc_type.__name__
+        if self._trace_tok is not None:
+            _tok, tid, sid, parent, t0_wall = self._trace_tok
+            _tracing.span_end(self._trace_tok, self.name,
+                              self.elapsed, self.fields)
+            fields["trace_id"] = tid
+            fields["span_id"] = sid
+            fields["parent_id"] = parent
+            fields["t_start"] = round(t0_wall, 6)
         event(self.name, **fields)
         return False  # never swallow exceptions
 
@@ -466,3 +524,8 @@ def span(name: str, registry: Optional[MetricsRegistry] = None,
          **fields) -> Span:
     """``with span("train/step", step=i): ...``"""
     return Span(name, registry or _REGISTRY, fields)
+
+
+# Route tracing's root/explicit span records into the event log.
+# (Span emits its own events above, so it bypasses this hook.)
+_tracing.set_event_hook(event)

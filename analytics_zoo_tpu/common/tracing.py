@@ -1,0 +1,427 @@
+"""Context-propagated tracing (the diagnostics layer, L1.5).
+
+PR 1's :mod:`~analytics_zoo_tpu.common.observability` answers "how
+long do spans take in aggregate"; this module answers "what happened
+to THIS request / THIS step". A **trace** is a tree of timed spans
+sharing one ``trace_id``; the ambient (trace_id, span_id) pair lives
+in a :class:`contextvars.ContextVar`, so it is inherited by nested
+``with span(...)`` blocks automatically and is per-thread by
+construction (the native front-end's worker threads each carry their
+own context).
+
+Three moving parts:
+
+- **ambient context** — :func:`trace` opens a root span and sets the
+  context; every ``observability.span()`` entered underneath joins it
+  as a child (via :func:`span_start`/:func:`span_end`, called by
+  ``observability.Span``). Work handed to *another* thread (e.g. the
+  batcher's dispatcher) captures :func:`current` at enqueue time and
+  either re-enters it with :func:`activate` or records explicit child
+  spans with :func:`record_span`.
+- **ring-buffered store** — every finished span lands in a bounded
+  in-process :class:`TraceStore` (``ZOO_TPU_TRACE_BUFFER`` records,
+  default 4096) served by ``GET /debug/traces``.
+- **Perfetto export** — :func:`to_chrome_trace` /
+  :func:`chrome_events` render spans as chrome-trace JSON
+  (``ph: "X"`` complete events, one process per trace) loadable at
+  https://ui.perfetto.dev.
+
+``ZOO_TPU_TRACE=0`` disables the whole layer: :func:`span_start`
+returns ``None`` before touching the context var and :func:`trace`
+yields a no-op handle, so the serving hot path pays nothing.
+
+Stdlib-only on purpose (observability imports *us*, never the other
+way around); event-log integration is inverted through
+:func:`set_event_hook`.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextvars
+import os
+import re
+import threading
+import time
+import uuid
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "TRACE_HEADER",
+    "SpanRecord",
+    "TraceStore",
+    "Trace",
+    "enabled",
+    "new_trace_id",
+    "sanitize_trace_id",
+    "current",
+    "trace",
+    "activate",
+    "record_span",
+    "span_start",
+    "span_end",
+    "get_store",
+    "reset_tracing",
+    "chrome_events",
+    "to_chrome_trace",
+    "set_event_hook",
+]
+
+# HTTP header carrying the trace id across the serving front door.
+TRACE_HEADER = "X-Zoo-Trace-Id"
+
+# Wire-safe trace ids only: no header/log injection, bounded length.
+_ID_RE = re.compile(r"^[A-Za-z0-9_.\-]{1,64}$")
+
+
+def enabled() -> bool:
+    """Tracing is on unless ``ZOO_TPU_TRACE=0``."""
+    return os.environ.get("ZOO_TPU_TRACE", "1") != "0"
+
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def _new_span_id() -> str:
+    return uuid.uuid4().hex[:8]
+
+
+def sanitize_trace_id(trace_id: Optional[str]) -> Optional[str]:
+    """Return ``trace_id`` if it is wire-safe, else ``None`` (the
+    caller then mints a fresh one — a hostile header never reaches
+    the event log or a response header verbatim)."""
+    if isinstance(trace_id, str) and _ID_RE.match(trace_id):
+        return trace_id
+    return None
+
+
+class SpanRecord:
+    """One finished span. ``t_start`` is epoch seconds (wall clock,
+    so records from different threads line up); ``dur_s`` is a
+    monotonic-clock duration."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "name",
+                 "t_start", "dur_s", "thread", "fields")
+
+    def __init__(self, trace_id: str, span_id: str,
+                 parent_id: Optional[str], name: str, t_start: float,
+                 dur_s: float, thread: str,
+                 fields: Optional[Dict[str, Any]] = None):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = name
+        self.t_start = t_start
+        self.dur_s = dur_s
+        self.thread = thread
+        self.fields = fields or {}
+
+    def to_dict(self) -> dict:
+        return {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "name": self.name,
+            "t_start": round(self.t_start, 6),
+            "dur_s": round(self.dur_s, 6),
+            "thread": self.thread,
+            "fields": dict(self.fields),
+        }
+
+
+class TraceStore:
+    """Bounded, thread-safe ring buffer of :class:`SpanRecord`.
+    Oldest records fall off; a trace whose spans outlive the buffer
+    simply truncates — this is a flight recorder, not a database."""
+
+    def __init__(self, capacity: Optional[int] = None):
+        if capacity is None:
+            try:
+                capacity = int(os.environ.get(
+                    "ZOO_TPU_TRACE_BUFFER", "4096"))
+            except ValueError:
+                capacity = 4096
+        self.capacity = max(1, capacity)
+        self._buf: "collections.deque[SpanRecord]" = collections.deque(
+            maxlen=self.capacity)
+        self._lock = threading.Lock()
+
+    def add(self, rec: SpanRecord):
+        with self._lock:
+            self._buf.append(rec)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._buf)
+
+    def records(self) -> "List[SpanRecord]":
+        with self._lock:
+            return list(self._buf)
+
+    def spans(self, trace_id: str) -> "List[SpanRecord]":
+        """All buffered spans of one trace, oldest-start first."""
+        return sorted((r for r in self.records()
+                       if r.trace_id == trace_id),
+                      key=lambda r: r.t_start)
+
+    def recent(self, n: int = 20) -> "List[dict]":
+        """The ``n`` most recently finished traces, newest first,
+        each as ``{"trace_id", "t_start", "dur_s", "spans": [...]}``
+        (``dur_s`` spans first start to last end)."""
+        by_trace: "Dict[str, List[SpanRecord]]" = {}
+        order: "List[str]" = []
+        for rec in self.records():
+            if rec.trace_id not in by_trace:
+                by_trace[rec.trace_id] = []
+            else:
+                try:
+                    order.remove(rec.trace_id)
+                except ValueError:
+                    pass
+            by_trace[rec.trace_id].append(rec)
+            order.append(rec.trace_id)
+        out = []
+        for tid in reversed(order[-max(0, n):] if n else []):
+            recs = sorted(by_trace[tid], key=lambda r: r.t_start)
+            t0 = recs[0].t_start
+            t1 = max(r.t_start + r.dur_s for r in recs)
+            out.append({"trace_id": tid,
+                        "t_start": round(t0, 6),
+                        "dur_s": round(t1 - t0, 6),
+                        "n_spans": len(recs),
+                        "spans": [r.to_dict() for r in recs]})
+        return out
+
+    def clear(self):
+        with self._lock:
+            self._buf.clear()
+
+
+_STORE = TraceStore()
+
+
+def get_store() -> TraceStore:
+    return _STORE
+
+
+def reset_tracing():
+    """Drop all buffered spans (test isolation)."""
+    _STORE.clear()
+
+
+# Ambient (trace_id, span_id) of the innermost open span, or None.
+_ctx: "contextvars.ContextVar[Optional[Tuple[str, str]]]" = (
+    contextvars.ContextVar("zoo_tpu_trace", default=None))
+
+
+def current() -> "Optional[Tuple[str, str]]":
+    """The ambient ``(trace_id, span_id)`` pair, or ``None``. Capture
+    this before handing work to another thread, then pass it to
+    :func:`activate` or :func:`record_span` over there."""
+    return _ctx.get()
+
+
+# observability registers its event() here so trace/root and explicit
+# record_span() records reach the JSONL event log without a circular
+# import. observability.Span emits its own events and bypasses this.
+_event_hook = None
+
+
+def set_event_hook(hook):
+    global _event_hook
+    _event_hook = hook
+
+
+def _emit(rec: SpanRecord):
+    hook = _event_hook
+    if hook is None:
+        return
+    try:
+        hook(rec.name, trace_id=rec.trace_id, span_id=rec.span_id,
+             parent_id=rec.parent_id, t_start=round(rec.t_start, 6),
+             dur_s=round(rec.dur_s, 6), **rec.fields)
+    except Exception:
+        pass  # telemetry must never take down the traced path
+
+
+class Trace:
+    """Handle yielded by :func:`trace`. ``trace_id`` is ``None`` when
+    tracing is disabled; :meth:`annotate` attaches fields to the root
+    span record."""
+
+    __slots__ = ("trace_id", "span_id", "fields")
+
+    def __init__(self, trace_id: Optional[str],
+                 span_id: Optional[str], fields: Dict[str, Any]):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.fields = fields
+
+    def annotate(self, **fields):
+        for k, v in fields.items():
+            if v is not None:
+                self.fields[k] = v
+
+
+_NOOP = Trace(None, None, {})
+
+
+@contextmanager
+def trace(name: str = "trace", trace_id: Optional[str] = None,
+          **fields):
+    """Open a **root** span: mint (or adopt) a trace id, set the
+    ambient context for the block, and record the span on exit. Yields
+    a :class:`Trace`; no-op (``trace_id is None``) when disabled."""
+    if not enabled():
+        yield _NOOP
+        return
+    tid = sanitize_trace_id(trace_id) or new_trace_id()
+    sid = _new_span_id()
+    tok = _ctx.set((tid, sid))
+    t0_wall = time.time()
+    t0 = time.perf_counter()
+    handle = Trace(tid, sid, dict(fields))
+    try:
+        yield handle
+    finally:
+        _ctx.reset(tok)
+        rec = SpanRecord(tid, sid, None, name, t0_wall,
+                         time.perf_counter() - t0,
+                         threading.current_thread().name,
+                         handle.fields)
+        _STORE.add(rec)
+        _emit(rec)
+
+
+@contextmanager
+def activate(ctx: "Optional[Tuple[str, str]]"):
+    """Re-enter a context captured with :func:`current` on another
+    thread, so spans opened inside join that trace. No-op on None."""
+    if ctx is None:
+        yield
+        return
+    tok = _ctx.set(ctx)
+    try:
+        yield
+    finally:
+        _ctx.reset(tok)
+
+
+def record_span(ctx: "Optional[Tuple[str, str]]", name: str,
+                t_start: float, dur_s: float, **fields):
+    """Record an already-timed child span of ``ctx`` (explicit
+    cross-thread form — e.g. the batcher crediting queue wait back to
+    the submitting request). ``t_start`` is epoch seconds. No-op when
+    ``ctx`` is None or tracing is disabled."""
+    if ctx is None or not enabled():
+        return
+    tid, parent = ctx
+    rec = SpanRecord(tid, _new_span_id(), parent, name, t_start,
+                     dur_s, threading.current_thread().name, fields)
+    _STORE.add(rec)
+    _emit(rec)
+
+
+def span_start(name: str):
+    """Called by ``observability.Span.__enter__``: join the ambient
+    trace as a child span. Returns an opaque token for
+    :func:`span_end`, or **None** (the hot-path fast exit) when
+    tracing is disabled or no trace is open."""
+    if not enabled():
+        return None
+    cur = _ctx.get()
+    if cur is None:
+        return None
+    tid, parent = cur
+    sid = _new_span_id()
+    tok = _ctx.set((tid, sid))
+    return (tok, tid, sid, parent, time.time())
+
+
+def span_end(token, name: str, dur_s: float,
+             fields: Optional[Dict[str, Any]] = None):
+    """Close a span opened by :func:`span_start` (token must be
+    non-None) and buffer its record. The caller (observability.Span)
+    owns event-log emission."""
+    tok, tid, sid, parent, t0_wall = token
+    try:
+        _ctx.reset(tok)
+    except ValueError:
+        pass  # exited in a different context; record anyway
+    _STORE.add(SpanRecord(tid, sid, parent, name, t0_wall, dur_s,
+                          threading.current_thread().name,
+                          dict(fields or {})))
+
+
+# ---------------------------------------------------------------------------
+# Perfetto / chrome-trace export
+# ---------------------------------------------------------------------------
+
+def _get(rec, key, default=None):
+    if isinstance(rec, SpanRecord):
+        return getattr(rec, key, default)
+    return rec.get(key, default)
+
+
+def chrome_events(records) -> "List[dict]":
+    """Render span records (:class:`SpanRecord` or plain dicts with
+    the same keys, e.g. parsed event-log lines) as chrome-trace
+    events: one ``ph: "X"`` complete event per span, one *process*
+    per trace id, one *thread* per source thread, plus ``ph: "M"``
+    metadata naming both."""
+    pids: "Dict[str, int]" = {}
+    tids: "Dict[Tuple[int, str], int]" = {}
+    events: "List[dict]" = []
+    for rec in records:
+        dur = _get(rec, "dur_s")
+        tid_str = _get(rec, "trace_id")
+        if dur is None or tid_str is None:
+            continue
+        t_start = _get(rec, "t_start")
+        if t_start is None:
+            ts = _get(rec, "ts")  # event-log lines stamp exit time
+            if ts is None:
+                continue
+            t_start = float(ts) - float(dur)
+        if tid_str not in pids:
+            pids[tid_str] = len(pids) + 1
+            events.append({"ph": "M", "name": "process_name",
+                           "pid": pids[tid_str], "tid": 0,
+                           "args": {"name": f"trace {tid_str}"}})
+        pid = pids[tid_str]
+        thread = _get(rec, "thread", "main") or "main"
+        tkey = (pid, thread)
+        if tkey not in tids:
+            tids[tkey] = len([k for k in tids if k[0] == pid]) + 1
+            events.append({"ph": "M", "name": "thread_name",
+                           "pid": pid, "tid": tids[tkey],
+                           "args": {"name": thread}})
+        args = {"trace_id": tid_str,
+                "span_id": _get(rec, "span_id"),
+                "parent_id": _get(rec, "parent_id")}
+        fields = _get(rec, "fields")
+        if isinstance(fields, dict):
+            args.update(fields)
+        events.append({
+            "name": _get(rec, "name") or _get(rec, "event", "span"),
+            "ph": "X",
+            "ts": round(float(t_start) * 1e6, 3),
+            "dur": round(float(dur) * 1e6, 3),
+            "pid": pid,
+            "tid": tids[tkey],
+            "args": {k: v for k, v in args.items() if v is not None},
+        })
+    return events
+
+
+def to_chrome_trace(trace_ids=None) -> dict:
+    """Chrome-trace JSON object for the buffered spans (optionally
+    restricted to ``trace_ids``), loadable by Perfetto."""
+    recs = _STORE.records()
+    if trace_ids is not None:
+        wanted = set(trace_ids)
+        recs = [r for r in recs if r.trace_id in wanted]
+    return {"traceEvents": chrome_events(recs),
+            "displayTimeUnit": "ms"}

@@ -107,6 +107,13 @@ def load_native() -> Optional[ctypes.CDLL]:
         lib.zoo_http_respond.argtypes = [
             ctypes.c_void_p, ctypes.c_long, ctypes.c_int,
             ctypes.c_char_p, ctypes.c_long]
+        try:  # absent from a stale pre-tracing .so — optional
+            lib.zoo_http_respond_hdr.restype = ctypes.c_int
+            lib.zoo_http_respond_hdr.argtypes = [
+                ctypes.c_void_p, ctypes.c_long, ctypes.c_int,
+                ctypes.c_char_p, ctypes.c_long, ctypes.c_char_p]
+        except AttributeError:
+            pass
         lib.zoo_http_destroy.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
@@ -255,10 +262,13 @@ class NativeHttpServer:
                                           payload_json.encode())
 
     def next_request(self, timeout_ms: int = -1):
-        """Returns (req_id, path, body_bytes), or None on timeout, or
-        raises StopIteration after close(). Buffers are per-THREAD
-        (reused across polls — no 16MB alloc churn), so concurrent
-        worker pulls never share a buffer."""
+        """Returns (req_id, path, body_bytes, trace_id_or_None), or
+        None on timeout, or raises StopIteration after close().
+        ``trace_id`` is the request's X-Zoo-Trace-Id header when the
+        C++ side captured one (it rides the path buffer after a
+        ``\\n``; a stale pre-tracing .so simply never sends it).
+        Buffers are per-THREAD (reused across polls — no 16MB alloc
+        churn), so concurrent worker pulls never share a buffer."""
         if not self._handle:
             raise StopIteration
         if not hasattr(self._tls, "buf"):
@@ -273,11 +283,17 @@ class NativeHttpServer:
             return None
         if n == -2:
             raise StopIteration
-        return rid.value, path.value.decode(), buf.raw[:n]
+        route, _, trace = path.value.decode().partition("\n")
+        return rid.value, route, buf.raw[:n], trace or None
 
-    def respond(self, req_id: int, status: int, body: bytes) -> bool:
+    def respond(self, req_id: int, status: int, body: bytes,
+                trace_id: "Optional[str]" = None) -> bool:
         if not self._handle:
             return False
+        if trace_id and hasattr(self._lib, "zoo_http_respond_hdr"):
+            return self._lib.zoo_http_respond_hdr(
+                self._handle, req_id, status, body, len(body),
+                trace_id.encode()) == 0
         return self._lib.zoo_http_respond(
             self._handle, req_id, status, body, len(body)) == 0
 

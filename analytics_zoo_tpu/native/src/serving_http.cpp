@@ -15,7 +15,13 @@
 //   zoo_http_port(h)                 -> bound port
 //   zoo_http_next(h, buf, cap, timeout_ms, &req_id, path, path_cap)
 //       -> body length >=0, -1 timeout, -2 shutdown
+//       (when the request carried an X-Zoo-Trace-Id header, the path
+//        buffer holds "path\ntrace_id" — '\n' never appears in a
+//        request line, and an old .so simply never emits it, so the
+//        Python side degrades gracefully against a stale binary)
 //   zoo_http_respond(h, req_id, status, body, len) -> 0 ok
+//   zoo_http_respond_hdr(h, req_id, status, body, len, trace)
+//       -> same, echoing trace as an X-Zoo-Trace-Id response header
 //   zoo_http_set_health(h, json)     -> health payload
 //   zoo_http_destroy(h)
 #include <arpa/inet.h>
@@ -40,6 +46,7 @@ struct Request {
     long id;
     std::string path;
     std::string body;
+    std::string trace;  // X-Zoo-Trace-Id header value ("" = none)
     int fd;
 };
 
@@ -69,7 +76,8 @@ void write_all(int fd, const char* p, size_t n) {
 }
 
 void send_response(int fd, int status, const std::string& body,
-                   const char* ctype = "application/json") {
+                   const char* ctype = "application/json",
+                   const std::string& extra_hdr = "") {
     const char* reason = status == 200 ? "OK" : status == 400
         ? "Bad Request" : status == 404 ? "Not Found"
         : status == 413 ? "Payload Too Large" : status == 503
@@ -77,14 +85,32 @@ void send_response(int fd, int status, const std::string& body,
     std::string head = "HTTP/1.1 " + std::to_string(status) + " " +
         reason + "\r\nContent-Type: " + ctype + "\r\n"
         "Content-Length: " + std::to_string(body.size()) +
-        "\r\nConnection: close\r\n\r\n";
+        "\r\nConnection: close\r\n" + extra_hdr + "\r\n";
     write_all(fd, head.data(), head.size());
     write_all(fd, body.data(), body.size());
 }
 
+// wire-safe trace ids only (mirrors tracing.sanitize_trace_id): no
+// header/log injection, bounded length
+std::string sanitize_trace(const std::string& v) {
+    std::string out;
+    for (char c : v) {
+        if ((c >= 'A' && c <= 'Z') || (c >= 'a' && c <= 'z') ||
+            (c >= '0' && c <= '9') || c == '_' || c == '.' ||
+            c == '-') {
+            out.push_back(c);
+            if (out.size() >= 64) break;
+        } else if (c != ' ' && c != '\t') {
+            return "";  // anything else: drop the header entirely
+        }
+    }
+    return out;
+}
+
 // read one HTTP request (headers + Content-Length body); false = drop
 bool read_request(Server* s, int fd, std::string* method,
-                  std::string* path, std::string* body) {
+                  std::string* path, std::string* body,
+                  std::string* trace) {
     // overall deadline: SO_RCVTIMEO only bounds each recv, not a
     // slow-trickle client; destroy() relies on this hard cap
     const auto deadline = std::chrono::steady_clock::now() +
@@ -109,7 +135,7 @@ bool read_request(Server* s, int fd, std::string* method,
     *method = head.substr(0, sp1);
     *path = head.substr(sp1 + 1, sp2 - sp1 - 1);
     long content_len = 0;
-    // case-insensitive Content-Length scan
+    // case-insensitive Content-Length / X-Zoo-Trace-Id scan
     for (size_t pos = 0; (pos = head.find(':', pos)) !=
          std::string::npos; ++pos) {
         size_t ls = head.rfind('\n', pos);
@@ -118,7 +144,12 @@ bool read_request(Server* s, int fd, std::string* method,
         for (auto& c : name) c = static_cast<char>(::tolower(c));
         if (name == "content-length") {
             content_len = ::atol(head.c_str() + pos + 1);
-            break;
+        } else if (name == "x-zoo-trace-id" && trace) {
+            size_t ve = head.find('\r', pos);
+            if (ve == std::string::npos) ve = head.find('\n', pos);
+            if (ve == std::string::npos) ve = head.size();
+            *trace = sanitize_trace(head.substr(pos + 1,
+                                                ve - pos - 1));
         }
     }
     if (content_len < 0 || content_len > s->max_body) {
@@ -139,11 +170,13 @@ bool read_request(Server* s, int fd, std::string* method,
 // per-connection: read + parse + enqueue off the acceptor thread, so
 // one slow client cannot stall other connections or /health
 void handle_conn(Server* s, int fd) {
-    std::string method, path, body;
-    if (read_request(s, fd, &method, &path, &body)) {
-        // GET /metrics rides the worker queue: Python owns the
-        // metrics registry, so only it can render the exposition
+    std::string method, path, body, trace;
+    if (read_request(s, fd, &method, &path, &body, &trace)) {
+        // GET /metrics and GET /debug/* ride the worker queue:
+        // Python owns the metrics registry and the trace store
         bool is_metrics = method == "GET" && path == "/metrics";
+        bool is_debug = method == "GET" &&
+            path.rfind("/debug/", 0) == 0;
         if (method == "GET" && path == "/health") {
             std::string payload;
             {
@@ -152,7 +185,7 @@ void handle_conn(Server* s, int fd) {
             }
             send_response(fd, 200, payload);
             ::close(fd);
-        } else if (method != "POST" && !is_metrics) {
+        } else if (method != "POST" && !is_metrics && !is_debug) {
             send_response(fd, 404, "{\"error\": \"POST only\"}");
             ::close(fd);
         } else {
@@ -162,6 +195,7 @@ void handle_conn(Server* s, int fd) {
                 req.id = s->next_id++;
                 req.path = path;
                 req.body = std::move(body);
+                req.trace = std::move(trace);
                 req.fd = fd;
                 s->pending[req.id] = {fd, is_metrics};
                 s->queue.push_back(std::move(req));
@@ -271,17 +305,23 @@ long zoo_http_next(void* h, char* buf, long cap, long timeout_ms,
     }
     std::memcpy(buf, req.body.data(), req.body.size());
     if (path_cap > 0) {
+        // piggyback the trace id after the path ('\n' separated) so
+        // the ABI stays stable — a trace id never fits worse than
+        // the path alone did (path_cap is 1024, ids cap at 64)
+        std::string out = req.path;
+        if (!req.trace.empty()) out += "\n" + req.trace;
         long n = std::min<long>(path_cap - 1,
-                                static_cast<long>(req.path.size()));
-        std::memcpy(path, req.path.data(), static_cast<size_t>(n));
+                                static_cast<long>(out.size()));
+        std::memcpy(path, out.data(), static_cast<size_t>(n));
         path[n] = '\0';
     }
     *req_id = req.id;
     return static_cast<long>(req.body.size());
 }
 
-int zoo_http_respond(void* h, long req_id, int status,
-                     const char* body, long len) {
+static int respond_impl(void* h, long req_id, int status,
+                        const char* body, long len,
+                        const char* trace) {
     auto* s = static_cast<Server*>(h);
     int fd = -1;
     bool is_metrics = false;
@@ -293,12 +333,29 @@ int zoo_http_respond(void* h, long req_id, int status,
         is_metrics = it->second.second;
         s->pending.erase(it);
     }
+    std::string extra;
+    if (trace && *trace) {
+        std::string t = sanitize_trace(trace);
+        if (!t.empty()) extra = "X-Zoo-Trace-Id: " + t + "\r\n";
+    }
     send_response(fd, status,
                   std::string(body, static_cast<size_t>(len)),
                   is_metrics ? "text/plain; version=0.0.4"
-                             : "application/json");
+                             : "application/json",
+                  extra);
     ::close(fd);
     return 0;
+}
+
+int zoo_http_respond(void* h, long req_id, int status,
+                     const char* body, long len) {
+    return respond_impl(h, req_id, status, body, len, nullptr);
+}
+
+int zoo_http_respond_hdr(void* h, long req_id, int status,
+                         const char* body, long len,
+                         const char* trace) {
+    return respond_impl(h, req_id, status, body, len, trace);
 }
 
 void zoo_http_destroy(void* h) {

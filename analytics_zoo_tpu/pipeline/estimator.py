@@ -30,7 +30,9 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from analytics_zoo_tpu.common import diagnostics
 from analytics_zoo_tpu.common import observability as obs
+from analytics_zoo_tpu.common import tracing
 from analytics_zoo_tpu.common.nncontext import NNContext, get_nncontext, \
     logger
 from analytics_zoo_tpu.ops import losses as losses_lib
@@ -295,6 +297,21 @@ def _prefetch_iter(it, place, depth: int):
             yield item
     finally:
         stop.set()
+
+
+def _timed_iter(it):
+    """Wrap an iterator, yielding ``(wait_s, item)`` — how long the
+    consumer blocked waiting for each item. With the prefetch worker
+    ahead of compute this is ~0; a sustained positive wait means the
+    input pipeline, not the device, is the bottleneck."""
+    it = iter(it)
+    while True:
+        t0 = time.perf_counter()
+        try:
+            item = next(it)
+        except StopIteration:
+            return
+        yield time.perf_counter() - t0, item
 
 
 def _prefetch_depth() -> int:
@@ -773,6 +790,15 @@ class Estimator:
             "zoo_tpu_train_examples_total",
             help="training examples consumed")
         first_step = True
+        # diagnostics (docs/observability.md anomaly catalog):
+        # straggler steps + recompile storms fire structured events
+        watcher = diagnostics.StepTimeWatcher()
+        diagnostics.install_recompile_monitor()
+        # ZOO_TPU_TRACE_SYNC=1 adds a block_until_ready per step so
+        # step traces carry true device time — a per-step sync, so
+        # opt-in (it caps dispatch pipelining)
+        trace_sync = os.environ.get(
+            "ZOO_TPU_TRACE_SYNC", "0") == "1"
 
         try:
             for epoch in range(1, nb_epoch + 1):
@@ -801,64 +827,91 @@ class Estimator:
                 with ep_span:
                     try:
                         t_prev = time.perf_counter()
-                        for xb, yb in batches:
-                            rng = jax.random.fold_in(base_rng,
-                                                     self.step)
-                            if self._profile_dir and \
-                                    not self._profiling and \
-                                    self.step + 1 >= p_start:
-                                jax.profiler.start_trace(
-                                    self._profile_dir)
-                                self._profiling = True
-                            self.params, self.opt_state, loss = \
-                                self._train_step(
-                                    self.params, self.opt_state,
-                                    rng, xb, yb)
-                            self.step += 1
-                            if first_step:
-                                # includes XLA compile when this call
-                                # traced a fresh step fn; the one-time
-                                # sync is noise next to the compile
-                                jax.block_until_ready(loss)
-                                obs.gauge(
-                                    "zoo_tpu_train_first_step_seconds",
-                                    help="first-step wall time of the "
-                                         "latest run (incl. compile)"
-                                ).set(time.perf_counter() - t_prev)
-                                first_step = False
-                            if self._profiling and self.step >= p_end:
-                                jax.block_until_ready(loss)
-                                jax.profiler.stop_trace()
-                                self._profiling = False
-                                self._profile_dir = None
-                            now = time.perf_counter()
-                            step_hist.observe(now - t_prev)
-                            t_prev = now
-                            steps_total.inc()
-                            examples_total.inc(batch_size)
-                            n_records += batch_size
-                            pending.append((self.step, loss))
-                            if self._summary_triggers:
-                                trig = self._summary_triggers.get(
-                                    "Parameters")
-                                if tb is not None and trig is not None \
-                                        and trig(epoch, self.step,
-                                                 False):
-                                    self._write_param_histograms(
-                                        tb, self.step)
-                                trig = self._summary_triggers.get(
-                                    "LearningRate")
-                                if trig is not None and trig(
-                                        epoch, self.step, False):
-                                    self._record_lr(tb, self.step)
-                            if self.checkpoint_path and \
-                                    self.checkpoint_trigger(
-                                        epoch, self.step, False):
-                                self.save_checkpoint()
-                            if end_trigger is not None and end_trigger(
-                                    epoch - 1, self.step, False):
-                                stop = True
-                                break
+                        for wait_s, (xb, yb) in _timed_iter(batches):
+                            with tracing.trace(
+                                      "train/step", step=self.step + 1,
+                                      epoch=epoch) as tr:
+                                rng = jax.random.fold_in(base_rng,
+                                                         self.step)
+                                if self._profile_dir and \
+                                        not self._profiling and \
+                                        self.step + 1 >= p_start:
+                                    jax.profiler.start_trace(
+                                        self._profile_dir)
+                                    self._profiling = True
+                                # step markers line up with our spans in
+                                # on-demand XLA profiles (/debug/profile)
+                                t_disp = time.perf_counter()
+                                with jax.profiler.StepTraceAnnotation(
+                                        "train", step_num=self.step):
+                                    self.params, self.opt_state, loss = \
+                                        self._train_step(
+                                            self.params, self.opt_state,
+                                            rng, xb, yb)
+                                dispatch_s = (time.perf_counter()
+                                              - t_disp)
+                                self.step += 1
+                                device_s = None
+                                if trace_sync:
+                                    t_dev = time.perf_counter()
+                                    jax.block_until_ready(loss)
+                                    device_s = (time.perf_counter()
+                                                - t_dev)
+                                if first_step:
+                                    # includes XLA compile when this call
+                                    # traced a fresh step fn; the one-time
+                                    # sync is noise next to the compile
+                                    jax.block_until_ready(loss)
+                                    obs.gauge(
+                                        "zoo_tpu_train_first_step_seconds",
+                                        help="first-step wall time of the "
+                                             "latest run (incl. compile)"
+                                    ).set(time.perf_counter() - t_prev)
+                                    first_step = False
+                                if self._profiling and self.step >= p_end:
+                                    jax.block_until_ready(loss)
+                                    jax.profiler.stop_trace()
+                                    self._profiling = False
+                                    self._profile_dir = None
+                                now = time.perf_counter()
+                                step_hist.observe(now - t_prev)
+                                watcher.observe(now - t_prev,
+                                                step=self.step)
+                                t_prev = now
+                                steps_total.inc()
+                                examples_total.inc(batch_size)
+                                n_records += batch_size
+                                pending.append((self.step, loss))
+                                if self._summary_triggers:
+                                    trig = self._summary_triggers.get(
+                                        "Parameters")
+                                    if tb is not None and trig is not None \
+                                            and trig(epoch, self.step,
+                                                     False):
+                                        self._write_param_histograms(
+                                            tb, self.step)
+                                    trig = self._summary_triggers.get(
+                                        "LearningRate")
+                                    if trig is not None and trig(
+                                            epoch, self.step, False):
+                                        self._record_lr(tb, self.step)
+                                ckpt_s = None
+                                if self.checkpoint_path and \
+                                        self.checkpoint_trigger(
+                                            epoch, self.step, False):
+                                    t_ck = time.perf_counter()
+                                    self.save_checkpoint()
+                                    ckpt_s = (time.perf_counter()
+                                              - t_ck)
+                                tr.annotate(
+                                    data_wait_s=round(wait_s, 6),
+                                    dispatch_s=round(dispatch_s, 6),
+                                    device_s=device_s,
+                                    checkpoint_s=ckpt_s)
+                                if end_trigger is not None and end_trigger(
+                                        epoch - 1, self.step, False):
+                                    stop = True
+                                    break
                     finally:
                         # break/exception must stop the worker thread
                         # NOW, not at GC — a retained traceback would
@@ -884,6 +937,7 @@ class Estimator:
                     "zoo_tpu_train_throughput_examples_per_sec",
                     help="epoch training throughput").set(throughput)
                 self._record_lr(None, self.step)  # gauge refresh
+                diagnostics.update_device_memory_gauges()
                 entry = {"epoch": epoch,
                          "loss": epoch_loss / max(epoch_batches, 1),
                          "throughput": throughput, "step": self.step}
@@ -981,11 +1035,16 @@ class Estimator:
                             drop_last=False),
             _place, _prefetch_depth())
         try:
-            with obs.span("train/eval", step=self.step,
-                          n=ds.num_samples):
+            # each evaluate() call is one trace: the eval span (and
+            # any nested spans) lands in /debug/traces & the exporter
+            with tracing.trace("train/eval_run", step=self.step), \
+                    obs.span("train/eval", step=self.step,
+                             n=ds.num_samples):
                 for xb, yb, wb in batches:
-                    stats = jax.device_get(
-                        self._eval_step(self.params, xb, yb, wb))
+                    with jax.profiler.StepTraceAnnotation(
+                            "eval", step_num=self.step):
+                        stats = jax.device_get(
+                            self._eval_step(self.params, xb, yb, wb))
                     for mname, mstats in stats.items():
                         acc = totals.setdefault(mname, {})
                         for k, v in mstats.items():

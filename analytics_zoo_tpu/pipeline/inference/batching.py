@@ -52,7 +52,9 @@ from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
+from analytics_zoo_tpu.common import diagnostics
 from analytics_zoo_tpu.common import observability as obs
+from analytics_zoo_tpu.common import tracing
 from analytics_zoo_tpu.common.nncontext import logger
 
 __all__ = [
@@ -105,9 +107,13 @@ def bucket_ladder(max_batch: int,
 
 class _Entry:
     """One queued request: input arrays, row count, completion
-    future, and the two clocks (enqueue time, absolute deadline)."""
+    future, the two clocks (enqueue time, absolute deadline), and —
+    when the submitting thread had an open trace — its captured
+    trace context, so the dispatcher can credit queue-wait / execute
+    / scatter back to the request's trace."""
 
-    __slots__ = ("xs", "n", "sig", "future", "t_enq", "deadline")
+    __slots__ = ("xs", "n", "sig", "future", "t_enq", "deadline",
+                 "trace", "t_enq_wall")
 
     def __init__(self, xs, n, sig, deadline):
         self.xs = xs
@@ -116,6 +122,8 @@ class _Entry:
         self.future: "Future" = Future()
         self.t_enq = time.monotonic()
         self.deadline = deadline  # absolute monotonic, or None
+        self.trace = tracing.current()  # None when untraced
+        self.t_enq_wall = time.time() if self.trace else 0.0
 
 
 def _signature(xs) -> tuple:
@@ -206,6 +214,7 @@ class DynamicBatcher:
         and start the dispatcher thread. Idempotent."""
         if self._thread is not None and self._thread.is_alive():
             return self
+        diagnostics.install_recompile_monitor()
         self.warm()
         self._stop = False
         self._thread = threading.Thread(
@@ -376,31 +385,53 @@ class DynamicBatcher:
         wait_h = obs.histogram(
             "zoo_tpu_serving_queue_wait_seconds",
             help="time requests spent queued before dispatch")
+        rows = sum(e.n for e in batch)
         for e in batch:
             wait_h.observe(now - e.t_enq)
+            # credit the queue wait back to each request's trace
+            tracing.record_span(
+                e.trace, "serving/queue_wait", e.t_enq_wall,
+                now - e.t_enq, rows=e.n, batch_rows=rows,
+                n_requests=len(batch))
         sig = batch[0].sig
         n_inputs = len(batch[0].xs)
-        rows = sum(e.n for e in batch)
         if len(batch) == 1:
             xs = batch[0].xs
         else:
             xs = [np.concatenate([e.xs[i] for e in batch])
                   for i in range(n_inputs)]
         t0 = time.monotonic()
+        t0_wall = time.time()
         try:
-            outs, multi = self._run_rows(sig, xs, rows)
+            # the first entry's trace becomes ambient, so the pad /
+            # predict spans inside _pad_and_run join it as children
+            with tracing.activate(batch[0].trace):
+                outs, multi = self._run_rows(sig, xs, rows)
         except Exception as e:
             for entry in batch:
                 entry.future.set_exception(e)
             return
-        self._ema_batch_s = (0.8 * self._ema_batch_s
-                             + 0.2 * (time.monotonic() - t0))
+        exec_s = time.monotonic() - t0
+        # coalesced requests beyond the first get an explicit execute
+        # span (their trace was not the ambient one during the call)
+        for e in batch[1:]:
+            tracing.record_span(
+                e.trace, "serving/execute", t0_wall, exec_s,
+                rows=e.n, batch_rows=rows, n_requests=len(batch))
+        self._ema_batch_s = (0.8 * self._ema_batch_s + 0.2 * exec_s)
         off = 0
+        t_sc = time.monotonic()
+        t_sc_wall = time.time()
         for entry in batch:
             rows_out = [o[off:off + entry.n] for o in outs]
             entry.future.set_result(
                 rows_out if multi else rows_out[0])
             off += entry.n
+        scatter_s = time.monotonic() - t_sc
+        for e in batch:
+            tracing.record_span(
+                e.trace, "serving/scatter", t_sc_wall, scatter_s,
+                rows=e.n, n_requests=len(batch))
 
     def _run_rows(self, sig, xs, rows):
         """Execute ``rows`` coalesced rows, chunking when a single
@@ -441,16 +472,19 @@ class DynamicBatcher:
             return [np.asarray(o) for o in outs], multi
         pad = bucket - n
         if pad:
-            xs = [np.concatenate(
-                [x, np.zeros((pad,) + x.shape[1:], x.dtype)])
-                for x in xs]
+            with obs.span("serving/pad", rows=n, bucket=bucket,
+                          pad=pad):
+                xs = [np.concatenate(
+                    [x, np.zeros((pad,) + x.shape[1:], x.dtype)])
+                    for x in xs]
             obs.counter("zoo_tpu_serving_padding_rows_total",
                         help="padding rows executed (bucket waste)"
                         ).inc(pad)
         obs.counter("zoo_tpu_serving_batch_executions_total",
                     help="bucket executions",
                     labels={"bucket": str(bucket)}).inc()
-        with obs.span("serving/predict", rows=n, bucket=bucket):
+        with obs.span("serving/predict", rows=n, bucket=bucket,
+                      fill=round(n / bucket, 4)):
             out = fn(*xs)
         multi = isinstance(out, (list, tuple))
         outs = list(out) if multi else [out]

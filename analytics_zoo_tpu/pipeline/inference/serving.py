@@ -9,6 +9,16 @@ services, here a stdlib HTTP/JSON endpoint (no framework deps).
 POST /predict  {"inputs": [[...], ...]}  →  {"outputs": [[...], ...]}
 GET  /health   →  {"status": "ok", "free_slots": N, "batcher": {...}}
 GET  /metrics  →  Prometheus text exposition (docs/observability.md)
+GET  /debug/traces[?n=20]  →  recent traces as JSON (docs/observability.md)
+POST /debug/profile {"dir": ..., "ms": 500}  →  on-demand jax.profiler
+     capture written to ``dir`` (one at a time; 503 while busy)
+
+Tracing: /predict accepts and echoes an ``X-Zoo-Trace-Id`` header
+(minted server-side when absent); the request runs under that trace,
+so the batcher's queue/pad/execute/scatter child spans and the model
+span land in ``GET /debug/traces`` under one id. ``ZOO_TPU_TRACE=0``
+disables all of it (the hot path then skips trace bookkeeping
+entirely).
 
 Requests route through a :class:`DynamicBatcher`
 (`pipeline/inference/batching.py`, docs/serving.md) by default:
@@ -35,6 +45,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 from analytics_zoo_tpu.common import observability as obs
+from analytics_zoo_tpu.common import tracing
 from analytics_zoo_tpu.pipeline.inference.batching import (
     DeadlineExpiredError, DynamicBatcher, QueueFullError)
 from analytics_zoo_tpu.pipeline.inference.inference_model import (
@@ -54,7 +65,9 @@ def _count_error(kind: str):
 
 
 def _record_request(path: str, status: int, dt: float):
-    """Shared per-request telemetry for both HTTP front-ends."""
+    """Shared per-request telemetry for both HTTP front-ends. Query
+    strings are stripped so label cardinality stays bounded."""
+    path = path.split("?", 1)[0]
     obs.counter("zoo_tpu_serving_requests_total",
                 help="HTTP requests served",
                 labels={"path": path, "status": str(status)}).inc()
@@ -154,6 +167,84 @@ def _health_payload(model: InferenceModel,
     }
 
 
+def _traces_payload(path: str) -> dict:
+    """``GET /debug/traces[?n=20]``: the most recent traces from the
+    in-process ring buffer, newest first."""
+    from urllib.parse import parse_qs, urlsplit
+    q = parse_qs(urlsplit(path).query)
+    try:
+        n = int(q.get("n", ["20"])[0])
+    except ValueError:
+        n = 20
+    return {"enabled": tracing.enabled(),
+            "traces": tracing.get_store().recent(
+                max(1, min(n, 200)))}
+
+
+# On-demand jax.profiler capture: one at a time per process (the XLA
+# profiler is a process-global singleton).
+_profile_lock = threading.Lock()
+_profile_thread: "Optional[threading.Thread]" = None
+
+
+def _profiler_capture(out_dir: str, ms: float):
+    """Capture ``ms`` milliseconds of jax.profiler trace into
+    ``out_dir`` (module-level so tests can stub it)."""
+    import jax
+
+    jax.profiler.start_trace(out_dir)
+    try:
+        time.sleep(ms / 1e3)
+    finally:
+        jax.profiler.stop_trace()
+
+
+def handle_profile(body: bytes) -> "Tuple[int, dict]":
+    """``POST /debug/profile {"dir": ..., "ms": 500}``: trigger an
+    on-demand ``jax.profiler`` capture in a background thread (the
+    train loop's ``StepTraceAnnotation`` step markers line up with
+    our spans in the result). Returns immediately; 503 while a
+    capture is already running."""
+    global _profile_thread
+    try:
+        req = json.loads(body) if body else {}
+    except (ValueError, UnicodeDecodeError) as e:
+        _count_error("bad_json")
+        return 400, _error_body(400, f"malformed JSON body: {e}")
+    if not isinstance(req, dict) or not req.get("dir"):
+        _count_error("bad_request")
+        return 400, _error_body(
+            400, 'request must be a JSON object with a "dir" key '
+            '(profile output directory); optional "ms" duration')
+    out_dir = str(req["dir"])
+    try:
+        ms = float(req.get("ms", 500))
+    except (TypeError, ValueError):
+        _count_error("bad_request")
+        return 400, _error_body(400, '"ms" must be a number')
+    ms = max(1.0, min(ms, 60_000.0))
+    if not _profile_lock.acquire(blocking=False):
+        _count_error("profile_busy")
+        return 503, _error_body(
+            503, "a profiler capture is already running")
+
+    def _run():
+        try:
+            _profiler_capture(out_dir, ms)
+            obs.event("serving/profile_capture", dir=out_dir, ms=ms)
+        except Exception as e:
+            obs.event("serving/profile_error", dir=out_dir,
+                      error=f"{type(e).__name__}: {e}")
+        finally:
+            _profile_lock.release()
+
+    t = threading.Thread(target=_run, name="zoo-tpu-profiler",
+                         daemon=True)
+    _profile_thread = t
+    t.start()
+    return 200, {"status": "capturing", "dir": out_dir, "ms": ms}
+
+
 def _resolve_batcher(model: InferenceModel, batcher):
     """``"auto"`` → env-configured batcher (None when
     ``ZOO_TPU_SERVING_BATCH=0``); explicit ``None`` → per-request
@@ -174,15 +265,20 @@ class InferenceServer:
             def log_message(self, *args):
                 pass
 
-            def _reply(self, code: int, payload: dict):
+            def _reply(self, code: int, payload: dict,
+                       headers: Optional[dict] = None):
                 body = json.dumps(payload).encode()
-                self._reply_raw(code, body, "application/json")
+                self._reply_raw(code, body, "application/json",
+                                headers)
 
             def _reply_raw(self, code: int, body: bytes,
-                           ctype: str):
+                           ctype: str,
+                           headers: Optional[dict] = None):
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 if code == 503:
                     err = {}
                     try:
@@ -203,18 +299,22 @@ class InferenceServer:
                 _in_flight().inc()
                 status = 0
                 payload = None  # None == /metrics (rendered below)
+                route = self.path.split("?", 1)[0]
                 try:
-                    if self.path == "/health":
+                    if route == "/health":
                         status = 200
                         payload = _health_payload(
                             server.model, server.batcher)
-                    elif self.path == "/metrics":
+                    elif route == "/metrics":
                         status = 200
+                    elif route == "/debug/traces":
+                        status = 200
+                        payload = _traces_payload(self.path)
                     else:
                         status = 404
                         _count_error("not_found")
                         payload = _error_body(
-                            404, "not found", path=self.path)
+                            404, "not found", path=route)
                 finally:
                     # account BEFORE replying: a client that scrapes
                     # /metrics right after a response must see its own
@@ -233,12 +333,14 @@ class InferenceServer:
                 t0 = time.perf_counter()
                 _in_flight().inc()
                 status = 0
+                trace_id = None
+                route = self.path.split("?", 1)[0]
                 try:
-                    if self.path != "/predict":
+                    if route not in ("/predict", "/debug/profile"):
                         status = 404
                         _count_error("not_found")
                         payload = _error_body(
-                            404, "not found", path=self.path)
+                            404, "not found", path=route)
                     else:
                         try:
                             n = int(self.headers.get(
@@ -249,14 +351,29 @@ class InferenceServer:
                             _count_error("bad_request")
                             payload = _error_body(400, str(e))
                         else:
-                            status, payload = handle_predict(
-                                server.model, body,
-                                batcher=server.batcher)
+                            if route == "/debug/profile":
+                                status, payload = handle_profile(
+                                    body)
+                            else:
+                                with tracing.trace(
+                                        "serving/request",
+                                        trace_id=self.headers.get(
+                                            tracing.TRACE_HEADER),
+                                        path=route) as tr:
+                                    status, payload = \
+                                        handle_predict(
+                                            server.model, body,
+                                            batcher=server.batcher)
+                                    tr.annotate(status=status)
+                                trace_id = tr.trace_id
                 finally:
                     _in_flight().dec()
-                    _record_request(self.path, status,
+                    _record_request(route, status,
                                     time.perf_counter() - t0)
-                self._reply(status, payload)
+                self._reply(
+                    status, payload,
+                    {tracing.TRACE_HEADER: trace_id}
+                    if trace_id else None)
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._thread: Optional[threading.Thread] = None
@@ -313,23 +430,38 @@ class NativeInferenceServer:
     def port(self) -> int:
         return self._srv.port
 
-    def _serve_one(self, rid: int, path: str, body: bytes):
+    def _serve_one(self, rid: int, path: str, body: bytes,
+                   trace_hdr: "Optional[str]" = None):
         t0 = time.perf_counter()
         _in_flight().inc()
         status = 0
         out = b""
+        trace_id = None
+        route = path.split("?", 1)[0]
         try:
-            if path == "/metrics":
+            if route == "/metrics":
                 status = 200
                 out = None  # rendered after accounting, below
-            elif path != "/predict":
+            elif route == "/debug/traces":
+                status = 200
+                out = json.dumps(_traces_payload(path)).encode()
+            elif route == "/debug/profile":
+                status, payload = handle_profile(body)
+                out = json.dumps(payload).encode()
+            elif route != "/predict":
                 status = 404
                 _count_error("not_found")
                 out = json.dumps(
-                    _error_body(404, "not found", path=path)).encode()
+                    _error_body(404, "not found",
+                                path=route)).encode()
             else:
-                status, payload = handle_predict(
-                    self.model, body, batcher=self.batcher)
+                with tracing.trace("serving/request",
+                                   trace_id=trace_hdr,
+                                   path=route) as tr:
+                    status, payload = handle_predict(
+                        self.model, body, batcher=self.batcher)
+                    tr.annotate(status=status)
+                trace_id = tr.trace_id
                 out = json.dumps(payload).encode()
         except Exception as e:
             status = 500
@@ -340,11 +472,11 @@ class NativeInferenceServer:
             # /metrics right after its response must see this request
             # already counted (and in-flight back at 0)
             _in_flight().dec()
-            _record_request(path, status, time.perf_counter() - t0)
+            _record_request(route, status, time.perf_counter() - t0)
         if out is None:
             out = obs.to_prometheus().encode()
         try:
-            self._srv.respond(rid, status, out)
+            self._srv.respond(rid, status, out, trace_id=trace_id)
         except Exception:
             pass  # client gone — nothing to tell it
         # refresh the C++-cached health AFTER the slot freed, so

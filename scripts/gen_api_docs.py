@@ -30,6 +30,10 @@ MODULES = [
     ("analytics_zoo_tpu.common", "common — context & config"),
     ("analytics_zoo_tpu.common.observability",
      "observability — metrics, spans, event log"),
+    ("analytics_zoo_tpu.common.tracing",
+     "tracing — trace ids, span buffer, chrome-trace export"),
+    ("analytics_zoo_tpu.common.diagnostics",
+     "diagnostics — anomaly detectors & device watermarks"),
     ("analytics_zoo_tpu.feature", "feature — FeatureSet & ingest"),
     ("analytics_zoo_tpu.feature.image", "feature.image — ImageSet"),
     ("analytics_zoo_tpu.feature.image3d", "feature.image3d"),

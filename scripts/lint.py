@@ -9,7 +9,10 @@ stdlib ast:
 - line length <= 79 (reference pep8 default); URLs and noqa exempt;
 - unused `import x` / `from x import y` at module top level
   (skipped in `__init__.py` re-export hubs, for names in `__all__`,
-  and on lines carrying a `# noqa` comment).
+  and on lines carrying a `# noqa` comment);
+- metric naming (package files only): every string-literal metric
+  name passed to `counter()` / `gauge()` / `histogram()` must match
+  `zoo_tpu_<snake_case>` (docs/observability.md naming contract).
 
 Run: `python scripts/lint.py` (exit 1 on findings). `make lint`.
 """
@@ -18,6 +21,7 @@ from __future__ import annotations
 
 import ast
 import os
+import re
 import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -96,6 +100,34 @@ def _string_mentions(tree: ast.AST) -> set:
     return out
 
 
+_METRIC_FNS = {"counter", "gauge", "histogram"}
+_METRIC_RE = re.compile(r"^zoo_tpu_[a-z0-9]+(_[a-z0-9]+)*$")
+
+
+def _metric_name_problems(rel: str, tree: ast.AST) -> list:
+    """Metric naming contract (docs/observability.md): every literal
+    name handed to counter()/gauge()/histogram() is `zoo_tpu_*`
+    snake_case. Only package code is held to it — tests deliberately
+    mint odd names to exercise escaping."""
+    problems = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        fn_name = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else None)
+        if fn_name not in _METRIC_FNS or not node.args:
+            continue
+        first = node.args[0]
+        if isinstance(first, ast.Constant) and isinstance(
+                first.value, str):
+            if not _METRIC_RE.match(first.value):
+                problems.append(
+                    f"{rel}:{node.lineno}: metric name "
+                    f"'{first.value}' violates zoo_tpu_* snake_case")
+    return problems
+
+
 def check_file(path: str) -> list:
     rel = os.path.relpath(path, ROOT)
     try:
@@ -116,6 +148,8 @@ def check_file(path: str) -> list:
                 and "http://" not in line and "https://" not in line):
             problems.append(
                 f"{rel}:{i}: line too long ({len(line)} > {MAX_LEN})")
+    if rel.startswith("analytics_zoo_tpu" + os.sep):
+        problems.extend(_metric_name_problems(rel, tree))
     if os.path.basename(path) != "__init__.py":
         used = _used_names(tree) | _string_mentions(tree)
         lines = src.splitlines()

@@ -1,0 +1,148 @@
+"""Offline trace/diagnostics report over a JSONL event log.
+
+`make trace-report` renders the structured event log written by
+``ZOO_TPU_EVENT_LOG`` (see docs/observability.md) into three views:
+
+  1. per-step training timeline — one line per ``train/step`` span
+     with the data-wait / dispatch / device / checkpoint breakdown
+  2. top-N slowest serving requests — ``serving/request`` roots
+     joined to their child spans (queue wait, pad, predict, scatter)
+     by trace id
+  3. anomaly digest — ``diagnostics/anomaly`` events grouped by kind
+
+``--chrome OUT`` additionally exports every traced span as Perfetto-
+loadable chrome-trace JSON (open at https://ui.perfetto.dev).
+
+Usage:
+    python scripts/trace_report.py --events PATH [--top N]
+                                   [--chrome OUT]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:  # `python scripts/trace_report.py` from root
+    sys.path.insert(0, ROOT)
+
+from analytics_zoo_tpu.common import tracing  # noqa: E402
+
+
+def load_events(path: str) -> "List[Dict[str, Any]]":
+    """Parse a JSONL event log, skipping malformed lines (a crashed
+    writer may leave a truncated tail)."""
+    out: "List[Dict[str, Any]]" = []
+    with open(path, "r", encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+    return out
+
+
+def _fmt_ms(v) -> str:
+    return "-" if v is None else f"{float(v) * 1e3:8.2f}"
+
+
+def step_timeline(events, out=sys.stdout):
+    steps = [e for e in events if e.get("event") == "train/step"]
+    print(f"\n== training timeline ({len(steps)} steps) ==", file=out)
+    if not steps:
+        return
+    print("  step  epoch   total_ms    wait_ms   dispatch_ms  "
+          "device_ms    ckpt_ms", file=out)
+    for e in steps:
+        print(f"  {e.get('step', '?'):>4}  {e.get('epoch', '?'):>5}"
+              f"  {_fmt_ms(e.get('dur_s')):>9}"
+              f"  {_fmt_ms(e.get('data_wait_s')):>9}"
+              f"  {_fmt_ms(e.get('dispatch_s')):>11}"
+              f"  {_fmt_ms(e.get('device_s')):>9}"
+              f"  {_fmt_ms(e.get('checkpoint_s')):>9}", file=out)
+
+
+def slowest_requests(events, top: int, out=sys.stdout):
+    reqs = [e for e in events if e.get("event") == "serving/request"
+            and e.get("dur_s") is not None]
+    reqs.sort(key=lambda e: float(e["dur_s"]), reverse=True)
+    by_trace: "Dict[str, List[Dict[str, Any]]]" = {}
+    for e in events:
+        tid = e.get("trace_id")
+        if tid and e.get("event") != "serving/request":
+            by_trace.setdefault(tid, []).append(e)
+    print(f"\n== slowest serving requests (top {top} of"
+          f" {len(reqs)}) ==", file=out)
+    for e in reqs[:top]:
+        tid = e.get("trace_id")
+        print(f"  {_fmt_ms(e['dur_s'])} ms  status={e.get('status')}"
+              f"  trace={tid}", file=out)
+        for c in sorted(by_trace.get(tid, []),
+                        key=lambda c: c.get("t_start", c.get("ts", 0))):
+            extra = "".join(
+                f" {k}={c[k]}" for k in ("rows", "bucket", "fill")
+                if c.get(k) is not None)
+            print(f"      {_fmt_ms(c.get('dur_s'))} ms "
+                  f" {c.get('event')}{extra}", file=out)
+
+
+def anomaly_digest(events, out=sys.stdout):
+    anomalies = [e for e in events
+                 if e.get("event") == "diagnostics/anomaly"]
+    print(f"\n== anomalies ({len(anomalies)}) ==", file=out)
+    kinds: "Dict[str, int]" = {}
+    for e in anomalies:
+        kinds[e.get("kind", "?")] = kinds.get(e.get("kind", "?"), 0) + 1
+    for kind, n in sorted(kinds.items()):
+        print(f"  {kind}: {n}", file=out)
+
+
+def export_chrome(events, path: str):
+    """Write the traced subset of the event log as chrome-trace JSON
+    (the same schema :func:`tracing.to_chrome_trace` emits live)."""
+    doc = {"traceEvents": tracing.chrome_events(events),
+           "displayTimeUnit": "ms"}
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh)
+    print(f"\nchrome trace -> {path} "
+          f"({len(doc['traceEvents'])} events); open in "
+          "https://ui.perfetto.dev")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--events",
+                    default=os.environ.get("ZOO_TPU_EVENT_LOG"),
+                    help="event-log JSONL path (default: "
+                         "$ZOO_TPU_EVENT_LOG)")
+    ap.add_argument("--top", type=int, default=10,
+                    help="how many slow requests to show")
+    ap.add_argument("--chrome", metavar="OUT",
+                    help="also export chrome-trace JSON to OUT")
+    args = ap.parse_args(argv)
+    if not args.events:
+        ap.error("--events required (or set ZOO_TPU_EVENT_LOG)")
+    if not os.path.exists(args.events):
+        print(f"no event log at {args.events}", file=sys.stderr)
+        return 1
+    events = load_events(args.events)
+    print(f"{len(events)} events from {args.events}")
+    step_timeline(events)
+    slowest_requests(events, args.top)
+    anomaly_digest(events)
+    if args.chrome:
+        export_chrome(events, args.chrome)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,6 +33,19 @@ def _fresh_context():
     nncontext.reset_nncontext()
 
 
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    """Reset the global metrics registry and trace-span buffer around
+    every test, so counters/spans leaked by one test can never satisfy
+    (or break) another's assertions."""
+    from analytics_zoo_tpu.common import observability, tracing
+    observability.reset_metrics()
+    tracing.reset_tracing()
+    yield
+    observability.reset_metrics()
+    tracing.reset_tracing()
+
+
 @pytest.fixture
 def rng():
     return np.random.RandomState(42)

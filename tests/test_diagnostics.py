@@ -1,0 +1,136 @@
+"""Diagnostics layer (common/diagnostics.py): recompile-storm and
+step-regression detectors fire deterministically (fake clocks / fed
+durations), anomalies land in metrics + events. Tier-1 fast."""
+
+import json
+
+from analytics_zoo_tpu.common import diagnostics, observability as obs
+
+
+def _anomaly_count(kind):
+    s = obs.snapshot()
+    fam = s.get("zoo_tpu_anomalies_total", {"values": []})
+    for v in fam["values"]:
+        if v["labels"].get("kind") == kind:
+            return v["value"]
+    return 0
+
+
+def test_anomaly_counter_and_event(tmp_path, monkeypatch):
+    path = tmp_path / "events.jsonl"
+    monkeypatch.setenv("ZOO_TPU_EVENT_LOG", str(path))
+    diagnostics.anomaly("unit_test", detail=42)
+    obs.reset_metrics()  # close the sink handle
+    rec = json.loads(path.read_text().strip())
+    assert rec["event"] == "diagnostics/anomaly"
+    assert rec["kind"] == "unit_test" and rec["detail"] == 42
+
+
+def test_recompile_monitor_fires_deterministically():
+    mon = diagnostics.RecompileMonitor(threshold=3, window_s=60.0)
+    # 3 compiles inside the window: at the threshold, not over it
+    assert [mon.note(now=t) for t in (0.0, 1.0, 2.0)] == \
+        [False, False, False]
+    assert mon.note(now=3.0) is True      # 4th tips it over
+    assert mon.storms == 1
+    assert _anomaly_count("recompile_storm") == 1
+    # muted for one full window: no anomaly storm from the storm
+    assert mon.note(now=4.0) is False
+    # window slides past the mute -> a sustained storm re-fires
+    assert mon.note(now=70.0) is False    # old entries evicted
+    for t in (70.1, 70.2):
+        mon.note(now=t)
+    assert mon.note(now=70.3) is True
+    assert mon.storms == 2
+    s = obs.snapshot()
+    assert s["zoo_tpu_xla_compiles_total"]["values"][0]["value"] == 9
+
+
+def test_recompile_listener_filters_event_names():
+    mon = diagnostics.RecompileMonitor(threshold=100, window_s=60.0)
+    mon._listener("/jax/core/backend_compile_duration", 0.1)
+    mon._listener("/jax/unrelated_duration", 0.1)
+    s = obs.snapshot()
+    assert s["zoo_tpu_xla_compiles_total"]["values"][0]["value"] == 1
+
+
+def test_install_recompile_monitor_is_singleton():
+    a = diagnostics.install_recompile_monitor()
+    b = diagnostics.install_recompile_monitor()
+    assert a is b
+    assert diagnostics.get_recompile_monitor() is a
+
+
+def test_step_time_watcher_fires_on_straggler():
+    w = diagnostics.StepTimeWatcher(window=16, min_samples=4,
+                                    factor=3.0, cooldown=2)
+    for _ in range(8):
+        assert w.observe(0.1) is False
+    assert w.observe(0.31) is True        # > 3 x median(0.1)
+    assert w.fired == 1
+    assert _anomaly_count("step_time_regression") == 1
+    # cooldown mutes the next 2 observations even if slow
+    assert w.observe(1.0) is False
+    assert w.observe(1.0) is False
+    # median has absorbed the slow samples; a modest step is fine
+    assert w.observe(0.1) is False
+
+
+def test_step_time_watcher_excuses_warmup():
+    w = diagnostics.StepTimeWatcher(window=16, min_samples=4,
+                                    factor=3.0)
+    # the first min_samples steps never fire (compile-heavy warmup)
+    assert w.observe(10.0) is False
+    assert w.observe(0.1) is False
+    assert w.observe(0.1) is False
+    assert w.observe(0.1) is False
+    assert w.fired == 0
+
+
+def test_step_time_watcher_env_factor(monkeypatch):
+    monkeypatch.setenv("ZOO_TPU_STEP_ANOMALY_FACTOR", "10")
+    w = diagnostics.StepTimeWatcher(window=8, min_samples=2)
+    assert w.factor == 10.0
+    for _ in range(4):
+        w.observe(0.1)
+    assert w.observe(0.5) is False        # 5x < 10x: no fire
+    assert w.fired == 0
+
+
+def test_device_memory_gauges_safe_on_cpu():
+    # CPU backends expose no memory_stats(); must be a clean no-op
+    n = diagnostics.update_device_memory_gauges()
+    assert n >= 0
+    if n:
+        s = obs.snapshot()
+        fam = s["zoo_tpu_device_memory_bytes"]
+        kinds = {v["labels"]["kind"] for v in fam["values"]}
+        assert kinds <= {"in_use", "peak", "limit"}
+
+
+def test_env_threshold_defaults(monkeypatch):
+    monkeypatch.setenv("ZOO_TPU_RECOMPILE_THRESHOLD", "2")
+    monkeypatch.setenv("ZOO_TPU_RECOMPILE_WINDOW_S", "5")
+    mon = diagnostics.RecompileMonitor()
+    assert mon.threshold == 2 and mon.window_s == 5.0
+    monkeypatch.setenv("ZOO_TPU_RECOMPILE_THRESHOLD", "garbage")
+    assert diagnostics.RecompileMonitor().threshold == 5
+
+
+def test_recompile_monitor_thread_safety():
+    import threading
+    mon = diagnostics.RecompileMonitor(threshold=10 ** 6,
+                                       window_s=1e9)
+    def work():
+        for _ in range(500):
+            mon.note(now=1.0)
+
+    threads = [threading.Thread(target=work) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    s = obs.snapshot()
+    assert s["zoo_tpu_xla_compiles_total"][
+        "values"][0]["value"] == 2000
+    assert mon.storms == 0

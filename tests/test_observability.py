@@ -105,6 +105,53 @@ def test_prometheus_label_escaping_and_name_sanitizing():
     assert '{v="a\\"b\\\\c\\nd"}' in text
 
 
+@pytest.mark.parametrize("raw,escaped", [
+    ('quo"te', 'quo\\"te'),
+    ("back\\slash", "back\\\\slash"),
+    ("new\nline", "new\\nline"),
+    ('all\\"\n', 'all\\\\\\"\\n'),
+])
+def test_prometheus_label_escaping_each_char(raw, escaped):
+    reg = MetricsRegistry()
+    reg.counter("esc_total", labels={"v": raw}).inc()
+    line = [ln for ln in reg.to_prometheus().splitlines()
+            if ln.startswith("esc_total{")][0]
+    assert line == 'esc_total{v="%s"} 1' % escaped
+    assert "\n" not in line  # a raw newline would split the line
+
+
+def test_render_while_writing_from_threads():
+    """to_prometheus() stays consistent while counters and histogram
+    buckets are being hammered from other threads."""
+    reg = MetricsRegistry()
+    c = reg.counter("rw_total")
+    h = reg.histogram("rw_seconds", buckets=(0.5,))
+    stop = threading.Event()
+
+    def work():
+        while not stop.is_set():
+            c.inc()
+            h.observe(0.1)
+
+    threads = [threading.Thread(target=work) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(50):
+            text = reg.to_prometheus()
+            # bucket counts render monotone: le="0.5" <= le="+Inf"
+            lines = {ln.rsplit(" ", 1)[0]: float(ln.rsplit(" ", 1)[1])
+                     for ln in text.splitlines()
+                     if ln.startswith("rw_")}
+            lo = lines.get('rw_seconds_bucket{le="0.5"}', 0)
+            hi = lines.get('rw_seconds_bucket{le="+Inf"}', 0)
+            assert lo <= hi
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+
+
 def test_snapshot_shape():
     counter("zoo_tpu_snap_total", help="h").inc(2)
     s = snapshot()
@@ -144,6 +191,43 @@ def test_event_log_jsonl_roundtrip(tmp_path, monkeypatch):
     assert lines[0]["stage"] == "rdd" and lines[0]["n"] == 3
     assert lines[1]["step"] == 7 and lines[1]["dur_s"] >= 0
     assert all("ts" in ln for ln in lines)
+
+
+def test_event_log_size_rotation(tmp_path, monkeypatch):
+    """ZOO_TPU_EVENT_LOG_MAX_MB rotates path -> path.1 -> path.2,
+    keeping ZOO_TPU_EVENT_LOG_KEEP rotated files."""
+    path = tmp_path / "events.jsonl"
+    monkeypatch.setenv("ZOO_TPU_EVENT_LOG", str(path))
+    # ~200-byte threshold: a handful of events per generation
+    monkeypatch.setenv("ZOO_TPU_EVENT_LOG_MAX_MB", "0.0002")
+    monkeypatch.setenv("ZOO_TPU_EVENT_LOG_KEEP", "2")
+    from analytics_zoo_tpu.common.observability import event
+    for i in range(60):
+        event("rotate/test", i=i, pad="x" * 40)
+    reset_metrics()
+    assert path.exists()
+    assert (tmp_path / "events.jsonl.1").exists()
+    assert (tmp_path / "events.jsonl.2").exists()
+    assert not (tmp_path / "events.jsonl.3").exists()  # keep=2
+    # every surviving file holds whole, parseable JSONL lines
+    for p in (path, tmp_path / "events.jsonl.1",
+              tmp_path / "events.jsonl.2"):
+        for ln in p.read_text().strip().splitlines():
+            assert json.loads(ln)["event"] == "rotate/test"
+    # rotated generations stay under threshold + one event
+    assert (tmp_path / "events.jsonl.1").stat().st_size < 400
+
+
+def test_event_log_no_rotation_without_flag(tmp_path, monkeypatch):
+    path = tmp_path / "events.jsonl"
+    monkeypatch.setenv("ZOO_TPU_EVENT_LOG", str(path))
+    monkeypatch.delenv("ZOO_TPU_EVENT_LOG_MAX_MB", raising=False)
+    from analytics_zoo_tpu.common.observability import event
+    for i in range(50):
+        event("no/rotate", i=i, pad="x" * 40)
+    reset_metrics()
+    assert not (tmp_path / "events.jsonl.1").exists()
+    assert len(path.read_text().strip().splitlines()) == 50
 
 
 def test_event_log_noop_without_env(monkeypatch):

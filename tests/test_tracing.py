@@ -1,0 +1,347 @@
+"""Tracing layer (common/tracing.py): context propagation, the span
+ring buffer, chrome-trace export, and the end-to-end serving/training
+wiring (one trace id front-end -> batcher -> model). Tier-1 fast."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common import observability as obs
+from analytics_zoo_tpu.common import tracing
+
+
+# -- core ------------------------------------------------------------------
+
+def test_trace_mints_and_adopts_ids():
+    with tracing.trace("unit/root") as tr:
+        assert tr.trace_id and tr.span_id
+    with tracing.trace("unit/root", trace_id="req-42") as tr:
+        assert tr.trace_id == "req-42"
+    # header values are sanitized, not trusted
+    assert tracing.sanitize_trace_id("ok-1_2.3") == "ok-1_2.3"
+    assert tracing.sanitize_trace_id("bad id\nx") is None
+    assert tracing.sanitize_trace_id("a" * 65) is None
+    assert tracing.sanitize_trace_id(None) is None
+
+
+def test_obs_span_joins_ambient_trace():
+    with tracing.trace("unit/root") as tr:
+        with obs.span("unit/child", step=3):
+            pass
+    recs = tracing.get_store().spans(tr.trace_id)
+    by_name = {r.name: r for r in recs}
+    assert set(by_name) == {"unit/root", "unit/child"}
+    root, child = by_name["unit/root"], by_name["unit/child"]
+    assert root.parent_id is None
+    assert child.parent_id == root.span_id
+    assert child.fields["step"] == 3
+
+
+def test_nested_spans_chain_parents():
+    with tracing.trace("unit/root") as tr:
+        with obs.span("unit/outer"):
+            with obs.span("unit/inner"):
+                pass
+    by_name = {r.name: r for r in
+               tracing.get_store().spans(tr.trace_id)}
+    assert by_name["unit/inner"].parent_id == \
+        by_name["unit/outer"].span_id
+    assert by_name["unit/outer"].parent_id == \
+        by_name["unit/root"].span_id
+
+
+def test_span_without_trace_records_nothing():
+    with obs.span("unit/orphan"):
+        pass
+    assert len(tracing.get_store()) == 0
+
+
+def test_cross_thread_propagation():
+    """current() + activate()/record_span() carry a trace into worker
+    threads (contextvars do not cross threads by themselves)."""
+    got = {}
+
+    def worker(ctx):
+        with tracing.activate(ctx):
+            with obs.span("unit/worker_span"):
+                pass
+        tracing.record_span(ctx, "unit/explicit",
+                            time.time(), 0.001, rows=4)
+        got["done"] = True
+
+    with tracing.trace("unit/root") as tr:
+        t = threading.Thread(target=worker,
+                             args=(tracing.current(),))
+        t.start()
+        t.join()
+    assert got["done"]
+    recs = tracing.get_store().spans(tr.trace_id)
+    names = {r.name for r in recs}
+    assert {"unit/root", "unit/worker_span", "unit/explicit"} <= names
+    root = next(r for r in recs if r.name == "unit/root")
+    for r in recs:
+        if r.name != "unit/root":
+            assert r.parent_id == root.span_id
+    explicit = next(r for r in recs if r.name == "unit/explicit")
+    assert explicit.fields["rows"] == 4
+
+
+def test_store_ring_buffer_bound():
+    store = tracing.TraceStore(capacity=8)
+    for i in range(50):
+        store.add(tracing.SpanRecord(
+            f"t{i}", f"s{i}", None, "unit/x", time.time(), 0.0,
+            "main", {}))
+    assert len(store) == 8
+    assert store.records()[0].trace_id == "t42"  # oldest evicted
+
+
+def test_recent_groups_by_trace():
+    with tracing.trace("unit/a") as ta:
+        with obs.span("unit/a_child"):
+            pass
+    with tracing.trace("unit/b") as tb:
+        pass
+    recent = tracing.get_store().recent(10)
+    assert [t["trace_id"] for t in recent[:2]] == \
+        [tb.trace_id, ta.trace_id]  # newest first
+    a = recent[1]
+    assert a["n_spans"] == 2
+    assert {s["name"] for s in a["spans"]} == \
+        {"unit/a", "unit/a_child"}
+    json.dumps(recent)  # payload must be JSON-able
+
+
+# -- disabled: guarded no-op -----------------------------------------------
+
+def test_disabled_is_noop(monkeypatch):
+    monkeypatch.setenv("ZOO_TPU_TRACE", "0")
+    assert not tracing.enabled()
+    with tracing.trace("unit/root", trace_id="x") as tr:
+        assert tr.trace_id is None
+        # the hot-path guard: span_start bails before any allocation
+        assert tracing.span_start("unit/child") is None
+        with obs.span("unit/child"):  # still times the histogram
+            pass
+        tracing.record_span(("t", "s"), "unit/x", time.time(), 0.0)
+    assert len(tracing.get_store()) == 0
+    assert tracing.current() is None
+
+
+def test_disabled_span_keeps_metrics(monkeypatch):
+    monkeypatch.setenv("ZOO_TPU_TRACE", "0")
+    with obs.span("unit/timed"):
+        pass
+    s = obs.snapshot()
+    assert s["zoo_tpu_unit_timed_seconds"]["values"][0]["count"] == 1
+
+
+# -- chrome-trace export ---------------------------------------------------
+
+def test_chrome_trace_structure():
+    with tracing.trace("unit/root") as tr:
+        with obs.span("unit/child", rows=2):
+            pass
+    doc = tracing.to_chrome_trace([tr.trace_id])
+    assert doc["displayTimeUnit"] == "ms"
+    evs = doc["traceEvents"]
+    meta = [e for e in evs if e["ph"] == "M"]
+    spans = [e for e in evs if e["ph"] == "X"]
+    assert {m["name"] for m in meta} >= {"process_name",
+                                         "thread_name"}
+    assert {s["name"] for s in spans} == {"unit/root", "unit/child"}
+    child = next(s for s in spans if s["name"] == "unit/child")
+    root = next(s for s in spans if s["name"] == "unit/root")
+    assert child["pid"] == root["pid"]  # same trace -> same process
+    assert child["args"]["parent_id"] == root["args"]["span_id"]
+    assert child["args"]["rows"] == 2
+    for s in spans:  # ts/dur are microseconds
+        assert s["ts"] > 1e15 and s["dur"] >= 0
+    json.dumps(doc)
+
+
+def test_chrome_events_from_event_log_dicts():
+    """The exporter accepts parsed event-log lines, which stamp exit
+    time (`ts`) rather than `t_start`."""
+    evs = tracing.chrome_events([
+        {"event": "serving/request", "trace_id": "t1",
+         "span_id": "s1", "parent_id": None, "ts": 100.0,
+         "dur_s": 0.25, "status": 200},
+        {"event": "untraced/event", "ts": 100.0},  # skipped
+    ])
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert len(xs) == 1
+    assert xs[0]["name"] == "serving/request"
+    assert xs[0]["ts"] == pytest.approx((100.0 - 0.25) * 1e6)
+
+
+# -- serving end-to-end ----------------------------------------------------
+
+def _toy_model():
+    from analytics_zoo_tpu.pipeline.api.keras import (
+        Sequential, layers as L)
+    m = Sequential()
+    m.add(L.Dense(4, input_shape=(3,)))
+    m.add(L.Dense(1))
+    m.compile(optimizer="sgd", loss="mse")
+    return m
+
+
+def _server(cls_name="InferenceServer"):
+    from analytics_zoo_tpu.pipeline.inference import InferenceModel
+    from analytics_zoo_tpu.pipeline.inference import serving
+    im = InferenceModel(supported_concurrent_num=2)
+    im.load_keras_net(_toy_model())
+    return getattr(serving, cls_name)(im, port=0)
+
+
+def _post_predict(port, x, trace_id=None):
+    headers = {"Content-Type": "application/json"}
+    if trace_id:
+        headers[tracing.TRACE_HEADER] = trace_id
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/predict",
+        data=json.dumps({"inputs": x.tolist()}).encode(),
+        headers=headers)
+    return urllib.request.urlopen(req)
+
+
+def test_serving_single_trace_id_end_to_end(rng):
+    """Acceptance: one traced request shows a single trace id
+    spanning front-end -> batcher queue/pad/execute -> model."""
+    srv = _server().start()
+    try:
+        # 3 rows never fill a power-of-two bucket -> the pad span runs
+        x = rng.randn(3, 3).astype(np.float32)
+        resp = _post_predict(srv.port, x, trace_id="req-abc")
+        assert json.loads(resp.read())["outputs"]
+        assert resp.headers[tracing.TRACE_HEADER] == "req-abc"
+        dbg = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/debug/traces?n=50"
+        ).read())
+    finally:
+        srv.stop()
+    assert dbg["enabled"] is True
+    ours = [t for t in dbg["traces"] if t["trace_id"] == "req-abc"]
+    assert len(ours) == 1, dbg["traces"]
+    spans = ours[0]["spans"]
+    assert all(s["trace_id"] == "req-abc" for s in spans)
+    names = {s["name"] for s in spans}
+    assert {"serving/request", "serving/queue_wait",
+            "serving/pad", "serving/predict",
+            "serving/scatter"} <= names
+    root = next(s for s in spans if s["name"] == "serving/request")
+    assert root["parent_id"] is None
+    assert root["fields"]["status"] == 200
+    # child spans hang off the request root (directly or nested)
+    ids = {s["span_id"] for s in spans}
+    for s in spans:
+        if s["parent_id"] is not None:
+            assert s["parent_id"] in ids
+
+
+def test_serving_minted_trace_id_when_header_absent(rng):
+    srv = _server().start()
+    try:
+        x = rng.randn(2, 3).astype(np.float32)
+        resp = _post_predict(srv.port, x)
+        minted = resp.headers[tracing.TRACE_HEADER]
+        assert minted  # server minted one and echoed it
+    finally:
+        srv.stop()
+    assert any(r.trace_id == minted for r in
+               tracing.get_store().records())
+
+
+def test_serving_trace_disabled(rng, monkeypatch):
+    monkeypatch.setenv("ZOO_TPU_TRACE", "0")
+    srv = _server().start()
+    try:
+        x = rng.randn(2, 3).astype(np.float32)
+        resp = _post_predict(srv.port, x, trace_id="ignored")
+        assert resp.headers.get(tracing.TRACE_HEADER) is None
+        dbg = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/debug/traces").read())
+    finally:
+        srv.stop()
+    assert dbg == {"enabled": False, "traces": []}
+
+
+def test_native_serving_trace_header(rng):
+    """The C++ front-end parses X-Zoo-Trace-Id, hands it to Python
+    alongside the path, and echoes it on the response."""
+    try:
+        srv = _server("NativeInferenceServer")
+    except (RuntimeError, OSError):
+        pytest.skip("native toolchain unavailable")
+    srv.start()
+    try:
+        x = rng.randn(2, 3).astype(np.float32)
+        resp = _post_predict(srv.port, x, trace_id="native-1")
+        assert json.loads(resp.read())["outputs"]
+        assert resp.headers[tracing.TRACE_HEADER] == "native-1"
+        dbg = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/debug/traces?n=50"
+        ).read())
+    finally:
+        srv.stop()
+    ours = [t for t in dbg["traces"] if t["trace_id"] == "native-1"]
+    assert len(ours) == 1
+    assert {"serving/request", "serving/predict"} <= \
+        {s["name"] for s in ours[0]["spans"]}
+
+
+def test_debug_profile_capture(tmp_path, monkeypatch):
+    from analytics_zoo_tpu.pipeline.inference import serving
+    calls = []
+
+    def fake_capture(out_dir, ms):
+        calls.append((out_dir, ms))
+
+    monkeypatch.setattr(serving, "_profiler_capture", fake_capture)
+    status, body = serving.handle_profile(
+        json.dumps({"dir": str(tmp_path), "ms": 5}).encode())
+    assert status == 200 and body["status"] == "capturing"
+    serving._profile_thread.join(timeout=10)
+    assert calls == [(str(tmp_path), 5.0)]
+    # bad requests are structured 400s
+    assert serving.handle_profile(b"{nope")[0] == 400
+    assert serving.handle_profile(b"{}")[0] == 400
+    assert serving.handle_profile(
+        json.dumps({"dir": "x", "ms": "NaN?"}).encode())[0] == 400
+
+
+# -- estimator integration -------------------------------------------------
+
+def test_estimator_step_traces(rng):
+    m = _toy_model()
+    x = rng.randn(16, 3).astype(np.float32)
+    y = rng.randn(16, 1).astype(np.float32)
+    m.fit(x, y, batch_size=8, nb_epoch=1)  # 2 steps
+    steps = [r for r in tracing.get_store().records()
+             if r.name == "train/step"]
+    assert len(steps) == 2
+    for r in steps:
+        assert r.parent_id is None
+        assert r.fields["data_wait_s"] >= 0
+        assert r.fields["dispatch_s"] >= 0
+    assert [r.fields["step"] for r in steps] == [1, 2]
+
+
+def test_evaluate_traced(rng):
+    m = _toy_model()
+    x = rng.randn(16, 3).astype(np.float32)
+    y = rng.randn(16, 1).astype(np.float32)
+    m.fit(x, y, batch_size=8, nb_epoch=1)
+    m.evaluate(x, y, batch_size=8)
+    recs = tracing.get_store().records()
+    runs = [r for r in recs if r.name == "train/eval_run"]
+    assert len(runs) == 1
+    evals = [r for r in recs if r.name == "train/eval"
+             and r.trace_id == runs[0].trace_id]
+    assert len(evals) == 1
+    assert evals[0].parent_id == runs[0].span_id
