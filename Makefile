@@ -7,15 +7,18 @@ IMAGE ?= analytics-zoo-tpu
 
 .PHONY: test docker-build docker-test docker-test-spark dist docs \
     lint obs-smoke fused-conformance flops-audit serving-smoke \
-    bench-serving trace-smoke trace-report
+    bench-serving trace-smoke trace-report slo-smoke perf-sentinel
 
-# unit tests plus the two end-to-end telemetry smokes (metrics
-# exposition + tracing), so `make test` proves the observability
-# stack, not just the library
+# unit tests plus the end-to-end telemetry smokes (metrics
+# exposition, tracing, SLO control loop), so `make test` proves the
+# observability stack, not just the library; the perf sentinel runs
+# advisory here so every test run prints the bench trajectory
 test:
 	python -m pytest tests/ -x -q
 	$(MAKE) obs-smoke
 	$(MAKE) trace-smoke
+	$(MAKE) slo-smoke
+	python scripts/perf_sentinel.py --advisory
 
 # conv+BN (+ residual-epilogue) conformance: the exact Pallas kernel
 # code paths the fused ResNet runs on chip, exercised under the
@@ -34,6 +37,18 @@ obs-smoke:
 # echo, /debug/traces, chrome-trace export) — docs/observability.md
 trace-smoke:
 	JAX_PLATFORMS=cpu python scripts/trace_smoke.py
+
+# SLO control loop end-to-end: shipped serving objectives on
+# /debug/slo, a driven error burst trips the error-rate breach and
+# the breach/anomaly counters increment (docs/slo.md)
+slo-smoke:
+	JAX_PLATFORMS=cpu python scripts/slo_smoke.py
+
+# perf-regression sentinel over BENCH_r*.json / BENCH_serving.json:
+# trajectory table + exit 1 when the newest round regressed >10%
+# vs the best comparable (same-lineage) prior value (docs/slo.md)
+perf-sentinel:
+	python scripts/perf_sentinel.py
 
 # offline report over a ZOO_TPU_EVENT_LOG JSONL: per-step timeline,
 # top-N slowest requests, anomaly digest, optional Perfetto export

@@ -6,8 +6,8 @@ from analytics_zoo_tpu.common.nncontext import (
 )
 from analytics_zoo_tpu.common.config import ZooBuildInfo
 from analytics_zoo_tpu.common import (
-    diagnostics, dictionary, observability, safe_pickle, tracing,
-    utils)
+    diagnostics, dictionary, observability, safe_pickle, slo,
+    tracing, utils)
 from analytics_zoo_tpu.common.dictionary import ZooDictionary
 from analytics_zoo_tpu.common.observability import (
     MetricsRegistry,
@@ -45,6 +45,7 @@ __all__ = [
     "dictionary",
     "observability",
     "safe_pickle",
+    "slo",
     "tracing",
     "utils",
 ]

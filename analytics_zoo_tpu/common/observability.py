@@ -59,6 +59,7 @@ __all__ = [
     "to_prometheus",
     "get_registry",
     "reset_metrics",
+    "bucket_quantile",
     "DEFAULT_BUCKETS",
     "SIZE_BUCKETS",
 ]
@@ -107,6 +108,39 @@ def _label_str(key: "Tuple[Tuple[str, str], ...]") -> str:
         return ""
     inner = ",".join(f'{k}="{_escape_label(v)}"' for k, v in key)
     return "{" + inner + "}"
+
+
+def bucket_quantile(buckets: "Sequence[float]",
+                    counts: "Sequence[float]", q: float) -> float:
+    """Prometheus-style quantile estimate from per-bucket counts.
+
+    ``buckets`` are the finite upper bounds (sorted ascending);
+    ``counts`` are *per-bucket* (not cumulative) observation counts,
+    with one extra trailing entry for the ``+Inf`` overflow bucket
+    (``len(counts) == len(buckets) + 1``). Linear interpolation
+    inside the winning bucket, a lower edge of 0 for the first
+    bucket, and — like Prometheus ``histogram_quantile`` — the
+    highest finite bound when the rank lands in the overflow bucket.
+    Returns NaN when there are no observations.
+    """
+    if len(counts) != len(buckets) + 1:
+        raise ValueError("counts must be per-bucket plus overflow")
+    total = float(sum(counts))
+    if total <= 0:
+        return float("nan")
+    q = min(max(float(q), 0.0), 1.0)
+    rank = q * total
+    acc = 0.0
+    for i, hi in enumerate(buckets):
+        prev = acc
+        acc += counts[i]
+        if acc >= rank:
+            if counts[i] <= 0:
+                return float(hi)
+            lo = float(buckets[i - 1]) if i > 0 else 0.0
+            frac = (rank - prev) / counts[i]
+            return lo + (float(hi) - lo) * min(max(frac, 0.0), 1.0)
+    return float(buckets[-1])  # rank fell in the +Inf bucket
 
 
 class Counter:
@@ -194,6 +228,14 @@ class Histogram:
             out.append((_fmt(b), acc))
         out.append(("+Inf", acc + counts[-1]))
         return out
+
+    def quantile(self, q: float) -> float:
+        """Estimate the q-quantile (0 ≤ q ≤ 1) from the bucket
+        counts via :func:`bucket_quantile`. Accuracy is bounded by
+        the bucket width around the true quantile; NaN when empty."""
+        with self._lock:
+            counts = list(self._counts)
+        return bucket_quantile(self.buckets, counts, q)
 
 
 class _Family:

@@ -1,2 +1,7 @@
 """Performance accounting helpers (FLOPs audit, executed-vs-model
-ratios) shared by bench.py, scripts/flops_audit.py and tests."""
+ratios, the live goodput/MFU ledger) shared by bench.py,
+scripts/flops_audit.py, the Estimator train loop and tests."""
+
+from analytics_zoo_tpu.perf import flops, goodput
+
+__all__ = ["flops", "goodput"]

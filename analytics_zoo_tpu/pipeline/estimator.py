@@ -32,7 +32,9 @@ import optax
 
 from analytics_zoo_tpu.common import diagnostics
 from analytics_zoo_tpu.common import observability as obs
+from analytics_zoo_tpu.common import slo as slo_lib
 from analytics_zoo_tpu.common import tracing
+from analytics_zoo_tpu.perf import goodput as goodput_lib
 from analytics_zoo_tpu.common.nncontext import NNContext, get_nncontext, \
     logger
 from analytics_zoo_tpu.ops import losses as losses_lib
@@ -759,6 +761,22 @@ class Estimator:
         elif self._train_step is None:
             self._train_step = self._build_train_step(self._tx())
 
+    def _measure_step_flops(self, rng, xb, yb):
+        """Executed-semantics FLOPs of one compiled train step
+        (:mod:`analytics_zoo_tpu.perf.flops` — dilation zeros counted
+        the way the MXU executes them), via a one-off AOT retrace.
+        None when the graph cannot be lowered or parsed; the goodput
+        ledger then reports MFU as 0 but keeps the wall-time
+        decomposition live."""
+        try:
+            from analytics_zoo_tpu.perf import flops as flops_lib
+            lowered = self._train_step.lower(
+                self.params, self.opt_state, rng, xb, yb)
+            return flops_lib.executed_flops(
+                flops_lib.hlo_text(lowered))
+        except Exception:
+            return None
+
     # -- API ---------------------------------------------------------------
     def train(self, data, y=None, batch_size: int = 32,
               nb_epoch: int = 1,
@@ -794,6 +812,11 @@ class Estimator:
         # straggler steps + recompile storms fire structured events
         watcher = diagnostics.StepTimeWatcher()
         diagnostics.install_recompile_monitor()
+        # judgement layer: shipped training objectives (docs/slo.md)
+        # + the live goodput/MFU ledger (docs/observability.md) —
+        # each env-gated (ZOO_TPU_SLO / ZOO_TPU_GOODPUT)
+        slo_lib.ensure_default_slos("training")
+        ledger = goodput_lib.ledger_for_backend()
         # ZOO_TPU_TRACE_SYNC=1 adds a block_until_ready per step so
         # step traces carry true device time — a per-step sync, so
         # opt-in (it caps dispatch pipelining)
@@ -827,6 +850,7 @@ class Estimator:
                 with ep_span:
                     try:
                         t_prev = time.perf_counter()
+                        t_led_prev = t_prev
                         for wait_s, (xb, yb) in _timed_iter(batches):
                             with tracing.trace(
                                       "train/step", step=self.step + 1,
@@ -868,6 +892,11 @@ class Estimator:
                                              "latest run (incl. compile)"
                                     ).set(time.perf_counter() - t_prev)
                                     first_step = False
+                                    if ledger is not None and \
+                                            goodput_lib.flops_enabled():
+                                        ledger.set_flops_per_step(
+                                            self._measure_step_flops(
+                                                rng, xb, yb))
                                 if self._profiling and self.step >= p_end:
                                     jax.block_until_ready(loss)
                                     jax.profiler.stop_trace()
@@ -908,6 +937,17 @@ class Estimator:
                                     dispatch_s=round(dispatch_s, 6),
                                     device_s=device_s,
                                     checkpoint_s=ckpt_s)
+                                if ledger is not None:
+                                    # ledger wall is iteration-to-
+                                    # iteration (incl. checkpoint) so
+                                    # the decomposition sums to 1
+                                    t_led = time.perf_counter()
+                                    ledger.note_step(
+                                        t_led - t_led_prev,
+                                        data_wait_s=wait_s,
+                                        dispatch_s=dispatch_s,
+                                        checkpoint_s=ckpt_s or 0.0)
+                                    t_led_prev = t_led
                                 if end_trigger is not None and end_trigger(
                                         epoch - 1, self.step, False):
                                     stop = True
@@ -941,6 +981,10 @@ class Estimator:
                 entry = {"epoch": epoch,
                          "loss": epoch_loss / max(epoch_batches, 1),
                          "throughput": throughput, "step": self.step}
+                if ledger is not None:
+                    gp = ledger.epoch_summary(epoch=epoch)
+                    if gp is not None:
+                        entry["goodput"] = gp
                 if tb is not None:
                     tb.add_scalar("Throughput", throughput, self.step)
                 if validation_data is not None and validation_trigger(

@@ -215,6 +215,12 @@ class DynamicBatcher:
         if self._thread is not None and self._thread.is_alive():
             return self
         diagnostics.install_recompile_monitor()
+        # re-touch the gauges at start: the serving_queue_depth SLO
+        # (docs/slo.md) must see the family before the first request,
+        # even if the registry was reset since construction
+        with self._cond:
+            self._depth_gauge().set(len(self._q))
+        self._warmed_gauge().set(self.warmed_buckets)
         self.warm()
         self._stop = False
         self._thread = threading.Thread(

@@ -10,6 +10,10 @@ POST /predict  {"inputs": [[...], ...]}  →  {"outputs": [[...], ...]}
 GET  /health   →  {"status": "ok", "free_slots": N, "batcher": {...}}
 GET  /metrics  →  Prometheus text exposition (docs/observability.md)
 GET  /debug/traces[?n=20]  →  recent traces as JSON (docs/observability.md)
+GET  /debug/slo[?tick=0]  →  live SLO status (docs/slo.md): shipped
+     serving objectives (p99 latency, error burn rate, queue depth)
+     are installed at server start; the engine re-evaluates on each
+     request unless ``tick=0``
 POST /debug/profile {"dir": ..., "ms": 500}  →  on-demand jax.profiler
      capture written to ``dir`` (one at a time; 503 while busy)
 
@@ -45,6 +49,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 from analytics_zoo_tpu.common import observability as obs
+from analytics_zoo_tpu.common import slo as slo_lib
 from analytics_zoo_tpu.common import tracing
 from analytics_zoo_tpu.pipeline.inference.batching import (
     DeadlineExpiredError, DynamicBatcher, QueueFullError)
@@ -181,6 +186,19 @@ def _traces_payload(path: str) -> dict:
                 max(1, min(n, 200)))}
 
 
+def _slo_payload(path: str) -> dict:
+    """``GET /debug/slo[?tick=0]``: live objective status from the
+    process-global SLO engine (docs/slo.md). Ticks the engine first
+    by default so the report reflects this instant, not the last
+    background tick; ``tick=0`` reads passively."""
+    from urllib.parse import parse_qs, urlsplit
+    q = parse_qs(urlsplit(path).query)
+    engine = slo_lib.get_engine()
+    if q.get("tick", ["1"])[0] != "0":
+        return engine.tick()
+    return engine.status()
+
+
 # On-demand jax.profiler capture: one at a time per process (the XLA
 # profiler is a process-global singleton).
 _profile_lock = threading.Lock()
@@ -310,6 +328,9 @@ class InferenceServer:
                     elif route == "/debug/traces":
                         status = 200
                         payload = _traces_payload(self.path)
+                    elif route == "/debug/slo":
+                        status = 200
+                        payload = _slo_payload(self.path)
                     else:
                         status = 404
                         _count_error("not_found")
@@ -387,6 +408,9 @@ class InferenceServer:
         # state then serves any request-size mix with zero compiles
         if self.batcher is not None:
             self.batcher.start()
+        # shipped serving objectives + background evaluation ticker
+        # (docs/slo.md; ZOO_TPU_SLO=0 disables)
+        slo_lib.ensure_default_slos("serving")
         if background:
             self._thread = threading.Thread(
                 target=self._httpd.serve_forever, daemon=True)
@@ -445,6 +469,9 @@ class NativeInferenceServer:
             elif route == "/debug/traces":
                 status = 200
                 out = json.dumps(_traces_payload(path)).encode()
+            elif route == "/debug/slo":
+                status = 200
+                out = json.dumps(_slo_payload(path)).encode()
             elif route == "/debug/profile":
                 status, payload = handle_profile(body)
                 out = json.dumps(payload).encode()
@@ -505,6 +532,7 @@ class NativeInferenceServer:
     def start(self, background: bool = True):
         if self.batcher is not None:
             self.batcher.start()
+        slo_lib.ensure_default_slos("serving")
         self._srv.set_health(json.dumps(
             _health_payload(self.model, self.batcher)))
         for _ in range(self._workers):

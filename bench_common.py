@@ -26,8 +26,14 @@ self-contained best-so-far record — the last is the most complete):
   (ncf/bert/conformance/resnet fallback stages, each with its own
   metric/value/unit).
 - ``diag``/``stage_errors``: what went wrong, per stage.
+- ``probe_latency_s``/``probe_failure``: how long the backend probe
+  took and, on failure, its kind (``timeout``/``probe_rc``/
+  ``no_probe_ok``).
 - ``telemetry``: process-global metrics snapshot
   (`attach_metrics_snapshot`).
+- ``goodput``: recent per-epoch goodput/MFU summaries from
+  `analytics_zoo_tpu.perf.goodput` when an Estimator fit ran in this
+  process (docs/observability.md).
 
 Exit code 0 iff real signal was banked (chip headline or at least one
 fallback stage record).
@@ -63,6 +69,13 @@ def attach_metrics_snapshot(rec: dict) -> dict:
     snap = snapshot()
     if snap:
         rec["telemetry"] = snap
+    try:
+        from analytics_zoo_tpu.perf.goodput import recent_summaries
+        summaries = recent_summaries()
+        if summaries:
+            rec["goodput"] = summaries
+    except Exception:
+        pass  # goodput is optional decoration on the artifact
     return rec
 
 

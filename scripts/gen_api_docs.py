@@ -34,6 +34,12 @@ MODULES = [
      "tracing — trace ids, span buffer, chrome-trace export"),
     ("analytics_zoo_tpu.common.diagnostics",
      "diagnostics — anomaly detectors & device watermarks"),
+    ("analytics_zoo_tpu.common.slo",
+     "slo — declarative objectives & burn-rate engine"),
+    ("analytics_zoo_tpu.perf",
+     "perf — FLOPs accounting & goodput"),
+    ("analytics_zoo_tpu.perf.goodput",
+     "perf.goodput — live goodput/MFU ledger"),
     ("analytics_zoo_tpu.feature", "feature — FeatureSet & ingest"),
     ("analytics_zoo_tpu.feature.image", "feature.image — ImageSet"),
     ("analytics_zoo_tpu.feature.image3d", "feature.image3d"),

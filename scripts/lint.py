@@ -12,7 +12,13 @@ stdlib ast:
   and on lines carrying a `# noqa` comment);
 - metric naming (package files only): every string-literal metric
   name passed to `counter()` / `gauge()` / `histogram()` must match
-  `zoo_tpu_<snake_case>` (docs/observability.md naming contract).
+  `zoo_tpu_<snake_case>` (docs/observability.md naming contract);
+- shipped SLO defaults (`DEFAULT_SERVING_SLOS` /
+  `DEFAULT_TRAINING_SLOS` in `common/slo.py`, kept as pure dict
+  literals precisely so this works): every rule id is unique, every
+  window positive and ascending, and every referenced metric name is
+  one the package actually registers — a typoed selector would
+  otherwise sit silently in `no_data` forever (docs/slo.md).
 
 Run: `python scripts/lint.py` (exit 1 on findings). `make lint`.
 """
@@ -23,6 +29,7 @@ import ast
 import os
 import re
 import sys
+from typing import Optional
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TARGETS = ["analytics_zoo_tpu", "tests", "scripts", "apps",
@@ -104,11 +111,14 @@ _METRIC_FNS = {"counter", "gauge", "histogram"}
 _METRIC_RE = re.compile(r"^zoo_tpu_[a-z0-9]+(_[a-z0-9]+)*$")
 
 
-def _metric_name_problems(rel: str, tree: ast.AST) -> list:
+def _metric_name_problems(rel: str, tree: ast.AST,
+                          registered: set) -> list:
     """Metric naming contract (docs/observability.md): every literal
     name handed to counter()/gauge()/histogram() is `zoo_tpu_*`
     snake_case. Only package code is held to it — tests deliberately
-    mint odd names to exercise escaping."""
+    mint odd names to exercise escaping. Conforming names are
+    accumulated into ``registered`` (the SLO-default check below
+    validates selectors against this set)."""
     problems = []
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call):
@@ -125,10 +135,90 @@ def _metric_name_problems(rel: str, tree: ast.AST) -> list:
                 problems.append(
                     f"{rel}:{node.lineno}: metric name "
                     f"'{first.value}' violates zoo_tpu_* snake_case")
+            else:
+                registered.add(first.value)
     return problems
 
 
-def check_file(path: str) -> list:
+_SLO_DEFAULT_NAMES = ("DEFAULT_SERVING_SLOS", "DEFAULT_TRAINING_SLOS")
+_SLO_FILE = os.path.join("analytics_zoo_tpu", "common", "slo.py")
+
+
+def _slo_rule_metrics(rule: dict) -> list:
+    """Every metric family name a rule's selector references."""
+    sig = rule.get("signal") or {}
+    out = []
+    for part in (sig, sig.get("numerator") or {},
+                 sig.get("denominator") or {}):
+        m = part.get("metric")
+        if isinstance(m, str):
+            out.append(m)
+    return out
+
+
+def check_slo_defaults(registered: set) -> list:
+    """Validate the shipped SLO rules (docs/slo.md) without importing
+    the package: the defaults are pure dict literals, so they
+    ``ast.literal_eval`` straight off the tree. Flags duplicate ids
+    (across BOTH lists), non-positive or non-ascending windows, and
+    selectors naming metrics no package file registers."""
+    path = os.path.join(ROOT, _SLO_FILE)
+    if not os.path.isfile(path):
+        return [f"{_SLO_FILE}: missing (SLO defaults unchecked)"]
+    tree = ast.parse(open(path, encoding="utf-8").read())
+    problems = []
+    seen_ids = {}
+    found = set()
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        for tgt in node.targets:
+            if not (isinstance(tgt, ast.Name)
+                    and tgt.id in _SLO_DEFAULT_NAMES):
+                continue
+            found.add(tgt.id)
+            try:
+                rules = ast.literal_eval(node.value)
+            except ValueError:
+                problems.append(
+                    f"{_SLO_FILE}:{node.lineno}: {tgt.id} is not a "
+                    f"pure literal (lint cannot validate it)")
+                continue
+            for rule in rules:
+                rid = rule.get("id")
+                where = f"{_SLO_FILE}:{node.lineno}: {tgt.id}"
+                if not rid or not isinstance(rid, str):
+                    problems.append(f"{where}: rule without an id")
+                    continue
+                if rid in seen_ids:
+                    problems.append(
+                        f"{where}: duplicate slo id '{rid}' (also "
+                        f"in {seen_ids[rid]})")
+                seen_ids[rid] = tgt.id
+                windows = rule.get("windows") or []
+                if not windows:
+                    problems.append(f"{where}: '{rid}' has no "
+                                    f"windows")
+                if any(not isinstance(w, (int, float)) or w <= 0
+                       for w in windows):
+                    problems.append(f"{where}: '{rid}' has a "
+                                    f"non-positive window")
+                elif list(windows) != sorted(windows):
+                    problems.append(f"{where}: '{rid}' windows not "
+                                    f"ascending")
+                for metric in _slo_rule_metrics(rule):
+                    if metric not in registered:
+                        problems.append(
+                            f"{where}: '{rid}' selects metric "
+                            f"'{metric}' that no package file "
+                            f"registers")
+    for name in _SLO_DEFAULT_NAMES:
+        if name not in found:
+            problems.append(f"{_SLO_FILE}: {name} not found")
+    return problems
+
+
+def check_file(path: str, registered: Optional[set] = None) -> list:
     rel = os.path.relpath(path, ROOT)
     try:
         src = open(path, encoding="utf-8").read()
@@ -149,7 +239,9 @@ def check_file(path: str) -> list:
             problems.append(
                 f"{rel}:{i}: line too long ({len(line)} > {MAX_LEN})")
     if rel.startswith("analytics_zoo_tpu" + os.sep):
-        problems.extend(_metric_name_problems(rel, tree))
+        problems.extend(_metric_name_problems(
+            rel, tree, registered if registered is not None
+            else set()))
     if os.path.basename(path) != "__init__.py":
         used = _used_names(tree) | _string_mentions(tree)
         lines = src.splitlines()
@@ -178,10 +270,12 @@ def check_file(path: str) -> list:
 
 def main() -> int:
     all_problems = []
+    registered: set = set()
     n = 0
     for path in _py_files():
         n += 1
-        all_problems.extend(check_file(path))
+        all_problems.extend(check_file(path, registered))
+    all_problems.extend(check_slo_defaults(registered))
     for p in all_problems:
         print(p)
     print(f"# linted {n} files: "

@@ -1,0 +1,274 @@
+#!/usr/bin/env python
+"""Perf-regression sentinel over the bench artifact history.
+
+Rounds 3-5 went dark (dead tunnels) and nobody noticed the perf
+trajectory by rereading JSON — this tool makes the comparison
+mechanical. It loads every ``BENCH_r<NN>.json`` wrapper (the driver's
+``{n, cmd, rc, tail, parsed}`` capture — the merged artifact line is
+recovered from ``tail``), plus ``BENCH_serving.json`` and
+``BASELINE.json``, normalizes every number into per-metric series,
+and judges the NEWEST numbered round against the best comparable
+prior value of each series.
+
+Lineage discipline (the whole point): chip measurements and host-CPU
+fallback measurements are SEPARATE series. An artifact is fallback
+when it carries ``cpu_fallback_value``/``fallback`` (or a fallback
+diag); ``*_CPU_FALLBACK`` metric names are normalized into the cpu
+lineage under their base name. A 0.63 img/s CPU number is never
+compared against round 2's 2715 img/s chip headline.
+
+Direction is inferred from the metric name (err/p99/latency/_ms/
+seconds → lower is better; everything else → higher is better).
+A regression is a drop past ``--tolerance`` (default 10%) below the
+best prior comparable value (or, lower-better, a rise past the
+tolerance above it, with a small absolute floor so a 1e-9 conformance
+wiggle over a 0.0 best does not page).
+
+Exit codes: 1 when the newest round regressed (0 with
+``--advisory``), 2 when no artifacts could be loaded, else 0.
+``make perf-sentinel`` runs it enforcing; ``make test`` runs it
+advisory so every run prints the trajectory table.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+_FB_SUFFIX = "_CPU_FALLBACK"
+_LOWER_RE = re.compile(
+    r"(err|error|p99|latency|_ms$|_ms_|seconds)", re.I)
+
+
+def direction(metric: str) -> str:
+    """'lower' when smaller values are better, else 'higher'."""
+    return "lower" if _LOWER_RE.search(metric) else "higher"
+
+
+def _json_lines(text: str) -> "List[dict]":
+    out = []
+    for line in (text or "").splitlines():
+        if line.startswith("{"):
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                pass  # truncated mid-line by a kill
+    return out
+
+
+def load_artifact(path: str) -> Optional[dict]:
+    """The most complete merged artifact record in ``path``: either
+    the file IS the artifact (BENCH_serving.json), or it is a driver
+    wrapper whose ``tail`` holds the bench's incremental JSON lines
+    (the last line is the most complete; ``parsed`` is the
+    fallback)."""
+    try:
+        with open(path, encoding="utf-8") as fh:
+            d = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(d, dict):
+        return None
+    if "tail" in d or "parsed" in d:
+        recs = _json_lines(d.get("tail", ""))
+        if recs:
+            return recs[-1]
+        parsed = d.get("parsed")
+        return parsed if isinstance(parsed, dict) else None
+    return d
+
+
+def is_fallback_artifact(rec: dict) -> bool:
+    """Chip-unreachable rounds: the cpu_fallback_value/fallback keys
+    (or a fallback diag) mark every number in the record as host-CPU
+    lineage."""
+    if rec.get("cpu_fallback_value") is not None:
+        return True
+    if rec.get("fallback"):
+        return True
+    return "fallback" in (rec.get("diag") or "").lower()
+
+
+def extract_series(rec: dict) -> "Dict[Tuple[str, str], float]":
+    """``{(lineage, metric): value}`` for one artifact.
+    ``lineage`` is ``"chip"`` or ``"cpu"`` — comparisons only ever
+    happen within one lineage."""
+    out: "Dict[Tuple[str, str], float]" = {}
+    if not isinstance(rec, dict):
+        return out
+    fb = is_fallback_artifact(rec)
+    art_lin = "cpu" if fb else "chip"
+    headline = rec.get("metric") or "headline"
+    value = rec.get("value")
+    # a 0.0 headline is this schema's "nothing measured" sentinel
+    if isinstance(value, (int, float)) and value > 0:
+        out[(art_lin, headline)] = float(value)
+    cfv = rec.get("cpu_fallback_value")
+    if isinstance(cfv, (int, float)) and cfv > 0:
+        out[("cpu", headline)] = float(cfv)
+    for m in rec.get("extra_metrics") or []:
+        if not isinstance(m, dict):
+            continue
+        name = m.get("metric")
+        v = m.get("value")
+        if isinstance(name, str) and isinstance(v, (int, float)):
+            if name.endswith(_FB_SUFFIX):
+                out[("cpu", name[:-len(_FB_SUFFIX)])] = float(v)
+            else:
+                out[(art_lin, name)] = float(v)
+        elif "mode" in m and isinstance(
+                m.get("rows_per_sec"), (int, float)):
+            out[(art_lin, f"rows_per_sec[{m['mode']}]")] = float(
+                m["rows_per_sec"])
+    return out
+
+
+def load_rounds(dirpath: str):
+    """Numbered rounds (sorted) + optional serving artifact + the
+    BASELINE descriptor. Returns ``(rounds, serving, baseline)``
+    where rounds is ``[(n, label, series_dict), ...]``."""
+    rounds = []
+    for fn in sorted(os.listdir(dirpath)):
+        m = ROUND_RE.match(fn)
+        if not m:
+            continue
+        rec = load_artifact(os.path.join(dirpath, fn))
+        series = extract_series(rec) if rec else {}
+        rounds.append((int(m.group(1)), f"r{int(m.group(1)):02d}",
+                       series))
+    rounds.sort()
+    serving = None
+    sp = os.path.join(dirpath, "BENCH_serving.json")
+    if os.path.exists(sp):
+        rec = load_artifact(sp)
+        if rec:
+            serving = extract_series(rec)
+    baseline = None
+    bp = os.path.join(dirpath, "BASELINE.json")
+    if os.path.exists(bp):
+        baseline = load_artifact(bp)
+    return rounds, serving, baseline
+
+
+def judge_latest(rounds, tolerance: float,
+                 floor: float = 1e-3) -> "List[dict]":
+    """Regressions of the newest numbered round vs the best
+    comparable (same lineage+metric) value from any prior round."""
+    if len(rounds) < 2:
+        return []
+    latest_n, latest_label, latest = rounds[-1]
+    regressions = []
+    for key, value in sorted(latest.items()):
+        prior = [series[key] for _, _, series in rounds[:-1]
+                 if key in series]
+        if not prior:
+            continue  # nothing comparable — never cross lineages
+        lineage, metric = key
+        if direction(metric) == "higher":
+            best = max(prior)
+            bad = value < best * (1.0 - tolerance)
+        else:
+            best = min(prior)
+            bad = value > max(best * (1.0 + tolerance),
+                              best + floor)
+        if bad:
+            regressions.append({
+                "round": latest_label, "lineage": lineage,
+                "metric": metric, "value": value, "best": best,
+                "direction": direction(metric)})
+    return regressions
+
+
+def _fmt(v: Optional[float]) -> str:
+    if v is None:
+        return "—"
+    if v == 0:
+        return "0"
+    if abs(v) >= 1000:
+        return f"{v:,.0f}"
+    if abs(v) >= 1:
+        return f"{v:.2f}"
+    return f"{v:.4g}"
+
+
+def trajectory_table(rounds, serving=None) -> str:
+    """Per-series trajectory across rounds (and the serving artifact
+    as its own column), chip and cpu lineages in separate blocks."""
+    cols = [label for _, label, _ in rounds]
+    series_by_round = {label: s for _, label, s in rounds}
+    if serving:
+        cols.append("serving")
+        series_by_round["serving"] = serving
+    keys = sorted({k for s in series_by_round.values() for k in s})
+    lines = []
+    width = max([len(m) for _, m in keys] + [24]) + 2
+    header = ("lineage".ljust(8) + "metric".ljust(width)
+              + "".join(c.rjust(12) for c in cols))
+    lines.append(header)
+    lines.append("-" * len(header))
+    for lineage in ("chip", "cpu"):
+        for key in keys:
+            if key[0] != lineage:
+                continue
+            row = (lineage.ljust(8) + key[1].ljust(width)
+                   + "".join(
+                       _fmt(series_by_round[c].get(key)).rjust(12)
+                       for c in cols))
+            lines.append(row)
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--dir", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        help="directory holding BENCH_*.json / BASELINE.json")
+    ap.add_argument("--tolerance", type=float, default=float(
+        os.environ.get("ZOO_TPU_SENTINEL_TOLERANCE", "0.10")),
+        help="relative regression tolerance (default 0.10)")
+    ap.add_argument("--floor", type=float, default=1e-3,
+                    help="absolute slack for lower-is-better metrics "
+                         "whose best prior is ~0")
+    ap.add_argument("--advisory", action="store_true",
+                    help="print the verdict but always exit 0")
+    args = ap.parse_args(argv)
+
+    rounds, serving, baseline = load_rounds(args.dir)
+    if not rounds and not serving:
+        print("perf-sentinel: no BENCH artifacts found in "
+              f"{args.dir}", file=sys.stderr)
+        return 0 if args.advisory else 2
+
+    print("# perf trajectory "
+          f"({len(rounds)} rounds, tolerance {args.tolerance:.0%})")
+    if baseline and baseline.get("metric"):
+        print(f"# baseline: {baseline['metric']}")
+    print(trajectory_table(rounds, serving))
+
+    regressions = judge_latest(rounds, args.tolerance, args.floor)
+    if regressions:
+        print()
+        for r in regressions:
+            worse = ("below" if r["direction"] == "higher"
+                     else "above")
+            print(f"REGRESSION [{r['lineage']}] {r['metric']}: "
+                  f"{_fmt(r['value'])} is >{args.tolerance:.0%} "
+                  f"{worse} best prior {_fmt(r['best'])} "
+                  f"({r['round']})")
+        print(f"\nperf-sentinel: {len(regressions)} regression(s) "
+              f"in {rounds[-1][1]}"
+              + (" [advisory]" if args.advisory else ""))
+        return 0 if args.advisory else 1
+    latest = rounds[-1][1] if rounds else "serving"
+    print(f"\nperf-sentinel: OK — no comparable series in {latest} "
+          f"regressed past {args.tolerance:.0%}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -35,15 +35,21 @@ def _fresh_context():
 
 @pytest.fixture(autouse=True)
 def _fresh_telemetry():
-    """Reset the global metrics registry and trace-span buffer around
-    every test, so counters/spans leaked by one test can never satisfy
-    (or break) another's assertions."""
-    from analytics_zoo_tpu.common import observability, tracing
+    """Reset the global metrics registry, trace-span buffer, SLO
+    engine and goodput ring around every test, so counters/spans/
+    breach state leaked by one test can never satisfy (or break)
+    another's assertions."""
+    from analytics_zoo_tpu.common import observability, slo, tracing
+    from analytics_zoo_tpu.perf import goodput
     observability.reset_metrics()
     tracing.reset_tracing()
+    slo.reset_slo()
+    goodput.reset_goodput()
     yield
     observability.reset_metrics()
     tracing.reset_tracing()
+    slo.reset_slo()
+    goodput.reset_goodput()
 
 
 @pytest.fixture

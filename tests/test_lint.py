@@ -14,3 +14,62 @@ def test_lint_clean():
         [sys.executable, os.path.join(_ROOT, "scripts", "lint.py")],
         capture_output=True, text=True, timeout=300, cwd=_ROOT)
     assert out.returncode == 0, out.stdout[-4000:]
+
+
+# -- shipped SLO default validation (docs/slo.md) ---------------------------
+
+def _lint_mod():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "zoo_lint", os.path.join(_ROOT, "scripts", "lint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_slo_defaults_clean_against_registered_metrics():
+    """The shipped rules only select metric families some package
+    file actually registers (full-repo collection pass)."""
+    lint = _lint_mod()
+    registered = set()
+    for path in lint._py_files():
+        lint.check_file(path, registered)
+    assert lint.check_slo_defaults(registered) == []
+
+
+def test_slo_defaults_unknown_metric_flagged():
+    lint = _lint_mod()
+    problems = lint.check_slo_defaults(set())
+    assert problems
+    assert all("no package file registers" in p for p in problems)
+
+
+def test_slo_defaults_structural_problems(tmp_path, monkeypatch):
+    """Duplicate ids, non-positive / non-ascending / missing windows
+    and non-literal defaults are all caught from the AST alone."""
+    lint = _lint_mod()
+    pkg = tmp_path / "analytics_zoo_tpu" / "common"
+    pkg.mkdir(parents=True)
+    (pkg / "slo.py").write_text('''
+DEFAULT_SERVING_SLOS = [
+    {"id": "a", "windows": [60.0],
+     "signal": {"type": "gauge", "metric": "zoo_tpu_ok"}},
+    {"id": "a", "windows": [-5.0],
+     "signal": {"type": "gauge", "metric": "zoo_tpu_ok"}},
+    {"id": "b", "windows": [600.0, 60.0],
+     "signal": {"type": "gauge", "metric": "zoo_tpu_nope"}},
+    {"id": "c",
+     "signal": {"type": "gauge", "metric": "zoo_tpu_ok"}},
+]
+DEFAULT_TRAINING_SLOS = [{"id": "d", "windows": [object()],
+                          "signal": {}}] + []
+''')
+    monkeypatch.setattr(lint, "ROOT", str(tmp_path))
+    problems = lint.check_slo_defaults({"zoo_tpu_ok"})
+    text = "\n".join(problems)
+    assert "duplicate slo id 'a'" in text
+    assert "non-positive window" in text
+    assert "'b' windows not ascending" in text
+    assert "'c' has no windows" in text
+    assert "'zoo_tpu_nope' that no package file registers" in text
+    assert "DEFAULT_TRAINING_SLOS is not a pure literal" in text

@@ -467,3 +467,53 @@ def test_to_prometheus_served_registry_is_global():
     """The module-level helpers and /metrics read the same registry."""
     counter("zoo_tpu_global_check_total").inc()
     assert "zoo_tpu_global_check_total 1" in to_prometheus()
+
+
+# -- bucket quantiles (SLO latency estimator) -------------------------------
+
+def test_bucket_quantile_known_uniform():
+    """1000 uniform observations over (0, 10] against unit-width
+    buckets: interpolation pins p50/p90/p99 to the true quantiles."""
+    from analytics_zoo_tpu.common.observability import bucket_quantile
+    buckets = [float(b) for b in range(1, 11)]
+    counts = [100.0] * 10 + [0.0]  # per-bucket + empty overflow
+    assert bucket_quantile(buckets, counts, 0.5) == pytest.approx(
+        5.0, abs=0.02)
+    assert bucket_quantile(buckets, counts, 0.9) == pytest.approx(
+        9.0, abs=0.02)
+    assert bucket_quantile(buckets, counts, 0.99) == pytest.approx(
+        9.9, abs=0.02)
+    assert bucket_quantile(buckets, counts, 0.0) == 0.0
+    assert bucket_quantile(buckets, counts, 1.0) == 10.0
+
+
+def test_bucket_quantile_skewed_and_overflow():
+    from analytics_zoo_tpu.common.observability import bucket_quantile
+    # 90% fast, 10% slow: p50 interpolates inside the first bucket
+    assert bucket_quantile([0.1, 1.0], [90.0, 0.0, 10.0], 0.5) == \
+        pytest.approx(0.1 * (50 / 90))
+    # rank falling in +Inf clamps to the highest finite bound
+    assert bucket_quantile([0.1, 1.0], [90.0, 0.0, 10.0], 0.99) == \
+        pytest.approx(1.0)
+
+
+def test_bucket_quantile_edge_cases():
+    from analytics_zoo_tpu.common.observability import bucket_quantile
+    import math
+    assert math.isnan(bucket_quantile([1.0], [0.0, 0.0], 0.5))
+    with pytest.raises(ValueError):
+        bucket_quantile([1.0, 2.0], [1.0, 1.0], 0.5)  # no overflow
+
+
+def test_histogram_quantile_method():
+    """Histogram.quantile on a known distribution: 100 obs spread
+    1..100 ms against default-ish bucket edges."""
+    h = histogram("zoo_tpu_q_seconds",
+                  buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0))
+    for i in range(1, 101):  # 1ms..100ms uniform
+        h.observe(i / 1000.0)
+    assert h.quantile(0.5) == pytest.approx(0.05, rel=0.15)
+    assert h.quantile(0.99) == pytest.approx(0.1, rel=0.05)
+    import math
+    empty = histogram("zoo_tpu_q2_seconds", buckets=(1.0,))
+    assert math.isnan(empty.quantile(0.5))

@@ -1,0 +1,140 @@
+"""Perf-regression sentinel (scripts/perf_sentinel.py): artifact
+recovery from driver wrappers, chip-vs-CPU-fallback lineage
+separation, direction-aware regression judgment, and the repo's real
+BENCH history staying green. Tier-1 fast."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def sentinel():
+    spec = importlib.util.spec_from_file_location(
+        "perf_sentinel",
+        os.path.join(_ROOT, "scripts", "perf_sentinel.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _wrap(tmp_path, n, rec):
+    """Write a driver-wrapper round file the way the bench driver
+    does: the artifact JSON line lives in ``tail``."""
+    (tmp_path / f"BENCH_r{n:02d}.json").write_text(json.dumps(
+        {"n": n, "cmd": "python bench.py", "rc": 0,
+         "tail": "noise line\n" + json.dumps(rec),
+         "parsed": None}))
+
+
+CHIP = "resnet50_train_images_per_sec_per_chip"
+
+
+def test_real_repo_history_is_green(sentinel, capsys):
+    """Acceptance: the shipped BENCH_r01..r05 + BENCH_serving set
+    must pass — r05's CPU-fallback numbers have no comparable prior
+    round and are never judged against r02's chip headline."""
+    assert sentinel.main(["--dir", _ROOT]) == 0
+    out = capsys.readouterr().out
+    assert "perf-sentinel: OK" in out
+    assert "r05" in out and "serving" in out
+
+
+def test_synthetic_regression_fails(sentinel, tmp_path, capsys):
+    _wrap(tmp_path, 1, {"metric": CHIP, "value": 2700.0})
+    _wrap(tmp_path, 2, {"metric": CHIP, "value": 2000.0})
+    assert sentinel.main(["--dir", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSION [chip]" in out
+    # advisory mode reports but exits clean
+    assert sentinel.main(["--dir", str(tmp_path),
+                          "--advisory"]) == 0
+
+
+def test_within_tolerance_passes(sentinel, tmp_path):
+    _wrap(tmp_path, 1, {"metric": CHIP, "value": 2700.0})
+    _wrap(tmp_path, 2, {"metric": CHIP, "value": 2500.0})  # -7.4%
+    assert sentinel.main(["--dir", str(tmp_path)]) == 0
+    assert sentinel.main(["--dir", str(tmp_path),
+                          "--tolerance", "0.05"]) == 1
+
+
+def test_lineages_never_compared(sentinel, tmp_path):
+    """A fallback round after a chip round regresses nothing: the
+    0.5 img/s CPU number is a different series from 2700 on chip."""
+    _wrap(tmp_path, 1, {"metric": CHIP, "value": 2700.0})
+    _wrap(tmp_path, 2, {"metric": CHIP, "value": 0.5,
+                        "fallback": "resnet50-cpu",
+                        "diag": "dead tunnel; CPU fallback"})
+    assert sentinel.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_cpu_lineage_regression_detected(sentinel, tmp_path):
+    """...but within the cpu lineage, regressions do fire."""
+    fb = {"metric": CHIP, "value": None, "fallback": "cpu",
+          "cpu_fallback_value": 100.0}
+    _wrap(tmp_path, 1, fb)
+    _wrap(tmp_path, 2, dict(fb, cpu_fallback_value=50.0))
+    assert sentinel.main(["--dir", str(tmp_path)]) == 1
+
+
+def test_lower_is_better_direction(sentinel, tmp_path):
+    err = "conv_bn_conformance_max_abs_err"
+    _wrap(tmp_path, 1, {"metric": CHIP, "value": 2700.0,
+                        "extra_metrics": [
+                            {"metric": err, "value": 1e-6}]})
+    _wrap(tmp_path, 2, {"metric": CHIP, "value": 2700.0,
+                        "extra_metrics": [
+                            {"metric": err, "value": 0.5}]})
+    assert sentinel.main(["--dir", str(tmp_path)]) == 1
+    # a wiggle under the absolute floor over a ~0 best is fine
+    (tmp_path / "BENCH_r02.json").unlink()
+    _wrap(tmp_path, 2, {"metric": CHIP, "value": 2700.0,
+                        "extra_metrics": [
+                            {"metric": err, "value": 5e-4}]})
+    assert sentinel.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_fallback_suffix_normalization(sentinel):
+    rec = {"metric": CHIP, "value": 0.63, "fallback": "cpu",
+           "extra_metrics": [
+               {"metric": "ncf_train_samples_per_sec_CPU_FALLBACK",
+                "value": 5e5}]}
+    series = sentinel.extract_series(rec)
+    assert ("cpu", "ncf_train_samples_per_sec") in series
+    assert ("cpu", CHIP) in series  # headline follows the artifact
+    assert not any(lin == "chip" for lin, _ in series)
+
+
+def test_wrapper_tail_recovery(sentinel, tmp_path):
+    """The last JSON line in ``tail`` wins over ``parsed``; garbage
+    and truncated lines are skipped."""
+    p = tmp_path / "BENCH_r01.json"
+    early = {"metric": CHIP, "value": 100.0}
+    final = {"metric": CHIP, "value": 200.0}
+    p.write_text(json.dumps({
+        "n": 1, "cmd": "x", "rc": 0,
+        "tail": (json.dumps(early) + "\nlog noise\n"
+                 + json.dumps(final) + "\n{\"truncat"),
+        "parsed": early}))
+    rec = sentinel.load_artifact(str(p))
+    assert rec["value"] == 200.0
+
+
+def test_empty_round_contributes_nothing(sentinel, tmp_path):
+    """A timed-out round (empty tail, parsed null — the real r01)
+    still shows in the table but has no series."""
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+        {"n": 1, "cmd": "x", "rc": 124, "tail": "", "parsed": None}))
+    _wrap(tmp_path, 2, {"metric": CHIP, "value": 2700.0})
+    assert sentinel.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_no_artifacts_is_an_error(sentinel, tmp_path):
+    assert sentinel.main(["--dir", str(tmp_path)]) == 2
+    assert sentinel.main(["--dir", str(tmp_path),
+                          "--advisory"]) == 0

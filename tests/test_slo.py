@@ -1,0 +1,391 @@
+"""SLO engine (common/slo.py): rule validation, windowed evaluation
+math, the breach/recovery state machine under an injectable clock (no
+sleeps anywhere), default installation, and the /debug/slo endpoint.
+Tier-1 fast."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common import observability as obs
+from analytics_zoo_tpu.common import slo as slo_lib
+from analytics_zoo_tpu.common.slo import SLO, SLOEngine
+
+
+class Clock:
+    """Deterministic monotonic clock the engine ticks against."""
+
+    def __init__(self, t=1000.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+def _engine():
+    reg = obs.MetricsRegistry()
+    clk = Clock()
+    return SLOEngine(registry=reg, clock=clk), reg, clk
+
+
+def _state(status, rid):
+    return {o["id"]: o for o in status["objectives"]}[rid]
+
+
+def _breach_count(reg, rid):
+    fam = reg.snapshot().get("zoo_tpu_slo_breaches_total")
+    if fam is None:
+        return 0
+    for rec in fam["values"]:
+        if rec["labels"].get("slo") == rid:
+            return rec["value"]
+    return 0
+
+
+# -- rule validation --------------------------------------------------------
+
+def test_from_dict_rejects_unknown_keys():
+    with pytest.raises(ValueError, match="unknown keys"):
+        SLO.from_dict({"id": "x", "threshold": 1.0, "windows": [60],
+                       "signal": {"type": "gauge", "metric": "m"},
+                       "bogus": 1})
+
+
+@pytest.mark.parametrize("bad", [
+    {"id": "", "signal": {"type": "gauge", "metric": "m"},
+     "threshold": 1.0},
+    {"id": "x", "signal": {"type": "nope", "metric": "m"},
+     "threshold": 1.0},
+    {"id": "x", "signal": {"type": "gauge", "metric": "m"},
+     "threshold": 1.0, "windows": []},
+    {"id": "x", "signal": {"type": "gauge", "metric": "m"},
+     "threshold": 1.0, "windows": [0.0]},
+    {"id": "x", "signal": {"type": "gauge", "metric": "m"},
+     "threshold": 1.0, "op": "!="},
+    {"id": "x", "signal": {"type": "gauge", "metric": "m"}},
+    {"id": "x", "signal": {"type": "quantile", "metric": "m",
+                           "q": 1.5}, "threshold": 1.0},
+    {"id": "x", "signal": {"type": "ratio",
+                           "numerator": {"metric": "n"},
+                           "denominator": {"metric": "d"}},
+     "objective": 1.0},
+])
+def test_bad_definitions_raise(bad):
+    with pytest.raises(ValueError):
+        SLO.from_dict(bad)
+
+
+def test_shipped_defaults_all_parse():
+    seen = set()
+    for d in (slo_lib.DEFAULT_SERVING_SLOS
+              + slo_lib.DEFAULT_TRAINING_SLOS):
+        rule = SLO.from_dict(d)
+        assert rule.id not in seen
+        seen.add(rule.id)
+        assert rule.windows == tuple(sorted(rule.windows))
+
+
+def test_add_duplicate_id_raises():
+    eng, _reg, _clk = _engine()
+    rule = SLO("dup", {"type": "gauge", "metric": "m"},
+               threshold=1.0)
+    eng.add(rule)
+    with pytest.raises(ValueError, match="duplicate"):
+        eng.add(SLO("dup", {"type": "gauge", "metric": "m"},
+                    threshold=2.0))
+    eng.add(SLO("dup", {"type": "gauge", "metric": "m"},
+                threshold=2.0), replace=True)
+
+
+# -- breach lifecycle (fake clock, no sleeps) -------------------------------
+
+def test_gauge_single_window_trip_recover_retrip():
+    """The full lifecycle on an instantaneous gauge rule: the breach
+    counter increments exactly once per healthy->breach transition,
+    holding a breach does not re-count, recovery rearms it."""
+    eng, reg, clk = _engine()
+    eng.add(SLO("depth", {"type": "gauge", "metric": "zoo_tpu_q"},
+                threshold=100.0, op=">", windows=[60.0]))
+    g = reg.gauge("zoo_tpu_q")
+
+    g.set(10)
+    st = _state(eng.tick(), "depth")
+    assert st["state"] == "ok" and st["breaches"] == 0
+
+    g.set(300)
+    clk.advance(5)
+    st = _state(eng.tick(), "depth")
+    assert st["state"] == "breach" and st["breaches"] == 1
+    assert st["since"] == clk.t
+    assert _breach_count(reg, "depth") == 1
+
+    # still breaching: no double-count
+    clk.advance(5)
+    st = _state(eng.tick(), "depth")
+    assert st["state"] == "breach" and st["breaches"] == 1
+    assert _breach_count(reg, "depth") == 1
+
+    g.set(50)
+    clk.advance(5)
+    st = _state(eng.tick(), "depth")
+    assert st["state"] == "ok" and st["breaches"] == 1
+
+    g.set(500)
+    clk.advance(5)
+    st = _state(eng.tick(), "depth")
+    assert st["state"] == "breach" and st["breaches"] == 2
+    assert _breach_count(reg, "depth") == 2
+
+
+def test_breach_rides_anomaly_pipeline():
+    """A healthy->breach transition emits exactly one slo_breach
+    anomaly through the shared diagnostics pipeline (the GLOBAL
+    registry, where operators already watch anomalies_total)."""
+    eng, reg, clk = _engine()
+    eng.add(SLO("hot", {"type": "gauge", "metric": "zoo_tpu_t"},
+                threshold=1.0, windows=[60.0]))
+    reg.gauge("zoo_tpu_t").set(9.0)
+    eng.tick()
+    clk.advance(1)
+    eng.tick()  # held breach: must not re-emit
+    fam = obs.snapshot()["zoo_tpu_anomalies_total"]
+    kinds = {r["labels"]["kind"]: r["value"] for r in fam["values"]}
+    assert kinds["slo_breach"] == 1
+
+
+def test_rate_rule_windowed_delta():
+    eng, reg, clk = _engine()
+    eng.add(SLO("recompiles",
+                {"type": "rate", "metric": "zoo_tpu_c_total"},
+                threshold=1.0, op=">", windows=[60.0]))
+    c = reg.counter("zoo_tpu_c_total")
+    c.inc(5)
+    st = _state(eng.tick(), "recompiles")
+    assert st["state"] == "no_data"  # no baseline snapshot yet
+    for _ in range(6):  # 0.5/s for a minute: healthy
+        clk.advance(10)
+        c.inc(5)
+        st = _state(eng.tick(), "recompiles")
+    assert st["state"] == "ok"
+    assert st["value"] == pytest.approx(0.5, rel=1e-6)
+    for _ in range(6):  # 2/s for a minute: breach
+        clk.advance(10)
+        c.inc(20)
+        st = _state(eng.tick(), "recompiles")
+    assert st["state"] == "breach"
+    assert st["value"] == pytest.approx(2.0, rel=0.35)
+
+
+def test_multi_window_fast_then_slow_burn():
+    """Google-SRE multi-window gating: a fresh error burst trips the
+    fast (60 s) window immediately but the rule only breaches once
+    the slow (600 s) window burns too; recovery clears it."""
+    eng, reg, clk = _engine()
+    eng.add(SLO.from_dict({
+        "id": "errs",
+        "signal": {"type": "ratio",
+                   "numerator": {"metric": "zoo_tpu_e_total"},
+                   "denominator": {"metric": "zoo_tpu_r_total"}},
+        "objective": 0.9, "burn_rate": 2.0,
+        "windows": [60.0, 600.0], "min_events": 10}))
+    err = reg.counter("zoo_tpu_e_total")
+    req = reg.counter("zoo_tpu_r_total")
+
+    # 10 min of clean traffic (10 req / 10 s)
+    req.inc(0)
+    err.inc(0)
+    eng.tick()
+    for _ in range(60):
+        clk.advance(10)
+        req.inc(10)
+        eng.tick()
+
+    # 100%-error burst: fast window burns (ratio 1.0 >= 0.2 target)
+    # within ~2 ticks, but the 600 s window is still diluted
+    states = []
+    for _ in range(6):
+        clk.advance(10)
+        req.inc(10)
+        err.inc(10)
+        st = _state(eng.tick(), "errs")
+        states.append(st["state"])
+    assert set(states) == {"ok"}  # fast-only never breaches
+    fast, slow = st["window_results"]
+    assert fast["breaching"] and not slow["breaching"]
+    assert fast["value"] == pytest.approx(1.0)
+
+    # keep burning until the slow window crosses 2x budget burn:
+    # needs err_delta/600req >= 0.2 -> ~12 error ticks total
+    for _ in range(10):
+        clk.advance(10)
+        req.inc(10)
+        err.inc(10)
+        st = _state(eng.tick(), "errs")
+        if st["state"] == "breach":
+            break
+    assert st["state"] == "breach"
+    assert st["breaches"] == 1
+    assert _breach_count(reg, "errs") == 1
+    fast, slow = st["window_results"]
+    assert fast["breaching"] and slow["breaching"]
+
+    # recovery: clean traffic flushes the fast window first; the
+    # rule clears as soon as ANY window stops burning
+    clk.advance(10)
+    req.inc(10)
+    st = _state(eng.tick(), "errs")
+    clk.advance(60)
+    req.inc(60)
+    st = _state(eng.tick(), "errs")
+    assert st["state"] == "ok"
+    assert st["breaches"] == 1  # recovery does not count breaches
+    assert _breach_count(reg, "errs") == 1
+
+
+def test_quantile_rule_min_events_gate():
+    eng, reg, clk = _engine()
+    eng.add(SLO("lat", {"type": "quantile",
+                        "metric": "zoo_tpu_l_seconds", "q": 0.99},
+                threshold=0.5, op=">", windows=[60.0],
+                min_events=20))
+    h = reg.histogram("zoo_tpu_l_seconds",
+                      buckets=(0.1, 0.25, 0.5, 1.0, 2.5))
+    h.observe(0.01)
+    eng.tick()
+    clk.advance(10)
+    for _ in range(5):  # only 5 events in window: below the floor
+        h.observe(2.0)
+    st = _state(eng.tick(), "lat")
+    assert st["state"] == "no_data" and not st["has_data"]
+    clk.advance(10)
+    for _ in range(30):  # past the floor, all slow -> p99 >> 0.5
+        h.observe(2.0)
+    st = _state(eng.tick(), "lat")
+    assert st["state"] == "breach"
+    assert st["value"] > 0.5
+
+
+def test_no_data_rule_never_transitions():
+    eng, reg, clk = _engine()
+    eng.add(SLO("ghost", {"type": "gauge", "metric": "zoo_tpu_nope"},
+                threshold=1.0, windows=[60.0]))
+    for _ in range(3):
+        st = _state(eng.tick(), "ghost")
+        assert st["state"] == "no_data"
+        assert st["breaches"] == 0
+        clk.advance(10)
+    assert _breach_count(reg, "ghost") == 0
+
+
+def test_windows_clip_to_uptime():
+    """A 10-minute window rule evaluates within seconds of process
+    start: the oldest snapshot stands in as baseline."""
+    eng, reg, clk = _engine()
+    eng.add(SLO("young", {"type": "rate",
+                          "metric": "zoo_tpu_y_total"},
+                threshold=1.0, op=">", windows=[600.0]))
+    c = reg.counter("zoo_tpu_y_total")
+    c.inc()
+    eng.tick()
+    clk.advance(5)
+    c.inc(50)  # 10/s over the 5 s of actual history
+    st = _state(eng.tick(), "young")
+    assert st["state"] == "breach"
+    assert st["window_results"][0]["value"] == pytest.approx(10.0)
+
+
+# -- defaults / env overrides ----------------------------------------------
+
+def test_install_defaults_idempotent():
+    eng, _reg, _clk = _engine()
+    assert slo_lib.install_defaults(eng, "serving") == 3
+    assert slo_lib.install_defaults(eng, "serving") == 0
+    assert slo_lib.install_defaults(eng, "training") == 3
+    with pytest.raises(ValueError):
+        slo_lib.install_defaults(eng, "nope")
+
+
+def test_env_threshold_override(monkeypatch):
+    monkeypatch.setenv(
+        "ZOO_TPU_SLO_SERVING_QUEUE_DEPTH_THRESHOLD", "5")
+    eng, _reg, _clk = _engine()
+    slo_lib.install_defaults(eng, "serving")
+    st = _state(eng.status(), "serving_queue_depth")
+    assert st["threshold"] == 5.0
+
+
+def test_disabled_by_env(monkeypatch):
+    monkeypatch.setenv("ZOO_TPU_SLO", "0")
+    assert slo_lib.ensure_default_slos("serving") is None
+
+
+def test_manual_tick_mode_starts_no_thread(monkeypatch):
+    monkeypatch.setenv("ZOO_TPU_SLO_TICK_S", "0")
+    eng = slo_lib.ensure_default_slos("serving")
+    assert eng is not None
+    assert eng._thread is None
+
+
+# -- /debug/slo endpoint (acceptance: a driven breach is observable) --------
+
+def _serving_fixture():
+    from analytics_zoo_tpu.pipeline.api.keras import (
+        Sequential, layers as L)
+    from analytics_zoo_tpu.pipeline.inference import InferenceModel
+    from analytics_zoo_tpu.pipeline.inference.serving import (
+        InferenceServer)
+    m = Sequential()
+    m.add(L.Dense(1, input_shape=(3,)))
+    m.compile(optimizer="sgd", loss="mse")
+    im = InferenceModel()
+    im.load_keras_net(m)
+    return InferenceServer(im, port=0).start()
+
+
+def test_debug_slo_endpoint_reports_and_breaches(monkeypatch, rng):
+    """GET /debug/slo serves the shipped serving objectives with live
+    status, and a deterministic 404 burst drives serving_error_rate
+    into breach — counter and anomaly observable on /metrics."""
+    monkeypatch.setenv("ZOO_TPU_SLO_TICK_S", "0")  # manual ticks
+    srv = _serving_fixture()
+    try:
+        url = f"http://127.0.0.1:{srv.port}"
+        first = json.loads(urllib.request.urlopen(
+            url + "/debug/slo").read())  # tick #1 seeds history
+        ids = {o["id"] for o in first["objectives"]}
+        assert {"serving_latency_p99", "serving_error_rate",
+                "serving_queue_depth"} <= ids
+        assert first["enabled"] and first["ticks"] == 1
+
+        x = rng.randn(2, 3).astype(np.float32)
+        good = urllib.request.Request(
+            url + "/predict",
+            data=json.dumps({"inputs": x.tolist()}).encode(),
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(good).read()
+        for _ in range(16):
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(url + "/nope")
+
+        second = json.loads(urllib.request.urlopen(
+            url + "/debug/slo").read())  # tick #2 sees the burst
+        er = _state(second, "serving_error_rate")
+        assert er["state"] == "breach" and er["breaches"] == 1
+
+        passive = json.loads(urllib.request.urlopen(
+            url + "/debug/slo?tick=0").read())  # no extra tick
+        assert passive["ticks"] == second["ticks"]
+
+        text = urllib.request.urlopen(url + "/metrics").read().decode()
+    finally:
+        srv.stop()
+    assert ('zoo_tpu_slo_breaches_total'
+            '{slo="serving_error_rate"} 1') in text
+    assert 'zoo_tpu_anomalies_total{kind="slo_breach"} 1' in text
