@@ -7,7 +7,8 @@ IMAGE ?= analytics-zoo-tpu
 
 .PHONY: test docker-build docker-test docker-test-spark dist docs \
     lint obs-smoke fused-conformance flops-audit serving-smoke \
-    bench-serving trace-smoke trace-report slo-smoke perf-sentinel
+    bench-serving bench-serving-fleet trace-smoke trace-report \
+    slo-smoke perf-sentinel fleet-smoke
 
 # unit tests plus the end-to-end telemetry smokes (metrics
 # exposition, tracing, SLO control loop), so `make test` proves the
@@ -18,6 +19,7 @@ test:
 	$(MAKE) obs-smoke
 	$(MAKE) trace-smoke
 	$(MAKE) slo-smoke
+	$(MAKE) fleet-smoke
 	python scripts/perf_sentinel.py --advisory
 
 # conv+BN (+ residual-epilogue) conformance: the exact Pallas kernel
@@ -71,6 +73,19 @@ serving-smoke:
 # (the chip headline stays null; see bench_serving.py)
 bench-serving:
 	JAX_PLATFORMS=cpu python bench_serving.py --cpu-fallback
+
+# fleet A/B sweep: 1 replica vs N replicas behind the router, writes
+# BENCH_serving_fleet.json (its own perf-sentinel lineage — never
+# compared against single-process serving rows)
+bench-serving-fleet:
+	JAX_PLATFORMS=cpu python bench_serving.py --cpu-fallback \
+	    --replicas 4
+
+# replicated-fleet end-to-end: 2-replica CPU fleet, mixed concurrent
+# load with exact outputs, one replica killed mid-load (zero lost
+# acked requests), ejected, healed, re-admitted (docs/serving.md)
+fleet-smoke:
+	JAX_PLATFORMS=cpu python scripts/fleet_smoke.py
 
 docker-build:
 	docker build -t $(IMAGE) -f docker/Dockerfile .

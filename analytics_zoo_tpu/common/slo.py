@@ -27,7 +27,8 @@ judges the registry against them, Google-SRE style:
   ``GET /debug/slo`` on both HTTP front-ends serves
   :meth:`~SLOEngine.status`.
 
-Shipped default objectives live in :data:`DEFAULT_SERVING_SLOS` and
+Shipped default objectives live in :data:`DEFAULT_SERVING_SLOS`,
+:data:`DEFAULT_FLEET_SLOS` and
 :data:`DEFAULT_TRAINING_SLOS` as pure dict literals so
 ``scripts/lint.py`` can validate them (metric names, windows,
 duplicate ids) without importing this module. Thresholds are
@@ -55,6 +56,7 @@ __all__ = [
     "SLO",
     "SLOEngine",
     "DEFAULT_SERVING_SLOS",
+    "DEFAULT_FLEET_SLOS",
     "DEFAULT_TRAINING_SLOS",
     "get_engine",
     "install_defaults",
@@ -114,6 +116,44 @@ DEFAULT_SERVING_SLOS = [
         "threshold": 192.0,
         "op": ">",
         "windows": [60.0],
+    },
+]
+
+DEFAULT_FLEET_SLOS = [
+    {
+        "id": "fleet_replicas_admitting",
+        "description": "the serving fleet keeps at least one "
+                       "replica admitting traffic",
+        "signal": {"type": "gauge",
+                   "metric": "zoo_tpu_fleet_replicas_admitting"},
+        "threshold": 1.0,
+        "op": "<",
+        "windows": [60.0],
+    },
+    {
+        "id": "fleet_error_rate",
+        "description": "99% of routed requests resolve (replica "
+                       "failures absorbed by sibling retries)",
+        "signal": {"type": "ratio",
+                   "numerator": {
+                       "metric":
+                           "zoo_tpu_fleet_requests_failed_total"},
+                   "denominator": {
+                       "metric": "zoo_tpu_fleet_requests_total"}},
+        "objective": 0.99,
+        "burn_rate": 14.0,
+        "windows": [60.0, 600.0],
+        "min_events": 10,
+    },
+    {
+        "id": "fleet_retry_rate",
+        "description": "sibling retries stay under 1/s (a dying "
+                       "replica burns retry budget before ejection)",
+        "signal": {"type": "rate",
+                   "metric": "zoo_tpu_fleet_retries_total"},
+        "threshold": 1.0,
+        "op": ">",
+        "windows": [120.0],
     },
 ]
 
@@ -611,12 +651,14 @@ def _env_overrides(d: dict) -> dict:
 
 
 def install_defaults(engine: SLOEngine, role: str) -> int:
-    """Install the shipped objectives for ``role`` (``"serving"`` or
-    ``"training"``) into ``engine``, skipping ids already present
-    (idempotent; user-replaced rules are never clobbered). Returns
-    how many rules were added."""
+    """Install the shipped objectives for ``role`` (``"serving"``,
+    ``"fleet"`` or ``"training"``) into ``engine``, skipping ids
+    already present (idempotent; user-replaced rules are never
+    clobbered). Returns how many rules were added."""
     if role == "serving":
         defaults = DEFAULT_SERVING_SLOS
+    elif role == "fleet":
+        defaults = DEFAULT_FLEET_SLOS
     elif role == "training":
         defaults = DEFAULT_TRAINING_SLOS
     else:

@@ -3,6 +3,8 @@ from analytics_zoo_tpu.parallel.mesh import (
     logical_sharding,
     shard_params,
     shard_batch,
+    place_inference_params,
+    replica_device_slices,
     DP_RULES,
     FSDP_RULES,
     TP_RULES,
@@ -13,6 +15,8 @@ __all__ = [
     "logical_sharding",
     "shard_params",
     "shard_batch",
+    "place_inference_params",
+    "replica_device_slices",
     "DP_RULES",
     "FSDP_RULES",
     "TP_RULES",

@@ -203,6 +203,56 @@ def shard_params_ep(params: Any, mesh: Mesh, axis: str = "expert",
     return jax.tree_util.tree_map_with_path(place, params)
 
 
+def replica_device_slices(n_replicas: int,
+                          devices_per_replica: int = 1,
+                          devices: Optional[Sequence] = None) -> list:
+    """Partition the host's devices into disjoint per-replica slices
+    for the serving fleet (`pipeline/inference/fleet.py`): replica i
+    owns ``devices[i*k : (i+1)*k]``. Raises when the host cannot seat
+    the fleet — a fleet silently time-slicing one chip would report
+    N× capacity it does not have."""
+    devs = list(devices) if devices is not None else jax.devices()
+    k = int(devices_per_replica)
+    need = int(n_replicas) * k
+    if k < 1 or n_replicas < 1:
+        raise ValueError("n_replicas and devices_per_replica must "
+                         "be >= 1")
+    if need > len(devs):
+        raise ValueError(
+            f"fleet needs {need} devices ({n_replicas} replicas x "
+            f"{k}) but the host has {len(devs)}")
+    return [tuple(devs[i * k:(i + 1) * k]) for i in range(n_replicas)]
+
+
+def place_inference_params(params: Any, devices: Sequence,
+                           mode: str = "auto",
+                           axis: str = "model") -> Any:
+    """Commit one inference replica's params to its device slice —
+    the mesh.py inference path used by ``ReplicaPool``.
+
+    A single device gets a committed single-device placement; a
+    multi-device slice gets a 1-D mesh over ``axis`` with the
+    Megatron column split (`auto_tp_sharding`) under ``mode="auto"``
+    / ``"tp"``, or full replication under ``mode="replicate"``.
+    Because the placement is *committed*, `InferenceModel.lower_for`
+    AOT-compiles the predict program onto exactly this slice and
+    GSPMD inserts the TP all-reduces — uncommitted (numpy) request
+    rows follow the params."""
+    devs = tuple(devices)
+    if not devs:
+        raise ValueError("empty device slice")
+    if len(devs) == 1:
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, devs[0]), params)
+    mesh = Mesh(np.asarray(devs), (axis,))
+    if mode == "replicate":
+        return shard_params(params, mesh)
+    if mode in ("auto", "tp"):
+        return shard_params_tp(params, mesh, axis=axis)
+    raise ValueError(f"unknown inference placement mode {mode!r} "
+                     f"(auto|tp|replicate)")
+
+
 def collect_ep_paths(model) -> set:
     """(layer_name, param_key) pairs of expert-stacked params, from
     each layer's ``expert_stacked_params`` declaration. Recurses into

@@ -1,9 +1,14 @@
 from analytics_zoo_tpu.pipeline.inference.batching import (
     DynamicBatcher)
+from analytics_zoo_tpu.pipeline.inference.fleet import (
+    FleetRouter, HttpReplica, Replica, ReplicaPool,
+    make_fleet_server)
 from analytics_zoo_tpu.pipeline.inference.inference_model import (
     InferenceModel)
 from analytics_zoo_tpu.pipeline.inference.serving import (
     InferenceServer, make_inference_server)
 
 __all__ = ["InferenceModel", "InferenceServer", "DynamicBatcher",
-           "make_inference_server"]
+           "make_inference_server",
+           "ReplicaPool", "Replica", "HttpReplica", "FleetRouter",
+           "make_fleet_server"]

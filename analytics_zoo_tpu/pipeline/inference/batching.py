@@ -147,7 +147,8 @@ class DynamicBatcher:
                  max_wait_ms: Optional[float] = None,
                  queue_depth: Optional[int] = None,
                  deadline_ms: Optional[float] = None,
-                 buckets: Optional[Sequence[int]] = None):
+                 buckets: Optional[Sequence[int]] = None,
+                 labels: Optional[dict] = None):
         env = os.environ
         if max_batch_size is None:
             max_batch_size = int(env.get(
@@ -182,6 +183,11 @@ class DynamicBatcher:
         self._unlowerable: set = set()  # sigs that failed to warm
         self._compile_lock = threading.Lock()
         self._model_gen = getattr(model, "generation", 0)
+        # optional metric labels (the serving fleet tags each
+        # replica's batcher with {"replica": name} so the shared
+        # gauge families stay per-queue; label-free children keep
+        # the exact pre-fleet exposition)
+        self._labels = dict(labels) if labels else None
         self._ema_batch_s = 0.01  # retry-after estimator seed
         # touch the gauges so /metrics carries them from the start
         self._depth_gauge().set(0)
@@ -198,15 +204,15 @@ class DynamicBatcher:
         return cls(model)
 
     # -- metrics handles ----------------------------------------------------
-    @staticmethod
-    def _depth_gauge():
+    def _depth_gauge(self):
         return obs.gauge("zoo_tpu_serving_queue_depth",
-                         help="requests waiting in the batcher queue")
+                         help="requests waiting in the batcher queue",
+                         labels=self._labels)
 
-    @staticmethod
-    def _warmed_gauge():
+    def _warmed_gauge(self):
         return obs.gauge("zoo_tpu_serving_warmed_buckets",
-                         help="bucket executables compiled and ready")
+                         help="bucket executables compiled and ready",
+                         labels=self._labels)
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "DynamicBatcher":
@@ -561,6 +567,15 @@ class DynamicBatcher:
     def warmed_buckets(self) -> int:
         with self._compile_lock:
             return len(self._compiled)
+
+    def retry_hint_s(self) -> float:
+        """The Retry-After estimate a ``QueueFullError`` raised right
+        now would carry (EMA batch execution time x queued entries).
+        The fleet router aggregates this across replicas to hint
+        clients when the whole fleet is saturated."""
+        with self._cond:
+            depth = len(self._q)
+        return max(0.05, depth * self._ema_batch_s)
 
     def stats(self) -> dict:
         """JSON-able summary for ``GET /health``."""

@@ -1,0 +1,1188 @@
+"""Replicated serving fleet: a router over N model replicas.
+
+The reference platform's headline serving capability was Cluster
+Serving — distributed inference served by a *fleet*, not one process
+(SURVEY §2.11, PAPER.md). This module is that front door for the TPU
+port: a :class:`ReplicaPool` owning N model replicas (one per device,
+or one per multi-device mesh slice for models too big for a chip —
+the `parallel/mesh.py` inference path), and a :class:`FleetRouter`
+dispatching requests across them::
+
+    clients ──HTTP──► front-end (serving.py)
+                          │ handle_predict
+                          ▼
+                     FleetRouter        least-outstanding-rows, or
+                      │  │  │           consistent-hash affinity
+              ┌───────┘  │  └───────┐
+              ▼          ▼          ▼
+          Replica r0  Replica r1  Replica r2     each: OWN
+          DynamicBatcher + InferenceModel        bucket ladder,
+          (devices[0])  (devices[1]) (dev[2])    OWN AOT warmup
+
+Design notes:
+
+* **Layering.** The router duck-types BOTH the model surface
+  (``predict`` / ``example_input_specs`` / ``concurrent_slots_free``)
+  and the batcher surface (``batchable`` / ``submit`` / ``stats`` /
+  ``start`` / ``stop``), so the existing front-ends serve a fleet
+  unchanged: ``InferenceServer(router, batcher=router)``. Each
+  replica keeps its own :class:`DynamicBatcher` — per-queue EMA,
+  per-queue ladder, per-queue warmup — the router only picks which
+  queue a request joins.
+* **Exactly-once for acked work.** ``submit`` returns a router-level
+  future. A replica that dies mid-request fails *its own* future;
+  the router then re-dispatches those rows to a sibling (bounded by
+  ``ZOO_TPU_FLEET_MAX_RETRIES``, the dead replica excluded). Rows
+  whose future already resolved are never re-executed — the router
+  future resolves exactly once.
+* **Lifecycle.** admitting → (failures ≥ ``ZOO_TPU_FLEET_EJECT_``
+  ``AFTER``) → down, with exponential-backoff re-admission probes;
+  or admitting → draining (stop admitting, flush in-flight, stop the
+  batcher) → drained → restart (re-warm; a model reload bumps
+  ``InferenceModel.generation`` so stale bucket executables drop).
+* **Backpressure.** One full replica queue just steers traffic to a
+  sibling. When EVERY admitting replica is full, the router raises
+  :class:`FleetSaturatedError` carrying the *minimum* Retry-After
+  EMA hint across the fleet — the shared ``handle_predict`` maps it
+  to HTTP 503 + ``Retry-After`` like any queue-full.
+* **Tracing.** Dispatch/retry spans join the ambient request trace
+  (``X-Zoo-Trace-Id``); in-process replicas inherit it through the
+  batcher's submit-time capture, HTTP replicas forward the header.
+
+Env config (read at construction; kwargs override — see
+docs/perf_flags.md):
+
+``ZOO_TPU_FLEET_REPLICAS``              fleet size (default: one per
+                                        device slice)
+``ZOO_TPU_FLEET_DEVICES_PER_REPLICA``   devices per mesh slice (1)
+``ZOO_TPU_FLEET_POLICY``                least_loaded | hash
+``ZOO_TPU_FLEET_MAX_RETRIES``           sibling retries (2)
+``ZOO_TPU_FLEET_EJECT_AFTER``           consecutive failures → down
+``ZOO_TPU_FLEET_BACKOFF_S``             first re-admission delay (1)
+``ZOO_TPU_FLEET_BACKOFF_MAX_S``         backoff ceiling (30)
+``ZOO_TPU_FLEET_PROBE_S``               health-prober interval (2;
+                                        <= 0 → manual ``tick()``)
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import json
+import os
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from analytics_zoo_tpu.common import diagnostics
+from analytics_zoo_tpu.common import observability as obs
+from analytics_zoo_tpu.common import tracing
+from analytics_zoo_tpu.common.nncontext import logger
+from analytics_zoo_tpu.pipeline.inference.batching import (
+    DeadlineExpiredError,
+    DynamicBatcher,
+    QueueFullError,
+)
+
+__all__ = [
+    "Replica",
+    "HttpReplica",
+    "ReplicaPool",
+    "ReplicaContext",
+    "FleetRouter",
+    "FleetSaturatedError",
+    "ReplicaUnavailableError",
+    "make_fleet_server",
+]
+
+# replica lifecycle states (fleet_status()/debug surfaces)
+STARTING = "starting"
+ADMITTING = "admitting"
+DRAINING = "draining"
+DRAINED = "drained"
+DOWN = "down"
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class FleetSaturatedError(QueueFullError):
+    """Every admitting replica's queue is at capacity. Subclasses
+    :class:`QueueFullError` so the shared ``handle_predict`` maps it
+    onto HTTP 503 + ``Retry-After`` unchanged; ``retry_after_s`` is
+    the MINIMUM EMA drain hint across the fleet (the soonest any
+    queue frees up)."""
+
+    def __init__(self, replicas: int, retry_after_s: float):
+        Exception.__init__(
+            self,
+            f"all {replicas} admitting replica queues are full; "
+            f"retry in ~{retry_after_s:.2f}s")
+        self.retry_after_s = retry_after_s
+        self.replicas = replicas
+
+
+class ReplicaUnavailableError(QueueFullError):
+    """The fleet has no admitting replica (all down or draining).
+    Also a 503 — capacity returns when backoff probes re-admit a
+    replica, so ``retry_after_s`` carries the soonest probe."""
+
+    def __init__(self, retry_after_s: float):
+        Exception.__init__(
+            self,
+            f"no admitting replica in the fleet; retry in "
+            f"~{retry_after_s:.2f}s")
+        self.retry_after_s = retry_after_s
+
+
+# -- metric handles (naming contract: docs/observability.md) ------------------
+
+def _g_admitting():
+    return obs.gauge("zoo_tpu_fleet_replicas_admitting",
+                     help="replicas currently accepting traffic")
+
+
+def _g_size():
+    return obs.gauge("zoo_tpu_fleet_replicas_total",
+                     help="replicas in the pool (any state)")
+
+
+def _g_up(name: str):
+    return obs.gauge("zoo_tpu_fleet_replica_up",
+                     help="1 while the replica admits traffic",
+                     labels={"replica": name})
+
+
+def _g_outstanding(name: str):
+    return obs.gauge("zoo_tpu_fleet_outstanding_rows",
+                     help="rows dispatched to the replica and not "
+                          "yet resolved",
+                     labels={"replica": name})
+
+
+def _c_dispatch(name: str):
+    return obs.counter("zoo_tpu_fleet_dispatches_total",
+                       help="requests dispatched, by replica",
+                       labels={"replica": name})
+
+
+def _c_requests():
+    return obs.counter("zoo_tpu_fleet_requests_total",
+                       help="requests entering the router")
+
+
+def _c_failed():
+    return obs.counter("zoo_tpu_fleet_requests_failed_total",
+                       help="router requests that ultimately failed")
+
+
+def _c_retries():
+    return obs.counter("zoo_tpu_fleet_retries_total",
+                       help="dispatches retried on a sibling replica")
+
+
+def _c_saturated():
+    return obs.counter("zoo_tpu_fleet_saturated_total",
+                       help="requests rejected with every replica "
+                            "queue full")
+
+
+def _c_ejections(name: str):
+    return obs.counter("zoo_tpu_fleet_ejections_total",
+                       help="replica ejections (marked down)",
+                       labels={"replica": name})
+
+
+def _c_readmissions(name: str):
+    return obs.counter("zoo_tpu_fleet_readmissions_total",
+                       help="replicas re-admitted after backoff",
+                       labels={"replica": name})
+
+
+class ReplicaContext:
+    """What a :class:`ReplicaPool` ``model_fn`` receives: the
+    replica's index, name, and the device slice it owns."""
+
+    def __init__(self, index: int, name: str, devices: Sequence):
+        self.index = int(index)
+        self.name = name
+        self.devices = tuple(devices)
+
+    def __repr__(self):
+        return (f"ReplicaContext({self.name}, "
+                f"devices={[str(d) for d in self.devices]})")
+
+
+class _ReplicaBase:
+    """Shared replica state machine + accounting. Subclasses provide
+    transport (`Replica` in-process, `HttpReplica` remote)."""
+
+    def __init__(self, name: str, clock: Callable[[], float]):
+        self.name = name
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.state = STARTING
+        self.down_reason: Optional[str] = None
+        self.outstanding_rows = 0
+        self.consecutive_failures = 0
+        self.failures_total = 0
+        self.dispatches_total = 0
+        self._backoff_base = _env_float("ZOO_TPU_FLEET_BACKOFF_S",
+                                        1.0)
+        self._backoff_max = _env_float("ZOO_TPU_FLEET_BACKOFF_MAX_S",
+                                       30.0)
+        self.backoff_s = self._backoff_base
+        self.next_probe_at = 0.0  # clock() time of next revival try
+        _g_outstanding(name).set(0)
+        _g_up(name).set(0)
+
+    # -- state ---------------------------------------------------------------
+    def admitting(self) -> bool:
+        with self._lock:
+            return self.state == ADMITTING
+
+    def _set_admitting(self):
+        with self._lock:
+            self.state = ADMITTING
+            self.down_reason = None
+            self.consecutive_failures = 0
+            self.backoff_s = self._backoff_base
+        _g_up(self.name).set(1)
+
+    def mark_down(self, reason: str,
+                  now: Optional[float] = None) -> bool:
+        """admitting/draining → down. Schedules the first revival
+        probe one backoff from now. Returns False when already
+        down."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            if self.state == DOWN:
+                return False
+            self.state = DOWN
+            self.down_reason = reason
+            self.next_probe_at = now + self.backoff_s
+        _g_up(self.name).set(0)
+        _c_ejections(self.name).inc()
+        diagnostics.anomaly("fleet_replica_down", replica=self.name,
+                            reason=reason)
+        logger.warning("fleet: replica %s marked down (%s)",
+                       self.name, reason)
+        return True
+
+    def backoff_bump(self, now: float):
+        """A revival probe failed: double the backoff (capped) and
+        schedule the next probe."""
+        with self._lock:
+            self.backoff_s = min(self.backoff_s * 2.0,
+                                 self._backoff_max)
+            self.next_probe_at = now + self.backoff_s
+
+    # -- accounting (router-driven) ------------------------------------------
+    def note_dispatch(self, rows: int):
+        with self._lock:
+            self.outstanding_rows += rows
+            self.dispatches_total += 1
+            out = self.outstanding_rows
+        _g_outstanding(self.name).set(out)
+        _c_dispatch(self.name).inc()
+
+    def note_done(self, rows: int):
+        with self._lock:
+            self.outstanding_rows = max(
+                0, self.outstanding_rows - rows)
+            out = self.outstanding_rows
+        _g_outstanding(self.name).set(out)
+
+    def note_success(self):
+        with self._lock:
+            self.consecutive_failures = 0
+
+    def note_failure(self) -> int:
+        """Count one dispatch failure; returns the consecutive-failure
+        count (the router ejects past its threshold)."""
+        with self._lock:
+            self.consecutive_failures += 1
+            self.failures_total += 1
+            return self.consecutive_failures
+
+    # -- introspection -------------------------------------------------------
+    def status(self) -> dict:
+        with self._lock:
+            st = {
+                "name": self.name,
+                "state": self.state,
+                "outstanding_rows": self.outstanding_rows,
+                "consecutive_failures": self.consecutive_failures,
+                "failures_total": self.failures_total,
+                "dispatches_total": self.dispatches_total,
+                "backoff_s": self.backoff_s,
+            }
+            if self.down_reason:
+                st["down_reason"] = self.down_reason
+        st["batcher"] = self.batcher_stats()
+        return st
+
+    # -- transport surface (subclass responsibility) -------------------------
+    def start(self):
+        raise NotImplementedError
+
+    def stop(self):
+        raise NotImplementedError
+
+    def batchable(self, xs) -> bool:
+        raise NotImplementedError
+
+    def submit(self, xs) -> "Future":
+        raise NotImplementedError
+
+    def predict(self, inputs, timeout_ms: int = -1):
+        raise NotImplementedError
+
+    def probe(self) -> bool:
+        raise NotImplementedError
+
+    def retry_hint_s(self) -> float:
+        return 0.05
+
+    def batcher_stats(self) -> dict:
+        return {"enabled": False}
+
+    def slots_free(self) -> int:
+        return 1
+
+    def concurrency(self) -> int:
+        return 1
+
+    def input_specs(self):
+        return None
+
+
+class Replica(_ReplicaBase):
+    """One in-process replica: a model (usually an
+    :class:`InferenceModel` with params committed to this replica's
+    device slice) plus its OWN :class:`DynamicBatcher` — own bounded
+    queue, own bucket ladder, own AOT warmup, gauges labelled
+    ``{replica=<name>}``."""
+
+    def __init__(self, name: str, model, batcher="auto",
+                 batcher_kwargs: Optional[dict] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        super().__init__(name, clock)
+        self.model = model
+        if batcher == "auto":
+            if os.environ.get("ZOO_TPU_SERVING_BATCH", "1") == "0":
+                self.batcher = None
+            else:
+                kw = dict(batcher_kwargs or {})
+                kw.setdefault("labels", {"replica": name})
+                self.batcher = DynamicBatcher(model, **kw)
+        else:
+            self.batcher = batcher
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "Replica":
+        """Warm the bucket ladder and begin admitting. Idempotent."""
+        if self.batcher is not None:
+            self.batcher.start()
+        self._set_admitting()
+        return self
+
+    def stop(self):
+        if self.batcher is not None:
+            self.batcher.stop()
+        with self._lock:
+            self.state = DOWN
+            self.down_reason = "stopped"
+        _g_up(self.name).set(0)
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Graceful drain: stop admitting (the router skips
+        non-admitting replicas), flush everything in flight (the
+        batcher executes its queued entries before its dispatcher
+        exits), then park in ``drained``. Returns True when fully
+        flushed within ``timeout`` (wall clock — draining waits on
+        real threads)."""
+        with self._lock:
+            if self.state == DOWN:
+                return True
+            self.state = DRAINING
+        _g_up(self.name).set(0)
+        deadline = time.monotonic() + timeout
+        if self.batcher is not None:
+            self.batcher.stop(timeout=timeout)
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self.outstanding_rows == 0:
+                    break
+            time.sleep(0.005)
+        with self._lock:
+            flushed = self.outstanding_rows == 0
+            self.state = DRAINED
+        obs.event("fleet/drained", replica=self.name,
+                  flushed=flushed)
+        return flushed
+
+    def restart(self) -> "Replica":
+        """Bring a drained replica back: restart the batcher (its
+        bucket cache re-validates against ``model.generation``, so a
+        reload in between serves fresh executables) and resume
+        admitting."""
+        if self.batcher is not None:
+            self.batcher.start()
+        self._set_admitting()
+        return self
+
+    # -- transport -----------------------------------------------------------
+    def batchable(self, xs) -> bool:
+        return self.batcher is not None and self.batcher.batchable(xs)
+
+    def submit(self, xs) -> "Future":
+        return self.batcher.submit(xs)
+
+    def predict(self, inputs, timeout_ms: int = -1):
+        if timeout_ms is not None and timeout_ms > 0:
+            return self.model.predict(inputs,
+                                      timeout_ms=timeout_ms)
+        return self.model.predict(inputs)
+
+    def probe(self) -> bool:
+        """One predict at the declared example shape through the
+        per-request path (bypasses the batcher queue; AOT-compiled
+        models only accept that exact shape) to prove the replica
+        serves again before re-admission."""
+        try:
+            specs = getattr(self.model, "example_input_specs", None)
+            if specs:
+                xs = [np.zeros(tuple(shape), np.dtype(dt))
+                      for shape, dt in specs]
+                self.model.predict(xs if len(xs) > 1 else xs[0])
+            return True
+        except Exception as e:
+            logger.info("fleet: probe failed on %s: %s",
+                        self.name, e)
+            return False
+
+    def retry_hint_s(self) -> float:
+        if self.batcher is not None:
+            return self.batcher.retry_hint_s()
+        return 0.05
+
+    def batcher_stats(self) -> dict:
+        if self.batcher is None:
+            return {"enabled": False}
+        return self.batcher.stats()
+
+    def slots_free(self) -> int:
+        return int(getattr(self.model, "concurrent_slots_free", 1))
+
+    def concurrency(self) -> int:
+        return int(getattr(self.model,
+                           "supported_concurrent_num", 1))
+
+    def input_specs(self):
+        return getattr(self.model, "example_input_specs", None)
+
+
+class HttpReplica(_ReplicaBase):
+    """A replica living in another process behind the standard HTTP
+    front-end (the Cluster-Serving shape: router node + worker
+    nodes). ``submit`` POSTs ``/predict`` with the ambient trace id
+    in ``X-Zoo-Trace-Id`` so one trace id spans router dispatch →
+    remote queue/pad/execute; remote 503/504 map back onto
+    :class:`QueueFullError` / :class:`DeadlineExpiredError` and ride
+    the same retry/backpressure paths as in-process replicas.
+
+    JSON carries no dtype, so remote replicas serve single-output
+    float32 models; heterogeneous fleets should keep int-input
+    models in-process."""
+
+    def __init__(self, url: str, name: Optional[str] = None,
+                 timeout_s: float = 30.0, workers: int = 4,
+                 clock: Callable[[], float] = time.monotonic):
+        self.url = url.rstrip("/")
+        if name is None:
+            name = self.url.split("//", 1)[-1].replace(
+                "/", "_").replace(":", "_")
+        super().__init__(name, clock)
+        self.timeout_s = float(timeout_s)
+        self._workers = int(workers)
+        self._pool: Optional[ThreadPoolExecutor] = None
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "HttpReplica":
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=self._workers,
+                thread_name_prefix=f"zoo-fleet-{self.name}")
+        self._set_admitting()
+        return self
+
+    def stop(self):
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+        with self._lock:
+            self.state = DOWN
+            self.down_reason = "stopped"
+        _g_up(self.name).set(0)
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        with self._lock:
+            if self.state == DOWN:
+                return True
+            self.state = DRAINING
+        _g_up(self.name).set(0)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self.outstanding_rows == 0:
+                    break
+            time.sleep(0.005)
+        with self._lock:
+            flushed = self.outstanding_rows == 0
+            self.state = DRAINED
+        return flushed
+
+    def restart(self) -> "HttpReplica":
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=self._workers,
+                thread_name_prefix=f"zoo-fleet-{self.name}")
+        self._set_admitting()
+        return self
+
+    # -- transport -----------------------------------------------------------
+    def batchable(self, xs) -> bool:
+        # the remote front-end re-batches for itself; anything
+        # row-aligned can ride the future path
+        if not xs or not all(isinstance(x, np.ndarray)
+                             and x.ndim >= 1 for x in xs):
+            return False
+        n = xs[0].shape[0]
+        return n >= 1 and all(x.shape[0] == n for x in xs)
+
+    def submit(self, xs) -> "Future":
+        ctx = tracing.current()  # forwarded as X-Zoo-Trace-Id
+        return self._pool.submit(self._post_predict, list(xs), ctx)
+
+    def predict(self, inputs, timeout_ms: int = -1):
+        xs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        return self._post_predict([np.asarray(x) for x in xs],
+                                  tracing.current())
+
+    def _post_predict(self, xs, ctx):
+        import urllib.error
+        import urllib.request
+        if len(xs) == 1:
+            inputs = xs[0].tolist()
+        else:
+            inputs = [{"data": x.tolist()} for x in xs]
+        body = json.dumps({"inputs": inputs}).encode()
+        req = urllib.request.Request(
+            self.url + "/predict", data=body,
+            headers={"Content-Type": "application/json"})
+        if ctx is not None:
+            req.add_header(tracing.TRACE_HEADER, ctx[0])
+        t0 = time.time()
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=self.timeout_s) as resp:
+                payload = json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            detail = {}
+            try:
+                detail = json.loads(e.read()).get("error", {})
+            except (ValueError, OSError):
+                pass
+            if e.code == 503:
+                raise QueueFullError(
+                    0, float(detail.get("retry_after_s", 1.0)))
+            if e.code == 504:
+                raise DeadlineExpiredError(
+                    detail.get("message", "remote deadline expired"))
+            raise RuntimeError(
+                f"replica {self.name} HTTP {e.code}: "
+                f"{detail.get('message', '')}")
+        tracing.record_span(ctx, "fleet/remote_predict", t0,
+                            time.time() - t0, replica=self.name)
+        out = payload["outputs"]
+        return np.asarray(out, np.float32)
+
+    def probe(self) -> bool:
+        import urllib.request
+        try:
+            with urllib.request.urlopen(
+                    self.url + "/health", timeout=5.0) as resp:
+                return json.loads(
+                    resp.read()).get("status") == "ok"
+        except Exception:
+            return False
+
+    def batcher_stats(self) -> dict:
+        return {"enabled": False, "remote": self.url}
+
+    def concurrency(self) -> int:
+        return self._workers
+
+
+class ReplicaPool:
+    """Owns the fleet's replicas. Either wrap pre-built replicas
+    (``ReplicaPool(replicas=[...])`` — mixed in-process/HTTP fleets
+    are fine) or give a factory ``model_fn(ctx: ReplicaContext)``
+    that builds one model per device slice; the pool then carves
+    ``jax.devices()`` into ``n_replicas`` disjoint slices of
+    ``devices_per_replica`` each (`parallel.replica_device_slices`)
+    and wraps each model in a :class:`Replica`."""
+
+    def __init__(self, model_fn: Optional[Callable] = None,
+                 replicas: Optional[Sequence[_ReplicaBase]] = None,
+                 n_replicas: Optional[int] = None,
+                 devices_per_replica: Optional[int] = None,
+                 devices: Optional[Sequence] = None,
+                 batcher="auto",
+                 batcher_kwargs: Optional[dict] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.clock = clock
+        if replicas is not None:
+            if model_fn is not None:
+                raise ValueError(
+                    "pass model_fn OR replicas, not both")
+            self.replicas = list(replicas)
+        else:
+            if model_fn is None:
+                raise ValueError("need model_fn or replicas")
+            from analytics_zoo_tpu.parallel.mesh import \
+                replica_device_slices
+            if devices is None:
+                import jax
+                devices = jax.devices()
+            k = devices_per_replica or _env_int(
+                "ZOO_TPU_FLEET_DEVICES_PER_REPLICA", 1)
+            n = n_replicas or _env_int("ZOO_TPU_FLEET_REPLICAS", 0) \
+                or len(devices) // k
+            slices = replica_device_slices(n, k, devices)
+            self.replicas = []
+            for i, sl in enumerate(slices):
+                ctx = ReplicaContext(i, f"r{i}", sl)
+                self.replicas.append(Replica(
+                    ctx.name, model_fn(ctx), batcher=batcher,
+                    batcher_kwargs=batcher_kwargs, clock=clock))
+        if not self.replicas:
+            raise ValueError("empty replica pool")
+        names = [r.name for r in self.replicas]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate replica names: {names}")
+
+    @classmethod
+    def for_keras(cls, net, params=None,
+                  example_inputs: Optional[Sequence] = None,
+                  n_replicas: Optional[int] = None,
+                  devices_per_replica: Optional[int] = None,
+                  sharding: str = "auto",
+                  devices: Optional[Sequence] = None,
+                  concurrency: int = 1,
+                  batcher="auto",
+                  batcher_kwargs: Optional[dict] = None,
+                  clock: Callable[[], float] = time.monotonic
+                  ) -> "ReplicaPool":
+        """N replicas of one in-memory KerasNet. Each replica's
+        params are committed to its device slice
+        (`parallel.place_inference_params`): a 1-device slice pins
+        them to that device; a k-device slice builds a 1-D "model"
+        mesh and applies the Megatron column split (``sharding="tp"``
+        / ``"auto"``) or full replication (``"replicate"``). Because
+        committed params steer jit placement, each replica's
+        ``lower_for`` AOT-compiles its whole bucket ladder onto its
+        own slice — N independent executables, no time-slicing."""
+        from analytics_zoo_tpu.parallel.mesh import \
+            place_inference_params
+        from analytics_zoo_tpu.pipeline.inference.inference_model \
+            import InferenceModel
+        if params is None:
+            try:
+                est = net.estimator
+                if est.params is None:
+                    est._ensure_initialized()
+                params = est.params
+            except RuntimeError:
+                # uncompiled net (inference-only): fresh init params
+                params = net.init_params()
+
+        def model_fn(ctx: ReplicaContext):
+            placed = place_inference_params(params, ctx.devices,
+                                            mode=sharding)
+            im = InferenceModel(supported_concurrent_num=concurrency)
+            im.load_keras_net(net, params=placed,
+                              example_inputs=example_inputs)
+            return im
+
+        return cls(model_fn, n_replicas=n_replicas,
+                   devices_per_replica=devices_per_replica,
+                   devices=devices, batcher=batcher,
+                   batcher_kwargs=batcher_kwargs, clock=clock)
+
+    def start(self) -> "ReplicaPool":
+        for r in self.replicas:
+            r.start()
+        _g_size().set(len(self.replicas))
+        return self
+
+    def stop(self):
+        for r in self.replicas:
+            try:
+                r.stop()
+            except Exception as e:
+                logger.warning("fleet: stopping %s failed: %s",
+                               r.name, e)
+
+    def __len__(self):
+        return len(self.replicas)
+
+    def __repr__(self):
+        states = {r.name: r.state for r in self.replicas}
+        return f"ReplicaPool({states})"
+
+
+class FleetRouter:
+    """The fleet's front door. Duck-types the model AND batcher
+    surfaces the HTTP front-ends expect, so
+    ``make_inference_server(router)`` serves the whole fleet (the
+    front-ends auto-use a router as its own batcher).
+
+    Dispatch: ``policy="least_loaded"`` picks the admitting replica
+    with the fewest outstanding rows (ties round-robin);
+    ``policy="hash"`` routes by consistent hash over a virtual-node
+    ring — same payload (or explicit ``key=``) lands on the same
+    replica while it stays admitting (cache-warm affinity), walking
+    the ring past down replicas."""
+
+    def __init__(self, pool: ReplicaPool,
+                 policy: Optional[str] = None,
+                 max_retries: Optional[int] = None,
+                 eject_after: Optional[int] = None,
+                 probe_interval_s: Optional[float] = None,
+                 vnodes: int = 64):
+        self.pool = pool
+        self.policy = policy or os.environ.get(
+            "ZOO_TPU_FLEET_POLICY", "least_loaded")
+        if self.policy not in ("least_loaded", "hash"):
+            raise ValueError(
+                f"unknown fleet policy {self.policy!r} "
+                f"(least_loaded|hash)")
+        self.max_retries = (max_retries if max_retries is not None
+                            else _env_int("ZOO_TPU_FLEET_MAX_RETRIES",
+                                          2))
+        self.eject_after = (eject_after if eject_after is not None
+                            else _env_int("ZOO_TPU_FLEET_EJECT_AFTER",
+                                          3))
+        self.probe_interval_s = (
+            probe_interval_s if probe_interval_s is not None
+            else _env_float("ZOO_TPU_FLEET_PROBE_S", 2.0))
+        self._clock = pool.clock
+        self._rr = 0  # least-loaded tie-breaker
+        self._rr_lock = threading.Lock()
+        self._ring = self._build_ring(vnodes)
+        self._prober: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
+
+    # -- model-ish surface (serving.py duck-typing) --------------------------
+    @property
+    def example_input_specs(self):
+        for r in self.pool.replicas:
+            specs = r.input_specs()
+            if specs:
+                return specs
+        return None
+
+    @property
+    def concurrent_slots_free(self) -> int:
+        return sum(r.slots_free() for r in self.pool.replicas
+                   if r.admitting())
+
+    @property
+    def supported_concurrent_num(self) -> int:
+        return max(1, sum(r.concurrency()
+                          for r in self.pool.replicas))
+
+    def predict(self, inputs, timeout_ms: int = -1):
+        """Per-request path (inputs the batcher cannot coalesce):
+        synchronous dispatch with the same sibling-retry and
+        failure-accounting semantics as :meth:`submit`."""
+        _c_requests().inc()
+        tried: set = set()
+        last_exc: Optional[Exception] = None
+        for attempt in range(self.max_retries + 1):
+            r = self._pick(rows=1, key=None, exclude=tried)
+            if r is None:
+                break
+            try:
+                with obs.span("fleet/dispatch", replica=r.name,
+                              attempt=attempt, path="predict"):
+                    r.note_dispatch(1)
+                    try:
+                        out = r.predict(inputs,
+                                        timeout_ms=timeout_ms)
+                    finally:
+                        r.note_done(1)
+                r.note_success()
+                return out
+            except (QueueFullError, DeadlineExpiredError):
+                raise  # backpressure/deadline: not a replica fault
+            except Exception as e:
+                last_exc = e
+                tried.add(r.name)
+                self._note_replica_failure(r, e)
+                if attempt < self.max_retries:
+                    _c_retries().inc()
+        _c_failed().inc()
+        if last_exc is not None:
+            raise last_exc
+        raise ReplicaUnavailableError(self._soonest_probe_s())
+
+    # -- batcher-ish surface -------------------------------------------------
+    def batchable(self, xs) -> bool:
+        for r in self.pool.replicas:
+            if r.admitting():
+                return r.batchable(xs)
+        return False
+
+    def submit(self, xs, key: Optional[bytes] = None) -> "Future":
+        """Dispatch one row-aligned request to a replica's batcher.
+        Returns a ROUTER-level future: replica death mid-request
+        re-dispatches the rows to a sibling (never a row whose
+        future already resolved), bounded retries, then the failure
+        surfaces. Fleet-wide saturation resolves the future with
+        :class:`FleetSaturatedError` (HTTP 503 + min Retry-After)."""
+        xs = [np.asarray(x) for x in xs]
+        if not self.batchable(xs):
+            raise ValueError(
+                "inputs are not row-aligned (every input needs the "
+                "same leading dimension >= 1)")
+        _c_requests().inc()
+        fut: "Future" = Future()
+        if key is None and self.policy == "hash":
+            key = self._affinity_key(xs)
+        self._dispatch(xs, xs[0].shape[0], fut, key, attempt=0,
+                       exclude=frozenset(), ctx=tracing.current())
+        return fut
+
+    def stats(self) -> dict:
+        """Aggregate ``/health`` "batcher" block: fleet totals plus
+        per-replica queue state."""
+        per = {r.name: r.batcher_stats()
+               for r in self.pool.replicas}
+        return {
+            "enabled": True,
+            "fleet": True,
+            "replicas_total": len(self.pool),
+            "replicas_admitting": sum(
+                1 for r in self.pool.replicas if r.admitting()),
+            "queue_depth": sum(p.get("queue_depth", 0)
+                               for p in per.values()),
+            "queue_capacity": sum(p.get("queue_capacity", 0)
+                                  for p in per.values()),
+            "per_replica": per,
+        }
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "FleetRouter":
+        """Start every replica (each warms its own ladder), then the
+        health prober (``ZOO_TPU_FLEET_PROBE_S <= 0`` → no thread;
+        drive :meth:`tick` manually)."""
+        self.pool.start()
+        self._refresh_gauges()
+        if self.probe_interval_s > 0 and self._prober is None:
+            self._stop_evt.clear()
+            self._prober = threading.Thread(
+                target=self._probe_loop, name="zoo-fleet-prober",
+                daemon=True)
+            self._prober.start()
+        return self
+
+    def stop(self):
+        self._stop_evt.set()
+        if self._prober is not None:
+            self._prober.join(timeout=5)
+            self._prober = None
+        self.pool.stop()
+        self._refresh_gauges()
+
+    def _probe_loop(self):
+        while not self._stop_evt.wait(self.probe_interval_s):
+            try:
+                self.tick()
+            except Exception as e:  # prober must not die
+                logger.warning("fleet prober: %s", e)
+
+    def tick(self, now: Optional[float] = None) -> dict:
+        """One health pass: try to revive replicas whose backoff
+        expired (probe, then re-admit or double the backoff). Called
+        by the prober thread, or manually from tests/smokes with an
+        injected ``now``. Returns :meth:`fleet_status`."""
+        now = self._clock() if now is None else now
+        for r in self.pool.replicas:
+            with r._lock:
+                due = (r.state == DOWN
+                       and r.down_reason != "stopped"
+                       and r.next_probe_at <= now)
+            if not due:
+                continue
+            if r.probe():
+                try:
+                    r.restart()
+                except Exception as e:
+                    logger.warning(
+                        "fleet: restart of %s failed: %s",
+                        r.name, e)
+                    r.backoff_bump(now)
+                    continue
+                _c_readmissions(r.name).inc()
+                obs.event("fleet/readmitted", replica=r.name)
+                logger.info("fleet: replica %s re-admitted",
+                            r.name)
+            else:
+                r.backoff_bump(now)
+        self._refresh_gauges()
+        return self.fleet_status()
+
+    def drain(self, name: str, timeout: float = 30.0) -> bool:
+        """Gracefully drain one replica by name (stop admitting,
+        flush in-flight, stop its batcher). Pair with
+        ``restart_replica`` to complete a rolling reload."""
+        r = self._replica(name)
+        ok = r.drain(timeout=timeout)
+        self._refresh_gauges()
+        return ok
+
+    def restart_replica(self, name: str):
+        """Re-admit a drained replica (re-warms its ladder; a model
+        reload in between is picked up via
+        ``InferenceModel.generation``)."""
+        r = self._replica(name)
+        r.restart()
+        self._refresh_gauges()
+        return r
+
+    def _replica(self, name: str) -> _ReplicaBase:
+        for r in self.pool.replicas:
+            if r.name == name:
+                return r
+        raise KeyError(f"no replica named {name!r}")
+
+    # -- dispatch ------------------------------------------------------------
+    def _affinity_key(self, xs) -> bytes:
+        """Deterministic content key for hash routing: shapes, dtypes
+        and a bounded byte prefix of each input — identical payloads
+        land on the same replica (cache-warm affinity)."""
+        h = hashlib.blake2b(digest_size=8)
+        for x in xs:
+            h.update(str(x.shape).encode())
+            h.update(str(x.dtype).encode())
+            h.update(x.tobytes()[:1024])
+        return h.digest()
+
+    def _build_ring(self, vnodes: int):
+        ring = []
+        for r in self.pool.replicas:
+            for v in range(vnodes):
+                hv = int.from_bytes(
+                    hashlib.blake2b(
+                        f"{r.name}#{v}".encode(),
+                        digest_size=8).digest(), "big")
+                ring.append((hv, r))
+        ring.sort(key=lambda t: t[0])
+        self._ring_keys = [t[0] for t in ring]
+        return ring
+
+    def _pick_hash(self, key: bytes,
+                   exclude: set) -> Optional[_ReplicaBase]:
+        if not self._ring:
+            return None
+        hv = int.from_bytes(
+            hashlib.blake2b(key, digest_size=8).digest(), "big")
+        start = bisect.bisect_left(self._ring_keys, hv)
+        n = len(self._ring)
+        seen: set = set()
+        for i in range(n):
+            _, r = self._ring[(start + i) % n]
+            if r.name in seen:
+                continue
+            seen.add(r.name)
+            if r.name not in exclude and r.admitting():
+                return r
+        return None
+
+    def _pick(self, rows: int, key: Optional[bytes],
+              exclude: set) -> Optional[_ReplicaBase]:
+        if key is not None:
+            return self._pick_hash(key, exclude)
+        cands = [r for r in self.pool.replicas
+                 if r.admitting() and r.name not in exclude]
+        if not cands:
+            return None
+        lo = min(r.outstanding_rows for r in cands)
+        ties = [r for r in cands if r.outstanding_rows == lo]
+        with self._rr_lock:
+            self._rr += 1
+            return ties[self._rr % len(ties)]
+
+    def _soonest_probe_s(self) -> float:
+        """Retry hint when nothing admits: time to the next revival
+        probe (floor 0.05s)."""
+        now = self._clock()
+        waits = [max(0.05, r.next_probe_at - now)
+                 for r in self.pool.replicas if r.state == DOWN]
+        return min(waits) if waits else 1.0
+
+    def _dispatch(self, xs, rows, fut, key, attempt, exclude, ctx):
+        """Pick a replica and hand it the rows; on synchronous
+        queue-full try the next one; when every admitting replica is
+        full resolve with the fleet-level 503 (min EMA hint)."""
+        tried = set(exclude)
+        busy_hints = []
+        while True:
+            r = self._pick(rows, key, tried)
+            if r is None:
+                if busy_hints:
+                    _c_saturated().inc()
+                    _c_failed().inc()
+                    self._fail(fut, FleetSaturatedError(
+                        len(busy_hints), min(busy_hints)))
+                else:
+                    _c_failed().inc()
+                    self._fail(fut, ReplicaUnavailableError(
+                        self._soonest_probe_s()))
+                return
+            t0 = time.time()
+            try:
+                inner = r.submit(xs)
+            except QueueFullError as e:
+                busy_hints.append(e.retry_after_s)
+                tried.add(r.name)
+                continue
+            except Exception as e:  # broke at admission
+                tried.add(r.name)
+                self._note_replica_failure(r, e)
+                continue
+            r.note_dispatch(rows)
+            tracing.record_span(
+                ctx, "fleet/dispatch", t0, time.time() - t0,
+                replica=r.name, rows=rows, attempt=attempt)
+            inner.add_done_callback(
+                lambda f, r=r: self._on_replica_done(
+                    r, f, xs, rows, fut, key, attempt, exclude,
+                    ctx))
+            return
+
+    def _on_replica_done(self, r, inner, xs, rows, fut, key,
+                         attempt, exclude, ctx):
+        """Replica future resolved (dispatcher/executor thread).
+        Success propagates; deadline expiry propagates (request-
+        level, not a replica fault); queue-full retries a sibling
+        without failure accounting; anything else counts against the
+        replica (ejection past the threshold) and re-dispatches the
+        rows on a sibling — the router future resolves exactly once,
+        so acked work is never re-executed."""
+        r.note_done(rows)
+        exc = inner.exception()
+        if exc is None:
+            r.note_success()
+            self._resolve(fut, inner.result())
+            return
+        if isinstance(exc, DeadlineExpiredError):
+            _c_failed().inc()
+            self._fail(fut, exc)
+            return
+        is_busy = isinstance(exc, QueueFullError)
+        if not is_busy:
+            self._note_replica_failure(r, exc)
+        if attempt >= self.max_retries:
+            _c_failed().inc()
+            self._fail(fut, exc)
+            return
+        _c_retries().inc()
+        tracing.record_span(ctx, "fleet/retry", time.time(), 0.0,
+                            replica=r.name, rows=rows,
+                            attempt=attempt + 1,
+                            error=type(exc).__name__)
+        with tracing.activate(ctx):
+            self._dispatch(xs, rows, fut, key, attempt + 1,
+                           set(exclude) | {r.name}, ctx)
+
+    def _note_replica_failure(self, r, exc):
+        fails = r.note_failure()
+        logger.warning("fleet: dispatch to %s failed (%s: %s), "
+                       "consecutive=%d", r.name,
+                       type(exc).__name__, exc, fails)
+        if fails >= self.eject_after and r.admitting():
+            r.mark_down(f"{type(exc).__name__}: {exc}",
+                        now=self._clock())
+            self._refresh_gauges()
+
+    @staticmethod
+    def _resolve(fut, value):
+        try:
+            fut.set_result(value)
+        except Exception:
+            pass  # already resolved (defensive; single-dispatch)
+
+    @staticmethod
+    def _fail(fut, exc):
+        try:
+            fut.set_exception(exc)
+        except Exception:
+            pass
+
+    # -- introspection -------------------------------------------------------
+    def _refresh_gauges(self):
+        _g_admitting().set(sum(
+            1 for r in self.pool.replicas if r.admitting()))
+        _g_size().set(len(self.pool))
+
+    def fleet_status(self) -> dict:
+        """JSON-able fleet topology + lifecycle state — the
+        ``GET /debug/fleet`` payload."""
+        return {
+            "policy": self.policy,
+            "max_retries": self.max_retries,
+            "eject_after": self.eject_after,
+            "probe_interval_s": self.probe_interval_s,
+            "replicas_admitting": sum(
+                1 for r in self.pool.replicas if r.admitting()),
+            "replicas": [r.status() for r in self.pool.replicas],
+        }
+
+    def __repr__(self):
+        return (f"FleetRouter(policy={self.policy}, "
+                f"replicas={len(self.pool)})")
+
+
+def make_fleet_server(pool_or_router, port: int = 0,
+                      prefer_native: bool = True):
+    """Serve a fleet behind the standard front-ends: wraps a
+    :class:`ReplicaPool` in a :class:`FleetRouter` (pass a router to
+    choose policy/retries) and mounts it as both the model and the
+    batcher — ``/predict``, ``/health``, ``/metrics``,
+    ``/debug/fleet`` and friends all work (docs/serving.md)."""
+    from analytics_zoo_tpu.pipeline.inference.serving import \
+        make_inference_server
+    router = pool_or_router
+    if isinstance(router, ReplicaPool):
+        router = FleetRouter(router)
+    return make_inference_server(router, port=port,
+                                 prefer_native=prefer_native,
+                                 batcher=router)

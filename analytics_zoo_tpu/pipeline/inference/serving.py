@@ -14,6 +14,9 @@ GET  /debug/slo[?tick=0]  →  live SLO status (docs/slo.md): shipped
      serving objectives (p99 latency, error burn rate, queue depth)
      are installed at server start; the engine re-evaluates on each
      request unless ``tick=0``
+GET  /debug/fleet  →  fleet topology + per-replica lifecycle state
+     when a FleetRouter fronts this server (docs/serving.md fleet
+     section); 404 on single-model servers
 POST /debug/profile {"dir": ..., "ms": 500}  →  on-demand jax.profiler
      capture written to ``dir`` (one at a time; 503 while busy)
 
@@ -205,6 +208,20 @@ _profile_lock = threading.Lock()
 _profile_thread: "Optional[threading.Thread]" = None
 
 
+def _fleet_payload(batcher) -> "Tuple[int, dict]":
+    """``GET /debug/fleet``: topology + per-replica lifecycle state
+    (state machine, outstanding rows, failure counts, per-queue
+    batcher stats) when a ``FleetRouter`` fronts this server.
+    Single-model servers 404 — the route's presence is how clients
+    discover they are talking to a fleet."""
+    status_fn = getattr(batcher, "fleet_status", None)
+    if status_fn is None:
+        _count_error("not_found")
+        return 404, _error_body(
+            404, "no fleet router mounted on this server")
+    return 200, status_fn()
+
+
 def _profiler_capture(out_dir: str, ms: float):
     """Capture ``ms`` milliseconds of jax.profiler trace into
     ``out_dir`` (module-level so tests can stub it)."""
@@ -266,8 +283,13 @@ def handle_profile(body: bytes) -> "Tuple[int, dict]":
 def _resolve_batcher(model: InferenceModel, batcher):
     """``"auto"`` → env-configured batcher (None when
     ``ZOO_TPU_SERVING_BATCH=0``); explicit ``None`` → per-request
-    serving; a DynamicBatcher instance passes through."""
+    serving; a DynamicBatcher instance passes through. A
+    ``FleetRouter`` passed as the *model* is its own batcher (it
+    duck-types both surfaces — `pipeline/inference/fleet.py`), so
+    ``make_inference_server(router)`` just works."""
     if batcher == "auto":
+        if hasattr(model, "fleet_status"):
+            return model
         return DynamicBatcher.from_env(model)
     return batcher
 
@@ -331,6 +353,9 @@ class InferenceServer:
                     elif route == "/debug/slo":
                         status = 200
                         payload = _slo_payload(self.path)
+                    elif route == "/debug/fleet":
+                        status, payload = _fleet_payload(
+                            server.batcher)
                     else:
                         status = 404
                         _count_error("not_found")
@@ -409,8 +434,11 @@ class InferenceServer:
         if self.batcher is not None:
             self.batcher.start()
         # shipped serving objectives + background evaluation ticker
-        # (docs/slo.md; ZOO_TPU_SLO=0 disables)
+        # (docs/slo.md; ZOO_TPU_SLO=0 disables); a fleet front door
+        # adds the fleet-level objectives on top
         slo_lib.ensure_default_slos("serving")
+        if hasattr(self.batcher, "fleet_status"):
+            slo_lib.ensure_default_slos("fleet")
         if background:
             self._thread = threading.Thread(
                 target=self._httpd.serve_forever, daemon=True)
@@ -472,6 +500,9 @@ class NativeInferenceServer:
             elif route == "/debug/slo":
                 status = 200
                 out = json.dumps(_slo_payload(path)).encode()
+            elif route == "/debug/fleet":
+                status, payload = _fleet_payload(self.batcher)
+                out = json.dumps(payload).encode()
             elif route == "/debug/profile":
                 status, payload = handle_profile(body)
                 out = json.dumps(payload).encode()
@@ -533,6 +564,8 @@ class NativeInferenceServer:
         if self.batcher is not None:
             self.batcher.start()
         slo_lib.ensure_default_slos("serving")
+        if hasattr(self.batcher, "fleet_status"):
+            slo_lib.ensure_default_slos("fleet")
         self._srv.set_health(json.dumps(
             _health_payload(self.model, self.batcher)))
         for _ in range(self._workers):

@@ -22,6 +22,18 @@ null headline can never be mistaken for chip perf). ``extra_metrics``
 carries the unbatched counterpart, the latency percentiles for both
 modes, and the speedup — the acceptance gate is >= 2x throughput with
 >= 8 clients and batched p99 <= unbatched p99 + max_wait_ms.
+
+``--replicas N`` switches to the FLEET A/B sweep instead: the same
+closed-loop load against a 1-replica fleet and an N-replica fleet
+(`pipeline/inference/fleet.py`; one virtual host device per replica,
+forced via ``--xla_force_host_platform_device_count`` before jax
+loads). The artifact gains a ``"fleet"`` block ({replicas,
+host_cores, ...}) and is ALSO written to ``BENCH_serving_fleet.json``
+— the perf sentinel keys on the block to give fleet runs their own
+lineage, never compared against single-process serving rows. On a
+host with fewer physical cores than replicas the sweep measures
+router overhead, not real parallelism — ``host_cores`` is recorded
+precisely so the reader can tell which one they are looking at.
 """
 
 from __future__ import annotations
@@ -81,6 +93,33 @@ def _build_server(batched: bool, max_wait_ms: float):
     return InferenceServer(im, port=0, batcher=batcher).start()
 
 
+def _build_fleet_server(n_replicas: int, max_wait_ms: float):
+    from analytics_zoo_tpu import init_nncontext
+    from analytics_zoo_tpu.pipeline.api.keras import (
+        Sequential, layers as L)
+    from analytics_zoo_tpu.pipeline.inference import (
+        make_fleet_server)
+    from analytics_zoo_tpu.pipeline.inference.fleet import (
+        FleetRouter, ReplicaPool)
+
+    init_nncontext(seed=0, log_level="WARNING")
+    m = Sequential()
+    m.add(L.Dense(4096, activation="relu", input_shape=(256,)))
+    m.add(L.Dense(4096, activation="relu"))
+    m.add(L.Dense(512, activation="relu"))
+    m.add(L.Dense(10))
+    m.compile(optimizer="sgd", loss="mse")
+    rs = np.random.RandomState(0)
+    pool = ReplicaPool.for_keras(
+        m, example_inputs=[rs.randn(8, 256).astype(np.float32)],
+        n_replicas=n_replicas, devices_per_replica=1,
+        batcher_kwargs={"max_batch_size": 32,
+                        "max_wait_ms": max_wait_ms,
+                        "queue_depth": 512})
+    router = FleetRouter(pool)
+    return make_fleet_server(router).start()
+
+
 def _run_clients(port: int, clients: int, duration_s: float):
     """Closed loop: every client POSTs back-to-back until the window
     closes. Returns (rows_done, request_latencies_s, errors)."""
@@ -124,9 +163,12 @@ def _run_clients(port: int, clients: int, duration_s: float):
 
 
 def measure(mode: str, clients: int, duration_s: float,
-            max_wait_ms: float) -> dict:
-    srv = _build_server(batched=(mode == "batched"),
-                        max_wait_ms=max_wait_ms)
+            max_wait_ms: float, replicas: int = 0) -> dict:
+    if replicas:
+        srv = _build_fleet_server(replicas, max_wait_ms)
+    else:
+        srv = _build_server(batched=(mode == "batched"),
+                            max_wait_ms=max_wait_ms)
     try:
         # warmup outside the window: compiles every size in the mix
         # on the unbatched path (the batched path warmed at start())
@@ -157,6 +199,64 @@ def measure(mode: str, clients: int, duration_s: float,
     return rec
 
 
+def _main_fleet(args):
+    """``--replicas N``: the fleet A/B sweep. Same closed-loop load,
+    1-replica fleet vs N-replica fleet, artifact to stdout AND
+    ``BENCH_serving_fleet.json`` (own perf-sentinel lineage)."""
+    one = measure("fleet1", args.clients, args.duration,
+                  args.max_wait_ms, replicas=1)
+    many = measure(f"fleet{args.replicas}", args.clients,
+                   args.duration, args.max_wait_ms,
+                   replicas=args.replicas)
+    speedup = (many["rows_per_sec"] / one["rows_per_sec"]
+               if one["rows_per_sec"] else float("inf"))
+    cores = os.cpu_count() or 1
+    print(f"# fleet speedup={speedup:.2f}x over 1 replica "
+          f"(replicas={args.replicas}, host_cores={cores})",
+          file=sys.stderr, flush=True)
+
+    headline = many["rows_per_sec"]
+    rec = {
+        "metric": "serving_fleet_throughput_rows_per_sec",
+        "unit": "rows/sec",
+        "value": None if args.cpu_fallback else headline,
+        "vs_baseline": None,
+        # the sentinel keys on this block: fleet runs are their own
+        # lineage, never compared against single-process rows.
+        # host_cores tells the reader whether N replicas had N cores
+        # to scale onto or were time-slicing one (router-overhead
+        # measurement, not real parallelism).
+        "fleet": {
+            "replicas": args.replicas,
+            "devices_per_replica": 1,
+            "policy": "least_loaded",
+            "host_cores": cores,
+        },
+        "extra_metrics": [
+            one, many,
+            {"metric": "serving_fleet_speedup",
+             "value": round(speedup, 2), "unit": "x"},
+        ],
+    }
+    if args.cpu_fallback:
+        rec["cpu_fallback_value"] = headline
+        rec["fallback"] = (f"cpu clients={args.clients} "
+                           f"duration={args.duration}s "
+                           f"replicas={args.replicas}")
+    from bench_common import attach_metrics_snapshot
+    rec = attach_metrics_snapshot(rec)
+    out_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "BENCH_serving_fleet.json")
+    with open(out_path, "w", encoding="utf-8") as fh:
+        json.dump(rec, fh)
+        fh.write("\n")
+    print(json.dumps(rec), flush=True)
+    print(f"# wrote {out_path}", file=sys.stderr)
+    print(f"# total={time.perf_counter() - _t_start:.1f}s",
+          file=sys.stderr)
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--clients", type=int, default=int(os.environ.get(
@@ -171,7 +271,19 @@ def main():
                     help="pin the run to the host CPU backend; the "
                     "measurement lands in cpu_fallback_value and the "
                     "chip headline stays null")
+    ap.add_argument("--replicas", type=int, default=0,
+                    help="fleet A/B sweep: 1 replica vs N replicas "
+                    "behind the FleetRouter, writing "
+                    "BENCH_serving_fleet.json (own sentinel lineage)")
     args = ap.parse_args()
+
+    if args.replicas:
+        # one virtual host device per replica; must land in XLA_FLAGS
+        # before jax initializes its backends
+        flag = ("--xla_force_host_platform_device_count="
+                f"{max(2, args.replicas)}")
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " " + flag).strip()
 
     import jax
     if args.cpu_fallback:
@@ -182,6 +294,9 @@ def main():
           f"duration={args.duration}s "
           f"max_wait_ms={args.max_wait_ms}",
           file=sys.stderr, flush=True)
+
+    if args.replicas:
+        return _main_fleet(args)
 
     batched = measure("batched", args.clients, args.duration,
                       args.max_wait_ms)

@@ -62,6 +62,8 @@ MODULES = [
      "pipeline.inference — serving"),
     ("analytics_zoo_tpu.pipeline.inference.batching",
      "pipeline.inference.batching — dynamic request batching"),
+    ("analytics_zoo_tpu.pipeline.inference.fleet",
+     "pipeline.inference.fleet — replicated serving fleet"),
     ("analytics_zoo_tpu.pipeline.nnframes",
      "pipeline.nnframes — DataFrame ML pipeline"),
     ("analytics_zoo_tpu.models", "models — the zoo"),

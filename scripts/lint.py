@@ -14,7 +14,8 @@ stdlib ast:
   name passed to `counter()` / `gauge()` / `histogram()` must match
   `zoo_tpu_<snake_case>` (docs/observability.md naming contract);
 - shipped SLO defaults (`DEFAULT_SERVING_SLOS` /
-  `DEFAULT_TRAINING_SLOS` in `common/slo.py`, kept as pure dict
+  `DEFAULT_FLEET_SLOS` / `DEFAULT_TRAINING_SLOS` in
+  `common/slo.py`, kept as pure dict
   literals precisely so this works): every rule id is unique, every
   window positive and ascending, and every referenced metric name is
   one the package actually registers — a typoed selector would
@@ -140,7 +141,8 @@ def _metric_name_problems(rel: str, tree: ast.AST,
     return problems
 
 
-_SLO_DEFAULT_NAMES = ("DEFAULT_SERVING_SLOS", "DEFAULT_TRAINING_SLOS")
+_SLO_DEFAULT_NAMES = ("DEFAULT_SERVING_SLOS", "DEFAULT_FLEET_SLOS",
+                      "DEFAULT_TRAINING_SLOS")
 _SLO_FILE = os.path.join("analytics_zoo_tpu", "common", "slo.py")
 
 

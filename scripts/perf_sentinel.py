@@ -15,7 +15,12 @@ fallback measurements are SEPARATE series. An artifact is fallback
 when it carries ``cpu_fallback_value``/``fallback`` (or a fallback
 diag); ``*_CPU_FALLBACK`` metric names are normalized into the cpu
 lineage under their base name. A 0.63 img/s CPU number is never
-compared against round 2's 2715 img/s chip headline.
+compared against round 2's 2715 img/s chip headline. Fleet artifacts
+(``BENCH_serving_fleet.json`` / any record carrying a ``"fleet"``
+block — `bench_serving.py --replicas N`) get a ``-fleet`` lineage
+suffix for the same reason: N replicas time-slicing a host is a
+different series from one single-process server, and neither may
+judge the other.
 
 Direction is inferred from the metric name (err/p99/latency/_ms/
 seconds → lower is better; everything else → higher is better).
@@ -94,6 +99,12 @@ def is_fallback_artifact(rec: dict) -> bool:
     return "fallback" in (rec.get("diag") or "").lower()
 
 
+def is_fleet_artifact(rec: dict) -> bool:
+    """Replicated-fleet runs (`bench_serving.py --replicas N`) carry
+    a ``"fleet"`` block; their numbers form their own lineage."""
+    return isinstance(rec.get("fleet"), dict)
+
+
 def extract_series(rec: dict) -> "Dict[Tuple[str, str], float]":
     """``{(lineage, metric): value}`` for one artifact.
     ``lineage`` is ``"chip"`` or ``"cpu"`` — comparisons only ever
@@ -102,7 +113,9 @@ def extract_series(rec: dict) -> "Dict[Tuple[str, str], float]":
     if not isinstance(rec, dict):
         return out
     fb = is_fallback_artifact(rec)
-    art_lin = "cpu" if fb else "chip"
+    fleet_sfx = "-fleet" if is_fleet_artifact(rec) else ""
+    art_lin = ("cpu" if fb else "chip") + fleet_sfx
+    cpu_lin = "cpu" + fleet_sfx
     headline = rec.get("metric") or "headline"
     value = rec.get("value")
     # a 0.0 headline is this schema's "nothing measured" sentinel
@@ -110,7 +123,7 @@ def extract_series(rec: dict) -> "Dict[Tuple[str, str], float]":
         out[(art_lin, headline)] = float(value)
     cfv = rec.get("cpu_fallback_value")
     if isinstance(cfv, (int, float)) and cfv > 0:
-        out[("cpu", headline)] = float(cfv)
+        out[(cpu_lin, headline)] = float(cfv)
     for m in rec.get("extra_metrics") or []:
         if not isinstance(m, dict):
             continue
@@ -118,7 +131,7 @@ def extract_series(rec: dict) -> "Dict[Tuple[str, str], float]":
         v = m.get("value")
         if isinstance(name, str) and isinstance(v, (int, float)):
             if name.endswith(_FB_SUFFIX):
-                out[("cpu", name[:-len(_FB_SUFFIX)])] = float(v)
+                out[(cpu_lin, name[:-len(_FB_SUFFIX)])] = float(v)
             else:
                 out[(art_lin, name)] = float(v)
         elif "mode" in m and isinstance(
@@ -142,17 +155,21 @@ def load_rounds(dirpath: str):
         rounds.append((int(m.group(1)), f"r{int(m.group(1)):02d}",
                        series))
     rounds.sort()
-    serving = None
-    sp = os.path.join(dirpath, "BENCH_serving.json")
-    if os.path.exists(sp):
-        rec = load_artifact(sp)
-        if rec:
-            serving = extract_series(rec)
+    # named (non-round) artifacts, each its own trajectory column;
+    # the fleet artifact's series land in the *-fleet lineages
+    named = []
+    for label, fn in (("serving", "BENCH_serving.json"),
+                      ("fleet", "BENCH_serving_fleet.json")):
+        p = os.path.join(dirpath, fn)
+        if os.path.exists(p):
+            rec = load_artifact(p)
+            if rec:
+                named.append((label, extract_series(rec)))
     baseline = None
     bp = os.path.join(dirpath, "BASELINE.json")
     if os.path.exists(bp):
         baseline = load_artifact(bp)
-    return rounds, serving, baseline
+    return rounds, named, baseline
 
 
 def judge_latest(rounds, tolerance: float,
@@ -196,26 +213,31 @@ def _fmt(v: Optional[float]) -> str:
     return f"{v:.4g}"
 
 
-def trajectory_table(rounds, serving=None) -> str:
-    """Per-series trajectory across rounds (and the serving artifact
-    as its own column), chip and cpu lineages in separate blocks."""
+def trajectory_table(rounds, named=None) -> str:
+    """Per-series trajectory across rounds (named artifacts —
+    serving, fleet — as their own columns), one block per lineage:
+    chip, cpu, then the fleet lineages."""
     cols = [label for _, label, _ in rounds]
     series_by_round = {label: s for _, label, s in rounds}
-    if serving:
-        cols.append("serving")
-        series_by_round["serving"] = serving
+    for label, series in (named or []):
+        cols.append(label)
+        series_by_round[label] = series
     keys = sorted({k for s in series_by_round.values() for k in s})
     lines = []
+    lin_w = max([len(lin) for lin, _ in keys] + [8]) + 2
     width = max([len(m) for _, m in keys] + [24]) + 2
-    header = ("lineage".ljust(8) + "metric".ljust(width)
+    header = ("lineage".ljust(lin_w) + "metric".ljust(width)
               + "".join(c.rjust(12) for c in cols))
     lines.append(header)
     lines.append("-" * len(header))
-    for lineage in ("chip", "cpu"):
+    base = ("chip", "cpu")
+    lineages = list(base) + sorted(
+        {lin for lin, _ in keys} - set(base))
+    for lineage in lineages:
         for key in keys:
             if key[0] != lineage:
                 continue
-            row = (lineage.ljust(8) + key[1].ljust(width)
+            row = (lineage.ljust(lin_w) + key[1].ljust(width)
                    + "".join(
                        _fmt(series_by_round[c].get(key)).rjust(12)
                        for c in cols))
@@ -238,8 +260,8 @@ def main(argv=None) -> int:
                     help="print the verdict but always exit 0")
     args = ap.parse_args(argv)
 
-    rounds, serving, baseline = load_rounds(args.dir)
-    if not rounds and not serving:
+    rounds, named, baseline = load_rounds(args.dir)
+    if not rounds and not named:
         print("perf-sentinel: no BENCH artifacts found in "
               f"{args.dir}", file=sys.stderr)
         return 0 if args.advisory else 2
@@ -248,7 +270,7 @@ def main(argv=None) -> int:
           f"({len(rounds)} rounds, tolerance {args.tolerance:.0%})")
     if baseline and baseline.get("metric"):
         print(f"# baseline: {baseline['metric']}")
-    print(trajectory_table(rounds, serving))
+    print(trajectory_table(rounds, named))
 
     regressions = judge_latest(rounds, args.tolerance, args.floor)
     if regressions:

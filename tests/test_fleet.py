@@ -1,0 +1,547 @@
+"""Serving fleet (pipeline/inference/fleet.py): dispatch policies
+(least-loaded, consistent-hash determinism), replica kill/drain
+mid-load with zero lost acked requests, backoff re-admission, fleet
+backpressure (minimum Retry-After across full queues), sharded-
+predict exactness vs a single replica, the /debug/fleet surface, and
+router→replica trace propagation. Tier-1 fast."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu import init_nncontext
+from analytics_zoo_tpu.common import tracing
+from analytics_zoo_tpu.common.observability import (
+    reset_metrics, snapshot)
+from analytics_zoo_tpu.pipeline.api.keras import Sequential, \
+    layers as L
+from analytics_zoo_tpu.pipeline.inference import (
+    FleetRouter, InferenceModel, InferenceServer, Replica,
+    ReplicaPool)
+from analytics_zoo_tpu.pipeline.inference.batching import (
+    QueueFullError)
+from analytics_zoo_tpu.pipeline.inference.fleet import (
+    ADMITTING, DOWN, DRAINED, FleetSaturatedError,
+    ReplicaUnavailableError)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_metrics():
+    reset_metrics()
+    yield
+    reset_metrics()
+
+
+def _metric_sum(name, snap=None):
+    snap = snap or snapshot()
+    fam = snap.get(name)
+    if fam is None:
+        return 0.0
+    return sum(v["value"] for v in fam["values"])
+
+
+def _toy_net():
+    init_nncontext(seed=0)
+    m = Sequential()
+    m.add(L.Dense(8, activation="relu", input_shape=(4,)))
+    m.add(L.Dense(2))
+    return m
+
+
+class _KillableModel:
+    """Proxy over a real InferenceModel whose compiled-bucket calls
+    and per-request predicts raise while ``dead`` is set — the fault
+    injector for mid-request replica death (the batcher executes
+    compiled bucket fns from lower_for, so the wrapper must poison
+    those, not just predict)."""
+
+    def __init__(self, im):
+        self._im = im
+        self.dead = threading.Event()
+
+    def __getattr__(self, name):
+        return getattr(self._im, name)
+
+    def _check(self):
+        if self.dead.is_set():
+            raise RuntimeError("injected replica death")
+
+    def lower_for(self, example_args):
+        fn = self._im.lower_for(example_args)
+
+        def wrapped(*xs):
+            self._check()
+            return fn(*xs)
+        return wrapped
+
+    def predict(self, inputs, timeout_ms=-1):
+        self._check()
+        return self._im.predict(inputs, timeout_ms=timeout_ms)
+
+
+def _killable_pool(n=2, example_batch=2, **router_kw):
+    net = _toy_net()
+    params = net.init_params()
+    rs = np.random.RandomState(1)
+    ex = [rs.randn(example_batch, 4).astype(np.float32)]
+    models, replicas = [], []
+    for i in range(n):
+        im = InferenceModel()
+        im.load_keras_net(net, params=params, example_inputs=ex)
+        km = _KillableModel(im)
+        models.append(km)
+        replicas.append(Replica(
+            f"r{i}", km,
+            batcher_kwargs={"max_wait_ms": 1, "labels":
+                            {"replica": f"r{i}"}}))
+    pool = ReplicaPool(replicas=replicas)
+    router_kw.setdefault("probe_interval_s", 0)
+    router = FleetRouter(pool, **router_kw)
+    ref = lambda x: np.asarray(  # noqa: E731
+        net.forward(params, x, training=False))
+    return router, models, ref
+
+
+class _StubReplicaModel:
+    """Blocking duck-typed model for deterministic queue states."""
+
+    can_relower = False
+    example_input_specs = None
+    generation = 0
+    concurrent_slots_free = 1
+    supported_concurrent_num = 1
+
+    def __init__(self):
+        self.started = threading.Event()
+        self.release = threading.Event()
+        self.calls = 0
+        self.fail = False
+
+    def predict(self, xs, timeout_ms=-1):
+        self.started.set()
+        assert self.release.wait(10), "test forgot to release stub"
+        self.calls += 1
+        if self.fail:
+            raise RuntimeError("stub replica exploded")
+        x = xs[0] if isinstance(xs, list) else xs
+        return np.asarray(x) * 2.0
+
+
+def _stub_fleet(n=2, queue_depth=4, **router_kw):
+    models = [_StubReplicaModel() for _ in range(n)]
+    replicas = [
+        Replica(f"r{i}", m,
+                batcher_kwargs={"max_wait_ms": 1,
+                                "queue_depth": queue_depth})
+        for i, m in enumerate(models)]
+    pool = ReplicaPool(replicas=replicas)
+    router_kw.setdefault("probe_interval_s", 0)
+    return FleetRouter(pool, **router_kw).start(), models
+
+
+# -- dispatch policies --------------------------------------------------------
+
+def test_least_loaded_prefers_idle_replica():
+    router, models = _stub_fleet(2)
+    try:
+        x = np.ones((1, 3), np.float32)
+        f1 = router.submit([x])
+        # wait until one replica is actually busy (outstanding > 0)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if any(m.started.is_set() for m in models):
+                break
+            time.sleep(0.005)
+        busy = [r for r in router.pool.replicas
+                if r.outstanding_rows > 0]
+        assert len(busy) == 1
+        f2 = router.submit([x])
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if all(m.started.is_set() for m in models):
+                break
+            time.sleep(0.005)
+        # the second request went to the OTHER (idle) replica
+        assert all(m.started.is_set() for m in models)
+        for m in models:
+            m.release.set()
+        np.testing.assert_allclose(f1.result(10), x * 2.0)
+        np.testing.assert_allclose(f2.result(10), x * 2.0)
+    finally:
+        for m in models:
+            m.release.set()
+        router.stop()
+
+
+def test_consistent_hash_is_deterministic_and_sticky():
+    router, models = _stub_fleet(3, policy="hash")
+    try:
+        x = np.arange(6, dtype=np.float32).reshape(2, 3)
+        key = router._affinity_key([x])
+        picks = {router._pick(2, key, set()).name
+                 for _ in range(16)}
+        assert len(picks) == 1  # same payload → same replica
+        # a rebuilt router over same-named replicas agrees (ring is
+        # a pure function of replica names)
+        router2 = FleetRouter(router.pool, policy="hash",
+                              probe_interval_s=0)
+        assert router2._pick(2, key, set()).name == picks.pop()
+        # different payloads spread across replicas
+        names = {
+            router._pick(1, router._affinity_key(
+                [np.full((1, 3), i, np.float32)]), set()).name
+            for i in range(32)}
+        assert len(names) > 1
+    finally:
+        router.stop()
+
+
+def test_hash_ring_walks_past_down_replica():
+    router, models = _stub_fleet(3, policy="hash")
+    try:
+        x = np.arange(6, dtype=np.float32).reshape(2, 3)
+        key = router._affinity_key([x])
+        first = router._pick(2, key, set())
+        first.mark_down("test")
+        second = router._pick(2, key, set())
+        assert second is not None and second.name != first.name
+        # and the walk is itself deterministic
+        assert router._pick(2, key, set()).name == second.name
+    finally:
+        router.stop()
+
+
+# -- kill / retry / eject / re-admit -----------------------------------------
+
+def test_replica_death_mid_request_retries_on_sibling():
+    router, models, ref = _killable_pool(2, eject_after=1,
+                                         max_retries=2)
+    router.start()
+    try:
+        rs = np.random.RandomState(2)
+        x = rs.randn(2, 4).astype(np.float32)
+        # warm both replicas' ladders through real traffic
+        for _ in range(4):
+            router.submit([x]).result(timeout=30)
+
+        models[0].dead.set()  # r0 now fails compiled calls
+        outs = [router.submit([x]) for _ in range(8)]
+        for f in outs:
+            np.testing.assert_allclose(f.result(timeout=30),
+                                       ref(x), rtol=1e-5)
+        # the dead replica was ejected after its first failure and
+        # at least one dispatch was retried on the sibling
+        st = {r["name"]: r for r in
+              router.fleet_status()["replicas"]}
+        assert st["r0"]["state"] == DOWN
+        assert st["r1"]["state"] == ADMITTING
+        assert _metric_sum("zoo_tpu_fleet_retries_total") >= 1
+        assert _metric_sum("zoo_tpu_fleet_ejections_total") == 1
+        # zero lost acked work: every submitted future resolved with
+        # the exact model output (asserted above), none double-ran
+    finally:
+        router.stop()
+
+
+def test_dead_replica_readmitted_after_backoff():
+    router, models, ref = _killable_pool(2, eject_after=1)
+    router.start()
+    try:
+        x = np.random.RandomState(3).randn(2, 4).astype(np.float32)
+        router.submit([x]).result(timeout=30)
+        models[0].dead.set()
+        for _ in range(4):
+            router.submit([x]).result(timeout=30)
+        r0 = router.pool.replicas[0]
+        assert r0.state == DOWN
+        # probe while still dead: backoff doubles, stays down
+        t_probe = r0.next_probe_at
+        router.tick(now=t_probe + 0.01)
+        assert r0.state == DOWN
+        assert r0.next_probe_at > t_probe
+        # heal, probe again after the (grown) backoff → re-admitted
+        models[0].dead.clear()
+        router.tick(now=r0.next_probe_at + 0.01)
+        assert r0.state == ADMITTING
+        assert _metric_sum(
+            "zoo_tpu_fleet_readmissions_total") == 1
+        router.submit([x]).result(timeout=30)  # serves again
+    finally:
+        router.stop()
+
+
+def test_drain_flushes_in_flight_then_restart_readmits():
+    router, models = _stub_fleet(2)
+    try:
+        x = np.ones((1, 3), np.float32)
+        futs = [router.submit([x]) for _ in range(3)]
+        for m in models:
+            m.release.set()
+
+        def drain():
+            return router.drain("r0", timeout=10)
+
+        t = threading.Thread(target=drain)
+        t.start()
+        for f in futs:
+            np.testing.assert_allclose(f.result(10), x * 2.0)
+        t.join(timeout=10)
+        assert not t.is_alive()
+        r0 = router._replica("r0")
+        assert r0.state == DRAINED
+        assert r0.outstanding_rows == 0
+        # drained replicas take no traffic, the sibling serves
+        f = router.submit([x])
+        np.testing.assert_allclose(f.result(10), x * 2.0)
+        assert r0.outstanding_rows == 0
+        router.restart_replica("r0")
+        assert r0.state == ADMITTING
+    finally:
+        for m in models:
+            m.release.set()
+        router.stop()
+
+
+# -- backpressure -------------------------------------------------------------
+
+def test_fleet_saturation_returns_min_retry_hint():
+    router, models = _stub_fleet(2, queue_depth=1)
+    try:
+        x = np.ones((1, 3), np.float32)
+        # one in-flight per replica (dispatchers blocked in the stub)
+        futs = [router.submit([x]) for _ in range(2)]
+        for m in models:
+            assert m.started.wait(10)
+        # now one QUEUED per replica: every queue (depth 1) is full
+        futs += [router.submit([x]) for _ in range(2)]
+        with pytest.raises(FleetSaturatedError) as ei:
+            router.submit([x]).result(timeout=5)
+        assert ei.value.retry_after_s > 0
+        # the hint is the MINIMUM across the fleet's per-queue hints
+        hints = [r.retry_hint_s() for r in router.pool.replicas]
+        assert ei.value.retry_after_s <= max(hints) + 1e-6
+        assert isinstance(ei.value, QueueFullError)  # → HTTP 503
+        assert _metric_sum("zoo_tpu_fleet_saturated_total") == 1
+        for m in models:
+            m.release.set()
+        for f in futs:
+            np.testing.assert_allclose(f.result(timeout=10),
+                                       x * 2.0)
+    finally:
+        for m in models:
+            m.release.set()
+        router.stop()
+
+
+def test_no_admitting_replica_is_unavailable_not_crash():
+    router, models = _stub_fleet(2)
+    try:
+        for r in router.pool.replicas:
+            r.mark_down("test")
+        x = np.ones((1, 3), np.float32)
+        with pytest.raises(ReplicaUnavailableError) as ei:
+            router.predict(x)
+        assert isinstance(ei.value, QueueFullError)  # → HTTP 503
+        assert ei.value.retry_after_s > 0
+    finally:
+        router.stop()
+
+
+# -- sharded replicas ---------------------------------------------------------
+
+def test_sharded_replica_matches_single_replica_output():
+    net = _toy_net()
+    params = net.init_params()
+    rs = np.random.RandomState(4)
+    x = rs.randn(3, 4).astype(np.float32)
+    ref = np.asarray(net.forward(params, x, training=False))
+    pool = ReplicaPool.for_keras(
+        net, params=params, example_inputs=[x], n_replicas=2,
+        devices_per_replica=2, sharding="tp",
+        batcher_kwargs={"max_wait_ms": 1})
+    router = FleetRouter(pool, probe_interval_s=0).start()
+    try:
+        import jax
+        for r in pool.replicas:  # params live on 2-device slices
+            leaves = jax.tree_util.tree_leaves(
+                r.model._export_src[0][0])
+            assert any(len(lf.sharding.device_set) == 2
+                       for lf in leaves)
+        for _ in range(3):
+            out = router.submit([x]).result(timeout=60)
+            np.testing.assert_allclose(out, ref, rtol=1e-5,
+                                       atol=1e-6)
+        # direct (per-request) path agrees too
+        np.testing.assert_allclose(router.predict(x), ref,
+                                   rtol=1e-5, atol=1e-6)
+    finally:
+        router.stop()
+
+
+# -- serving integration ------------------------------------------------------
+
+def _fleet_server():
+    net = _toy_net()
+    params = net.init_params()
+    rs = np.random.RandomState(5)
+    ex = [rs.randn(2, 4).astype(np.float32)]
+    pool = ReplicaPool.for_keras(
+        net, params=params, example_inputs=ex, n_replicas=2,
+        devices_per_replica=1, batcher_kwargs={"max_wait_ms": 1})
+    router = FleetRouter(pool, probe_interval_s=0)
+    srv = InferenceServer(router, batcher=router)
+    srv.start()
+    ref = lambda x: np.asarray(  # noqa: E731
+        net.forward(params, x, training=False))
+    return srv, router, ref
+
+
+def _post(port, payload, headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/predict",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json",
+                 **(headers or {})})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return (resp.status, json.loads(resp.read()),
+                dict(resp.headers))
+
+
+def test_fleet_behind_http_server_with_debug_fleet():
+    srv, router, ref = _fleet_server()
+    try:
+        x = np.random.RandomState(6).randn(2, 4).astype(np.float32)
+        status, payload, _ = _post(srv.port, {"inputs": x.tolist()})
+        assert status == 200
+        np.testing.assert_allclose(
+            np.asarray(payload["outputs"], np.float32), ref(x),
+            rtol=1e-5)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/fleet",
+                timeout=10) as resp:
+            fleet = json.loads(resp.read())
+        assert fleet["replicas_admitting"] == 2
+        assert {r["name"] for r in fleet["replicas"]} == \
+            {"r0", "r1"}
+        assert all(r["batcher"]["enabled"]
+                   for r in fleet["replicas"])
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/health",
+                timeout=10) as resp:
+            health = json.loads(resp.read())
+        assert health["batcher"]["fleet"] is True
+        assert health["batcher"]["replicas_admitting"] == 2
+    finally:
+        srv.stop()
+
+
+def test_debug_fleet_404_on_single_model_server():
+    from analytics_zoo_tpu.pipeline.inference.serving import (
+        _fleet_payload)
+    status, body = _fleet_payload(None)
+    assert status == 404
+    status, body = _fleet_payload(object())
+    assert status == 404
+
+
+def test_fleet_installs_fleet_slos():
+    from analytics_zoo_tpu.common import slo as slo_lib
+    srv, router, _ = _fleet_server()
+    try:
+        ids = {s["id"] for s in
+               slo_lib.get_engine().status()["objectives"]}
+        assert "fleet_replicas_admitting" in ids
+        assert "fleet_error_rate" in ids
+        assert "serving_latency_p99" in ids  # serving set too
+    finally:
+        srv.stop()
+
+
+# -- trace propagation --------------------------------------------------------
+
+def test_trace_id_spans_router_and_replica_inprocess():
+    router, models, ref = _killable_pool(2)
+    router.start()
+    try:
+        x = np.random.RandomState(7).randn(2, 4).astype(np.float32)
+        router.submit([x]).result(timeout=30)  # warm
+        with tracing.trace("client/request") as tr:
+            router.submit([x]).result(timeout=30)
+            tid = tr.trace_id
+        names = {s.name for s in tracing.get_store().spans(tid)}
+        assert "fleet/dispatch" in names
+        # the replica's batcher spans joined the SAME trace id
+        assert any(n.startswith("serving/") for n in names), names
+    finally:
+        router.stop()
+
+
+def test_trace_header_forwarded_to_http_replica():
+    from analytics_zoo_tpu.pipeline.inference.fleet import (
+        HttpReplica)
+    srv, _, ref = _fleet_server()  # stands in for a remote replica
+    try:
+        remote = HttpReplica(f"http://127.0.0.1:{srv.port}",
+                             name="remote0").start()
+        pool = ReplicaPool(replicas=[remote])
+        router = FleetRouter(pool, probe_interval_s=0)
+        x = np.random.RandomState(8).randn(2, 4).astype(np.float32)
+        with tracing.trace("client/request") as tr:
+            out = router.submit([x]).result(timeout=30)
+            tid = tr.trace_id
+        np.testing.assert_allclose(out, ref(x), rtol=1e-4,
+                                   atol=1e-5)
+        # the remote server (same process here) recorded its
+        # serving/request span under the forwarded trace id
+        names = {s.name for s in tracing.get_store().spans(tid)}
+        assert "serving/request" in names
+        assert "fleet/remote_predict" in names
+        router.stop()
+    finally:
+        srv.stop()
+
+
+def test_http_replica_probe_and_health():
+    from analytics_zoo_tpu.pipeline.inference.fleet import (
+        HttpReplica)
+    srv, _, _ = _fleet_server()
+    try:
+        remote = HttpReplica(f"http://127.0.0.1:{srv.port}").start()
+        assert remote.probe() is True
+        remote.stop()
+        dead = HttpReplica("http://127.0.0.1:1/")
+        assert dead.probe() is False
+    finally:
+        srv.stop()
+
+
+# -- pool construction --------------------------------------------------------
+
+def test_replica_device_slices_partition_and_validate():
+    import jax
+    from analytics_zoo_tpu.parallel import replica_device_slices
+    devs = jax.devices()
+    slices = replica_device_slices(4, 2, devs)
+    assert len(slices) == 4
+    flat = [d for sl in slices for d in sl]
+    assert len(set(flat)) == 8  # disjoint
+    with pytest.raises(ValueError):
+        replica_device_slices(5, 2, devs)  # needs 10 > 8
+    with pytest.raises(ValueError):
+        replica_device_slices(0, 1, devs)
+
+
+def test_pool_rejects_bad_construction():
+    with pytest.raises(ValueError):
+        ReplicaPool()
+    with pytest.raises(ValueError):
+        ReplicaPool(model_fn=lambda ctx: None,
+                    replicas=[Replica("x", _StubReplicaModel())])
+    with pytest.raises(ValueError):
+        ReplicaPool(replicas=[
+            Replica("same", _StubReplicaModel()),
+            Replica("same", _StubReplicaModel())])

@@ -110,6 +110,48 @@ def test_fallback_suffix_normalization(sentinel):
     assert not any(lin == "chip" for lin, _ in series)
 
 
+def test_fleet_artifacts_are_their_own_lineage(sentinel, tmp_path):
+    """A fleet record (the ``"fleet"`` block from ``bench_serving.py
+    --replicas N``) never shares a series with single-process serving
+    rows — and fleet-vs-fleet regressions still fire."""
+    fleet = {"metric": "serving_fleet_throughput_rows_per_sec",
+             "value": None, "fallback": "cpu replicas=4",
+             "cpu_fallback_value": 700.0,
+             "fleet": {"replicas": 4, "host_cores": 1}}
+    series = sentinel.extract_series(fleet)
+    assert ("cpu-fleet",
+            "serving_fleet_throughput_rows_per_sec") in series
+    assert not any(lin in ("chip", "cpu") for lin, _ in series)
+    # same metric name in a NON-fleet record: different lineage, so
+    # a huge gap between them regresses nothing
+    single = {"metric": "serving_fleet_throughput_rows_per_sec",
+              "value": None, "fallback": "cpu",
+              "cpu_fallback_value": 5000.0}
+    _wrap(tmp_path, 1, single)
+    _wrap(tmp_path, 2, fleet)
+    assert sentinel.main(["--dir", str(tmp_path)]) == 0
+    # fleet-vs-fleet IS compared: a 50% drop fires
+    _wrap(tmp_path, 3, dict(fleet, cpu_fallback_value=350.0))
+    assert sentinel.main(["--dir", str(tmp_path)]) == 1
+
+
+def test_fleet_named_artifact_loaded_as_own_column(sentinel,
+                                                   tmp_path, capsys):
+    (tmp_path / "BENCH_serving_fleet.json").write_text(json.dumps(
+        {"metric": "serving_fleet_throughput_rows_per_sec",
+         "value": None, "fallback": "cpu", "cpu_fallback_value": 7.0,
+         "fleet": {"replicas": 4, "host_cores": 1},
+         "extra_metrics": [
+             {"mode": "fleet1", "rows_per_sec": 5.0},
+             {"mode": "fleet4", "rows_per_sec": 7.0}]}))
+    _wrap(tmp_path, 1, {"metric": CHIP, "value": 2700.0})
+    assert sentinel.main(["--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "fleet" in out
+    assert "cpu-fleet" in out
+    assert "rows_per_sec[fleet4]" in out
+
+
 def test_wrapper_tail_recovery(sentinel, tmp_path):
     """The last JSON line in ``tail`` wins over ``parsed``; garbage
     and truncated lines are skipped."""
