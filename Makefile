@@ -8,7 +8,8 @@ IMAGE ?= analytics-zoo-tpu
 .PHONY: test docker-build docker-test docker-test-spark dist docs \
     lint obs-smoke fused-conformance flops-audit serving-smoke \
     bench-serving bench-serving-fleet trace-smoke trace-report \
-    slo-smoke perf-sentinel fleet-smoke
+    slo-smoke perf-sentinel fleet-smoke generate-smoke \
+    bench-generate
 
 # unit tests plus the end-to-end telemetry smokes (metrics
 # exposition, tracing, SLO control loop), so `make test` proves the
@@ -20,6 +21,7 @@ test:
 	$(MAKE) trace-smoke
 	$(MAKE) slo-smoke
 	$(MAKE) fleet-smoke
+	$(MAKE) generate-smoke
 	python scripts/perf_sentinel.py --advisory
 
 # conv+BN (+ residual-epilogue) conformance: the exact Pallas kernel
@@ -80,6 +82,18 @@ bench-serving:
 bench-serving-fleet:
 	JAX_PLATFORMS=cpu python bench_serving.py --cpu-fallback \
 	    --replicas 4
+
+# decode fast path end-to-end: compiled generate loop must EXACTLY
+# match a naive uncached re-forward reference, then mixed concurrent
+# /generate requests through the continuous batcher (docs/serving.md)
+generate-smoke:
+	JAX_PLATFORMS=cpu python scripts/generate_smoke.py
+
+# continuous batching vs sequential per-request decode on the host
+# CPU backend; writes BENCH_generate.json (its own perf-sentinel
+# lineage — decode tokens/s is never compared against predict rows/s)
+bench-generate:
+	JAX_PLATFORMS=cpu python bench_generate.py --cpu-fallback
 
 # replicated-fleet end-to-end: 2-replica CPU fleet, mixed concurrent
 # load with exact outputs, one replica killed mid-load (zero lost

@@ -1,1 +1,2 @@
-from analytics_zoo_tpu.ops import activations, initializers, regularizers
+from analytics_zoo_tpu.ops import (activations, initializers, kv_cache,
+                                   regularizers, sampling)

@@ -61,6 +61,63 @@ def flash_profitable(tk: int) -> bool:
     return tk >= int(os.environ.get("ZOO_TPU_FLASH_MIN_T", "1024"))
 
 
+def decode_flash_profitable(tk: int) -> bool:
+    """Whether the Pallas decode kernel beats XLA dense single-query
+    attention at this cached length. A 1-query attention is tiny —
+    the dense logits are only (S, H, 1, Tk) — so the kernel's win is
+    HBM traffic at long contexts, not FLOPs; crossover sits higher
+    than the training kernel's. Overridable via
+    ``ZOO_TPU_DECODE_FLASH_MIN_T``."""
+    return tk >= int(os.environ.get("ZOO_TPU_DECODE_FLASH_MIN_T",
+                                    "2048"))
+
+
+def decode_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                     seq_lens: jnp.ndarray,
+                     scale: Optional[float] = None,
+                     impl: Optional[str] = None) -> jnp.ndarray:
+    """Single-query (decode-mode) attention against a cached context.
+
+    The generation-time sibling of :func:`dot_product_attention`,
+    sharing its impl selector: ``q`` is ONE new token per slot,
+    (S, H, D); ``k``/``v`` are the gathered cache, (S, T, H, D) (the
+    dense view from `ops.kv_cache.gather_layer`); ``seq_lens`` (S,)
+    int32 masks positions ``>= seq_lens[s]`` (stale pages, pad rows).
+    Returns (S, H, D). Softmax in f32 regardless of input dtype.
+
+    Routing mirrors the training path: "auto" takes the Pallas decode
+    kernel (`ops.flash_attention.flash_decode_attention`, which
+    reuses the flash block machinery with the query replicated across
+    one sublane tile) when the backend qualifies, T is 128-divisible,
+    and T is past the decode crossover (`decode_flash_profitable` —
+    higher than the training crossover because single-query dense is
+    so cheap); otherwise XLA dense. No causal mask is needed — the
+    cache only ever holds positions the new token may see.
+    """
+    impl = resolve_attention_impl(impl)
+    d = q.shape[-1]
+    t = k.shape[1]
+    scale = float(scale if scale is not None else 1.0 / (d ** 0.5))
+    use_kernel = t % 128 == 0 and d <= 256 and (
+        impl == "flash" or (impl == "auto" and flash_backend_ok()
+                            and decode_flash_profitable(t)))
+    if use_kernel:
+        from analytics_zoo_tpu.ops import flash_attention as fa
+        key_mask = (jnp.arange(t, dtype=jnp.int32)[None, :] <
+                    seq_lens[:, None])
+        return fa.flash_decode_attention(q, k, v, key_mask,
+                                         scale=scale)
+    # dense: (S, H, 1, T) logits never materialise more than one
+    # query row per slot — already cheap at serving contexts
+    logits = jnp.einsum("shd,sthd->sht", q, k).astype(jnp.float32)
+    logits = logits * scale
+    valid = (jnp.arange(t, dtype=jnp.int32)[None, None, :] <
+             seq_lens[:, None, None])
+    logits = jnp.where(valid, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("sht,sthd->shd", probs, v)
+
+
 def dot_product_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                           mask: Optional[jnp.ndarray] = None,
                           causal: bool = False,

@@ -593,6 +593,72 @@ def flash_block_partial(q, k, v, qk_offset, causal: bool, scale: float,
     return jnp.transpose(acc, (0, 2, 1, 3)), m, l
 
 
+def flash_decode_attention(q: jnp.ndarray, k: jnp.ndarray,
+                           v: jnp.ndarray, key_mask: jnp.ndarray,
+                           scale: float,
+                           interpret: Optional[bool] = None
+                           ) -> jnp.ndarray:
+    """Single-query decode attention over a cached context, as a
+    Pallas kernel reusing the flash block machinery.
+
+    q: (S, H, D) — ONE new token per slot; k, v: (S, T, H, D) — the
+    dense page-table gather of the cache; key_mask: (S, T) 0/1
+    validity (1 = real cached token). Returns (S, H, D).
+
+    The query tile is the kernel's only novelty: TPU blocks need a
+    sublane dim divisible by 8, so the single query row is replicated
+    to an (8, D) tile and row 0 of the output is taken — the other 7
+    rows compute the identical softmax for free (the VPU processes
+    8×128 lanes regardless). Everything else IS `_attn_body` +
+    `_fwd_finalize` — same accumulation, same masking rule, same
+    VMEM scratch — on grid (S, H, 1, nk), causal off (the cache only
+    holds visible positions; `key_mask` owns validity). Inference
+    only: no VJP is defined (decode never differentiates).
+    """
+    global invocations
+    invocations += 1
+    s, h, d = q.shape
+    t = k.shape[1]
+    _, bk = _pick_blocks(t, t, jnp.dtype(q.dtype).itemsize)
+    if bk is None or d > 256:
+        raise ValueError(
+            f"flash_decode_attention needs T divisible by 128 and "
+            f"D <= 256; got T={t} D={d} (use decode_attention's "
+            f"dense path)")
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    qt = jnp.broadcast_to(q[:, :, None, :], (s, h, 8, d))
+    kt = jnp.transpose(k, (0, 2, 1, 3))      # (S, H, T, D)
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    kernel = functools.partial(
+        _fwd_kernel_masked, scale=scale, causal=False,
+        block_q=8, block_k=bk, causal_offset=0)
+    blk = lambda bs, im: pl.BlockSpec((1, 1, bs, d), im)
+    out = pl.pallas_call(
+        kernel,
+        grid=(s, h, 1, t // bk),
+        in_specs=[
+            blk(8, lambda bi, hi, qi, ki: (bi, hi, 0, 0)),
+            blk(bk, lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+            blk(bk, lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 8, bk),
+                         lambda bi, hi, qi, ki: (bi, 0, ki)),
+        ],
+        out_specs=blk(8, lambda bi, hi, qi, ki: (bi, hi, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((s, h, 8, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((8, d), jnp.float32),
+            pltpu.VMEM((8, 128), jnp.float32),
+            pltpu.VMEM((8, 128), jnp.float32),
+        ],
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(qt, kt, vt, _kmask8(key_mask, t))
+    return out[:, :, 0]
+
+
 def as_key_mask(mask, b: int, tk: int):
     """Reduce an attention mask (broadcastable to (B, H, Tq, Tk)) to
     the kernel-native (B, Tk) key-validity form, or None if it varies

@@ -1,0 +1,222 @@
+"""Paged KV cache for autoregressive decode.
+
+vLLM's PagedAttention (SOSP '23) insight, applied to this stack:
+instead of one contiguous (B, T_max, H, D) K/V buffer per layer —
+whose T axis either reallocates as sequences grow (recompile) or pads
+every sequence to the worst case (HBM waste) — K/V live in a
+fixed-size pool of small pages, `(max_pages, page_size, heads,
+head_dim)` per layer, preallocated once. A per-slot page table maps
+logical token positions to physical pages, so sequence growth only
+ever writes one (heads, head_dim) row into an existing page (or walks
+onto a freshly assigned one) and NO array shape ever changes: the
+whole decode loop stays one compiled program regardless of how many
+sequences join, leave, or how long they run.
+
+Everything device-side here is shape-static and jit-safe:
+
+- :func:`init_cache` — allocate the pool (zeros) + identity tables;
+- :func:`append_layer` — scatter one new token's K/V per slot into
+  one layer's pool (inactive slots are routed out-of-range and
+  dropped, so padded batch slots never corrupt live pages);
+- :func:`write_prompt_layer` — bulk-scatter a whole (right-padded)
+  prompt's K/V at prefill (pad rows land in pages past `seq_len` and
+  are never gathered — the length mask owns validity);
+- :func:`gather_layer` / :func:`length_mask` — page-table gather back
+  to a dense (S, T, H, D) view + key-validity mask for attention.
+
+The host-side :class:`PageAllocator` is the bookkeeping half: a free
+list of physical page ids for the continuous batcher, which assigns
+pages at admission / token-boundary growth and reclaims them at
+retirement (`pipeline/inference/batching.py::ContinuousBatcher`).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class PagedKVCache(NamedTuple):
+    """The device-side cache state threaded through the decode loop.
+
+    ``k_pages``/``v_pages``: (num_layers, max_pages, page_size,
+    heads, head_dim) — the preallocated pools.
+    ``page_table``: (max_slots, pages_per_slot) int32 physical page
+    ids (logical page j of slot s lives in ``page_table[s, j]``).
+    ``seq_lens``: (max_slots,) int32 tokens currently cached per slot
+    (0 = free slot; doubles as the active mask).
+    """
+
+    k_pages: jnp.ndarray
+    v_pages: jnp.ndarray
+    page_table: jnp.ndarray
+    seq_lens: jnp.ndarray
+
+    @property
+    def page_size(self) -> int:
+        return self.k_pages.shape[2]
+
+    @property
+    def max_context(self) -> int:
+        return self.page_table.shape[1] * self.page_size
+
+    @property
+    def max_slots(self) -> int:
+        return self.page_table.shape[0]
+
+
+def init_cache(num_layers: int, max_slots: int, max_context: int,
+               heads: int, head_dim: int, page_size: int = 16,
+               max_pages: int = 0,
+               dtype=jnp.float32) -> PagedKVCache:
+    """Allocate the pool. ``max_context`` rounds up to whole pages.
+    ``max_pages`` defaults to ``max_slots * pages_per_slot`` (every
+    slot can reach max_context simultaneously) and the table starts as
+    the identity mapping — the compiled-loop `generate()` path uses it
+    as-is; the continuous batcher overwrites tables from its
+    :class:`PageAllocator` as sequences come and go."""
+    pages_per_slot = -(-int(max_context) // int(page_size))
+    max_pages = int(max_pages) or int(max_slots) * pages_per_slot
+    if max_pages < max_slots * pages_per_slot:
+        raise ValueError(
+            f"max_pages {max_pages} < max_slots*pages_per_slot "
+            f"{max_slots * pages_per_slot}; the identity table "
+            f"would alias pages")
+    shape = (num_layers, max_pages, page_size, heads, head_dim)
+    table = np.arange(max_slots * pages_per_slot, dtype=np.int32)
+    return PagedKVCache(
+        k_pages=jnp.zeros(shape, dtype),
+        v_pages=jnp.zeros(shape, dtype),
+        page_table=jnp.asarray(
+            table.reshape(max_slots, pages_per_slot)),
+        seq_lens=jnp.zeros((max_slots,), jnp.int32),
+    )
+
+
+def _scatter_coords(page_table, seq_lens, positions, page_size,
+                    active):
+    """(physical page, in-page offset) per (slot, position); inactive
+    rows are pushed out of range so ``mode="drop"`` discards them."""
+    pages_per_slot = page_table.shape[1]
+    logical = positions // page_size                 # (S, ...) int32
+    # clamp the table lookup; `active` (which callers AND with
+    # position < max_context) owns whether the row lands at all
+    logical = jnp.minimum(logical, pages_per_slot - 1)
+    phys = jnp.take_along_axis(
+        page_table, logical.reshape(page_table.shape[0], -1), axis=1
+    ).reshape(logical.shape)
+    offset = positions % page_size
+    max_pages_shape = page_table.shape[0] * page_table.shape[1]
+    # any value past every real page id works as the drop sentinel
+    phys = jnp.where(active, phys, max_pages_shape + 2 ** 20)
+    return phys, offset
+
+
+def append_layer(k_pages, v_pages, page_table, seq_lens,
+                 k_new, v_new, active=None):
+    """Scatter one decode step's K/V into one layer's pool.
+
+    k_pages/v_pages: (P, page, H, D); k_new/v_new: (S, H, D) — the new
+    token of every slot, written at position ``seq_lens[s]``. Slots
+    with ``active == False`` (or ``seq_lens == 0`` when active is
+    None... callers pass the done-mask) are dropped, not written.
+    Returns the updated (k_pages, v_pages). Shape-static; safe inside
+    scan/while_loop."""
+    page_size = k_pages.shape[1]
+    if active is None:
+        active = jnp.ones(seq_lens.shape, jnp.bool_)
+    max_ctx = page_table.shape[1] * page_size
+    active = jnp.logical_and(active, seq_lens < max_ctx)
+    phys, offset = _scatter_coords(page_table, seq_lens, seq_lens,
+                                   page_size, active)
+    k_pages = k_pages.at[phys, offset].set(k_new, mode="drop")
+    v_pages = v_pages.at[phys, offset].set(v_new, mode="drop")
+    return k_pages, v_pages
+
+
+def write_prompt_layer(k_pages, v_pages, page_table, prompt_lens,
+                       k_seq, v_seq):
+    """Bulk prefill scatter for one layer: k_seq/v_seq (S, T, H, D)
+    hold the (right-padded) prompt K/V; positions past
+    ``prompt_lens[s]`` are dropped (never written), so pad tokens
+    cannot leak into pages a later admit might reuse."""
+    s, t = k_seq.shape[0], k_seq.shape[1]
+    page_size = k_pages.shape[1]
+    positions = jnp.broadcast_to(
+        jnp.arange(t, dtype=jnp.int32)[None, :], (s, t))
+    active = positions < prompt_lens[:, None]
+    phys, offset = _scatter_coords(page_table, prompt_lens, positions,
+                                   page_size, active)
+    k_pages = k_pages.at[phys, offset].set(k_seq, mode="drop")
+    v_pages = v_pages.at[phys, offset].set(v_seq, mode="drop")
+    return k_pages, v_pages
+
+
+def gather_layer(pages, page_table, t_max: int):
+    """Page-table gather back to a dense (S, t_max, H, D) view of one
+    layer's cache (positions past a slot's ``seq_len`` hold stale/zero
+    rows — :func:`length_mask` owns validity). ``t_max`` is static and
+    must be a whole number of pages."""
+    page_size = pages.shape[1]
+    if t_max % page_size:
+        raise ValueError(f"t_max {t_max} not a multiple of page_size "
+                         f"{page_size}")
+    n = t_max // page_size
+    picked = jnp.take(pages, page_table[:, :n], axis=0,
+                      mode="clip")                 # (S, n, page, H, D)
+    s = page_table.shape[0]
+    return picked.reshape((s, t_max) + pages.shape[2:])
+
+
+def length_mask(seq_lens, t: int):
+    """(S, t) bool key-validity mask: position p of slot s is a real
+    cached token iff ``p < seq_lens[s]``."""
+    return jnp.arange(t, dtype=jnp.int32)[None, :] < seq_lens[:, None]
+
+
+class PageAllocator:
+    """Host-side free list over the physical page pool (the half of
+    PagedAttention that is pure bookkeeping, so it stays in Python:
+    the continuous batcher calls it between compiled steps, never
+    inside them).
+
+    Not thread-safe by itself — the batcher serializes access under
+    its own lock.
+    """
+
+    def __init__(self, max_pages: int):
+        self.max_pages = int(max_pages)
+        self._free = list(range(self.max_pages - 1, -1, -1))
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def alloc(self, n: int) -> "list[int]":
+        """Pop ``n`` physical page ids; raises MemoryError when the
+        pool cannot satisfy the request (callers check
+        :meth:`can_alloc` to defer admission instead)."""
+        if n > len(self._free):
+            raise MemoryError(
+                f"page pool exhausted: want {n}, have "
+                f"{len(self._free)} of {self.max_pages}")
+        if n <= 0:
+            return []
+        out = self._free[-n:][::-1]
+        del self._free[-n:]
+        return out
+
+    def free(self, pages: Sequence[int]) -> None:
+        for p in pages:
+            if not 0 <= p < self.max_pages:
+                raise ValueError(f"bad page id {p}")
+        self._free.extend(pages)
+
+    @staticmethod
+    def pages_needed(tokens: int, page_size: int) -> int:
+        return -(-int(tokens) // int(page_size))

@@ -1,0 +1,36 @@
+"""Token sampling for the compiled decode loop.
+
+One traced program must serve every request mix, so the greedy/
+temperature switch is DATA, not structure: ``temperature`` is a
+per-slot traced vector and slots with ``temperature <= 0`` take the
+argmax while the rest draw from the (optionally top-k-truncated)
+softmax — a `where` between two always-computed candidates, the usual
+price of branchless batching. ``top_k`` stays a static int (it
+changes the lowering via `lax.top_k`), read once per engine from
+``ZOO_TPU_GEN_TOP_K`` so the serving step still compiles exactly
+once.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_tokens(rng, logits, temperature, top_k: int = 0):
+    """Next-token ids for a batch of slots.
+
+    logits: (S, V); temperature: scalar or (S,) — ``<= 0`` means
+    greedy for that slot; ``top_k``: static, 0/negative disables
+    truncation. Returns (S,) int32.
+    """
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    temperature = jnp.broadcast_to(
+        jnp.asarray(temperature, jnp.float32), logits.shape[:1])
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    if top_k and top_k > 0 and top_k < logits.shape[-1]:
+        kth = jax.lax.top_k(scaled, top_k)[0][:, -1:]
+        scaled = jnp.where(scaled >= kth, scaled, -1e30)
+    sampled = jax.random.categorical(rng, scaled).astype(jnp.int32)
+    return jnp.where(temperature > 0.0, sampled, greedy)

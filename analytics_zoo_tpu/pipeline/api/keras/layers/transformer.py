@@ -221,6 +221,36 @@ class TransformerLayer(KerasLayer):
         }
 
     # -- forward ------------------------------------------------------------
+    def _split_qkv(self, p, x):
+        """(…, H) → q, k, v with heads split — the projection half of
+        a block, shared by the full forward and the cached decode path
+        so both trace the exact same matmul."""
+        nh = self.n_head
+        hd = self.hidden_size // nh
+        qkv = x @ p["qkv_kernel"].astype(x.dtype) + \
+            p["qkv_bias"].astype(x.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shp = x.shape[:-1] + (nh, hd)
+        return q.reshape(shp), k.reshape(shp), v.reshape(shp)
+
+    def _block_tail(self, p, x, attn, r1=None, r2=None,
+                    training=False):
+        """Out-projection + residual/LN + MLP half of a block (every
+        op after attention) — the single copy run by the full forward
+        AND the decode step, so the paged-cache path is numerically
+        the training graph, not a reimplementation of it. Shape-
+        agnostic over leading dims ((B, T, H) or (S, H))."""
+        attn = attn @ p["attn_out_kernel"].astype(x.dtype) + \
+            p["attn_out_bias"].astype(x.dtype)
+        attn = _dropout(attn, self.hidden_p_drop, r1, training)
+        x = _layer_norm(x + attn, p["ln1_g"], p["ln1_b"])
+        mlp = jax.nn.gelu(x @ p["mlp_in_kernel"].astype(x.dtype) +
+                          p["mlp_in_bias"].astype(x.dtype))
+        mlp = mlp @ p["mlp_out_kernel"].astype(x.dtype) + \
+            p["mlp_out_bias"].astype(x.dtype)
+        mlp = _dropout(mlp, self.hidden_p_drop, r2, training)
+        return _layer_norm(x + mlp, p["ln2_g"], p["ln2_b"])
+
     def _embed(self, params, x):
         if x.ndim == 3:  # reference layout (B, T, 2): token + position
             tok_ids = x[..., 0].astype(jnp.int32)
@@ -232,7 +262,6 @@ class TransformerLayer(KerasLayer):
         return jnp.take(params["tok_embed"], tok_ids, axis=0) + pos
 
     def _run_blocks(self, params, h0, mask, training, rng):
-        nh, hd = self.n_head, self.hidden_size // self.n_head
         causal = not self.bidirectional
         sp_axis = self.sequence_parallel_axis
         n = self.n_block
@@ -246,12 +275,7 @@ class TransformerLayer(KerasLayer):
                 key = jax.random.wrap_key_data(blk_rng) if \
                     blk_rng.dtype == jnp.uint32 else blk_rng
                 r1, r2, r3 = jax.random.split(key, 3)
-            qkv = x @ p["qkv_kernel"].astype(x.dtype) + \
-                p["qkv_bias"].astype(x.dtype)
-            q, k, v = jnp.split(qkv, 3, axis=-1)
-            q = q.reshape(b, t, nh, hd)
-            k = k.reshape(b, t, nh, hd)
-            v = v.reshape(b, t, nh, hd)
+            q, k, v = self._split_qkv(p, x)
             if sp_axis:
                 if mask is not None:
                     raise NotImplementedError(
@@ -270,17 +294,7 @@ class TransformerLayer(KerasLayer):
                                              causal=causal,
                                              impl=self.attention_impl)
             attn = attn.reshape(b, t, hsz)
-            attn = attn @ p["attn_out_kernel"].astype(x.dtype) + \
-                p["attn_out_bias"].astype(x.dtype)
-            attn = _dropout(attn, self.hidden_p_drop, r1, training)
-            x = _layer_norm(x + attn, p["ln1_g"], p["ln1_b"])
-            mlp = jax.nn.gelu(x @ p["mlp_in_kernel"].astype(x.dtype) +
-                              p["mlp_in_bias"].astype(x.dtype))
-            mlp = mlp @ p["mlp_out_kernel"].astype(x.dtype) + \
-                p["mlp_out_bias"].astype(x.dtype)
-            mlp = _dropout(mlp, self.hidden_p_drop, r2, training)
-            x = _layer_norm(x + mlp, p["ln2_g"], p["ln2_b"])
-            return x
+            return self._block_tail(p, x, attn, r1, r2, training)
 
         if rng is not None:
             rngs_data = jax.vmap(jax.random.key_data)(rngs)
@@ -394,6 +408,182 @@ class TransformerLayer(KerasLayer):
         if self.output_all_block:
             return [shape] * self.n_block
         return shape
+
+    # -- decode fast path ---------------------------------------------------
+    # Autoregressive generation with a paged KV cache (ops/kv_cache):
+    # `prefill` runs the prompt once and caches every block's K/V;
+    # `decode_step` extends every slot by ONE token against the cache
+    # (O(T) per token instead of the naive O(T²) re-forward); and
+    # `generate` wires both into a lax.while_loop whose shapes are
+    # static in (slots, pages) — the whole loop compiles once and is
+    # AOT-warmable. Logits are tied to `tok_embed` (h @ tok_embedᵀ),
+    # the weight-tying the reference's LM head uses. Inference-only:
+    # no dropout, no sequence/pipeline parallelism.
+
+    def init_kv_cache(self, max_slots: int, max_context: int,
+                      page_size: int = 16, dtype=None):
+        """A fresh paged cache sized for this stack: one page pool
+        per block, identity page table (see `ops.kv_cache`)."""
+        from analytics_zoo_tpu.ops import kv_cache as kvc
+        return kvc.init_cache(
+            self.n_block, int(max_slots), int(max_context),
+            self.n_head, self.hidden_size // self.n_head,
+            page_size=int(page_size), dtype=dtype or jnp.float32)
+
+    def prefill(self, params, cache, token_ids, prompt_lens):
+        """Run the (right-padded) prompts once, writing every block's
+        K/V into the cache, and return ``(cache', logits)`` with
+        logits taken at each slot's last real prompt position.
+
+        token_ids: (S, T) int; prompt_lens: (S,) int32 — slots with
+        ``prompt_lens == 0`` are untouched (their pages, seq_lens and
+        neighbours' state are preserved), which is what lets the
+        continuous batcher admit into a live batch. Causality makes
+        right-padding safe: pad positions sit after every real token,
+        so they influence nothing — their K/V rows are dropped at the
+        scatter and masked at gather anyway."""
+        from analytics_zoo_tpu.ops import kv_cache as kvc
+        s, t = token_ids.shape
+        prompt_lens = jnp.asarray(prompt_lens, jnp.int32)
+        h0 = self._embed(params, token_ids)
+        causal = not self.bidirectional
+
+        def block(x, p):
+            q, k, v = self._split_qkv(p, x)
+            attn = dot_product_attention(q, k, v, causal=causal,
+                                         impl=self.attention_impl)
+            attn = attn.reshape(s, t, self.hidden_size)
+            return self._block_tail(p, x, attn), (k, v)
+
+        final, (k_all, v_all) = jax.lax.scan(block, h0,
+                                             params["blocks"])
+        dt = cache.k_pages.dtype
+        write = jax.vmap(kvc.write_prompt_layer,
+                         in_axes=(0, 0, None, None, 0, 0))
+        k_pages, v_pages = write(cache.k_pages, cache.v_pages,
+                                 cache.page_table, prompt_lens,
+                                 k_all.astype(dt), v_all.astype(dt))
+        cache = cache._replace(
+            k_pages=k_pages, v_pages=v_pages,
+            seq_lens=jnp.where(prompt_lens > 0, prompt_lens,
+                               cache.seq_lens))
+        last = final[jnp.arange(s), jnp.maximum(prompt_lens - 1, 0)]
+        logits = last @ params["tok_embed"].astype(last.dtype).T
+        return cache, logits
+
+    def decode_step(self, params, cache, token_ids, active=None):
+        """One decode step for every slot: consume ``token_ids`` (S,)
+        — each slot's previously sampled token — at position
+        ``cache.seq_lens[s]``, append its K/V, attend over the cache,
+        and return ``(cache', logits (S, V))``. Slots with
+        ``active == False`` are frozen: nothing is written, their
+        seq_lens do not advance, and (because inactive scatters are
+        dropped) their pages cannot be perturbed by neighbours.
+        Shape-static — safe inside while_loop and as ONE compiled
+        program under continuous batching."""
+        from analytics_zoo_tpu.ops import kv_cache as kvc
+        from analytics_zoo_tpu.ops.attention import decode_attention
+        s = token_ids.shape[0]
+        if active is None:
+            active = cache.seq_lens > 0
+        pos = jnp.clip(cache.seq_lens, 0, self.seq_len - 1)
+        x = jnp.take(params["tok_embed"],
+                     token_ids.astype(jnp.int32), axis=0) + \
+            jnp.take(params["pos_embed"], pos, axis=0)
+        t_max = cache.max_context
+        table = cache.page_table
+        seq_lens = cache.seq_lens
+        lens_after = seq_lens + active.astype(jnp.int32)
+
+        def block(x, xs):
+            p, kp, vp = xs
+            q, k_new, v_new = self._split_qkv(p, x)
+            kp, vp = kvc.append_layer(
+                kp, vp, table, seq_lens, k_new.astype(kp.dtype),
+                v_new.astype(vp.dtype), active=active)
+            k_ctx = kvc.gather_layer(kp, table, t_max).astype(x.dtype)
+            v_ctx = kvc.gather_layer(vp, table, t_max).astype(x.dtype)
+            attn = decode_attention(q, k_ctx, v_ctx, lens_after,
+                                    impl=self.attention_impl)
+            attn = attn.reshape(s, self.hidden_size)
+            return self._block_tail(p, x, attn), (kp, vp)
+
+        final, (k_pages, v_pages) = jax.lax.scan(
+            block, x, (params["blocks"], cache.k_pages,
+                       cache.v_pages))
+        cache = cache._replace(k_pages=k_pages, v_pages=v_pages,
+                               seq_lens=lens_after)
+        logits = final @ params["tok_embed"].astype(final.dtype).T
+        return cache, logits
+
+    def generate(self, params, prompts, prompt_lens=None,
+                 max_new_tokens: int = 32, *, temperature=0.0,
+                 top_k: int = 0, eos_id=None, rng=None,
+                 page_size: int = 16, cache_dtype=None):
+        """Compiled autoregressive generation: prefill + a
+        `lax.while_loop` of decode steps over (cache, token buffer,
+        done-mask). Greedy when ``temperature <= 0`` (per-slot —
+        temperature may be a (S,) vector), else softmax sampling with
+        optional static ``top_k`` truncation. Stops early when every
+        slot has emitted ``eos_id``.
+
+        prompts: (S, T) int, right-padded to ``prompt_lens``.
+        Returns ``(tokens (S, T + max_new_tokens), lengths (S,))`` —
+        per slot, ``tokens[s, :lengths[s]]`` is prompt + generation
+        (contiguous even when the prompt was padded). Shapes are
+        static in (S, T, max_new_tokens): wrap in `jax.jit` (or AOT
+        `.lower().compile()`) and the whole loop is one program."""
+        from analytics_zoo_tpu.ops.sampling import sample_tokens
+        prompts = jnp.asarray(prompts, jnp.int32)
+        s, tp = prompts.shape
+        if prompt_lens is None:
+            prompt_lens = jnp.full((s,), tp, jnp.int32)
+        prompt_lens = jnp.asarray(prompt_lens, jnp.int32)
+        if rng is None:
+            rng = jax.random.key(0)
+        max_new = int(max_new_tokens)
+        total = tp + max_new
+        cache = self.init_kv_cache(s, total, page_size=page_size,
+                                   dtype=cache_dtype)
+        cache, logits = self.prefill(params, cache, prompts,
+                                     prompt_lens)
+        temp = jnp.broadcast_to(
+            jnp.asarray(temperature, jnp.float32), (s,))
+        buf = jnp.zeros((s, total), jnp.int32)
+        buf = buf.at[:, :tp].set(prompts)
+        tok = sample_tokens(jax.random.fold_in(rng, 0), logits, temp,
+                            top_k)
+        buf = buf.at[jnp.arange(s), prompt_lens].set(tok)
+        done = (tok == eos_id) if eos_id is not None else \
+            jnp.zeros((s,), jnp.bool_)
+        n_new = jnp.ones((s,), jnp.int32)
+
+        def cond(st):
+            _, _, _, done, _, i = st
+            return jnp.logical_and(i < max_new,
+                                   jnp.logical_not(jnp.all(done)))
+
+        def body(st):
+            cache, buf, tok, done, n_new, i = st
+            active = jnp.logical_not(done)
+            cache, logits = self.decode_step(params, cache, tok,
+                                             active=active)
+            nxt = sample_tokens(jax.random.fold_in(rng, i), logits,
+                                temp, top_k)
+            pos = jnp.clip(prompt_lens + i, 0, total - 1)
+            cur = buf[jnp.arange(s), pos]
+            buf = buf.at[jnp.arange(s), pos].set(
+                jnp.where(active, nxt, cur))
+            n_new2 = n_new + active.astype(jnp.int32)
+            if eos_id is not None:
+                done = jnp.logical_or(
+                    done, jnp.logical_and(active, nxt == eos_id))
+            tok = jnp.where(active, nxt, tok)
+            return (cache, buf, tok, done, n_new2, i + 1)
+
+        st = (cache, buf, tok, done, n_new, jnp.asarray(1, jnp.int32))
+        _, buf, _, _, n_new, _ = jax.lax.while_loop(cond, body, st)
+        return buf, prompt_lens + n_new
 
 
 def is_multi(s):

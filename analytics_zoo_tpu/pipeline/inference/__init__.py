@@ -1,14 +1,17 @@
 from analytics_zoo_tpu.pipeline.inference.batching import (
-    DynamicBatcher)
+    ContinuousBatcher, DynamicBatcher)
 from analytics_zoo_tpu.pipeline.inference.fleet import (
     FleetRouter, HttpReplica, Replica, ReplicaPool,
     make_fleet_server)
+from analytics_zoo_tpu.pipeline.inference.generation import (
+    GenerationEngine)
 from analytics_zoo_tpu.pipeline.inference.inference_model import (
     InferenceModel)
 from analytics_zoo_tpu.pipeline.inference.serving import (
     InferenceServer, make_inference_server)
 
 __all__ = ["InferenceModel", "InferenceServer", "DynamicBatcher",
+           "ContinuousBatcher", "GenerationEngine",
            "make_inference_server",
            "ReplicaPool", "Replica", "HttpReplica", "FleetRouter",
            "make_fleet_server"]

@@ -59,6 +59,7 @@ from analytics_zoo_tpu.common.nncontext import logger
 
 __all__ = [
     "DynamicBatcher",
+    "ContinuousBatcher",
     "QueueFullError",
     "DeadlineExpiredError",
     "bucket_ladder",
@@ -597,3 +598,288 @@ class DynamicBatcher:
                 f"max_wait_ms={self.max_wait_s * 1e3:g}, "
                 f"queue_depth={self.queue_depth}, "
                 f"warmed={self.warmed_buckets})")
+
+
+class _GenEntry:
+    """One queued generation request: prompt tokens, decode budget,
+    sampling knobs, completion future, clocks, and — once admitted —
+    its slot and the tokens emitted so far."""
+
+    __slots__ = ("ids", "max_new", "temperature", "eos_id", "future",
+                 "t_enq", "t_enq_wall", "trace", "slot", "tokens",
+                 "t_first")
+
+    def __init__(self, ids, max_new, temperature, eos_id):
+        self.ids = ids
+        self.max_new = max_new
+        self.temperature = temperature
+        self.eos_id = eos_id
+        self.future: "Future" = Future()
+        self.t_enq = time.monotonic()
+        self.t_enq_wall = time.time()
+        self.trace = tracing.current()
+        self.slot = -1
+        self.tokens: "list[int]" = []
+        self.t_first = 0.0  # monotonic time of the first token
+
+
+class ContinuousBatcher:
+    """Iteration-level scheduling for autoregressive decode — the
+    generation-side sibling of :class:`DynamicBatcher` (ORCA,
+    OSDI'22). Where DynamicBatcher coalesces whole fixed-shape
+    forwards, generation requests run for a variable number of steps,
+    so batching whole *requests* would hold every sequence hostage to
+    the longest one. Instead ONE compiled decode step runs
+    continuously over a fixed slot array
+    (`pipeline/inference/generation.py::GenerationEngine`), and this
+    batcher reschedules **between steps**: finished sequences retire
+    (pages reclaimed, future resolved) and queued ones are admitted
+    into the freed slots via a bucket-padded prefill — the running
+    neighbours never stop, and (inactive-slot scatters being dropped)
+    never observe the churn.
+
+    Thread model: handler threads call :meth:`submit`; ONE loop
+    thread drives admit → step → retire. Admission is gated on a free
+    slot AND a full worst-case page reservation
+    (`GenerationEngine.can_admit`), so an admitted sequence always
+    runs to completion.
+
+    Telemetry: `decode/admit` / `decode/step` / `decode/retire` spans
+    (the PR 5 trace vocabulary), slot-occupancy + free-page gauges,
+    a tokens counter and a time-to-first-token histogram
+    (docs/observability.md). ``ZOO_TPU_GEN_QUEUE_DEPTH`` bounds the
+    wait queue (default 64; full → :class:`QueueFullError` → 503),
+    ``ZOO_TPU_GEN_MAX_NEW`` caps any request's decode budget
+    (default 256).
+    """
+
+    def __init__(self, engine, *,
+                 queue_depth: Optional[int] = None,
+                 max_new_cap: Optional[int] = None):
+        env = os.environ
+        if queue_depth is None:
+            queue_depth = int(env.get("ZOO_TPU_GEN_QUEUE_DEPTH", 64))
+        if max_new_cap is None:
+            max_new_cap = int(env.get("ZOO_TPU_GEN_MAX_NEW", 256))
+        self.engine = engine
+        self.queue_depth = int(queue_depth)
+        self.max_new_cap = int(max_new_cap)
+        self._q: "deque[_GenEntry]" = deque()
+        self._active: "list[_GenEntry]" = []
+        self._cond = threading.Condition()
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        self._ema_req_s = 0.05  # retry-after estimator seed
+        self._slots_gauge().set(0)
+        self._pages_gauge().set(engine.free_pages)
+
+    # -- metrics handles ----------------------------------------------------
+    def _slots_gauge(self):
+        return obs.gauge("zoo_tpu_serving_gen_slots_active",
+                         help="decode slots currently generating")
+
+    def _pages_gauge(self):
+        return obs.gauge("zoo_tpu_serving_gen_free_pages",
+                         help="free KV-cache pages in the pool")
+
+    def _depth_gauge(self):
+        return obs.gauge("zoo_tpu_serving_gen_queue_depth",
+                         help="generation requests waiting for a slot")
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "ContinuousBatcher":
+        """AOT-warm the decode/prefill programs and start the loop
+        thread. Idempotent."""
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        diagnostics.install_recompile_monitor()
+        with obs.span("decode/warm"):
+            self.engine.warm()
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._run, name="zoo-tpu-gen-batcher", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 30.0):
+        """Stop the loop thread; queued AND in-flight requests fail
+        with RuntimeError (generation cannot be handed off
+        mid-sequence the way a queued predict can)."""
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+        with self._cond:
+            pending = list(self._q) + list(self._active)
+            self._q.clear()
+            self._active = []
+        for e in pending:
+            if e.slot >= 0:
+                self.engine.release(e.slot)
+            if not e.future.done():
+                e.future.set_exception(
+                    RuntimeError("generation batcher stopped"))
+        self._slots_gauge().set(self.engine.slots_active)
+        self._pages_gauge().set(self.engine.free_pages)
+
+    # -- admission ----------------------------------------------------------
+    def submit(self, prompt_ids, max_new_tokens: int = 32,
+               temperature: float = 0.0, eos_id=None) -> "Future":
+        """Enqueue one generation request. The future resolves to a
+        1-D int array of the NEWLY generated token ids (eos, when
+        hit, included). Raises ValueError for prompts the cache can
+        never hold and :class:`QueueFullError` at capacity."""
+        ids = [int(t) for t in prompt_ids]
+        max_new = min(int(max_new_tokens), self.max_new_cap)
+        if max_new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if not 1 <= len(ids) <= self.engine.max_context - 1:
+            raise ValueError(
+                f"prompt length {len(ids)} outside [1, "
+                f"{self.engine.max_context - 1}] for this cache")
+        entry = _GenEntry(ids, max_new, float(temperature), eos_id)
+        with self._cond:
+            if len(self._q) >= self.queue_depth:
+                retry = max(0.05, len(self._q) * self._ema_req_s)
+                obs.counter("zoo_tpu_serving_errors_total",
+                            help="serving errors by kind",
+                            labels={"kind": "gen_queue_full"}).inc()
+                raise QueueFullError(len(self._q), retry)
+            self._q.append(entry)
+            self._depth_gauge().set(len(self._q))
+            self._cond.notify_all()
+        return entry.future
+
+    # -- the decode loop ----------------------------------------------------
+    def _finish(self, e: "_GenEntry", now: float):
+        with obs.span("decode/retire", slot=e.slot,
+                      tokens=len(e.tokens)):
+            self.engine.release(e.slot)
+        dur = now - e.t_enq
+        self._ema_req_s = 0.8 * self._ema_req_s + 0.2 * dur
+        tracing.record_span(e.trace, "decode/retire", e.t_enq_wall,
+                            dur, slot=e.slot, tokens=len(e.tokens))
+        e.future.set_result(np.asarray(e.tokens, np.int32))
+
+    def _token_out(self, e: "_GenEntry", tok: int, now: float
+                   ) -> bool:
+        """Record one emitted token; True when the request is done."""
+        if not e.tokens:
+            e.t_first = now
+            obs.histogram(
+                "zoo_tpu_serving_gen_ttft_seconds",
+                help="time from submit to first generated token"
+            ).observe(now - e.t_enq)
+        e.tokens.append(tok)
+        if e.eos_id is not None and tok == e.eos_id:
+            return True
+        return len(e.tokens) >= e.max_new
+
+    def _admit_locked_pop(self) -> "list[_GenEntry]":
+        """Pop the longest queue prefix that fits (FIFO — no request
+        starves behind a smaller one that jumped it). Slots and pages
+        consumed by entries popped earlier in the SAME batch are
+        debited provisionally — `engine.can_admit` alone only knows
+        the committed state."""
+        take = []
+        slots = len(self.engine.free_slots)
+        pages = self.engine.free_pages
+        while self._q and slots > 0:
+            e = self._q[0]
+            need = self.engine.pages_for(len(e.ids), e.max_new)
+            if need > pages:
+                break
+            take.append(self._q.popleft())
+            slots -= 1
+            pages -= need
+        if take:
+            self._depth_gauge().set(len(self._q))
+        return take
+
+    def _run(self):
+        engine = self.engine
+        while True:
+            with self._cond:
+                while not self._q and not self._active \
+                        and not self._stop:
+                    self._cond.wait(timeout=0.1)
+                if self._stop:
+                    return
+                fresh = self._admit_locked_pop()
+            try:
+                now = time.monotonic()
+                done: "list[_GenEntry]" = []
+                if fresh:
+                    with obs.span("decode/admit", n=len(fresh)):
+                        first = engine.admit(
+                            [(e.ids, e.max_new, e.temperature)
+                             for e in fresh])
+                    now = time.monotonic()
+                    for e, (slot, tok) in zip(fresh, first):
+                        e.slot = slot
+                        tracing.record_span(
+                            e.trace, "decode/admit", e.t_enq_wall,
+                            now - e.t_enq, slot=slot,
+                            prompt_len=len(e.ids))
+                        if self._token_out(e, tok, now):
+                            done.append(e)
+                        else:
+                            self._active.append(e)
+                if self._active:
+                    active = np.zeros((engine.max_slots,), np.bool_)
+                    for e in self._active:
+                        active[e.slot] = True
+                    with obs.span("decode/step",
+                                  n=int(active.sum())):
+                        toks = engine.step(active)
+                    now = time.monotonic()
+                    still = []
+                    for e in self._active:
+                        if self._token_out(e, int(toks[e.slot]),
+                                           now):
+                            done.append(e)
+                        else:
+                            still.append(e)
+                    self._active = still
+                    obs.counter(
+                        "zoo_tpu_serving_gen_tokens_total",
+                        help="tokens generated").inc(
+                        int(active.sum()))
+                    obs.counter(
+                        "zoo_tpu_serving_gen_steps_total",
+                        help="decode iterations executed").inc()
+                for e in done:
+                    self._finish(e, now)
+            except Exception as exc:
+                # a device/step failure must fail its requests, not
+                # the loop thread; slots are reclaimed so the batch
+                # keeps serving whoever comes next
+                for e in fresh + self._active:
+                    if e.slot >= 0:
+                        engine.release(e.slot)
+                    if not e.future.done():
+                        e.future.set_exception(exc)
+                self._active = []
+                logger.warning("generation batcher error: %s", exc)
+            self._slots_gauge().set(engine.slots_active)
+            self._pages_gauge().set(engine.free_pages)
+
+    # -- introspection ------------------------------------------------------
+    def stats(self) -> dict:
+        """JSON-able summary for ``GET /health``."""
+        with self._cond:
+            depth = len(self._q)
+            active = len(self._active)
+        s = {"enabled": True, "queue_depth": depth,
+             "queue_capacity": self.queue_depth,
+             "requests_active": active,
+             "max_new_cap": self.max_new_cap}
+        s.update(self.engine.stats())
+        return s
+
+    def __repr__(self):
+        return (f"ContinuousBatcher(slots={self.engine.max_slots}, "
+                f"context={self.engine.max_context}, "
+                f"queue_depth={self.queue_depth})")

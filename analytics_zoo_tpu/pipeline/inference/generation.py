@@ -1,0 +1,383 @@
+"""Generation serving engine: device state + compiled programs for
+autoregressive decode.
+
+The model layer owns the math (`TransformerLayer.prefill` /
+`decode_step` / `generate` — `pipeline/api/keras/layers/transformer.py`);
+this module owns everything a *server* needs around it:
+
+- ONE resident :class:`~analytics_zoo_tpu.ops.kv_cache.PagedKVCache`
+  sized ``(max_slots, max_context)``, with the host-side
+  `PageAllocator` assigning physical pages to slots at admission and
+  reclaiming them at retirement — the vLLM bookkeeping half;
+- ONE compiled decode-step program (shape-static over the full slot
+  array, inactive slots frozen by the ``active`` mask) plus one
+  compiled prefill program per prompt-length bucket (the PR 4 bucket
+  ladder, reused) — after :meth:`GenerationEngine.warm`, steady-state
+  serving performs **zero** compilations regardless of the
+  prompt/output-length mix;
+- per-slot sampling state: a traced ``(max_slots,)`` temperature
+  vector (per-request temperature without recompiles) and a static
+  ``top_k`` (``ZOO_TPU_GEN_TOP_K``);
+- a sequential whole-loop :meth:`generate` (the model's compiled
+  `lax.while_loop` path, jit-cached per shape) — the per-request
+  baseline `InferenceModel.generate` serves and `bench_generate.py`
+  A/Bs continuous batching against.
+
+The engine is NOT thread-safe by design: exactly one driver — the
+:class:`~analytics_zoo_tpu.pipeline.inference.batching.ContinuousBatcher`
+loop thread, or a caller of :meth:`generate` — may touch it at a time
+(the batcher serializes admission, stepping, and retirement by
+construction, the same single-dispatcher discipline DynamicBatcher
+uses).
+
+Configuration (constructor kwargs override the environment):
+``ZOO_TPU_GEN_SLOTS`` (default 8), ``ZOO_TPU_GEN_MAX_CONTEXT``
+(default: the net's ``seq_len``), ``ZOO_TPU_GEN_PAGE_SIZE`` (16),
+``ZOO_TPU_GEN_TOP_K`` (0 = full softmax). docs/serving.md has the
+slot/page sizing guide, docs/perf_flags.md the flag catalog.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+from analytics_zoo_tpu.common import observability as obs
+from analytics_zoo_tpu.pipeline.inference.batching import bucket_ladder
+
+__all__ = ["GenerationEngine"]
+
+
+class GenerationEngine:
+    """Resident decode state + compiled programs for one generative
+    net (module docstring has the design).
+
+    ``net`` must expose the decode surface the transformer layer
+    defines: ``init_kv_cache / prefill / decode_step / generate`` and
+    a ``seq_len`` attribute (duck-typed — any net with those methods
+    serves).
+    """
+
+    def __init__(self, net, params, *,
+                 max_slots: Optional[int] = None,
+                 max_context: Optional[int] = None,
+                 page_size: Optional[int] = None,
+                 top_k: Optional[int] = None,
+                 cache_dtype=None,
+                 rng_seed: int = 0):
+        import jax
+
+        env = os.environ
+        if max_slots is None:
+            max_slots = int(env.get("ZOO_TPU_GEN_SLOTS", 8))
+        if max_context is None:
+            max_context = int(env.get("ZOO_TPU_GEN_MAX_CONTEXT",
+                                      net.seq_len))
+        if page_size is None:
+            page_size = int(env.get("ZOO_TPU_GEN_PAGE_SIZE", 16))
+        if top_k is None:
+            top_k = int(env.get("ZOO_TPU_GEN_TOP_K", 0))
+        if max_context > net.seq_len:
+            raise ValueError(
+                f"max_context {max_context} exceeds the net's "
+                f"position table ({net.seq_len})")
+        self.net = net
+        self.params = params
+        self.max_slots = int(max_slots)
+        self.page_size = int(page_size)
+        self.top_k = int(top_k)
+        self.cache_dtype = cache_dtype
+
+        from analytics_zoo_tpu.ops import kv_cache as kvc
+        cache = net.init_kv_cache(self.max_slots, int(max_context),
+                                  page_size=self.page_size,
+                                  dtype=cache_dtype)
+        self.max_context = cache.max_context  # whole-page rounded
+        self.pages_per_slot = cache.page_table.shape[1]
+        # the engine owns page placement: blank the identity table and
+        # hand every physical page to the allocator
+        self._table = np.zeros(
+            (self.max_slots, self.pages_per_slot), np.int32)
+        self.cache = cache._replace(
+            page_table=jax.numpy.asarray(self._table))
+        self.allocator = kvc.PageAllocator(cache.k_pages.shape[1])
+        self._slot_pages: "dict[int, list]" = {}
+        self.free_slots = set(range(self.max_slots))
+
+        # per-slot sampling state (traced per call — no recompiles)
+        self._temps = np.zeros((self.max_slots,), np.float32)
+        self._last_tok = np.zeros((self.max_slots,), np.int32)
+        self._rng = jax.random.key(int(rng_seed))
+        self._step_id = 0
+
+        # prompt-length buckets: the PR 4 ladder, capped at what the
+        # position table and the cache can hold
+        self.prompt_buckets = bucket_ladder(
+            min(self.max_context, int(net.seq_len)))
+
+        self._compiled_step = None
+        self._compiled_prefill: dict = {}
+        self._gen_jits: dict = {}
+
+    # -- compiled programs --------------------------------------------------
+    def _step_fn(self, cache, params, tok, active, temps, rng, step):
+        import jax
+        from analytics_zoo_tpu.ops.sampling import sample_tokens
+        cache, logits = self.net.decode_step(params, cache, tok,
+                                             active=active)
+        nxt = sample_tokens(jax.random.fold_in(rng, step),
+                            logits.astype(jax.numpy.float32), temps,
+                            self.top_k)
+        return cache, nxt
+
+    def _prefill_fn(self, cache, params, ids, plens, temps, rng,
+                    step):
+        import jax
+        from analytics_zoo_tpu.ops.sampling import sample_tokens
+        cache, logits = self.net.prefill(params, cache, ids, plens)
+        nxt = sample_tokens(jax.random.fold_in(rng, step),
+                            logits.astype(jax.numpy.float32), temps,
+                            self.top_k)
+        return cache, nxt
+
+    def _abstract(self, tree):
+        import jax
+        return jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(np.shape(a),
+                                           np.asarray(a).dtype)
+            if not hasattr(a, "aval") else
+            jax.ShapeDtypeStruct(a.shape, a.dtype), tree)
+
+    def _get_step(self):
+        if self._compiled_step is None:
+            import jax
+            s = self.max_slots
+            structs = (
+                self._abstract(self.cache),
+                self._abstract(self.params),
+                jax.ShapeDtypeStruct((s,), np.int32),
+                jax.ShapeDtypeStruct((s,), np.bool_),
+                jax.ShapeDtypeStruct((s,), np.float32),
+                self._abstract(self._rng),
+                jax.ShapeDtypeStruct((), np.int32),
+            )
+            with obs.span("decode/compile", program="step"):
+                self._compiled_step = jax.jit(
+                    self._step_fn,
+                    donate_argnums=(0,)).lower(*structs).compile()
+            obs.counter(
+                "zoo_tpu_serving_gen_compiles_total",
+                help="generation programs compiled (warm-up only in "
+                "steady state)", labels={"program": "step"}).inc()
+        return self._compiled_step
+
+    def _get_prefill(self, tp: int):
+        fn = self._compiled_prefill.get(tp)
+        if fn is None:
+            import jax
+            s = self.max_slots
+            structs = (
+                self._abstract(self.cache),
+                self._abstract(self.params),
+                jax.ShapeDtypeStruct((s, tp), np.int32),
+                jax.ShapeDtypeStruct((s,), np.int32),
+                jax.ShapeDtypeStruct((s,), np.float32),
+                self._abstract(self._rng),
+                jax.ShapeDtypeStruct((), np.int32),
+            )
+            with obs.span("decode/compile", program="prefill",
+                          bucket=tp):
+                fn = jax.jit(
+                    self._prefill_fn,
+                    donate_argnums=(0,)).lower(*structs).compile()
+            obs.counter(
+                "zoo_tpu_serving_gen_compiles_total",
+                help="generation programs compiled (warm-up only in "
+                "steady state)", labels={"program": "prefill"}).inc()
+            self._compiled_prefill[tp] = fn
+        return fn
+
+    def warm(self) -> int:
+        """AOT-compile the decode step and every prompt bucket's
+        prefill up front, so the serving loop never compiles under
+        traffic (the DynamicBatcher bucket-warm discipline). Returns
+        the number of programs compiled this call. Idempotent."""
+        n0 = len(self._compiled_prefill) + bool(self._compiled_step)
+        self._get_step()
+        for tp in self.prompt_buckets:
+            self._get_prefill(tp)
+        return (len(self._compiled_prefill) + 1) - n0
+
+    # -- admission / stepping / retirement ----------------------------------
+    def pages_for(self, prompt_len: int, max_new: int) -> int:
+        """Worst-case page reservation for one request (prompt +
+        max_new tokens, capped at the context window)."""
+        from analytics_zoo_tpu.ops.kv_cache import PageAllocator
+        return PageAllocator.pages_needed(
+            min(prompt_len + max_new, self.max_context),
+            self.page_size)
+
+    def can_admit(self, prompt_len: int, max_new: int) -> bool:
+        """Whether a request of this size fits RIGHT NOW: a free slot
+        and enough free pages for its worst case. Pages are reserved
+        in full at admission (prompt + max_new tokens), so an admitted
+        sequence can always run to completion — no mid-decode
+        eviction, no allocation deadlock."""
+        return bool(self.free_slots) and self.allocator.can_alloc(
+            self.pages_for(prompt_len, max_new))
+
+    def admit(self, requests: "Sequence[tuple]") -> "list[tuple]":
+        """Admit ``[(prompt_ids, max_new, temperature), ...]`` into
+        free slots of the LIVE batch: assign pages, write the table
+        rows, run ONE bucket-padded prefill (slots not being admitted
+        pass ``prompt_lens == 0`` and are untouched — the property
+        `prefill` guarantees), and sample each new slot's first
+        token. Returns ``[(slot, first_token), ...]``. Raises
+        MemoryError when slots/pages run out mid-list (callers gate
+        with :meth:`can_admit` per request first)."""
+        import jax
+        from analytics_zoo_tpu.ops.kv_cache import PageAllocator
+        if not requests:
+            return []
+        for prompt_ids, _, _ in requests:
+            if not 1 <= len(prompt_ids) <= self.max_context - 1:
+                raise ValueError(
+                    f"prompt length {len(prompt_ids)} outside [1, "
+                    f"{self.max_context - 1}]")
+        tp = max(len(r[0]) for r in requests)
+        tp = next(b for b in self.prompt_buckets if b >= tp)
+        ids_arr = np.zeros((self.max_slots, tp), np.int32)
+        plens = np.zeros((self.max_slots,), np.int32)
+        admitted = []
+        for prompt_ids, max_new, temperature in requests:
+            n = len(prompt_ids)
+            need = PageAllocator.pages_needed(
+                min(n + int(max_new), self.max_context),
+                self.page_size)
+            if not self.free_slots:
+                raise MemoryError("no free decode slot")
+            pages = self.allocator.alloc(need)  # MemoryError if short
+            slot = min(self.free_slots)
+            self.free_slots.discard(slot)
+            self._slot_pages[slot] = pages
+            row = np.full((self.pages_per_slot,), pages[-1], np.int32)
+            row[:need] = pages
+            self._table[slot] = row
+            ids_arr[slot, :n] = np.asarray(prompt_ids, np.int32)
+            plens[slot] = n
+            self._temps[slot] = float(temperature)
+            admitted.append(slot)
+        self.cache = self.cache._replace(
+            page_table=jax.numpy.asarray(self._table))
+        fn = self._get_prefill(tp)
+        self.cache, toks = fn(self.cache, self.params, ids_arr,
+                              plens, self._temps, self._rng,
+                              np.int32(self._step_id))
+        self._step_id += 1
+        toks = np.asarray(toks)
+        out = []
+        for slot in admitted:
+            self._last_tok[slot] = toks[slot]
+            out.append((slot, int(toks[slot])))
+        return out
+
+    def step(self, active: np.ndarray) -> np.ndarray:
+        """One decode iteration over the WHOLE slot array: append each
+        active slot's last token to the cache, attend, sample. Slots
+        with ``active == False`` are frozen (nothing written, lengths
+        unchanged). Returns the ``(max_slots,)`` sampled tokens —
+        meaningful only at active slots."""
+        fn = self._get_step()
+        active = np.asarray(active, np.bool_)
+        self.cache, toks = fn(self.cache, self.params,
+                              self._last_tok, active, self._temps,
+                              self._rng, np.int32(self._step_id))
+        self._step_id += 1
+        toks = np.asarray(toks)
+        self._last_tok = np.where(active, toks, self._last_tok
+                                  ).astype(np.int32)
+        return toks
+
+    def release(self, slot: int):
+        """Retire a slot: reclaim its pages and return it to the free
+        pool. The cache rows need no reset — a future `prefill` with
+        ``prompt_lens > 0`` overwrites ``seq_lens``, and until then
+        the ``active`` mask keeps the slot frozen."""
+        pages = self._slot_pages.pop(slot, None)
+        if pages:
+            self.allocator.free(pages)
+        self.free_slots.add(slot)
+
+    @property
+    def slots_active(self) -> int:
+        return self.max_slots - len(self.free_slots)
+
+    @property
+    def free_pages(self) -> int:
+        return self.allocator.free_pages
+
+    # -- sequential whole-loop path -----------------------------------------
+    def generate(self, prompts, max_new_tokens: int = 32, *,
+                 temperature: float = 0.0, eos_id=None, rng=None
+                 ) -> "list[np.ndarray]":
+        """Per-request compiled generation: the model's whole-loop
+        `generate` (prefill + `lax.while_loop`), jit-cached per
+        (batch, prompt-bucket, max_new) shape. This is the SEQUENTIAL
+        baseline — each call owns a fresh cache and runs to
+        completion; concurrent traffic should go through the
+        continuous batcher instead. Returns one array of NEWLY
+        generated token ids per prompt (eos, when hit, included)."""
+        import jax
+        if prompts and np.isscalar(prompts[0]):
+            prompts = [prompts]
+        s = len(prompts)
+        tp = max(len(p) for p in prompts)
+        tp = next((b for b in self.prompt_buckets if b >= tp), tp)
+        max_new = int(max_new_tokens)
+        ids = np.zeros((s, tp), np.int32)
+        plens = np.zeros((s,), np.int32)
+        for i, p in enumerate(prompts):
+            ids[i, :len(p)] = np.asarray(p, np.int32)
+            plens[i] = len(p)
+        key = (s, tp, max_new, eos_id)
+        fn = self._gen_jits.get(key)
+        if fn is None:
+            net, tk = self.net, self.top_k
+            ps, cd = self.page_size, self.cache_dtype
+
+            def run(params, ids, plens, temps, rng):
+                return net.generate(
+                    params, ids, prompt_lens=plens,
+                    max_new_tokens=max_new, temperature=temps,
+                    top_k=tk, eos_id=eos_id, rng=rng,
+                    page_size=ps, cache_dtype=cd)
+
+            fn = jax.jit(run)
+            self._gen_jits[key] = fn
+        temps = np.full((s,), float(temperature), np.float32)
+        buf, lens = fn(self.params, ids, plens, temps,
+                       self._rng if rng is None else rng)
+        buf, lens = np.asarray(buf), np.asarray(lens)
+        return [buf[i, plens[i]:lens[i]] for i in range(s)]
+
+    def stats(self) -> dict:
+        """JSON-able summary for ``GET /health``."""
+        return {
+            "max_slots": self.max_slots,
+            "slots_active": self.slots_active,
+            "max_context": self.max_context,
+            "page_size": self.page_size,
+            "free_pages": self.free_pages,
+            "total_pages": self.allocator.max_pages,
+            "prompt_buckets": list(self.prompt_buckets),
+            "warmed_programs": (len(self._compiled_prefill)
+                                + bool(self._compiled_step)),
+        }
+
+    def __repr__(self):
+        return (f"GenerationEngine(slots={self.max_slots}, "
+                f"context={self.max_context}, "
+                f"page_size={self.page_size}, "
+                f"free_pages={self.free_pages})")

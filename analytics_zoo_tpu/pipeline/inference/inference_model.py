@@ -80,6 +80,7 @@ class InferenceModel:
         self._generation = 0
         self._lock = threading.Lock()
         self.quantized = None  # QuantizedModel when loaded with int8
+        self._generator = None  # GenerationEngine via load_generator
 
     # -- loaders ------------------------------------------------------------
     def _install(self, predict_fn: Callable,
@@ -472,6 +473,51 @@ class InferenceModel:
                 return np.asarray(out)
         finally:
             queue.put(slot)
+
+    # -- generation (pipeline/inference/generation.py) ----------------------
+    def load_generator(self, net, params=None, **engine_kwargs):
+        """Attach an autoregressive decode engine for ``net`` (a
+        transformer-style stack exposing ``init_kv_cache / prefill /
+        decode_step / generate`` — `pipeline/api/keras/layers/
+        transformer.py`). Orthogonal to the ``load_*`` predict path:
+        a model can serve ``/predict`` and ``/generate`` at once, and
+        loading a generator does not invalidate warmed predict
+        buckets. ``engine_kwargs`` forward to
+        :class:`~analytics_zoo_tpu.pipeline.inference.generation.
+        GenerationEngine` (``max_slots``, ``max_context``,
+        ``page_size``, ``top_k``, ``cache_dtype`` — env-defaulted,
+        docs/perf_flags.md)."""
+        from analytics_zoo_tpu.pipeline.inference.generation import \
+            GenerationEngine
+        if params is None:
+            est = net.estimator
+            if est.params is None:
+                est._ensure_initialized()
+            params = est.params
+        self._generator = GenerationEngine(net, params,
+                                           **engine_kwargs)
+        return self
+
+    @property
+    def generator(self):
+        """The attached GenerationEngine, or None — how the serving
+        front-ends decide whether to mount ``/generate``."""
+        return self._generator
+
+    def generate(self, prompts, max_new_tokens: int = 32, *,
+                 temperature: float = 0.0, eos_id=None):
+        """Sequential per-request generation: one compiled whole-loop
+        program per (batch, prompt-bucket, budget) shape — the
+        baseline the continuous batcher is benchmarked against
+        (`scripts/bench_generate.py`). ``prompts``: one token-id list
+        or a list of them. Returns a list of 1-D arrays of newly
+        generated ids."""
+        if self._generator is None:
+            raise RuntimeError(
+                "no generator loaded; call load_generator(net) first")
+        return self._generator.generate(
+            prompts, max_new_tokens=max_new_tokens,
+            temperature=temperature, eos_id=eos_id)
 
     # -- dynamic-batching hooks (pipeline/inference/batching.py) ------------
     @property

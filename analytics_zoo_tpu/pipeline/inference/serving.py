@@ -163,16 +163,93 @@ def handle_predict(model: InferenceModel, body: bytes,
         return 500, _error_body(500, str(e), kind="internal")
 
 
+def handle_generate(model: InferenceModel, body: bytes,
+                    gen_batcher=None) -> "Tuple[int, dict]":
+    """The /generate contract, shared by both front-ends: JSON body →
+    (http_status, payload_dict).
+
+    Request: ``{"prompt": [ids...]}`` (one sequence) or
+    ``{"prompts": [[ids...], ...]}``, with optional
+    ``max_new_tokens`` (default 32), ``temperature`` (default 0 =
+    greedy) and ``eos_id``. Response mirrors the request's shape:
+    ``{"tokens": [...]}`` or ``{"tokens": [[...], ...]}`` — the NEWLY
+    generated ids only (eos, when hit, included).
+
+    With a :class:`ContinuousBatcher` the sequences join the live
+    decode batch (one compiled step, token-boundary admission —
+    docs/serving.md); without one they run the sequential compiled
+    whole-loop path (`InferenceModel.generate`). 501 when the model
+    has no generator loaded."""
+    try:
+        req = json.loads(body)
+    except (ValueError, UnicodeDecodeError) as e:
+        _count_error("bad_json")
+        return 400, _error_body(400, f"malformed JSON body: {e}")
+    if not isinstance(req, dict) or \
+            ("prompt" not in req) == ("prompts" not in req):
+        _count_error("bad_request")
+        return 400, _error_body(
+            400, 'request must be a JSON object with exactly one of '
+            '"prompt" (one token-id list) or "prompts" (a list of '
+            'them)')
+    if getattr(model, "generator", None) is None:
+        _count_error("no_generator")
+        return 501, _error_body(
+            501, "this server has no generative model loaded "
+            "(InferenceModel.load_generator)")
+    single = "prompt" in req
+    prompts = [req["prompt"]] if single else req["prompts"]
+    try:
+        prompts = [[int(t) for t in p] for p in prompts]
+        max_new = int(req.get("max_new_tokens", 32))
+        temperature = float(req.get("temperature", 0.0))
+        eos_id = req.get("eos_id")
+        eos_id = None if eos_id is None else int(eos_id)
+    except (TypeError, ValueError) as e:
+        _count_error("bad_request")
+        return 400, _error_body(
+            400, f"prompts must be lists of token ids: {e}")
+    try:
+        if gen_batcher is not None:
+            futures = [gen_batcher.submit(
+                p, max_new_tokens=max_new, temperature=temperature,
+                eos_id=eos_id) for p in prompts]
+            outs = [f.result() for f in futures]
+        else:
+            outs = model.generate(prompts, max_new_tokens=max_new,
+                                  temperature=temperature,
+                                  eos_id=eos_id)
+        toks = [[int(t) for t in o] for o in outs]
+        return 200, {"tokens": toks[0] if single else toks}
+    except QueueFullError as e:
+        return 503, _error_body(
+            503, str(e), retry_after_s=round(e.retry_after_s, 3))
+    except ValueError as e:  # prompt/budget outside the cache bounds
+        _count_error("bad_request")
+        return 400, _error_body(400, str(e))
+    except Exception as e:  # serving boundary: report, not die
+        _count_error("internal")
+        return 500, _error_body(500, str(e), kind="internal")
+
+
 def _health_payload(model: InferenceModel,
-                    batcher: "Optional[DynamicBatcher]") -> dict:
+                    batcher: "Optional[DynamicBatcher]",
+                    gen_batcher=None) -> dict:
     """Shared /health body: model pool capacity plus the batcher's
-    queue/bucket state (docs/serving.md)."""
-    return {
+    queue/bucket state (docs/serving.md), and — when a generator is
+    mounted — the continuous batcher's slot/page occupancy."""
+    payload = {
         "status": "ok",
         "free_slots": model.concurrent_slots_free,
         "batcher": (batcher.stats() if batcher is not None
                     else {"enabled": False}),
     }
+    if gen_batcher is not None:
+        payload["generator"] = gen_batcher.stats()
+    elif getattr(model, "generator", None) is not None:
+        payload["generator"] = dict(model.generator.stats(),
+                                    enabled=False)
+    return payload
 
 
 def _traces_payload(path: str) -> dict:
@@ -280,6 +357,25 @@ def handle_profile(body: bytes) -> "Tuple[int, dict]":
     return 200, {"status": "capturing", "dir": out_dir, "ms": ms}
 
 
+def _resolve_gen_batcher(model: InferenceModel, gen_batcher):
+    """``"auto"`` → a :class:`ContinuousBatcher` over the model's
+    loaded generator (None when no generator is loaded or
+    ``ZOO_TPU_GEN_BATCH=0`` — /generate then runs the sequential
+    per-request path); explicit ``None`` / instance pass through. A
+    FleetRouter standing in for the model has no generator, so fleet
+    front doors resolve to None and /generate degrades cleanly."""
+    if gen_batcher == "auto":
+        import os
+        engine = getattr(model, "generator", None)
+        if engine is None or \
+                os.environ.get("ZOO_TPU_GEN_BATCH", "1") == "0":
+            return None
+        from analytics_zoo_tpu.pipeline.inference.batching import \
+            ContinuousBatcher
+        return ContinuousBatcher(engine)
+    return gen_batcher
+
+
 def _resolve_batcher(model: InferenceModel, batcher):
     """``"auto"`` → env-configured batcher (None when
     ``ZOO_TPU_SERVING_BATCH=0``); explicit ``None`` → per-request
@@ -296,9 +392,10 @@ def _resolve_batcher(model: InferenceModel, batcher):
 
 class InferenceServer:
     def __init__(self, model: InferenceModel, host: str = "127.0.0.1",
-                 port: int = 0, batcher="auto"):
+                 port: int = 0, batcher="auto", gen_batcher="auto"):
         self.model = model
         self.batcher = _resolve_batcher(model, batcher)
+        self.gen_batcher = _resolve_gen_batcher(model, gen_batcher)
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -344,7 +441,8 @@ class InferenceServer:
                     if route == "/health":
                         status = 200
                         payload = _health_payload(
-                            server.model, server.batcher)
+                            server.model, server.batcher,
+                            server.gen_batcher)
                     elif route == "/metrics":
                         status = 200
                     elif route == "/debug/traces":
@@ -382,7 +480,8 @@ class InferenceServer:
                 trace_id = None
                 route = self.path.split("?", 1)[0]
                 try:
-                    if route not in ("/predict", "/debug/profile"):
+                    if route not in ("/predict", "/generate",
+                                     "/debug/profile"):
                         status = 404
                         _count_error("not_found")
                         payload = _error_body(
@@ -406,10 +505,17 @@ class InferenceServer:
                                         trace_id=self.headers.get(
                                             tracing.TRACE_HEADER),
                                         path=route) as tr:
-                                    status, payload = \
-                                        handle_predict(
-                                            server.model, body,
-                                            batcher=server.batcher)
+                                    if route == "/generate":
+                                        status, payload = \
+                                            handle_generate(
+                                                server.model, body,
+                                                server.gen_batcher)
+                                    else:
+                                        status, payload = \
+                                            handle_predict(
+                                                server.model, body,
+                                                batcher=server
+                                                .batcher)
                                     tr.annotate(status=status)
                                 trace_id = tr.trace_id
                 finally:
@@ -433,6 +539,8 @@ class InferenceServer:
         # state then serves any request-size mix with zero compiles
         if self.batcher is not None:
             self.batcher.start()
+        if self.gen_batcher is not None:
+            self.gen_batcher.start()
         # shipped serving objectives + background evaluation ticker
         # (docs/slo.md; ZOO_TPU_SLO=0 disables); a fleet front door
         # adds the fleet-level objectives on top
@@ -453,6 +561,8 @@ class InferenceServer:
             self._thread.join(timeout=5)
         if self.batcher is not None:
             self.batcher.stop()
+        if self.gen_batcher is not None:
+            self.gen_batcher.stop()
 
 
 class NativeInferenceServer:
@@ -469,10 +579,12 @@ class NativeInferenceServer:
     """
 
     def __init__(self, model: InferenceModel, port: int = 0,
-                 workers: Optional[int] = None, batcher="auto"):
+                 workers: Optional[int] = None, batcher="auto",
+                 gen_batcher="auto"):
         from analytics_zoo_tpu.native import NativeHttpServer
         self.model = model
         self.batcher = _resolve_batcher(model, batcher)
+        self.gen_batcher = _resolve_gen_batcher(model, gen_batcher)
         self._srv = NativeHttpServer(port=port)
         self._workers = workers or model.supported_concurrent_num
         self._threads: "list[threading.Thread]" = []
@@ -506,7 +618,7 @@ class NativeInferenceServer:
             elif route == "/debug/profile":
                 status, payload = handle_profile(body)
                 out = json.dumps(payload).encode()
-            elif route != "/predict":
+            elif route not in ("/predict", "/generate"):
                 status = 404
                 _count_error("not_found")
                 out = json.dumps(
@@ -516,8 +628,12 @@ class NativeInferenceServer:
                 with tracing.trace("serving/request",
                                    trace_id=trace_hdr,
                                    path=route) as tr:
-                    status, payload = handle_predict(
-                        self.model, body, batcher=self.batcher)
+                    if route == "/generate":
+                        status, payload = handle_generate(
+                            self.model, body, self.gen_batcher)
+                    else:
+                        status, payload = handle_predict(
+                            self.model, body, batcher=self.batcher)
                     tr.annotate(status=status)
                 trace_id = tr.trace_id
                 out = json.dumps(payload).encode()
@@ -542,7 +658,8 @@ class NativeInferenceServer:
         # batcher queue state; the native front-end cannot set a
         # Retry-After header, so 503 bodies carry retry_after_s)
         self._srv.set_health(json.dumps(
-            _health_payload(self.model, self.batcher)))
+            _health_payload(self.model, self.batcher,
+                            self.gen_batcher)))
 
     def _loop(self):
         from analytics_zoo_tpu.common.nncontext import logger
@@ -563,11 +680,14 @@ class NativeInferenceServer:
     def start(self, background: bool = True):
         if self.batcher is not None:
             self.batcher.start()
+        if self.gen_batcher is not None:
+            self.gen_batcher.start()
         slo_lib.ensure_default_slos("serving")
         if hasattr(self.batcher, "fleet_status"):
             slo_lib.ensure_default_slos("fleet")
         self._srv.set_health(json.dumps(
-            _health_payload(self.model, self.batcher)))
+            _health_payload(self.model, self.batcher,
+                            self.gen_batcher)))
         for _ in range(self._workers):
             t = threading.Thread(target=self._loop, daemon=True)
             t.start()
@@ -589,6 +709,8 @@ class NativeInferenceServer:
             t.join(timeout=max(deadline - time.monotonic(), 0.1))
         if self.batcher is not None:
             self.batcher.stop()
+        if self.gen_batcher is not None:
+            self.gen_batcher.stop()
         if any(t.is_alive() for t in self._threads):
             from analytics_zoo_tpu.common.nncontext import logger
             logger.warning(
@@ -601,15 +723,20 @@ class NativeInferenceServer:
 
 def make_inference_server(model: InferenceModel, port: int = 0,
                           prefer_native: bool = True,
-                          batcher="auto"):
+                          batcher="auto", gen_batcher="auto"):
     """Native C++ front-end when the toolchain built it, else the
     stdlib ThreadingHTTPServer — same endpoints either way.
     ``batcher``: ``"auto"`` (env-configured dynamic batching),
-    ``None`` (per-request), or a :class:`DynamicBatcher`."""
+    ``None`` (per-request), or a :class:`DynamicBatcher`.
+    ``gen_batcher``: same trio for /generate — ``"auto"`` mounts a
+    :class:`ContinuousBatcher` iff the model has a generator loaded
+    (and ``ZOO_TPU_GEN_BATCH`` != 0)."""
     if prefer_native:
         try:
             return NativeInferenceServer(model, port=port,
-                                         batcher=batcher)
+                                         batcher=batcher,
+                                         gen_batcher=gen_batcher)
         except (RuntimeError, OSError):
             pass
-    return InferenceServer(model, port=port, batcher=batcher)
+    return InferenceServer(model, port=port, batcher=batcher,
+                           gen_batcher=gen_batcher)

@@ -121,6 +121,66 @@ def _probe_main():
 
 
 # ---------------------------------------------------------------------------
+# Sticky probe-failure cache: a dead tunnel stays dead for the rest of
+# the bench round (and usually the whole session) — once one probe has
+# burned its 25s confirming that, later invocations inside the TTL
+# should not pay it again. The first failure is banked to a small temp
+# file; while it is fresh the probe is SKIPPED and the round fails over
+# to CPU stages instantly, with `probe_fast_path: true` in the artifact
+# so dashboards can tell a measured dead probe from a remembered one.
+# A probe that succeeds clears the cache (tunnel revived).
+# ---------------------------------------------------------------------------
+
+def _probe_cache_path() -> str:
+    import tempfile
+    return os.environ.get(
+        "ZOO_TPU_BENCH_PROBE_CACHE",
+        os.path.join(tempfile.gettempdir(),
+                     f"zoo_tpu_probe_fail_{os.getuid()}.json"))
+
+
+def _probe_cache_ttl_s() -> float:
+    # 0 disables the fast path (every invocation probes live)
+    return float(os.environ.get("ZOO_TPU_BENCH_PROBE_CACHE_S", "600"))
+
+
+def _cached_probe_failure():
+    """The banked failure ``{"kind": ..., "ts": ..., "msg": ...}``
+    when one exists and is inside the TTL, else None."""
+    ttl = _probe_cache_ttl_s()
+    if ttl <= 0:
+        return None
+    try:
+        with open(_probe_cache_path()) as f:
+            rec = json.load(f)
+        age = time.time() - float(rec["ts"])
+        if 0 <= age < ttl:
+            rec["age_s"] = round(age, 1)
+            return rec
+    except (OSError, ValueError, KeyError, TypeError):
+        pass
+    return None
+
+
+def _bank_probe_failure(kind: str, msg: str) -> None:
+    if _probe_cache_ttl_s() <= 0:
+        return
+    try:
+        with open(_probe_cache_path(), "w") as f:
+            json.dump({"kind": kind, "msg": msg, "ts": time.time()},
+                      f)
+    except OSError:
+        pass  # uncacheable tmpdir — the next round just probes again
+
+
+def _clear_probe_failure() -> None:
+    try:
+        os.unlink(_probe_cache_path())
+    except OSError:
+        pass
+
+
+# ---------------------------------------------------------------------------
 # CPU fallback stages: each runs in its own subprocess (own deadline,
 # own interpreter) and prints ONE JSON record line. Each pins the CPU
 # platform FIRST — both the config (authoritative over the axon
@@ -707,21 +767,39 @@ def _supervise(budget_s: float) -> None:
     probe_s = float(os.environ.get("ZOO_TPU_BENCH_PROBE_S", "25"))
     t_probe = time.perf_counter()
     probe_fail_kind = None
-    try:
-        p = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--probe"],
-            timeout=min(probe_s,
-                        max(deadline - time.perf_counter(), 1.0)),
-            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
-            text=True)
-        probe_ok = p.returncode == 0 and "PROBE_OK" in (p.stdout or "")
-        probe_msg = (p.stdout or "").strip() or f"rc={p.returncode}"
-        if not probe_ok:
-            probe_fail_kind = ("probe_rc" if p.returncode != 0
-                               else "no_probe_ok")
-    except subprocess.TimeoutExpired:
-        probe_ok, probe_msg = False, f"no response in {probe_s:.0f}s"
-        probe_fail_kind = "timeout"
+    cached = _cached_probe_failure()
+    if cached is not None:
+        # sticky fast path: a probe already died within the TTL — skip
+        # straight to CPU fallback instead of re-burning up to 25s
+        probe_ok = False
+        probe_fail_kind = cached.get("kind", "cached")
+        probe_msg = (f"cached failure ({cached.get('msg', '?')}, "
+                     f"{cached.get('age_s', '?')}s ago)")
+        merged["probe_fast_path"] = True
+    else:
+        try:
+            p = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--probe"],
+                timeout=min(probe_s,
+                            max(deadline - time.perf_counter(), 1.0)),
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                text=True)
+            probe_ok = p.returncode == 0 and \
+                "PROBE_OK" in (p.stdout or "")
+            probe_msg = (p.stdout or "").strip() or \
+                f"rc={p.returncode}"
+            if not probe_ok:
+                probe_fail_kind = ("probe_rc" if p.returncode != 0
+                                   else "no_probe_ok")
+        except subprocess.TimeoutExpired:
+            probe_ok, probe_msg = (False,
+                                   f"no response in {probe_s:.0f}s")
+            probe_fail_kind = "timeout"
+        if probe_ok:
+            _clear_probe_failure()  # tunnel alive — forget old deaths
+        else:
+            _bank_probe_failure(probe_fail_kind, probe_msg)
     merged["probe_latency_s"] = round(
         time.perf_counter() - t_probe, 3)
 

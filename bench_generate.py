@@ -1,0 +1,265 @@
+"""Generation-path benchmark: continuous batching vs sequential decode.
+
+Closed-loop multi-client harness over the decode fast path
+(`pipeline/inference/generation.py` + `ContinuousBatcher`): N client
+threads each submit generation requests (mixed prompt lengths and
+decode budgets) as fast as results return, for a fixed wall-clock
+window. Run twice:
+
+- **continuous** — every client submits into the live
+  `ContinuousBatcher`; sequences share ONE compiled decode step and
+  join/leave at token boundaries (ORCA-style iteration scheduling);
+- **sequential** — the per-request baseline: one compiled whole-loop
+  `generate` at a time (`InferenceModel.generate`, batch 1),
+  serialized the way per-request decode actually serializes.
+
+Reports tokens/sec, request latency p50/p99, and mean time-to-first-
+token for both modes. Prints ONE JSON line in the bench_common
+artifact schema and ALSO writes it to ``BENCH_generate.json``:
+
+    {"metric": "generate_throughput_tokens_per_sec",
+     "unit": "tokens/sec", "value": N, "vs_baseline": null,
+     "generate": {...}, "extra_metrics": [...], "telemetry": {...}}
+
+The ``"generate"`` block (slots, page_size, max_context, clients) is
+what `scripts/perf_sentinel.py` keys on to give generation runs their
+own lineage — decode tokens/s is never compared against predict-path
+rows/s. With ``--cpu-fallback`` the headline ``value`` is null and
+the measured number moves to ``cpu_fallback_value`` (the schema's
+rule: a null headline can never be mistaken for chip perf). The
+acceptance gate is continuous >= sequential tokens/s at >= 4
+concurrent clients.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+_t_start = time.perf_counter()
+
+# mixed workload, cycled per client: (prompt_len, max_new_tokens) —
+# varied on both axes so admission is genuinely staggered and the
+# prompt-bucket ladder is exercised past one shape
+WORK_MIX = ((4, 16), (9, 24), (17, 8), (6, 32), (12, 16), (27, 12))
+
+SLOTS = 8
+SEQ_LEN = 128
+VOCAB = 256
+
+
+def _build_engine():
+    from analytics_zoo_tpu import init_nncontext
+    from analytics_zoo_tpu.pipeline.api.keras.layers.transformer \
+        import TransformerLayer
+    from analytics_zoo_tpu.pipeline.inference import InferenceModel
+
+    init_nncontext(seed=0, log_level="WARNING")
+    import jax
+    # enough width that a decode step has real matmul traffic, small
+    # enough that the CPU host finishes the window in seconds
+    net = TransformerLayer(n_block=2, hidden_size=128, n_head=4,
+                           seq_len=SEQ_LEN, vocab=VOCAB,
+                           hidden_p_drop=0.0, attn_p_drop=0.0,
+                           embed_p_drop=0.0)
+    params = net.build(jax.random.key(0), (SEQ_LEN,))
+    im = InferenceModel()
+    im.load_generator(net, params, max_slots=SLOTS,
+                      max_context=SEQ_LEN, page_size=16)
+    return im
+
+
+def _ttft_mean_ms(before: "tuple[float, float]") -> "float | None":
+    """Mean time-to-first-token over the window, from the serving
+    histogram's (sum, count) delta. None when nothing was observed."""
+    from analytics_zoo_tpu.common import observability as obs
+    h = obs.histogram("zoo_tpu_serving_gen_ttft_seconds",
+                      help="time from submit to first generated token")
+    ds, dc = h.sum - before[0], h.count - before[1]
+    return round(ds / dc * 1e3, 2) if dc else None
+
+
+def _ttft_state() -> "tuple[float, float]":
+    from analytics_zoo_tpu.common import observability as obs
+    h = obs.histogram("zoo_tpu_serving_gen_ttft_seconds",
+                      help="time from submit to first generated token")
+    return h.sum, h.count
+
+
+def _run_clients(submit, clients: int, duration_s: float):
+    """Closed loop: every client submits back-to-back until the
+    window closes. ``submit(prompt, max_new) -> token array``.
+    Returns (tokens_done, request_latencies_s, errors)."""
+    rs = np.random.RandomState(7)
+    prompts = {n: rs.randint(1, VOCAB, size=n).tolist()
+               for n, _ in WORK_MIX}
+    stop_at = time.perf_counter() + duration_s
+    lock = threading.Lock()
+    lat, toks, errors = [], [0], [0]
+
+    def client(cid: int):
+        i = cid  # stagger the mix across clients
+        while time.perf_counter() < stop_at:
+            n, max_new = WORK_MIX[i % len(WORK_MIX)]
+            i += 1
+            t0 = time.perf_counter()
+            try:
+                out = submit(prompts[n], max_new)
+            except Exception:
+                with lock:
+                    errors[0] += 1
+                continue
+            dt = time.perf_counter() - t0
+            with lock:
+                lat.append(dt)
+                toks[0] += len(out)
+
+    ts = [threading.Thread(target=client, args=(c,))
+          for c in range(clients)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    return toks[0], lat, errors[0]
+
+
+def measure(mode: str, im, clients: int, duration_s: float) -> dict:
+    from analytics_zoo_tpu.pipeline.inference import ContinuousBatcher
+
+    engine = im.generator
+    cb = None
+    if mode == "continuous":
+        cb = ContinuousBatcher(engine, queue_depth=512).start()
+
+        def submit(prompt, max_new):
+            return cb.submit(prompt,
+                             max_new_tokens=max_new).result(120)
+    else:
+        # sequential per-request decode: whole-loop generate, batch 1,
+        # one at a time — the engine is single-driver by contract, and
+        # that serialization IS the baseline being measured
+        seq_lock = threading.Lock()
+
+        def submit(prompt, max_new):
+            with seq_lock:
+                return im.generate(prompt,
+                                   max_new_tokens=max_new)[0]
+    try:
+        # warmup outside the window: every (bucket, budget) shape in
+        # the mix compiles here, not inside the measurement
+        for n, max_new in WORK_MIX:
+            submit(list(range(1, n + 1)), max_new)
+        ttft0 = _ttft_state()
+        t0 = time.perf_counter()
+        tokens, lat, errors = _run_clients(submit, clients,
+                                           duration_s)
+        window = time.perf_counter() - t0
+    finally:
+        if cb is not None:
+            cb.stop()
+    lat_ms = np.asarray(lat) * 1e3 if lat else np.zeros((1,))
+    rec = {
+        "mode": mode,
+        "clients": clients,
+        "window_s": round(window, 2),
+        "requests": len(lat),
+        "tokens_per_sec": round(tokens / window, 1),
+        "requests_per_sec": round(len(lat) / window, 1),
+        "latency_p50_ms": round(float(np.percentile(lat_ms, 50)), 2),
+        "latency_p99_ms": round(float(np.percentile(lat_ms, 99)), 2),
+        "errors": errors,
+    }
+    ttft = _ttft_mean_ms(ttft0)
+    # sequential has no streaming boundary: first token arrives with
+    # the rest, so mean latency IS its time-to-first-token
+    rec["ttft_mean_ms"] = (ttft if mode == "continuous"
+                           else round(float(np.mean(lat_ms)), 2))
+    print(f"# [{mode}] {rec['tokens_per_sec']} tok/s "
+          f"{rec['requests_per_sec']} req/s "
+          f"p50={rec['latency_p50_ms']}ms "
+          f"p99={rec['latency_p99_ms']}ms "
+          f"ttft={rec['ttft_mean_ms']}ms errors={errors}",
+          file=sys.stderr, flush=True)
+    return rec
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--clients", type=int, default=int(os.environ.get(
+        "ZOO_TPU_BENCH_GEN_CLIENTS", "6")))
+    ap.add_argument("--duration", type=float,
+                    default=float(os.environ.get(
+                        "ZOO_TPU_BENCH_GEN_DURATION", "6")))
+    ap.add_argument("--cpu-fallback", action="store_true",
+                    help="pin the run to the host CPU backend; the "
+                    "measurement lands in cpu_fallback_value and the "
+                    "chip headline stays null")
+    args = ap.parse_args()
+
+    import jax
+    if args.cpu_fallback:
+        jax.config.update("jax_platforms", "cpu")
+    devices = jax.devices()
+    print(f"# backend={devices[0].platform} "
+          f"n_devices={len(devices)} clients={args.clients} "
+          f"duration={args.duration}s slots={SLOTS}",
+          file=sys.stderr, flush=True)
+
+    im = _build_engine()
+    continuous = measure("continuous", im, args.clients,
+                         args.duration)
+    sequential = measure("sequential", im, args.clients,
+                         args.duration)
+    speedup = (continuous["tokens_per_sec"]
+               / sequential["tokens_per_sec"]
+               if sequential["tokens_per_sec"] else float("inf"))
+    print(f"# continuous speedup={speedup:.2f}x over sequential "
+          f"per-request decode ({args.clients} clients)",
+          file=sys.stderr, flush=True)
+
+    headline = continuous["tokens_per_sec"]
+    rec = {
+        "metric": "generate_throughput_tokens_per_sec",
+        "unit": "tokens/sec",
+        "value": None if args.cpu_fallback else headline,
+        "vs_baseline": None,
+        # the sentinel keys on this block: generation runs are their
+        # own lineage, never compared against predict-path rows
+        "generate": {
+            "slots": SLOTS,
+            "page_size": 16,
+            "max_context": SEQ_LEN,
+            "clients": args.clients,
+        },
+        "extra_metrics": [
+            continuous, sequential,
+            {"metric": "generate_continuous_speedup",
+             "value": round(speedup, 2), "unit": "x"},
+        ],
+    }
+    if args.cpu_fallback:
+        rec["cpu_fallback_value"] = headline
+        rec["fallback"] = (f"cpu clients={args.clients} "
+                           f"duration={args.duration}s")
+    from bench_common import attach_metrics_snapshot
+    rec = attach_metrics_snapshot(rec)
+    out_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "BENCH_generate.json")
+    with open(out_path, "w", encoding="utf-8") as fh:
+        json.dump(rec, fh)
+        fh.write("\n")
+    print(json.dumps(rec), flush=True)
+    print(f"# wrote {out_path}", file=sys.stderr)
+    print(f"# total={time.perf_counter() - _t_start:.1f}s",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

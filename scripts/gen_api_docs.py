@@ -62,8 +62,14 @@ MODULES = [
      "pipeline.inference — serving"),
     ("analytics_zoo_tpu.pipeline.inference.batching",
      "pipeline.inference.batching — dynamic request batching"),
+    ("analytics_zoo_tpu.pipeline.inference.generation",
+     "pipeline.inference.generation — autoregressive decode engine"),
     ("analytics_zoo_tpu.pipeline.inference.fleet",
      "pipeline.inference.fleet — replicated serving fleet"),
+    ("analytics_zoo_tpu.ops.kv_cache",
+     "ops.kv_cache — paged KV cache"),
+    ("analytics_zoo_tpu.ops.sampling",
+     "ops.sampling — token sampling"),
     ("analytics_zoo_tpu.pipeline.nnframes",
      "pipeline.nnframes — DataFrame ML pipeline"),
     ("analytics_zoo_tpu.models", "models — the zoo"),

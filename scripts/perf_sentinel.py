@@ -20,7 +20,10 @@ compared against round 2's 2715 img/s chip headline. Fleet artifacts
 block — `bench_serving.py --replicas N`) get a ``-fleet`` lineage
 suffix for the same reason: N replicas time-slicing a host is a
 different series from one single-process server, and neither may
-judge the other.
+judge the other. Generation artifacts (``BENCH_generate.json`` / any
+record carrying a ``"generate"`` block — `bench_generate.py`) get a
+``-generate`` suffix likewise: decode tokens/s is not predict-path
+rows/s and the two must never be compared.
 
 Direction is inferred from the metric name (err/p99/latency/_ms/
 seconds → lower is better; everything else → higher is better).
@@ -105,6 +108,13 @@ def is_fleet_artifact(rec: dict) -> bool:
     return isinstance(rec.get("fleet"), dict)
 
 
+def is_generate_artifact(rec: dict) -> bool:
+    """Decode-path runs (`bench_generate.py`) carry a ``"generate"``
+    block; generation tokens/s is its own lineage, never compared
+    against predict-path throughput."""
+    return isinstance(rec.get("generate"), dict)
+
+
 def extract_series(rec: dict) -> "Dict[Tuple[str, str], float]":
     """``{(lineage, metric): value}`` for one artifact.
     ``lineage`` is ``"chip"`` or ``"cpu"`` — comparisons only ever
@@ -113,9 +123,16 @@ def extract_series(rec: dict) -> "Dict[Tuple[str, str], float]":
     if not isinstance(rec, dict):
         return out
     fb = is_fallback_artifact(rec)
-    fleet_sfx = "-fleet" if is_fleet_artifact(rec) else ""
-    art_lin = ("cpu" if fb else "chip") + fleet_sfx
-    cpu_lin = "cpu" + fleet_sfx
+    # mutually exclusive in practice (a record is a fleet run OR a
+    # generation run); fleet wins if both ever appear
+    if is_fleet_artifact(rec):
+        sfx = "-fleet"
+    elif is_generate_artifact(rec):
+        sfx = "-generate"
+    else:
+        sfx = ""
+    art_lin = ("cpu" if fb else "chip") + sfx
+    cpu_lin = "cpu" + sfx
     headline = rec.get("metric") or "headline"
     value = rec.get("value")
     # a 0.0 headline is this schema's "nothing measured" sentinel
@@ -159,7 +176,8 @@ def load_rounds(dirpath: str):
     # the fleet artifact's series land in the *-fleet lineages
     named = []
     for label, fn in (("serving", "BENCH_serving.json"),
-                      ("fleet", "BENCH_serving_fleet.json")):
+                      ("fleet", "BENCH_serving_fleet.json"),
+                      ("generate", "BENCH_generate.json")):
         p = os.path.join(dirpath, fn)
         if os.path.exists(p):
             rec = load_artifact(p)

@@ -305,3 +305,71 @@ def test_package_import_restores_env_pin_over_plugin_clobber():
         text=True, timeout=120, env=env, cwd=_ROOT)
     assert out2.returncode == 0, (out2.stdout + out2.stderr)[-2000:]
     assert "PIN cpu" in out2.stdout
+
+
+def test_probe_failure_cache_helpers(tmp_path, monkeypatch):
+    # sticky probe-failure cache: bank -> fresh read -> TTL gates ->
+    # expiry -> clear (bench.py's dead-tunnel fast path)
+    import bench
+
+    cache = tmp_path / "probe.json"
+    monkeypatch.setenv("ZOO_TPU_BENCH_PROBE_CACHE", str(cache))
+    monkeypatch.setenv("ZOO_TPU_BENCH_PROBE_CACHE_S", "600")
+    assert bench._cached_probe_failure() is None
+    bench._bank_probe_failure("timeout", "no response in 25s")
+    rec = bench._cached_probe_failure()
+    assert rec["kind"] == "timeout"
+    assert rec["age_s"] >= 0
+    # TTL 0 disables the fast path entirely (read AND write)
+    monkeypatch.setenv("ZOO_TPU_BENCH_PROBE_CACHE_S", "0")
+    assert bench._cached_probe_failure() is None
+    cache.unlink()
+    bench._bank_probe_failure("timeout", "x")
+    assert not cache.exists()
+    # an expired record is ignored
+    monkeypatch.setenv("ZOO_TPU_BENCH_PROBE_CACHE_S", "600")
+    cache.write_text(json.dumps(
+        {"kind": "timeout", "msg": "x", "ts": time.time() - 9999}))
+    assert bench._cached_probe_failure() is None
+    # a successful probe clears the bank
+    bench._bank_probe_failure("probe_rc", "rc=1")
+    assert bench._cached_probe_failure() is not None
+    bench._clear_probe_failure()
+    assert bench._cached_probe_failure() is None
+    assert not cache.exists()
+
+
+def test_probe_fast_path_skips_live_probe(tmp_path):
+    # a banked failure inside the TTL must skip the live probe: the
+    # round fails over to CPU stages instantly and says so in the
+    # artifact (probe_fast_path), while the bank survives for the
+    # NEXT round
+    cache = tmp_path / "probe.json"
+    cache.write_text(json.dumps({"kind": "timeout",
+                                 "msg": "no response in 25s",
+                                 "ts": time.time()}))
+    env = dict(os.environ,
+               ZOO_TPU_BENCH_PROBE_CACHE=str(cache),
+               ZOO_TPU_BENCH_PROBE_CACHE_S="600",
+               ZOO_TPU_BENCH_SIMULATE_DEAD="1",
+               ZOO_TPU_BENCH_PROBE_S="5",
+               ZOO_TPU_BENCH_BUDGET_S="150",
+               ZOO_TPU_BENCH_NCF_BATCH="64",
+               ZOO_TPU_BENCH_STEPS="2",
+               ZOO_TPU_BENCH_FB_STAGES="ncf")
+    out = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "bench.py")],
+        capture_output=True, text=True, timeout=140, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    recs = _json_lines(out.stdout)
+    assert recs, out.stdout
+    last = recs[-1]
+    assert last["probe_fast_path"] is True
+    assert last["probe_latency_s"] < 1.0  # no subprocess probe ran
+    assert "cached failure" in last["diag"]
+    assert last["probe_failure"] == "timeout"
+    assert last["value"] is None
+    extras = {m["metric"]: m for m in last["extra_metrics"]}
+    assert extras["ncf_train_samples_per_sec_CPU_FALLBACK"][
+        "value"] > 0
+    assert cache.exists()  # still banked for the next round
