@@ -9,7 +9,7 @@ IMAGE ?= analytics-zoo-tpu
     lint obs-smoke fused-conformance flops-audit serving-smoke \
     bench-serving bench-serving-fleet trace-smoke trace-report \
     slo-smoke perf-sentinel fleet-smoke generate-smoke \
-    bench-generate
+    bench-generate chaos-smoke
 
 # unit tests plus the end-to-end telemetry smokes (metrics
 # exposition, tracing, SLO control loop), so `make test` proves the
@@ -22,6 +22,7 @@ test:
 	$(MAKE) slo-smoke
 	$(MAKE) fleet-smoke
 	$(MAKE) generate-smoke
+	$(MAKE) chaos-smoke
 	python scripts/perf_sentinel.py --advisory
 
 # conv+BN (+ residual-epilogue) conformance: the exact Pallas kernel
@@ -94,6 +95,13 @@ generate-smoke:
 # lineage — decode tokens/s is never compared against predict rows/s)
 bench-generate:
 	JAX_PLATFORMS=cpu python bench_generate.py --cpu-fallback
+
+# chaos end-to-end: injected kill/straggler/queue-wedge faults under
+# concurrent load (zero lost acked requests), then a canary rollout
+# auto-rolled-back by an injected error burst and a clean re-roll
+# promoted, all observable on /debug/rollout (docs/robustness.md)
+chaos-smoke:
+	JAX_PLATFORMS=cpu python scripts/chaos_smoke.py
 
 # replicated-fleet end-to-end: 2-replica CPU fleet, mixed concurrent
 # load with exact outputs, one replica killed mid-load (zero lost

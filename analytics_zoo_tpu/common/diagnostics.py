@@ -46,6 +46,8 @@ from analytics_zoo_tpu.common import observability as obs
 
 __all__ = [
     "anomaly",
+    "add_anomaly_listener",
+    "remove_anomaly_listener",
     "RecompileMonitor",
     "StepTimeWatcher",
     "install_recompile_monitor",
@@ -53,15 +55,49 @@ __all__ = [
     "update_device_memory_gauges",
 ]
 
+# control loops (e.g. the rollout controller's canary auto-rollback,
+# pipeline/inference/registry.py) subscribe here to REACT to
+# anomalies instead of polling the counter
+_listener_lock = threading.Lock()
+_listeners: list = []
+
+
+def add_anomaly_listener(fn) -> None:
+    """Register ``fn(kind, fields)`` to be called synchronously on
+    every :func:`anomaly` (after the counter/event are recorded).
+    Listener exceptions are swallowed — a broken reactor must not
+    mask the anomaly it reacted to."""
+    with _listener_lock:
+        if fn not in _listeners:
+            _listeners.append(fn)
+
+
+def remove_anomaly_listener(fn) -> None:
+    """Unregister a listener (no-op when absent)."""
+    with _listener_lock:
+        try:
+            _listeners.remove(fn)
+        except ValueError:
+            pass
+
 
 def anomaly(kind: str, **fields):
     """Record one detected anomaly: bump
     ``zoo_tpu_anomalies_total{kind}`` and append a structured
-    ``diagnostics/anomaly`` event (fields carry the evidence)."""
+    ``diagnostics/anomaly`` event (fields carry the evidence), then
+    notify registered listeners."""
     obs.counter("zoo_tpu_anomalies_total",
                 help="anomalies detected, by kind",
                 labels={"kind": kind}).inc()
     obs.event("diagnostics/anomaly", kind=kind, **fields)
+    with _listener_lock:
+        listeners = list(_listeners)
+    for fn in listeners:
+        try:
+            fn(kind, dict(fields))
+        except Exception as e:
+            from analytics_zoo_tpu.common.nncontext import logger
+            logger.warning("anomaly listener %r failed: %s", fn, e)
 
 
 def _env_float(name: str, default: float) -> float:

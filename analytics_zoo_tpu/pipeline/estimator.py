@@ -31,6 +31,7 @@ import numpy as np
 import optax
 
 from analytics_zoo_tpu.common import diagnostics
+from analytics_zoo_tpu.common import faults
 from analytics_zoo_tpu.common import observability as obs
 from analytics_zoo_tpu.common import slo as slo_lib
 from analytics_zoo_tpu.common import tracing
@@ -43,6 +44,28 @@ from analytics_zoo_tpu.ops import optimizers as optim_lib
 from analytics_zoo_tpu.parallel.mesh import shard_batch, shard_params
 
 logger = logging.getLogger("analytics_zoo_tpu")
+
+# fires after the pickle lands in the tmp file but before any
+# durability/rename work — a kill here must leave only an unpromoted
+# tmp, never a torn ckpt_*.pkl (tests/test_faults.py proves resume
+# skips it)
+_CKPT_FAULT = faults.point("estimator/checkpoint_write")
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync a directory so a rename inside it is durable; tolerated
+    to fail on filesystems (or platforms) that refuse O_RDONLY dir
+    fds — atomicity does not depend on it, only crash durability."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 # ---------------------------------------------------------------------------
@@ -1171,11 +1194,25 @@ class Estimator:
                 tmp = os.path.join(path, f".tmp_ckpt_{step}")
                 with open(tmp, "wb") as f:
                     pickle.dump(state, f)
+                    # fault point sits between "bytes written" and
+                    # "made durable/visible": a kill/error here leaves
+                    # only the .tmp_* file, which load_checkpoint
+                    # never considers
+                    _CKPT_FAULT.fire(step=step)
+                    f.flush()
+                    os.fsync(f.fileno())
                 final = os.path.join(path, f"ckpt_{step}.pkl")
                 os.replace(tmp, final)
+                # LATEST is promoted atomically too: a reader (or a
+                # crash) can never observe a half-written pointer
                 latest = os.path.join(path, "LATEST")
-                with open(latest, "w") as f:
+                ltmp = latest + ".tmp"
+                with open(ltmp, "w") as f:
                     f.write(os.path.basename(final))
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(ltmp, latest)
+                _fsync_dir(path)
             return final
 
         if block:

@@ -7,6 +7,8 @@ from analytics_zoo_tpu.pipeline.inference.generation import (
     GenerationEngine)
 from analytics_zoo_tpu.pipeline.inference.inference_model import (
     InferenceModel)
+from analytics_zoo_tpu.pipeline.inference.registry import (
+    ModelRegistry, ModelVersion, RolloutController)
 from analytics_zoo_tpu.pipeline.inference.serving import (
     InferenceServer, make_inference_server)
 
@@ -14,4 +16,5 @@ __all__ = ["InferenceModel", "InferenceServer", "DynamicBatcher",
            "ContinuousBatcher", "GenerationEngine",
            "make_inference_server",
            "ReplicaPool", "Replica", "HttpReplica", "FleetRouter",
-           "make_fleet_server"]
+           "make_fleet_server",
+           "ModelRegistry", "ModelVersion", "RolloutController"]

@@ -53,6 +53,7 @@ from typing import Optional, Sequence, Tuple
 import numpy as np
 
 from analytics_zoo_tpu.common import diagnostics
+from analytics_zoo_tpu.common import faults
 from analytics_zoo_tpu.common import observability as obs
 from analytics_zoo_tpu.common import tracing
 from analytics_zoo_tpu.common.nncontext import logger
@@ -67,6 +68,23 @@ __all__ = [
 
 # fill-ratio histogram buckets: rows / bucket capacity in (0, 1]
 _FILL_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
+
+# chaos hook: armed via ZOO_TPU_FAULTS or tests (docs/robustness.md);
+# fires at the head of every batch dispatch, inside the dispatcher
+# thread — the spot a pad/scatter bug would surface
+_DISPATCH_FAULT = faults.point("batcher/dispatch")
+
+
+def _fail_entry(entry, exc):
+    """Fail one entry's future without ever raising back into the
+    dispatcher: a future a client already cancelled (or that a prior
+    pass resolved) refuses ``set_exception``, and that must not take
+    the serving thread down with it."""
+    try:
+        if not entry.future.done():
+            entry.future.set_exception(exc)
+    except Exception:  # cancelled/resolved between check and set
+        pass
 
 
 class QueueFullError(Exception):
@@ -320,7 +338,7 @@ class DynamicBatcher:
                 obs.counter("zoo_tpu_serving_errors_total",
                             help="serving errors by kind",
                             labels={"kind": "deadline_expired"}).inc()
-                e.future.set_exception(DeadlineExpiredError(
+                _fail_entry(e, DeadlineExpiredError(
                     f"request waited past its "
                     f"{self.deadline_s * 1e3:.0f}ms deadline"))
             else:
@@ -356,44 +374,58 @@ class DynamicBatcher:
         return batch
 
     def _run(self):
+        # Hardening contract (docs/robustness.md): NOTHING that goes
+        # wrong while handling one batch — pad, scatter, an injected
+        # fault, even a bug in the queue bookkeeping itself — may
+        # escape this loop. An escape would kill the one dispatcher
+        # thread and wedge the queue forever: every later submit
+        # would enqueue, never dispatch, and time out. Each iteration
+        # therefore fails at most its own batch and keeps serving.
         while True:
-            with self._cond:
-                while not self._q and not self._stop:
-                    self._cond.wait(timeout=0.1)
-                if not self._q:
-                    if self._stop:
-                        return
-                    continue
-                self._evict_expired_locked()
-                if not self._q:
-                    continue
-                # coalescing window anchored at the head's arrival:
-                # the oldest request never waits past max_wait_ms
-                wait_until = self._q[0].t_enq + self.max_wait_s
-                while (not self._stop
-                       and self._ready_rows_locked() < self.max_batch):
-                    remaining = wait_until - time.monotonic()
-                    if remaining <= 0:
-                        break
-                    self._cond.wait(timeout=min(remaining, 0.05))
+            batch: "list[_Entry]" = []
+            try:
+                with self._cond:
+                    while not self._q and not self._stop:
+                        self._cond.wait(timeout=0.1)
+                    if not self._q:
+                        if self._stop:
+                            return
+                        continue
                     self._evict_expired_locked()
                     if not self._q:
-                        break
-                if not self._q:
-                    continue
-                batch = self._take_batch_locked()
-            if batch:
-                try:
+                        continue
+                    # coalescing window anchored at the head's
+                    # arrival: the oldest request never waits past
+                    # max_wait_ms
+                    wait_until = self._q[0].t_enq + self.max_wait_s
+                    while (not self._stop
+                           and self._ready_rows_locked()
+                           < self.max_batch):
+                        remaining = wait_until - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        self._cond.wait(timeout=min(remaining, 0.05))
+                        self._evict_expired_locked()
+                        if not self._q:
+                            break
+                    if not self._q:
+                        continue
+                    batch = self._take_batch_locked()
+                if batch:
                     self._execute(batch)
-                except Exception as e:  # belt & braces: a dispatch
-                    # failure must fail its requests, not the thread
-                    for entry in batch:
-                        if not entry.future.done():
-                            entry.future.set_exception(e)
-                    logger.warning("batcher dispatch error: %s", e)
+            except Exception as e:
+                for entry in batch:
+                    _fail_entry(entry, e)
+                obs.counter("zoo_tpu_serving_errors_total",
+                            help="serving errors by kind",
+                            labels={"kind": "dispatch_error"}).inc()
+                logger.warning("batcher dispatch error (%s: %s); "
+                               "dispatcher continues",
+                               type(e).__name__, e)
 
     # -- execution ----------------------------------------------------------
     def _execute(self, batch: "list[_Entry]"):
+        _DISPATCH_FAULT.fire(rows=sum(e.n for e in batch))
         now = time.monotonic()
         wait_h = obs.histogram(
             "zoo_tpu_serving_queue_wait_seconds",
@@ -422,7 +454,7 @@ class DynamicBatcher:
                 outs, multi = self._run_rows(sig, xs, rows)
         except Exception as e:
             for entry in batch:
-                entry.future.set_exception(e)
+                _fail_entry(entry, e)
             return
         exec_s = time.monotonic() - t0
         # coalesced requests beyond the first get an explicit execute
@@ -437,8 +469,12 @@ class DynamicBatcher:
         t_sc_wall = time.time()
         for entry in batch:
             rows_out = [o[off:off + entry.n] for o in outs]
-            entry.future.set_result(
-                rows_out if multi else rows_out[0])
+            try:
+                if not entry.future.done():
+                    entry.future.set_result(
+                        rows_out if multi else rows_out[0])
+            except Exception:  # cancelled under us: drop the rows,
+                pass           # the batchmates still get theirs
             off += entry.n
         scatter_s = time.monotonic() - t_sc
         for e in batch:
@@ -668,6 +704,7 @@ class ContinuousBatcher:
         self._active: "list[_GenEntry]" = []
         self._cond = threading.Condition()
         self._stop = False
+        self._draining = False
         self._thread: Optional[threading.Thread] = None
         self._ema_req_s = 0.05  # retry-after estimator seed
         self._slots_gauge().set(0)
@@ -696,15 +733,21 @@ class ContinuousBatcher:
         with obs.span("decode/warm"):
             self.engine.warm()
         self._stop = False
+        self._draining = False
         self._thread = threading.Thread(
             target=self._run, name="zoo-tpu-gen-batcher", daemon=True)
         self._thread.start()
         return self
 
     def stop(self, timeout: float = 30.0):
-        """Stop the loop thread; queued AND in-flight requests fail
-        with RuntimeError (generation cannot be handed off
-        mid-sequence the way a queued predict can)."""
+        """Drain first (resident sequences run to completion within
+        ``timeout``), then stop the loop thread. Whatever is STILL
+        resident or queued when the budget runs out fails with
+        RuntimeError and has its slot pages reclaimed — generation
+        cannot be handed off mid-sequence the way a queued predict
+        can, but an orderly stop should never have to cut anyone off
+        (`drain` waited for them)."""
+        self.drain(timeout=timeout)
         with self._cond:
             self._stop = True
             self._cond.notify_all()
@@ -718,11 +761,41 @@ class ContinuousBatcher:
         for e in pending:
             if e.slot >= 0:
                 self.engine.release(e.slot)
-            if not e.future.done():
-                e.future.set_exception(
-                    RuntimeError("generation batcher stopped"))
+            _fail_entry(e, RuntimeError("generation batcher stopped"))
         self._slots_gauge().set(self.engine.slots_active)
         self._pages_gauge().set(self.engine.free_pages)
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Stop admitting new sequences but run the RESIDENT ones to
+        completion: their futures resolve with real tokens and their
+        pages return to the pool (iteration-level scheduling makes
+        this cheap — the loop simply steps the shrinking active set
+        until it empties). Queued-but-unadmitted entries fail
+        immediately with a retryable RuntimeError — the fleet router
+        redispatches them to a sibling, exactly like a queued predict
+        during a predict-replica drain. New submits are rejected
+        while draining. Returns True when every resident sequence
+        retired within ``timeout`` (False = some still running; a
+        following `stop` cuts them off). Idempotent."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            self._draining = True
+            queued = list(self._q)
+            self._q.clear()
+            self._depth_gauge().set(0)
+            self._cond.notify_all()
+        for e in queued:
+            _fail_entry(e, RuntimeError(
+                "replica draining; resubmit to another replica"))
+        alive = (self._thread is not None
+                 and self._thread.is_alive())
+        while time.monotonic() < deadline:
+            with self._cond:
+                if not self._active or not alive:
+                    break
+            time.sleep(0.005)
+        with self._cond:
+            return not self._active
 
     # -- admission ----------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int = 32,
@@ -741,6 +814,9 @@ class ContinuousBatcher:
                 f"{self.engine.max_context - 1}] for this cache")
         entry = _GenEntry(ids, max_new, float(temperature), eos_id)
         with self._cond:
+            if self._draining or self._stop:
+                raise RuntimeError(
+                    "generation batcher is draining/stopped")
             if len(self._q) >= self.queue_depth:
                 retry = max(0.05, len(self._q) * self._ema_req_s)
                 obs.counter("zoo_tpu_serving_errors_total",
@@ -807,7 +883,8 @@ class ContinuousBatcher:
                     self._cond.wait(timeout=0.1)
                 if self._stop:
                     return
-                fresh = self._admit_locked_pop()
+                fresh = ([] if self._draining
+                         else self._admit_locked_pop())
             try:
                 now = time.monotonic()
                 done: "list[_GenEntry]" = []
@@ -859,8 +936,7 @@ class ContinuousBatcher:
                 for e in fresh + self._active:
                     if e.slot >= 0:
                         engine.release(e.slot)
-                    if not e.future.done():
-                        e.future.set_exception(exc)
+                    _fail_entry(e, exc)
                 self._active = []
                 logger.warning("generation batcher error: %s", exc)
             self._slots_gauge().set(engine.slots_active)

@@ -78,6 +78,7 @@ from typing import Callable, Optional, Sequence
 import numpy as np
 
 from analytics_zoo_tpu.common import diagnostics
+from analytics_zoo_tpu.common import faults
 from analytics_zoo_tpu.common import observability as obs
 from analytics_zoo_tpu.common import tracing
 from analytics_zoo_tpu.common.nncontext import logger
@@ -86,6 +87,13 @@ from analytics_zoo_tpu.pipeline.inference.batching import (
     DynamicBatcher,
     QueueFullError,
 )
+
+# chaos hook: armed via ZOO_TPU_FAULTS or tests (docs/robustness.md);
+# fires on every dispatch to an in-process replica with
+# ctx {replica: name}, so a fault can target one replica by name —
+# "kill" exercises ejection + sibling retry, "delay" a straggler,
+# "corrupt" a replica returning garbage
+_PREDICT_FAULT = faults.point("fleet/replica_predict")
 
 __all__ = [
     "Replica",
@@ -213,6 +221,32 @@ def _c_readmissions(name: str):
                        labels={"replica": name})
 
 
+# per-version cohort metrics (the rollout layer's observability
+# contract, docs/robustness.md): every replica completion is
+# attributed to the model VERSION that served it, so a canary
+# cohort's error/latency profile separates cleanly from the baseline
+
+def _c_cohort_requests(version: str):
+    return obs.counter("zoo_tpu_rollout_requests_total",
+                       help="replica completions by model version "
+                            "(canary cohort attribution)",
+                       labels={"version": version})
+
+
+def _c_cohort_errors(version: str):
+    return obs.counter("zoo_tpu_rollout_errors_total",
+                       help="replica failures by model version "
+                            "(canary cohort attribution)",
+                       labels={"version": version})
+
+
+def _h_cohort_latency(version: str):
+    return obs.histogram("zoo_tpu_rollout_latency_seconds",
+                         help="dispatch-to-resolve latency by model "
+                              "version",
+                         labels={"version": version})
+
+
 class ReplicaContext:
     """What a :class:`ReplicaPool` ``model_fn`` receives: the
     replica's index, name, and the device slice it owns."""
@@ -236,6 +270,9 @@ class _ReplicaBase:
         self._clock = clock
         self._lock = threading.Lock()
         self.state = STARTING
+        # model version this replica serves (cohort label; the
+        # rollout controller rewrites it across a warm-swap)
+        self.version = "v0"
         self.down_reason: Optional[str] = None
         self.outstanding_rows = 0
         self.consecutive_failures = 0
@@ -325,6 +362,7 @@ class _ReplicaBase:
             st = {
                 "name": self.name,
                 "state": self.state,
+                "version": self.version,
                 "outstanding_rows": self.outstanding_rows,
                 "consecutive_failures": self.consecutive_failures,
                 "failures_total": self.failures_total,
@@ -451,13 +489,16 @@ class Replica(_ReplicaBase):
         return self.batcher is not None and self.batcher.batchable(xs)
 
     def submit(self, xs) -> "Future":
+        _PREDICT_FAULT.fire(replica=self.name)
         return self.batcher.submit(xs)
 
     def predict(self, inputs, timeout_ms: int = -1):
+        _PREDICT_FAULT.fire(replica=self.name)
         if timeout_ms is not None and timeout_ms > 0:
-            return self.model.predict(inputs,
-                                      timeout_ms=timeout_ms)
-        return self.model.predict(inputs)
+            out = self.model.predict(inputs, timeout_ms=timeout_ms)
+        else:
+            out = self.model.predict(inputs)
+        return _PREDICT_FAULT.corrupt(out, replica=self.name)
 
     def probe(self) -> bool:
         """One predict at the declared example shape through the
@@ -798,6 +839,11 @@ class FleetRouter:
         self._ring = self._build_ring(vnodes)
         self._prober: Optional[threading.Thread] = None
         self._stop_evt = threading.Event()
+        # canary traffic split, installed/cleared by the rollout
+        # controller: {"version", "baseline", "pct"} or None
+        self._canary: Optional[dict] = None
+        self._cohort_rr = 0  # keyless-traffic bucket rotation
+        self._rollout = None  # the active/last RolloutController
 
     # -- model-ish surface (serving.py duck-typing) --------------------------
     @property
@@ -829,6 +875,7 @@ class FleetRouter:
             r = self._pick(rows=1, key=None, exclude=tried)
             if r is None:
                 break
+            t0 = time.time()
             try:
                 with obs.span("fleet/dispatch", replica=r.name,
                               attempt=attempt, path="predict"):
@@ -839,12 +886,17 @@ class FleetRouter:
                     finally:
                         r.note_done(1)
                 r.note_success()
+                _c_cohort_requests(r.version).inc()
+                _h_cohort_latency(r.version).observe(
+                    time.time() - t0)
                 return out
             except (QueueFullError, DeadlineExpiredError):
                 raise  # backpressure/deadline: not a replica fault
             except Exception as e:
                 last_exc = e
                 tried.add(r.name)
+                _c_cohort_requests(r.version).inc()
+                _c_cohort_errors(r.version).inc()
                 self._note_replica_failure(r, e)
                 if attempt < self.max_retries:
                     _c_retries().inc()
@@ -957,6 +1009,12 @@ class FleetRouter:
             else:
                 r.backoff_bump(now)
         self._refresh_gauges()
+        rollout = self._rollout
+        if rollout is not None and rollout.in_progress:
+            try:
+                rollout.tick(now=now)
+            except Exception as e:  # the prober must not die
+                logger.warning("fleet: rollout tick failed: %s", e)
         return self.fleet_status()
 
     def drain(self, name: str, timeout: float = 30.0) -> bool:
@@ -983,6 +1041,45 @@ class FleetRouter:
                 return r
         raise KeyError(f"no replica named {name!r}")
 
+    # -- versioned rollout ---------------------------------------------------
+    def rollout(self, version, canary_pct: int = 25, **kwargs):
+        """Warm-swap the fleet to ``version`` (a
+        :class:`~analytics_zoo_tpu.pipeline.inference.registry.ModelVersion`
+        or anything with ``name`` + ``load_into(model)``): drain one
+        replica at a time behind the router (zero dropped acked
+        requests — drained queues flush, the generation bump drops
+        stale executables), then split ``canary_pct``% of traffic
+        onto the new version and watch its cohort SLO. The canary
+        either bakes clean and promotes to the rest of the fleet, or
+        breaches and auto-rolls-back through the same drain path.
+        Returns the :class:`~analytics_zoo_tpu.pipeline.
+        inference.registry.RolloutController` (state machine at
+        ``GET /debug/rollout``); ``kwargs`` forward
+        to it (``bake_s``, ``max_canary_errors``, ...). The fleet
+        prober drives its :meth:`tick`; with the prober disabled
+        drive ``router.tick()`` manually (docs/robustness.md)."""
+        from analytics_zoo_tpu.pipeline.inference.registry import \
+            RolloutController
+        active = self._rollout
+        if active is not None and active.in_progress:
+            raise RuntimeError(
+                f"rollout of {active.version_name} still "
+                f"{active.state}; finish or roll it back first")
+        ctl = RolloutController(self, version,
+                                canary_pct=canary_pct, **kwargs)
+        self._rollout = ctl
+        ctl.begin()
+        return ctl
+
+    def rollout_status(self) -> dict:
+        """JSON-able rollout state — the ``GET /debug/rollout``
+        payload (idle when no rollout ever ran)."""
+        if self._rollout is None:
+            return {"state": "idle", "canary": self._canary}
+        st = self._rollout.status()
+        st["canary"] = self._canary
+        return st
+
     # -- dispatch ------------------------------------------------------------
     def _affinity_key(self, xs) -> bytes:
         """Deterministic content key for hash routing: shapes, dtypes
@@ -1008,14 +1105,54 @@ class FleetRouter:
         self._ring_keys = [t[0] for t in ring]
         return ring
 
-    def _pick_hash(self, key: bytes,
-                   exclude: set) -> Optional[_ReplicaBase]:
+    def _cohort_version(self, key: Optional[bytes]) -> Optional[str]:
+        """The model version this request's cohort should land on,
+        or None when no canary split is active. Keyed traffic buckets
+        deterministically off the affinity key (the same payload
+        stays in the same cohort across its whole session — a request
+        never flaps between versions); keyless traffic rotates
+        ``pct``% round-robin."""
+        canary = self._canary
+        if not canary:
+            return None
+        if key is not None:
+            hv = int.from_bytes(
+                hashlib.blake2b(b"cohort:" + key,
+                                digest_size=8).digest(), "big")
+            bucket = hv % 100
+        else:
+            with self._rr_lock:
+                self._cohort_rr = (self._cohort_rr + 1) % 100
+                bucket = self._cohort_rr
+        if bucket < canary["pct"]:
+            return canary["version"]
+        return canary["baseline"]
+
+    def set_canary(self, version: str, baseline: str, pct: int):
+        """Install a canary traffic split (rollout-controller API):
+        ``pct``% of requests prefer replicas serving ``version``, the
+        rest prefer ``baseline``. Preference, not a hard wall — when
+        a cohort's replicas are all down/draining, its traffic spills
+        to the other cohort (availability beats cohort purity)."""
+        self._canary = {"version": str(version),
+                        "baseline": str(baseline),
+                        "pct": max(0, min(100, int(pct)))}
+        obs.event("rollout/canary_split", version=version,
+                  baseline=baseline, pct=self._canary["pct"])
+
+    def clear_canary(self):
+        self._canary = None
+
+    def _pick_hash(self, key: bytes, exclude: set,
+                   prefer_version: Optional[str] = None
+                   ) -> Optional[_ReplicaBase]:
         if not self._ring:
             return None
         hv = int.from_bytes(
             hashlib.blake2b(key, digest_size=8).digest(), "big")
         start = bisect.bisect_left(self._ring_keys, hv)
         n = len(self._ring)
+        fallback = None
         seen: set = set()
         for i in range(n):
             _, r = self._ring[(start + i) % n]
@@ -1023,17 +1160,26 @@ class FleetRouter:
                 continue
             seen.add(r.name)
             if r.name not in exclude and r.admitting():
-                return r
-        return None
+                if (prefer_version is None
+                        or r.version == prefer_version):
+                    return r
+                if fallback is None:
+                    fallback = r  # wrong cohort, but admitting
+        return fallback
 
     def _pick(self, rows: int, key: Optional[bytes],
               exclude: set) -> Optional[_ReplicaBase]:
+        prefer = self._cohort_version(key)
         if key is not None:
-            return self._pick_hash(key, exclude)
+            return self._pick_hash(key, exclude, prefer)
         cands = [r for r in self.pool.replicas
                  if r.admitting() and r.name not in exclude]
         if not cands:
             return None
+        if prefer is not None:
+            cohort = [r for r in cands if r.version == prefer]
+            if cohort:  # spill to the other cohort only when empty
+                cands = cohort
         lo = min(r.outstanding_rows for r in cands)
         ties = [r for r in cands if r.outstanding_rows == lo]
         with self._rr_lock:
@@ -1076,6 +1222,12 @@ class FleetRouter:
                 continue
             except Exception as e:  # broke at admission
                 tried.add(r.name)
+                # an admission fault is still an attempt the replica
+                # failed: attribute it to its version cohort so a
+                # sick canary trips the rollout burst/SLO watch even
+                # when every failure happens before enqueue
+                _c_cohort_requests(r.version).inc()
+                _c_cohort_errors(r.version).inc()
                 self._note_replica_failure(r, e)
                 continue
             r.note_dispatch(rows)
@@ -1083,13 +1235,13 @@ class FleetRouter:
                 ctx, "fleet/dispatch", t0, time.time() - t0,
                 replica=r.name, rows=rows, attempt=attempt)
             inner.add_done_callback(
-                lambda f, r=r: self._on_replica_done(
+                lambda f, r=r, t0=t0: self._on_replica_done(
                     r, f, xs, rows, fut, key, attempt, exclude,
-                    ctx))
+                    ctx, t0))
             return
 
     def _on_replica_done(self, r, inner, xs, rows, fut, key,
-                         attempt, exclude, ctx):
+                         attempt, exclude, ctx, t0=None):
         """Replica future resolved (dispatcher/executor thread).
         Success propagates; deadline expiry propagates (request-
         level, not a replica fault); queue-full retries a sibling
@@ -1099,6 +1251,17 @@ class FleetRouter:
         so acked work is never re-executed."""
         r.note_done(rows)
         exc = inner.exception()
+        # cohort attribution: every attempt the replica actually
+        # worked on counts for its version (queue-full never reached
+        # the model, so it attributes to no cohort)
+        if not isinstance(exc, QueueFullError):
+            _c_cohort_requests(r.version).inc()
+            if t0 is not None:
+                _h_cohort_latency(r.version).observe(
+                    time.time() - t0)
+            if exc is not None and not isinstance(
+                    exc, DeadlineExpiredError):
+                _c_cohort_errors(r.version).inc()
         if exc is None:
             r.note_success()
             self._resolve(fut, inner.result())
@@ -1163,6 +1326,7 @@ class FleetRouter:
             "probe_interval_s": self.probe_interval_s,
             "replicas_admitting": sum(
                 1 for r in self.pool.replicas if r.admitting()),
+            "canary": self._canary,
             "replicas": [r.status() for r in self.pool.replicas],
         }
 

@@ -44,10 +44,16 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from analytics_zoo_tpu.common import faults
 from analytics_zoo_tpu.common import observability as obs
 from analytics_zoo_tpu.pipeline.inference.batching import bucket_ladder
 
 __all__ = ["GenerationEngine"]
+
+# chaos hook: armed via ZOO_TPU_FAULTS or tests (docs/robustness.md);
+# a "kill" here simulates the device/replica dying mid-decode with
+# resident sequences holding KV pages
+_STEP_FAULT = faults.point("generation/decode_step")
 
 
 class GenerationEngine:
@@ -289,6 +295,7 @@ class GenerationEngine:
         with ``active == False`` are frozen (nothing written, lengths
         unchanged). Returns the ``(max_slots,)`` sampled tokens —
         meaningful only at active slots."""
+        _STEP_FAULT.fire()
         fn = self._get_step()
         active = np.asarray(active, np.bool_)
         self.cache, toks = fn(self.cache, self.params,

@@ -17,6 +17,9 @@ GET  /debug/slo[?tick=0]  →  live SLO status (docs/slo.md): shipped
 GET  /debug/fleet  →  fleet topology + per-replica lifecycle state
      when a FleetRouter fronts this server (docs/serving.md fleet
      section); 404 on single-model servers
+GET  /debug/rollout  →  warm-swap rollout state machine + canary
+     split + per-replica versions (docs/robustness.md); 404 on
+     single-model servers
 POST /debug/profile {"dir": ..., "ms": 500}  →  on-demand jax.profiler
      capture written to ``dir`` (one at a time; 503 while busy)
 
@@ -299,6 +302,21 @@ def _fleet_payload(batcher) -> "Tuple[int, dict]":
     return 200, status_fn()
 
 
+def _rollout_payload(batcher) -> "Tuple[int, dict]":
+    """``GET /debug/rollout``: the rollout state machine (rolling →
+    canary → promoted | rolled_back), per-replica versions, swap
+    log, and the active canary split — the observable surface of
+    ``FleetRouter.rollout`` (docs/robustness.md). 404 on
+    single-model servers, ``{"state": "idle"}`` on fleets that never
+    rolled."""
+    status_fn = getattr(batcher, "rollout_status", None)
+    if status_fn is None:
+        _count_error("not_found")
+        return 404, _error_body(
+            404, "no fleet router mounted on this server")
+    return 200, status_fn()
+
+
 def _profiler_capture(out_dir: str, ms: float):
     """Capture ``ms`` milliseconds of jax.profiler trace into
     ``out_dir`` (module-level so tests can stub it)."""
@@ -453,6 +471,9 @@ class InferenceServer:
                         payload = _slo_payload(self.path)
                     elif route == "/debug/fleet":
                         status, payload = _fleet_payload(
+                            server.batcher)
+                    elif route == "/debug/rollout":
+                        status, payload = _rollout_payload(
                             server.batcher)
                     else:
                         status = 404
@@ -614,6 +635,9 @@ class NativeInferenceServer:
                 out = json.dumps(_slo_payload(path)).encode()
             elif route == "/debug/fleet":
                 status, payload = _fleet_payload(self.batcher)
+                out = json.dumps(payload).encode()
+            elif route == "/debug/rollout":
+                status, payload = _rollout_payload(self.batcher)
                 out = json.dumps(payload).encode()
             elif route == "/debug/profile":
                 status, payload = handle_profile(body)

@@ -36,6 +36,8 @@ MODULES = [
      "diagnostics — anomaly detectors & device watermarks"),
     ("analytics_zoo_tpu.common.slo",
      "slo — declarative objectives & burn-rate engine"),
+    ("analytics_zoo_tpu.common.faults",
+     "faults — chaos fault-injection registry"),
     ("analytics_zoo_tpu.perf",
      "perf — FLOPs accounting & goodput"),
     ("analytics_zoo_tpu.perf.goodput",
@@ -66,6 +68,8 @@ MODULES = [
      "pipeline.inference.generation — autoregressive decode engine"),
     ("analytics_zoo_tpu.pipeline.inference.fleet",
      "pipeline.inference.fleet — replicated serving fleet"),
+    ("analytics_zoo_tpu.pipeline.inference.registry",
+     "pipeline.inference.registry — model versions & rollout"),
     ("analytics_zoo_tpu.ops.kv_cache",
      "ops.kv_cache — paged KV cache"),
     ("analytics_zoo_tpu.ops.sampling",

@@ -13,6 +13,10 @@ stdlib ast:
 - metric naming (package files only): every string-literal metric
   name passed to `counter()` / `gauge()` / `histogram()` must match
   `zoo_tpu_<snake_case>` (docs/observability.md naming contract);
+- no bare `except:` in the robustness-critical trees
+  (`pipeline/inference/`, `common/`): a bare clause swallows
+  KeyboardInterrupt/SystemExit and masks injected faults the chaos
+  harness relies on seeing — catch `Exception` (docs/robustness.md);
 - shipped SLO defaults (`DEFAULT_SERVING_SLOS` /
   `DEFAULT_FLEET_SLOS` / `DEFAULT_TRAINING_SLOS` in
   `common/slo.py`, kept as pure dict
@@ -141,6 +145,28 @@ def _metric_name_problems(rel: str, tree: ast.AST,
     return problems
 
 
+_NO_BARE_EXCEPT = (
+    os.path.join("analytics_zoo_tpu", "pipeline", "inference") + os.sep,
+    os.path.join("analytics_zoo_tpu", "common") + os.sep,
+)
+
+
+def _bare_except_problems(rel: str, tree: ast.AST) -> list:
+    """Bare ``except:`` is banned in the serving and common trees:
+    it catches KeyboardInterrupt/SystemExit/InjectedKillError and
+    silently defeats both graceful shutdown and the fault-injection
+    harness (docs/robustness.md). ``except Exception`` expresses the
+    same intent without eating control-flow exceptions."""
+    problems = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            problems.append(
+                f"{rel}:{node.lineno}: bare 'except:' (catch "
+                f"'Exception' instead; bare clauses swallow "
+                f"KeyboardInterrupt and injected kill faults)")
+    return problems
+
+
 _SLO_DEFAULT_NAMES = ("DEFAULT_SERVING_SLOS", "DEFAULT_FLEET_SLOS",
                       "DEFAULT_TRAINING_SLOS")
 _SLO_FILE = os.path.join("analytics_zoo_tpu", "common", "slo.py")
@@ -244,6 +270,8 @@ def check_file(path: str, registered: Optional[set] = None) -> list:
         problems.extend(_metric_name_problems(
             rel, tree, registered if registered is not None
             else set()))
+    if rel.startswith(_NO_BARE_EXCEPT):
+        problems.extend(_bare_except_problems(rel, tree))
     if os.path.basename(path) != "__init__.py":
         used = _used_names(tree) | _string_mentions(tree)
         lines = src.splitlines()

@@ -39,17 +39,20 @@ def _fresh_telemetry():
     engine and goodput ring around every test, so counters/spans/
     breach state leaked by one test can never satisfy (or break)
     another's assertions."""
-    from analytics_zoo_tpu.common import observability, slo, tracing
+    from analytics_zoo_tpu.common import (
+        faults, observability, slo, tracing)
     from analytics_zoo_tpu.perf import goodput
     observability.reset_metrics()
     tracing.reset_tracing()
     slo.reset_slo()
     goodput.reset_goodput()
+    faults.reset_faults()
     yield
     observability.reset_metrics()
     tracing.reset_tracing()
     slo.reset_slo()
     goodput.reset_goodput()
+    faults.reset_faults()
 
 
 @pytest.fixture

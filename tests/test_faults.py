@@ -1,0 +1,500 @@
+"""Fault injection (common/faults.py) and the failure paths it
+exercises: behaviors/selectors/env grammar, the unarmed no-overhead
+guarantee, torn-checkpoint resume, dispatcher hardening, generation
+drain, stranded-page reclamation and exactly-once sibling retry
+under consistent-hash affinity. Tier-1 fast."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu import init_nncontext
+from analytics_zoo_tpu.common import faults
+from analytics_zoo_tpu.common.faults import (
+    InjectedFaultError, InjectedKillError)
+from analytics_zoo_tpu.common.observability import (
+    reset_metrics, snapshot)
+from analytics_zoo_tpu.pipeline.api.keras import Sequential, \
+    layers as L
+from analytics_zoo_tpu.ops import optimizers as O
+
+
+@pytest.fixture(autouse=True)
+def _fresh_faults():
+    reset_metrics()
+    faults.reset_faults()
+    yield
+    faults.reset_faults()
+    reset_metrics()
+
+
+def _metric_sum(name, snap=None):
+    snap = snap or snapshot()
+    fam = snap.get(name)
+    if fam is None:
+        return 0.0
+    return sum(v["value"] for v in fam["values"])
+
+
+# -- behaviors ---------------------------------------------------------------
+
+def test_unarmed_point_is_a_noop():
+    p = faults.point("test/noop")
+    assert not p.armed
+    p.fire()                       # nothing happens
+    p.fire(replica="r0")
+    assert p.corrupt([1.0, 2.0]) == [1.0, 2.0]
+    assert _metric_sum("zoo_tpu_faults_injected_total") == 0
+
+
+def test_error_and_kill_behaviors():
+    p = faults.point("test/err")
+    faults.arm("test/err", "error")
+    with pytest.raises(InjectedFaultError):
+        p.fire()
+    faults.arm("test/err", "kill")
+    with pytest.raises(InjectedKillError):
+        p.fire()
+    # kill IS-A fault error (sites catching the base see both)
+    assert issubclass(InjectedKillError, InjectedFaultError)
+    snap = snapshot()
+    vals = {v["labels"]["kind"]: v["value"] for v in
+            snap["zoo_tpu_faults_injected_total"]["values"]}
+    assert vals == {"error": 1, "kill": 1}
+
+
+def test_delay_behavior_sleeps():
+    p = faults.point("test/delay")
+    faults.arm("test/delay", "delay", seconds=0.05)
+    t0 = time.monotonic()
+    p.fire()
+    assert time.monotonic() - t0 >= 0.05
+
+
+def test_corrupt_behavior_poisons_arrays():
+    p = faults.point("test/corrupt")
+    faults.arm("test/corrupt", "corrupt")
+    out = p.corrupt(np.ones((2, 2), np.float32))
+    assert np.isnan(np.asarray(out)).all()
+    faults.arm("test/corrupt", "corrupt")
+    ids = p.corrupt(np.asarray([2, 3], np.int32))
+    assert ids.tolist() == [3, 2]  # bit-flipped, detectably wrong
+    # corrupt never fires through fire() (it has no value to mangle)
+    faults.arm("test/corrupt", "corrupt")
+    p.fire()  # no raise, no count
+    vals = {v["labels"]["kind"]: v["value"] for v in
+            snapshot()["zoo_tpu_faults_injected_total"]["values"]}
+    assert vals["corrupt"] == 2
+
+
+def test_wedge_blocks_until_disarmed():
+    p = faults.point("test/wedge")
+    faults.arm("test/wedge", "wedge", seconds=20.0)
+    done = threading.Event()
+
+    def worker():
+        p.fire()
+        done.set()
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    assert not done.wait(0.1)      # wedged
+    faults.disarm("test/wedge")    # releases the wedged thread
+    assert done.wait(5)
+    t.join(timeout=5)
+
+
+# -- selectors ---------------------------------------------------------------
+
+def test_times_budget_auto_disarms():
+    p = faults.point("test/times")
+    faults.arm("test/times", "error", times=2)
+    for _ in range(2):
+        with pytest.raises(InjectedFaultError):
+            p.fire()
+    p.fire()                       # budget spent: no-op again
+    assert not p.armed             # hot path restored
+    assert p._spec is None
+
+
+def test_where_selector_targets_by_context():
+    p = faults.point("test/where")
+    faults.arm("test/where", "error", where={"replica": "r1"})
+    p.fire(replica="r0")           # no match: no fault
+    p.fire()                       # missing key: no fault
+    with pytest.raises(InjectedFaultError):
+        p.fire(replica="r1")
+
+
+def test_probability_zero_never_fires():
+    p = faults.point("test/p")
+    faults.arm("test/p", "error", p=0.0)
+    for _ in range(50):
+        p.fire()
+    assert _metric_sum("zoo_tpu_faults_injected_total") == 0
+
+
+def test_disarm_all_and_introspection():
+    faults.arm("test/a", "error")
+    faults.arm("test/b", "delay", seconds=1.0, times=3)
+    armed = faults.armed()
+    assert armed["test/a"]["kind"] == "error"
+    assert armed["test/b"] == {"kind": "delay", "fired": 0,
+                               "seconds": 1.0, "times": 3}
+    faults.disarm_all()
+    assert faults.armed() == {}
+    assert "test/a" in faults.points()  # points persist, unarmed
+
+
+# -- env grammar -------------------------------------------------------------
+
+def test_env_grammar_arms_points(monkeypatch):
+    monkeypatch.setenv(
+        "ZOO_TPU_FAULTS",
+        "env/kill=kill:times=3:where_replica=r0;"
+        "env/slow=delay:0.25;"
+        "garbage-no-equals;"
+        "env/badkind=frobnicate")
+    faults.reset_faults()          # forget prior parse
+    p = faults.point("env/kill")
+    spec = p.status()["armed"]
+    assert spec["kind"] == "kill"
+    assert spec["times"] == 3
+    assert spec["where"] == {"replica": "r0"}
+    slow = faults.point("env/slow").status()["armed"]
+    assert slow == {"kind": "delay", "fired": 0, "seconds": 0.25}
+    # malformed / unknown-kind entries are skipped, not fatal
+    assert faults.point("env/badkind").status()["armed"] is None
+    # selectors work through the env path too
+    p.fire(replica="r1")           # wrong replica: no fault
+    with pytest.raises(InjectedKillError):
+        p.fire(replica="r0")
+
+
+def test_env_not_reparsed_after_first_use(monkeypatch):
+    monkeypatch.setenv("ZOO_TPU_FAULTS", "late/point=error")
+    faults.reset_faults()
+    faults.point("other/point")    # triggers the one-time parse
+    monkeypatch.setenv("ZOO_TPU_FAULTS", "late/point=delay:9")
+    p = faults.point("late/point")  # pending spec attaches now
+    assert p.status()["armed"]["kind"] == "error"  # first parse won
+
+
+# -- the no-overhead guarantee -----------------------------------------------
+
+def test_unarmed_fire_has_no_measurable_overhead():
+    """The unarmed hot path must be one attribute test — bounded
+    here both structurally (the guard slot) and by a generous
+    micro-benchmark (< 3us/call even on a loaded CI box; an
+    accidental dict lookup + lock would blow well past it)."""
+    p = faults.point("test/hot")
+    assert p._spec is None         # the entire unarmed branch
+    assert FaultPointSlots() == ("name", "_spec")
+    n = 100_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        p.fire()
+    per_call = (time.perf_counter() - t0) / n
+    assert per_call < 3e-6, f"unarmed fire costs {per_call:.2e}s"
+
+
+def FaultPointSlots():
+    return faults.FaultPoint.__slots__
+
+
+# -- torn checkpoint: never loaded -------------------------------------------
+
+def _fit_model(tmp_path, seed=8):
+    init_nncontext(seed=seed)
+    rs = np.random.RandomState(0)
+    x = rs.randn(64, 4).astype(np.float32)
+    y = (x @ rs.randn(4, 1)).astype(np.float32)
+    m = Sequential()
+    m.add(L.Dense(1, input_shape=(4,)))
+    m.compile(optimizer=O.Adam(lr=0.05), loss="mse")
+    m.fit(x, y, batch_size=32, nb_epoch=1)
+    return m, x, y
+
+
+def test_torn_checkpoint_is_never_loaded(tmp_path):
+    import os
+    m, x, y = _fit_model(tmp_path)
+    est = m.estimator
+    d = str(tmp_path / "ckpt")
+    est.save_checkpoint(d)         # good checkpoint at step A
+    step_a = est.step
+    params_a = np.asarray(
+        est.params[list(est.params)[0]]["kernel"])
+
+    m.fit(x, y, batch_size=32, nb_epoch=1)   # advance to step B
+    assert est.step > step_a
+    faults.arm("estimator/checkpoint_write", "kill")
+    with pytest.raises(InjectedKillError):
+        est.save_checkpoint(d)     # dies after pickle, before rename
+    # the torn write left only an unpromoted tmp: no final file,
+    # LATEST still points at step A
+    names = sorted(os.listdir(d))
+    assert f"ckpt_{step_a}.pkl" in names
+    assert f"ckpt_{est.step}.pkl" not in names
+    assert any(n.startswith(".tmp_ckpt_") for n in names)
+    with open(os.path.join(d, "LATEST")) as f:
+        assert f.read().strip() == f"ckpt_{step_a}.pkl"
+
+    m2 = Sequential()
+    m2.add(L.Dense(1, input_shape=(4,)))
+    m2.compile(optimizer=O.Adam(lr=0.05), loss="mse")
+    m2.estimator.load_checkpoint(d)
+    assert m2.estimator.step == step_a   # resumed the good one
+    k = list(m2.estimator.params)[0]
+    np.testing.assert_allclose(
+        np.asarray(m2.estimator.params[k]["kernel"]), params_a,
+        rtol=1e-6)
+
+
+def test_async_torn_checkpoint_surfaces_and_resumes(tmp_path):
+    m, x, y = _fit_model(tmp_path, seed=9)
+    est = m.estimator
+    d = str(tmp_path / "ckpt")
+    est.save_checkpoint(d)
+    step_a = est.step
+    m.fit(x, y, batch_size=32, nb_epoch=1)
+    faults.arm("estimator/checkpoint_write", "error")
+    est.save_checkpoint(d, block=False)
+    with pytest.raises(InjectedFaultError):
+        est.wait_for_checkpoint()  # background failure re-raises
+    m2 = Sequential()
+    m2.add(L.Dense(1, input_shape=(4,)))
+    m2.compile(optimizer=O.Adam(lr=0.05), loss="mse")
+    m2.estimator.load_checkpoint(d)
+    assert m2.estimator.step == step_a
+
+
+# -- dispatcher hardening ----------------------------------------------------
+
+def test_dispatcher_survives_poisoned_batch():
+    """One batch's failure (here: an injected dispatch error) fails
+    only that batch's futures; the loop thread keeps serving."""
+    from analytics_zoo_tpu.pipeline.inference import (
+        DynamicBatcher, InferenceModel)
+    init_nncontext(seed=0)
+    net = Sequential()
+    net.add(L.Dense(2, input_shape=(4,)))
+    net.compile(optimizer="sgd", loss="mse")
+    im = InferenceModel()
+    im.load_keras_net(net)
+    b = DynamicBatcher(im, max_batch_size=8, max_wait_ms=1,
+                       queue_depth=16).start()
+    try:
+        x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+        ref = np.asarray(im.predict(x))
+        b.submit([x]).result(timeout=30)  # warm
+        faults.arm("batcher/dispatch", "error", times=1)
+        with pytest.raises(InjectedFaultError):
+            b.submit([x]).result(timeout=30)
+        assert b._thread.is_alive()       # the loop survived
+        out = b.submit([x]).result(timeout=30)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5)
+        snap = snapshot()
+        kinds = {v["labels"]["kind"]: v["value"] for v in
+                 snap["zoo_tpu_serving_errors_total"]["values"]}
+        assert kinds["dispatch_error"] == 1
+    finally:
+        b.stop()
+
+
+# -- generation: drain, stranded pages ---------------------------------------
+
+SEQ, VOCAB = 32, 61
+
+
+def _gen_engine(**kw):
+    from analytics_zoo_tpu.pipeline.inference import GenerationEngine
+    init_nncontext(seed=0)
+    import jax
+    from analytics_zoo_tpu.pipeline.api.keras.layers.transformer \
+        import TransformerLayer
+    net = TransformerLayer(n_block=2, hidden_size=32, n_head=2,
+                           seq_len=SEQ, vocab=VOCAB,
+                           hidden_p_drop=0.0, attn_p_drop=0.0,
+                           embed_p_drop=0.0)
+    params = net.build(jax.random.key(0), (SEQ,))
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("max_context", SEQ)
+    kw.setdefault("page_size", 8)
+    return GenerationEngine(net, params, **kw)
+
+
+def test_continuous_batcher_drain_mid_generation():
+    """drain(): resident sequences complete with REAL tokens and
+    their pages free; queued-unadmitted ones fail retryably; new
+    submits are rejected."""
+    from analytics_zoo_tpu.pipeline.inference import (
+        ContinuousBatcher)
+    eng = _gen_engine(max_slots=2)
+    refs = [
+        [int(t) for t in eng.generate([4, 19, 7],
+                                      max_new_tokens=6)[0]],
+        [int(t) for t in eng.generate([9, 2],
+                                      max_new_tokens=5)[0]],
+    ]
+    cb = ContinuousBatcher(eng, queue_depth=8).start()
+    try:
+        # slow the decode loop down so the drain lands mid-sequence
+        faults.arm("generation/decode_step", "delay", seconds=0.05)
+        f0 = cb.submit([4, 19, 7], max_new_tokens=6)
+        f1 = cb.submit([9, 2], max_new_tokens=5)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if eng.slots_active == 2:
+                break
+            time.sleep(0.005)
+        assert eng.slots_active == 2      # both resident, decoding
+        f2 = cb.submit([5], max_new_tokens=4)  # queued behind them
+
+        assert cb.drain(timeout=30) is True
+        # resident sequences retired with exact tokens
+        assert [int(t) for t in f0.result(5)] == refs[0]
+        assert [int(t) for t in f1.result(5)] == refs[1]
+        # queued entry failed retryably (router would redispatch)
+        with pytest.raises(RuntimeError, match="draining"):
+            f2.result(5)
+        # pages and slots all returned
+        assert eng.slots_active == 0
+        assert eng.free_pages == eng.allocator.max_pages
+        with pytest.raises(RuntimeError, match="draining"):
+            cb.submit([1], max_new_tokens=2)
+    finally:
+        faults.disarm_all()
+        cb.stop()
+
+
+def test_decode_kill_reclaims_stranded_pages():
+    """A decode-step death mid-generation fails the resident
+    requests but strands nothing: every page returns to the pool
+    and the loop keeps serving new work."""
+    from analytics_zoo_tpu.pipeline.inference import (
+        ContinuousBatcher)
+    eng = _gen_engine(max_slots=2)
+    ref = [int(t) for t in eng.generate([4, 19, 7],
+                                        max_new_tokens=4)[0]]
+    cb = ContinuousBatcher(eng, queue_depth=8).start()
+    try:
+        faults.arm("generation/decode_step", "kill", times=1)
+        f = cb.submit([4, 19, 7], max_new_tokens=16)
+        with pytest.raises(InjectedKillError):
+            f.result(timeout=30)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if eng.free_pages == eng.allocator.max_pages:
+                break
+            time.sleep(0.005)
+        assert eng.free_pages == eng.allocator.max_pages
+        assert eng.slots_active == 0
+        # loop thread survived the kill and still serves
+        out = cb.submit([4, 19, 7], max_new_tokens=4).result(30)
+        assert [int(t) for t in out] == ref
+    finally:
+        cb.stop()
+
+
+# -- fleet: exactly-once sibling retry under hash affinity -------------------
+
+class _StubReplicaModel:
+    can_relower = False
+    example_input_specs = None
+    generation = 0
+    concurrent_slots_free = 1
+    supported_concurrent_num = 1
+
+    def __init__(self):
+        self.calls = 0
+
+    def predict(self, xs, timeout_ms=-1):
+        self.calls += 1
+        x = xs[0] if isinstance(xs, list) else xs
+        return np.asarray(x) * 2.0
+
+
+def test_hash_policy_sibling_retry_is_exactly_once():
+    """Kill the hash-affine replica at admission: the request lands
+    exactly once on the sibling — never zero times (lost), never
+    twice (double-charged) — and the dead replica is ejected."""
+    from analytics_zoo_tpu.pipeline.inference import (
+        FleetRouter, Replica, ReplicaPool)
+    models = [_StubReplicaModel() for _ in range(2)]
+    replicas = [
+        Replica(f"r{i}", m, batcher_kwargs={"max_wait_ms": 1})
+        for i, m in enumerate(models)]
+    router = FleetRouter(ReplicaPool(replicas=replicas),
+                         policy="hash", probe_interval_s=0,
+                         eject_after=1, max_retries=2).start()
+    try:
+        x = np.arange(8, dtype=np.float32).reshape(2, 4)
+        key = router._affinity_key([x])
+        home = router._pick(2, key, set()).name  # the hash pick
+        faults.arm("fleet/replica_predict", "kill",
+                   where={"replica": home})
+        out = router.submit([x]).result(timeout=30)
+        np.testing.assert_allclose(np.asarray(out), x * 2.0)
+        sibling = [m for i, m in enumerate(models)
+                   if f"r{i}" != home][0]
+        dead = [m for i, m in enumerate(models)
+                if f"r{i}" == home][0]
+        assert sibling.calls == 1  # exactly once
+        assert dead.calls == 0     # killed at admission, never ran
+        assert _metric_sum("zoo_tpu_fleet_ejections_total") == 1
+        st = {r["name"]: r["state"] for r in
+              router.fleet_status()["replicas"]}
+        assert st[home] == "down"
+    finally:
+        faults.disarm_all()
+        router.stop()
+
+
+def test_dispatch_fault_mid_batch_retries_on_sibling():
+    """A dispatcher-level failure AFTER admission (the batch was
+    acked into a queue) re-dispatches on a sibling through the
+    router retry path — the acked request is never lost."""
+    from analytics_zoo_tpu.pipeline.inference import (
+        FleetRouter, Replica, ReplicaPool)
+    models = [_StubReplicaModel() for _ in range(2)]
+    replicas = [
+        Replica(f"r{i}", m, batcher_kwargs={"max_wait_ms": 1})
+        for i, m in enumerate(models)]
+    router = FleetRouter(ReplicaPool(replicas=replicas),
+                         policy="hash", probe_interval_s=0,
+                         max_retries=2).start()
+    try:
+        x = np.arange(8, dtype=np.float32).reshape(2, 4)
+        faults.arm("batcher/dispatch", "error", times=1)
+        out = router.submit([x]).result(timeout=30)
+        np.testing.assert_allclose(np.asarray(out), x * 2.0)
+        assert sum(m.calls for m in models) == 1  # exactly once
+        assert _metric_sum("zoo_tpu_fleet_retries_total") >= 1
+    finally:
+        faults.disarm_all()
+        router.stop()
+
+
+def test_corrupt_fault_poisons_direct_predict_output():
+    """The corrupt behavior on fleet/replica_predict NaN-poisons a
+    replica's direct predict — the probe-able signal chaos runs use
+    to prove detection, without touching real model code."""
+    from analytics_zoo_tpu.pipeline.inference import (
+        FleetRouter, Replica, ReplicaPool)
+    m = _StubReplicaModel()
+    router = FleetRouter(
+        ReplicaPool(replicas=[Replica("r0", m)]),
+        probe_interval_s=0)
+    try:
+        faults.arm("fleet/replica_predict", "corrupt", times=1)
+        out = router.pool.replicas[0].predict(
+            np.ones((1, 4), np.float32))
+        assert np.isnan(np.asarray(out)).all()
+        out2 = router.pool.replicas[0].predict(
+            np.ones((1, 4), np.float32))
+        assert not np.isnan(np.asarray(out2)).any()
+    finally:
+        router.stop()

@@ -1,0 +1,429 @@
+"""Versioned model registry + warm-swap canary rollout
+(pipeline/inference/registry.py): registration/lookup/persistence,
+the rolling→canary→promoted happy path with zero dropped requests,
+auto-rollback on an injected canary error burst and on an SLO
+breach, cohort traffic-split determinism, and the /debug/rollout
+surface. Tier-1 fast."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common import faults
+from analytics_zoo_tpu.common import slo as slo_lib
+from analytics_zoo_tpu.common.observability import (
+    reset_metrics, snapshot)
+from analytics_zoo_tpu.pipeline.inference import (
+    FleetRouter, ModelRegistry, ModelVersion, Replica, ReplicaPool)
+from analytics_zoo_tpu.pipeline.inference.registry import (
+    CANARY, PROMOTED, ROLLED_BACK)
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    reset_metrics()
+    faults.reset_faults()
+    yield
+    faults.reset_faults()
+    reset_metrics()
+
+
+def _metric_sum(name, snap=None):
+    snap = snap or snapshot()
+    fam = snap.get(name)
+    if fam is None:
+        return 0.0
+    return sum(v["value"] for v in fam["values"])
+
+
+# -- registry ----------------------------------------------------------------
+
+def test_registry_register_lookup_latest():
+    reg = ModelRegistry(root=None)
+    v0 = reg.register("toy", "v0", loader=lambda m: None,
+                      metadata={"note": "baseline"})
+    time.sleep(0.002)  # created_at orders latest(); avoid a tie
+    v1 = reg.register("toy", "v1", loader=lambda m: None)
+    assert reg.get("toy", "v0") is v0
+    assert reg.latest("toy") is v1
+    assert reg.versions("toy") == ["v0", "v1"]
+    assert reg.models() == ["toy"]
+    with pytest.raises(ValueError, match="immutable"):
+        reg.register("toy", "v0", loader=lambda m: None)
+    with pytest.raises(KeyError):
+        reg.get("toy", "nope")
+    with pytest.raises(KeyError):
+        reg.latest("unknown-model")
+
+
+def test_model_version_needs_exactly_one_source(tmp_path):
+    with pytest.raises(ValueError):
+        ModelVersion("toy", "v1")
+    with pytest.raises(ValueError):
+        ModelVersion("toy", "v1", artifact="a.zip",
+                     loader=lambda m: None)
+
+
+def test_registry_persistence_roundtrip(tmp_path):
+    src = tmp_path / "export.zip"
+    src.write_bytes(b"fake-compiled-artifact")
+    root = str(tmp_path / "registry")
+    reg = ModelRegistry(root=root)
+    reg.register("toy", "v1", artifact=str(src),
+                 metadata={"mfu": 0.33}, warm_buckets=[1, 2, 4])
+    # a second process scanning the same root sees the version
+    reg2 = ModelRegistry(root=root)
+    mv = reg2.get("toy", "v1")
+    assert mv.metadata == {"mfu": 0.33}
+    assert mv.warm_buckets == [1, 2, 4]
+    with open(mv.artifact, "rb") as f:
+        assert f.read() == b"fake-compiled-artifact"
+    # a torn registration (version dir without meta.json) is
+    # invisible — meta.json is written last
+    os.makedirs(os.path.join(root, "toy", "v2"))
+    reg3 = ModelRegistry(root=root)
+    assert reg3.versions("toy") == ["v1"]
+    # in-memory versions never persist
+    reg3.register("toy", "v3", loader=lambda m: None)
+    assert ModelRegistry(root=root).versions("toy") == ["v1"]
+
+
+# -- fleet fixtures ----------------------------------------------------------
+
+class _VersionedStub:
+    """Duck-typed model whose output encodes the loaded version."""
+
+    can_relower = False
+    example_input_specs = None
+    generation = 0
+    concurrent_slots_free = 1
+    supported_concurrent_num = 1
+
+    def __init__(self, factor=2.0):
+        self.factor = factor
+        self.calls = 0
+
+    def predict(self, xs, timeout_ms=-1):
+        self.calls += 1
+        x = xs[0] if isinstance(xs, list) else xs
+        return np.asarray(x) * self.factor
+
+
+def _loader(factor):
+    def load(model):
+        model.factor = factor
+        model.generation += 1
+    return load
+
+
+def _rollout_fleet(n=4, **router_kw):
+    """n stub replicas on v0 (×2.0) + a registry holding v0 and a
+    v2 whose loader makes the model multiply by 3.0."""
+    reg = ModelRegistry(root=None)
+    reg.register("toy", "v0", loader=_loader(2.0))
+    v2 = reg.register("toy", "v2", loader=_loader(3.0))
+    models = [_VersionedStub() for _ in range(n)]
+    replicas = [
+        Replica(f"r{i}", m, batcher_kwargs={"max_wait_ms": 1})
+        for i, m in enumerate(models)]
+    router_kw.setdefault("probe_interval_s", 0)
+    router = FleetRouter(ReplicaPool(replicas=replicas),
+                         **router_kw).start()
+    return router, models, reg, v2
+
+
+# -- the happy path: canary bakes clean, promotes ----------------------------
+
+def test_canary_rollout_promotes_after_clean_bake():
+    router, models, reg, v2 = _rollout_fleet(4)
+    try:
+        x = np.ones((1, 3), np.float32)
+        np.testing.assert_allclose(
+            np.asarray(router.submit([x]).result(10)), x * 2.0)
+
+        ctl = router.rollout(v2, canary_pct=25, bake_s=30.0)
+        assert ctl.state == CANARY
+        st = router.rollout_status()
+        assert st["state"] == CANARY
+        assert st["canary"]["pct"] == 25
+        versions = st["replica_versions"]
+        assert sorted(versions.values()) == ["v0", "v0", "v0", "v2"]
+        canary_name = ctl.canary_replicas[0]
+        assert versions[canary_name] == "v2"
+        # the canary SLO is installed while baking
+        ids = {s["id"] for s in
+               slo_lib.get_engine().status()["objectives"]}
+        assert "rollout_canary" in ids
+
+        # traffic still flows, both cohorts produce valid outputs
+        for _ in range(12):
+            out = np.asarray(router.submit([x]).result(10))
+            assert (np.allclose(out, x * 2.0)
+                    or np.allclose(out, x * 3.0))
+
+        # clean bake elapses → promotion sweeps the rest
+        ctl.tick(now=ctl.canary_since + ctl.bake_s + 1.0)
+        assert ctl.state == PROMOTED
+        st = router.rollout_status()
+        assert set(st["replica_versions"].values()) == {"v2"}
+        assert st["canary"] is None          # split cleared
+        assert all(m.factor == 3.0 for m in models)
+        np.testing.assert_allclose(
+            np.asarray(router.submit([x]).result(10)), x * 3.0)
+        # every swap drained its replica: queues flushed
+        assert all(s["flushed"] for s in ctl.swaps)
+        assert len(ctl.swaps) == 4
+        # the canary SLO is removed once the rollout ends
+        ids = {s["id"] for s in
+               slo_lib.get_engine().status()["objectives"]}
+        assert "rollout_canary" not in ids
+        assert _metric_sum("zoo_tpu_rollout_active") == 0
+        states = [t["state"] for t in ctl.transitions]
+        assert states == ["rolling", "canary", "promoting",
+                          "promoted"]
+    finally:
+        router.stop()
+
+
+def test_plain_rolling_update_without_canary():
+    router, models, reg, v2 = _rollout_fleet(2)
+    try:
+        ctl = router.rollout(v2, canary_pct=100)
+        assert ctl.state == PROMOTED
+        assert all(m.factor == 3.0 for m in models)
+        assert router.rollout_status()["canary"] is None
+    finally:
+        router.stop()
+
+
+def test_second_rollout_rejected_while_in_progress():
+    router, models, reg, v2 = _rollout_fleet(4)
+    try:
+        router.rollout(v2, canary_pct=25, bake_s=3600)
+        with pytest.raises(RuntimeError, match="still"):
+            router.rollout(v2, canary_pct=25)
+    finally:
+        router.stop()
+
+
+def test_rollout_without_resolvable_baseline_refuses_to_start():
+    """A rollout that could not roll back must not begin: no
+    registry entry for the replicas' current version and no explicit
+    baseline= → error BEFORE any replica is touched."""
+    models = [_VersionedStub() for _ in range(2)]
+    replicas = [
+        Replica(f"r{i}", m, batcher_kwargs={"max_wait_ms": 1})
+        for i, m in enumerate(models)]
+    router = FleetRouter(ReplicaPool(replicas=replicas),
+                         probe_interval_s=0).start()
+    try:
+        orphan = ModelVersion("toy", "v9", loader=_loader(9.0))
+        with pytest.raises(ValueError, match="baseline"):
+            router.rollout(orphan, canary_pct=50)
+        assert all(m.factor == 2.0 for m in models)  # untouched
+        assert all(r.version == "v0"
+                   for r in router.pool.replicas)
+    finally:
+        router.stop()
+
+
+# -- auto-rollback -----------------------------------------------------------
+
+def test_canary_error_burst_rolls_back_automatically():
+    """Inject an error fault on the canary replica: the cohort's
+    error burst crosses max_canary_errors, the next router tick
+    rolls the canary back to baseline through the drain path — and
+    no client request was lost (sibling retry absorbed every
+    fault)."""
+    router, models, reg, v2 = _rollout_fleet(4)
+    try:
+        ctl = router.rollout(v2, canary_pct=25, bake_s=3600.0,
+                             max_canary_errors=3)
+        canary_name = ctl.canary_replicas[0]
+        faults.arm("fleet/replica_predict", "error",
+                   where={"replica": canary_name})
+        x = np.ones((1, 3), np.float32)
+        outs = []
+        for _ in range(40):
+            outs.append(np.asarray(router.predict(x)))
+        # zero lost requests: every predict resolved with a valid
+        # output (canary faults absorbed by sibling retry)
+        assert len(outs) == 40
+        for out in outs:
+            assert (np.allclose(out, x * 2.0)
+                    or np.allclose(out, x * 3.0))
+        errs = _metric_sum("zoo_tpu_rollout_errors_total")
+        assert errs >= 3
+
+        router.tick()          # the prober pass executes rollback
+        assert ctl.state == ROLLED_BACK
+        assert "error burst" in ctl.reason
+        st = router.rollout_status()
+        assert st["state"] == ROLLED_BACK
+        assert set(st["replica_versions"].values()) == {"v0"}
+        assert st["canary"] is None
+        assert all(m.factor == 2.0 for m in models)  # restored
+        # the rollback is observable: anomaly + transition metrics
+        assert _metric_sum("zoo_tpu_anomalies_total") >= 1
+        snap = snapshot()
+        trans = {v["labels"]["state"]: v["value"] for v in
+                 snap["zoo_tpu_rollout_transitions_total"]["values"]}
+        assert trans["rolling_back"] == 1
+        assert trans["rolled_back"] == 1
+        faults.disarm_all()
+        np.testing.assert_allclose(
+            np.asarray(router.predict(x)), x * 2.0)
+    finally:
+        faults.disarm_all()
+        router.stop()
+
+
+def test_slo_breach_on_canary_cohort_rolls_back():
+    """The SLO-engine path: a burn-rate breach on the cohort
+    error-ratio objective fires the anomaly listener, and the next
+    tick executes the rollback."""
+    from analytics_zoo_tpu.pipeline.inference.fleet import (
+        _c_cohort_errors, _c_cohort_requests)
+    engine = slo_lib.SLOEngine(clock=lambda: 0.0)
+    router, models, reg, v2 = _rollout_fleet(4)
+    try:
+        ctl = router.rollout(v2, canary_pct=25, bake_s=3600.0,
+                             max_canary_errors=None, engine=engine,
+                             slo_min_events=5)
+        assert ctl.state == CANARY
+        engine.tick(now=0.0)   # baseline snapshot
+        # the canary cohort then burns its error budget
+        _c_cohort_requests("v2").inc(10)
+        _c_cohort_errors("v2").inc(6)
+        engine.tick(now=200.0)
+        status = {s["id"]: s for s in
+                  engine.status()["objectives"]}
+        assert status["rollout_canary"]["state"] == "breach"
+        router.tick()
+        assert ctl.state == ROLLED_BACK
+        assert "slo_breach" in ctl.reason
+        assert all(m.factor == 2.0 for m in models)
+        # the rule is removed after the rollout ends
+        assert "rollout_canary" not in {
+            s["id"] for s in engine.status()["objectives"]}
+    finally:
+        router.stop()
+
+
+def test_manual_promote_and_rollback_guards():
+    router, models, reg, v2 = _rollout_fleet(4)
+    try:
+        ctl = router.rollout(v2, canary_pct=25, bake_s=3600.0)
+        ctl.promote()
+        assert ctl.state == PROMOTED
+        with pytest.raises(RuntimeError):
+            ctl.promote()      # nothing baking anymore
+        with pytest.raises(RuntimeError):
+            ctl.rollback()
+    finally:
+        router.stop()
+
+
+# -- traffic split -----------------------------------------------------------
+
+def test_cohort_split_is_sticky_and_proportional():
+    router, models, reg, v2 = _rollout_fleet(2, policy="hash")
+    try:
+        router.set_canary("v2", "v0", 25)
+        rs = np.random.RandomState(0)
+        keys = [router._affinity_key(
+            [rs.randn(1, 3).astype(np.float32)])
+            for _ in range(300)]
+        cohorts = [router._cohort_version(k) for k in keys]
+        # sticky: the same key always lands in the same cohort
+        for k, c in zip(keys, cohorts):
+            assert all(router._cohort_version(k) == c
+                       for _ in range(3))
+        share = cohorts.count("v2") / len(cohorts)
+        assert 0.15 < share < 0.35  # ~25% of distinct keys
+        router.set_canary("v2", "v0", 0)
+        assert all(router._cohort_version(k) == "v0" for k in keys)
+        router.clear_canary()
+        assert router._cohort_version(keys[0]) is None
+    finally:
+        router.stop()
+
+
+def test_concurrent_traffic_during_rollout_loses_nothing():
+    """Clients hammering the fleet THROUGH the swap see only valid
+    outputs (old or new version) — never an error, never a drop."""
+    router, models, reg, v2 = _rollout_fleet(3)
+    try:
+        x = np.ones((2, 3), np.float32)
+        stop = threading.Event()
+        results = {"ok": 0, "bad": []}
+        lock = threading.Lock()
+
+        def client():
+            while not stop.is_set():
+                try:
+                    out = np.asarray(router.submit([x]).result(30))
+                    good = (np.allclose(out, x * 2.0)
+                            or np.allclose(out, x * 3.0))
+                    with lock:
+                        if good:
+                            results["ok"] += 1
+                        else:
+                            results["bad"].append(out)
+                except Exception as e:
+                    with lock:
+                        results["bad"].append(repr(e))
+                time.sleep(0.001)
+
+        threads = [threading.Thread(target=client)
+                   for _ in range(4)]
+        for t in threads:
+            t.start()
+        try:
+            ctl = router.rollout(v2, canary_pct=34, bake_s=0.0)
+            ctl.tick(now=ctl.canary_since + 1.0)  # promote now
+            assert ctl.state == PROMOTED
+            time.sleep(0.05)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+        assert results["bad"] == []
+        assert results["ok"] > 0
+    finally:
+        router.stop()
+
+
+# -- debug surface -----------------------------------------------------------
+
+def test_debug_rollout_payload():
+    from analytics_zoo_tpu.pipeline.inference.serving import (
+        _rollout_payload)
+    # single-model servers have no rollout surface
+    status, _ = _rollout_payload(None)
+    assert status == 404
+    status, _ = _rollout_payload(object())
+    assert status == 404
+    router, models, reg, v2 = _rollout_fleet(4)
+    try:
+        status, payload = _rollout_payload(router)
+        assert status == 200
+        assert payload == {"state": "idle", "canary": None}
+        ctl = router.rollout(v2, canary_pct=25, bake_s=3600.0)
+        status, payload = _rollout_payload(router)
+        assert status == 200
+        assert payload["state"] == CANARY
+        assert payload["version"] == "v2"
+        assert payload["baseline"] == "v0"
+        assert payload["canary"]["pct"] == 25
+        assert payload["canary_replicas"] == ctl.canary_replicas
+        json.dumps(payload)    # the whole surface is JSON-able
+        ctl.promote()
+        status, payload = _rollout_payload(router)
+        assert payload["state"] == PROMOTED
+    finally:
+        router.stop()
