@@ -50,9 +50,11 @@ __all__ = [
     "remove_anomaly_listener",
     "RecompileMonitor",
     "StepTimeWatcher",
+    "ReplicaSkewDetector",
     "install_recompile_monitor",
     "get_recompile_monitor",
     "update_device_memory_gauges",
+    "update_process_vitals",
 ]
 
 # control loops (e.g. the rollout controller's canary auto-rollback,
@@ -235,6 +237,161 @@ class StepTimeWatcher:
                     dur_s=round(dur_s, 6),
                     median_s=round(median, 6), factor=self.factor)
         return fired
+
+
+class ReplicaSkewDetector:
+    """Fleet-level outlier detection: one replica drifting away from
+    its siblings (a thermally throttled host, a leaking process, a
+    bad NIC) while the fleet averages still look healthy.
+
+    :meth:`observe` takes per-replica window stats — latency p99 and
+    error ratio, as computed by the telemetry collector from
+    consecutive snapshot deltas (`common/federation.py`) — and
+    compares each replica against the **median of the other
+    replicas** (not the full-fleet median: with N=2 a plain median
+    averages the outlier in and can never flag it). A replica whose
+    p99 exceeds ``factor`` × that median, or whose error ratio
+    exceeds it by ``error_margin`` absolute, fires
+    ``zoo_tpu_anomalies_total{kind="replica_skew"}`` — which the
+    rollout controller's anomaly listener can act on. After firing,
+    the replica mutes for ``cooldown_s`` (one anomaly per breach
+    episode, not per tick). Pure function of its inputs + injected
+    ``now``: fully unit-testable with fake clocks, no sleeps."""
+
+    def __init__(self, factor: Optional[float] = None,
+                 error_margin: Optional[float] = None,
+                 min_events: int = 4,
+                 cooldown_s: float = 60.0):
+        if factor is None:
+            factor = _env_float("ZOO_TPU_SKEW_FACTOR", 3.0)
+        if error_margin is None:
+            error_margin = _env_float("ZOO_TPU_SKEW_ERROR_MARGIN",
+                                      0.25)
+        self.factor = float(factor)
+        self.error_margin = float(error_margin)
+        self.min_events = max(1, int(min_events))
+        self.cooldown_s = float(cooldown_s)
+        self.fired = 0
+        self._muted_until: "dict" = {}  # replica -> now threshold
+        self._lock = threading.Lock()
+        self.last: "dict" = {}  # latest verdicts, for debug payloads
+
+    @staticmethod
+    def _median_others(stats, name: str, key: str):
+        vals = [s.get(key) for n, s in stats.items()
+                if n != name and s.get(key) is not None]
+        if not vals:
+            return None
+        return statistics.median(vals)
+
+    def observe(self, stats: "dict",
+                now: Optional[float] = None) -> "list":
+        """``stats`` maps replica name → ``{"p99_s": float|None,
+        "error_ratio": float|None, "events": int}`` for one window.
+        Returns the list of anomalies fired (possibly empty)."""
+        if now is None:
+            now = time.monotonic()
+        fired = []
+        verdicts = {}
+        for name, s in stats.items():
+            events = int(s.get("events") or 0)
+            verdict = {"events": events, "skew": None}
+            p99 = s.get("p99_s")
+            med_p99 = self._median_others(stats, name, "p99_s")
+            err = s.get("error_ratio")
+            med_err = self._median_others(stats, name,
+                                          "error_ratio")
+            if events >= self.min_events:
+                if (p99 is not None and med_p99 is not None
+                        and med_p99 > 0 and self.factor > 0
+                        and p99 > self.factor * med_p99):
+                    verdict["skew"] = {
+                        "metric": "latency_p99",
+                        "value": round(float(p99), 6),
+                        "fleet_median": round(float(med_p99), 6)}
+                elif (err is not None and med_err is not None
+                        and err - med_err > self.error_margin):
+                    verdict["skew"] = {
+                        "metric": "error_ratio",
+                        "value": round(float(err), 6),
+                        "fleet_median": round(float(med_err), 6)}
+            verdicts[name] = verdict
+            if verdict["skew"] is None:
+                with self._lock:
+                    self._muted_until.pop(name, None)
+                continue
+            with self._lock:
+                muted = now < self._muted_until.get(
+                    name, float("-inf"))
+                if not muted:
+                    self._muted_until[name] = now + self.cooldown_s
+                    self.fired += 1
+            if muted:
+                continue
+            fields = dict(verdict["skew"], replica=name,
+                          factor=self.factor, events=events)
+            anomaly("replica_skew", **fields)
+            fired.append(fields)
+        self.last = verdicts
+        return fired
+
+
+def _read_rss_bytes() -> Optional[int]:
+    """Resident-set size from /proc (Linux); None where absent."""
+    try:
+        with open("/proc/self/statm", "r", encoding="ascii") as fh:
+            pages = int(fh.read().split()[1])
+        return pages * (os.sysconf("SC_PAGE_SIZE")
+                        if hasattr(os, "sysconf") else 4096)
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+_PROC_T0 = time.monotonic()  # fallback uptime origin (import time)
+
+
+def _uptime_s() -> float:
+    try:  # true process uptime via /proc (Linux)
+        with open("/proc/self/stat", "rb") as fh:
+            start_ticks = float(fh.read().rsplit(b")", 1)[-1]
+                                .split()[19])
+        with open("/proc/uptime", "r", encoding="ascii") as fh:
+            host_up = float(fh.read().split()[0])
+        hz = os.sysconf("SC_CLK_TCK") if hasattr(os, "sysconf") \
+            else 100
+        return max(0.0, host_up - start_ticks / float(hz))
+    except (OSError, ValueError, IndexError):
+        return time.monotonic() - _PROC_T0
+
+
+def update_process_vitals() -> dict:
+    """Refresh this process's vitals gauges —
+    ``zoo_tpu_process_rss_bytes``, ``zoo_tpu_process_uptime_s`` and
+    (where /proc exists) ``zoo_tpu_process_open_fds`` — so federated
+    views can spot a leaking or wedged replica without attaching a
+    profiler. Called on every ``/metrics`` render; cheap (three
+    /proc reads) and a clean partial no-op on platforms without
+    /proc. Returns the values set."""
+    out: "dict" = {}
+    rss = _read_rss_bytes()
+    if rss is not None:
+        obs.gauge("zoo_tpu_process_rss_bytes",
+                  help="resident set size of this process").set(rss)
+        out["rss_bytes"] = rss
+    up = _uptime_s()
+    obs.gauge("zoo_tpu_process_uptime_s",
+              help="seconds since this process started").set(up)
+    out["uptime_s"] = up
+    try:
+        n_fds = len(os.listdir("/proc/self/fd"))
+    except OSError:
+        n_fds = None
+    if n_fds is not None:
+        obs.gauge("zoo_tpu_process_open_fds",
+                  help="open file descriptors in this "
+                       "process").set(n_fds)
+        out["open_fds"] = n_fds
+    return out
 
 
 def update_device_memory_gauges() -> int:

@@ -57,6 +57,7 @@ __all__ = [
     "SLOEngine",
     "DEFAULT_SERVING_SLOS",
     "DEFAULT_FLEET_SLOS",
+    "DEFAULT_FED_SLOS",
     "DEFAULT_TRAINING_SLOS",
     "get_engine",
     "install_defaults",
@@ -154,6 +155,29 @@ DEFAULT_FLEET_SLOS = [
         "threshold": 1.0,
         "op": ">",
         "windows": [120.0],
+    },
+]
+
+DEFAULT_FED_SLOS = [
+    {
+        "id": "fed_latency_p99",
+        "description": "fleet-wide federated /predict p99 stays "
+                       "under 500 ms (per-source window worst case)",
+        "signal": {"type": "gauge",
+                   "metric": "zoo_tpu_fed_latency_p99_seconds"},
+        "threshold": 0.5,
+        "op": ">",
+        "windows": [60.0],
+    },
+    {
+        "id": "fed_error_ratio",
+        "description": "fleet-wide federated serving error ratio "
+                       "stays under 5%",
+        "signal": {"type": "gauge",
+                   "metric": "zoo_tpu_fed_error_ratio"},
+        "threshold": 0.05,
+        "op": ">",
+        "windows": [60.0],
     },
 ]
 
@@ -652,13 +676,15 @@ def _env_overrides(d: dict) -> dict:
 
 def install_defaults(engine: SLOEngine, role: str) -> int:
     """Install the shipped objectives for ``role`` (``"serving"``,
-    ``"fleet"`` or ``"training"``) into ``engine``, skipping ids
-    already present (idempotent; user-replaced rules are never
-    clobbered). Returns how many rules were added."""
+    ``"fleet"``, ``"fed"`` or ``"training"``) into ``engine``,
+    skipping ids already present (idempotent; user-replaced rules
+    are never clobbered). Returns how many rules were added."""
     if role == "serving":
         defaults = DEFAULT_SERVING_SLOS
     elif role == "fleet":
         defaults = DEFAULT_FLEET_SLOS
+    elif role == "fed":
+        defaults = DEFAULT_FED_SLOS
     elif role == "training":
         defaults = DEFAULT_TRAINING_SLOS
     else:

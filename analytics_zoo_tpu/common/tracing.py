@@ -134,7 +134,14 @@ class SpanRecord:
 class TraceStore:
     """Bounded, thread-safe ring buffer of :class:`SpanRecord`.
     Oldest records fall off; a trace whose spans outlive the buffer
-    simply truncates — this is a flight recorder, not a database."""
+    simply truncates — this is a flight recorder, not a database.
+
+    Every record gets a monotonically increasing ``seq`` at insert,
+    so collectors can scrape incrementally (:meth:`records_since`)
+    without ever re-reading the ring: fetch with the last seq they
+    saw, get only newer records plus the new cursor. Records that
+    fall off the ring before a scrape are lost (flight-recorder
+    semantics), never re-delivered twice."""
 
     def __init__(self, capacity: Optional[int] = None):
         if capacity is None:
@@ -144,21 +151,42 @@ class TraceStore:
             except ValueError:
                 capacity = 4096
         self.capacity = max(1, capacity)
-        self._buf: "collections.deque[SpanRecord]" = collections.deque(
-            maxlen=self.capacity)
+        self._buf: "collections.deque" = collections.deque(
+            maxlen=self.capacity)  # (seq, SpanRecord)
+        self._seq = 0
         self._lock = threading.Lock()
 
     def add(self, rec: SpanRecord):
         with self._lock:
-            self._buf.append(rec)
+            self._seq += 1
+            self._buf.append((self._seq, rec))
 
     def __len__(self) -> int:
         with self._lock:
             return len(self._buf)
 
+    def latest_seq(self) -> int:
+        """Seq of the most recently added record (0 when empty ever
+        since construction — seqs never reset while the store
+        lives)."""
+        with self._lock:
+            return self._seq
+
     def records(self) -> "List[SpanRecord]":
         with self._lock:
-            return list(self._buf)
+            return [rec for _seq, rec in self._buf]
+
+    def records_since(self, since: int
+                      ) -> "Tuple[int, List[SpanRecord]]":
+        """``(cursor, records)``: every buffered record with
+        ``seq > since``, oldest first, plus the cursor to pass next
+        time. Cursor and records are taken under ONE lock, so a
+        record added during the scrape has ``seq > cursor`` and is
+        returned by the next call — zero loss, zero duplication (as
+        long as it does not fall off the ring first)."""
+        with self._lock:
+            return self._seq, [rec for seq, rec in self._buf
+                               if seq > since]
 
     def spans(self, trace_id: str) -> "List[SpanRecord]":
         """All buffered spans of one trace, oldest-start first."""
@@ -365,12 +393,19 @@ def _get(rec, key, default=None):
     return rec.get(key, default)
 
 
-def chrome_events(records) -> "List[dict]":
+def chrome_events(records, source_lanes: bool = False
+                  ) -> "List[dict]":
     """Render span records (:class:`SpanRecord` or plain dicts with
     the same keys, e.g. parsed event-log lines) as chrome-trace
     events: one ``ph: "X"`` complete event per span, one *process*
     per trace id, one *thread* per source thread, plus ``ph: "M"``
-    metadata naming both."""
+    metadata naming both.
+
+    ``source_lanes=True`` assigns the process lane per the record's
+    ``source`` field instead (fleet-stitched spans carry the scraped
+    process's name there — `common/federation.py`), so a
+    cross-process trace renders each replica process as its own
+    Perfetto track group."""
     pids: "Dict[str, int]" = {}
     tids: "Dict[Tuple[int, str], int]" = {}
     events: "List[dict]" = []
@@ -385,12 +420,18 @@ def chrome_events(records) -> "List[dict]":
             if ts is None:
                 continue
             t_start = float(ts) - float(dur)
-        if tid_str not in pids:
-            pids[tid_str] = len(pids) + 1
+        if source_lanes:
+            lane = str(_get(rec, "source", None) or "router")
+            lane_name = f"process {lane}"
+        else:
+            lane = tid_str
+            lane_name = f"trace {tid_str}"
+        if lane not in pids:
+            pids[lane] = len(pids) + 1
             events.append({"ph": "M", "name": "process_name",
-                           "pid": pids[tid_str], "tid": 0,
-                           "args": {"name": f"trace {tid_str}"}})
-        pid = pids[tid_str]
+                           "pid": pids[lane], "tid": 0,
+                           "args": {"name": lane_name}})
+        pid = pids[lane]
         thread = _get(rec, "thread", "main") or "main"
         tkey = (pid, thread)
         if tkey not in tids:

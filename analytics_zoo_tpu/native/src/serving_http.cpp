@@ -172,9 +172,18 @@ bool read_request(Server* s, int fd, std::string* method,
 void handle_conn(Server* s, int fd) {
     std::string method, path, body, trace;
     if (read_request(s, fd, &method, &path, &body, &trace)) {
-        // GET /metrics and GET /debug/* ride the worker queue:
-        // Python owns the metrics registry and the trace store
-        bool is_metrics = method == "GET" && path == "/metrics";
+        // GET /metrics[?...], /metrics/json and GET /debug/* ride
+        // the worker queue: Python owns the metrics registry, the
+        // trace store, and the fleet federation collector. The
+        // pending flag picks the response content-type: Prometheus
+        // text for /metrics (with or without a ?fleet=1 query),
+        // JSON for everything else including /metrics/json.
+        bool is_json_metrics = method == "GET" &&
+            (path == "/metrics/json" ||
+             path.rfind("/metrics/json?", 0) == 0);
+        bool is_metrics = method == "GET" && !is_json_metrics &&
+            (path == "/metrics" ||
+             path.rfind("/metrics?", 0) == 0);
         bool is_debug = method == "GET" &&
             path.rfind("/debug/", 0) == 0;
         if (method == "GET" && path == "/health") {
@@ -185,7 +194,8 @@ void handle_conn(Server* s, int fd) {
             }
             send_response(fd, 200, payload);
             ::close(fd);
-        } else if (method != "POST" && !is_metrics && !is_debug) {
+        } else if (method != "POST" && !is_metrics &&
+                   !is_json_metrics && !is_debug) {
             send_response(fd, 404, "{\"error\": \"POST only\"}");
             ::close(fd);
         } else {

@@ -247,6 +247,25 @@ def _h_cohort_latency(version: str):
                          labels={"version": version})
 
 
+# per-REPLICA dispatch accounting (the skew detector's input,
+# docs/observability.md): the router measures dispatch-to-resolve
+# for every replica — in-process or HTTP — so the federation
+# collector can window these uniformly across transports
+
+def _h_replica_latency(name: str):
+    return obs.histogram("zoo_tpu_fleet_replica_latency_seconds",
+                         help="dispatch-to-resolve latency by "
+                              "replica (skew detection input)",
+                         labels={"replica": name})
+
+
+def _c_replica_errors(name: str):
+    return obs.counter("zoo_tpu_fleet_replica_errors_total",
+                       help="dispatch failures attributed to a "
+                            "replica (skew detection input)",
+                       labels={"replica": name})
+
+
 class ReplicaContext:
     """What a :class:`ReplicaPool` ``model_fn`` receives: the
     replica's index, name, and the device slice it owns."""
@@ -844,6 +863,9 @@ class FleetRouter:
         self._canary: Optional[dict] = None
         self._cohort_rr = 0  # keyless-traffic bucket rotation
         self._rollout = None  # the active/last RolloutController
+        # fleet telemetry plane (federation collector), created on
+        # start(): TelemetryCollector or None
+        self.telemetry = None
 
     # -- model-ish surface (serving.py duck-typing) --------------------------
     @property
@@ -887,8 +909,9 @@ class FleetRouter:
                         r.note_done(1)
                 r.note_success()
                 _c_cohort_requests(r.version).inc()
-                _h_cohort_latency(r.version).observe(
-                    time.time() - t0)
+                dt = time.time() - t0
+                _h_cohort_latency(r.version).observe(dt)
+                _h_replica_latency(r.name).observe(dt)
                 return out
             except (QueueFullError, DeadlineExpiredError):
                 raise  # backpressure/deadline: not a replica fault
@@ -897,6 +920,7 @@ class FleetRouter:
                 tried.add(r.name)
                 _c_cohort_requests(r.version).inc()
                 _c_cohort_errors(r.version).inc()
+                _c_replica_errors(r.name).inc()
                 self._note_replica_failure(r, e)
                 if attempt < self.max_retries:
                     _c_retries().inc()
@@ -963,6 +987,12 @@ class FleetRouter:
                 target=self._probe_loop, name="zoo-fleet-prober",
                 daemon=True)
             self._prober.start()
+        if self.telemetry is None:
+            # deferred import: federation pulls diagnostics/tracing,
+            # fleet must stay importable without the telemetry plane
+            from analytics_zoo_tpu.common import federation
+            self.telemetry = federation.TelemetryCollector(self)
+        self.telemetry.start()
         return self
 
     def stop(self):
@@ -970,6 +1000,8 @@ class FleetRouter:
         if self._prober is not None:
             self._prober.join(timeout=5)
             self._prober = None
+        if self.telemetry is not None:
+            self.telemetry.stop()
         self.pool.stop()
         self._refresh_gauges()
 
@@ -1228,6 +1260,7 @@ class FleetRouter:
                 # when every failure happens before enqueue
                 _c_cohort_requests(r.version).inc()
                 _c_cohort_errors(r.version).inc()
+                _c_replica_errors(r.name).inc()
                 self._note_replica_failure(r, e)
                 continue
             r.note_dispatch(rows)
@@ -1257,11 +1290,13 @@ class FleetRouter:
         if not isinstance(exc, QueueFullError):
             _c_cohort_requests(r.version).inc()
             if t0 is not None:
-                _h_cohort_latency(r.version).observe(
-                    time.time() - t0)
+                dt = time.time() - t0
+                _h_cohort_latency(r.version).observe(dt)
+                _h_replica_latency(r.name).observe(dt)
             if exc is not None and not isinstance(
                     exc, DeadlineExpiredError):
                 _c_cohort_errors(r.version).inc()
+                _c_replica_errors(r.name).inc()
         if exc is None:
             r.note_success()
             self._resolve(fut, inner.result())

@@ -8,8 +8,21 @@ services, here a stdlib HTTP/JSON endpoint (no framework deps).
 
 POST /predict  {"inputs": [[...], ...]}  →  {"outputs": [[...], ...]}
 GET  /health   →  {"status": "ok", "free_slots": N, "batcher": {...}}
-GET  /metrics  →  Prometheus text exposition (docs/observability.md)
-GET  /debug/traces[?n=20]  →  recent traces as JSON (docs/observability.md)
+GET  /metrics  →  Prometheus text exposition (docs/observability.md);
+     ``?fleet=1`` on a fleet front door serves the MERGED fleet view
+     from the federation collector (ticked first unless ``tick=0``)
+GET  /metrics/json  →  registry snapshot as JSON (the federation
+     collector's scrape format; explicit application/json)
+GET  /debug/traces[?n=20]  →  recent traces as JSON (docs/observability.md);
+     ``?since=<seq>`` switches to the incremental span scrape the
+     federation collector uses (cursor + new spans, zero loss/dup);
+     ``?fleet=1`` lists stitched traces from the fleet aggregator
+GET  /debug/trace/<id>[?chrome=1]  →  ONE stitched cross-process
+     timeline for a trace id (fleet aggregator when mounted, local
+     ring otherwise); ``chrome=1`` renders Perfetto JSON with one
+     process lane per source
+GET  /debug/fleet/telemetry  →  federation collector state (sources,
+     scrape health, skew verdicts); 404 when no collector mounted
 GET  /debug/slo[?tick=0]  →  live SLO status (docs/slo.md): shipped
      serving objectives (p99 latency, error burn rate, queue depth)
      are installed at server start; the engine re-evaluates on each
@@ -54,6 +67,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from analytics_zoo_tpu.common import diagnostics
 from analytics_zoo_tpu.common import observability as obs
 from analytics_zoo_tpu.common import slo as slo_lib
 from analytics_zoo_tpu.common import tracing
@@ -255,18 +269,126 @@ def _health_payload(model: InferenceModel,
     return payload
 
 
-def _traces_payload(path: str) -> dict:
+def _fed_collector(batcher):
+    """The FleetRouter's federation ``TelemetryCollector`` when this
+    server fronts a started fleet (None otherwise — the attribute's
+    presence is how these routes discover the telemetry plane)."""
+    return getattr(batcher, "telemetry", None)
+
+
+def _metrics_text() -> bytes:
+    """Local-registry Prometheus text; refreshes the process vitals
+    gauges first so every scrape carries current RSS/uptime/fd
+    readings (docs/observability.md)."""
+    diagnostics.update_process_vitals()
+    return obs.to_prometheus().encode()
+
+
+def _metrics_json_payload() -> dict:
+    """``GET /metrics/json``: the registry snapshot the federation
+    collector scrapes — same data as ``/metrics``, machine-mergeable
+    (explicit ``application/json``)."""
+    diagnostics.update_process_vitals()
+    return {"ts": time.time(), "metrics": obs.snapshot()}
+
+
+def _fleet_metrics_text(path: str, batcher
+                        ) -> "Tuple[int, Optional[bytes]]":
+    """``GET /metrics?fleet=1``: merged fleet-wide Prometheus text
+    from the federation collector (HELP/TYPE deduplicated). Ticks
+    the collector first by default so exact-sum assertions see this
+    instant, not the last background scrape; ``tick=0`` reads
+    passively. ``(404, None)`` when no collector is mounted."""
+    from urllib.parse import parse_qs, urlsplit
+    q = parse_qs(urlsplit(path).query)
+    tele = _fed_collector(batcher)
+    if tele is None:
+        _count_error("not_found")
+        return 404, None
+    if q.get("tick", ["1"])[0] != "0":
+        tele.tick()
+    return 200, tele.fleet_prometheus().encode()
+
+
+def _traces_payload(path: str, batcher=None) -> dict:
     """``GET /debug/traces[?n=20]``: the most recent traces from the
-    in-process ring buffer, newest first."""
+    in-process ring buffer, newest first. ``?since=<seq>`` switches
+    to the federation collector's incremental scrape: the ring's
+    cursor plus every span recorded after ``seq`` (cursor and spans
+    read under one lock — zero loss, zero duplication). ``?fleet=1``
+    on a fleet front door lists stitched traces from the
+    aggregator."""
     from urllib.parse import parse_qs, urlsplit
     q = parse_qs(urlsplit(path).query)
     try:
         n = int(q.get("n", ["20"])[0])
     except ValueError:
         n = 20
+    n = max(1, min(n, 200))
+    if "since" in q:
+        try:
+            since = int(q["since"][0])
+        except ValueError:
+            since = 0
+        seq, recs = tracing.get_store().records_since(since)
+        return {"enabled": tracing.enabled(), "seq": seq,
+                "spans": [r.to_dict() for r in recs]}
+    tele = _fed_collector(batcher)
+    if q.get("fleet", ["0"])[0] == "1" and tele is not None:
+        return {"enabled": tracing.enabled(), "fleet": True,
+                "traces": tele.aggregator.recent(n)}
     return {"enabled": tracing.enabled(),
-            "traces": tracing.get_store().recent(
-                max(1, min(n, 200)))}
+            "traces": tracing.get_store().recent(n)}
+
+
+def _stitched_trace_payload(route: str, path: str, batcher
+                            ) -> "Tuple[int, dict]":
+    """``GET /debug/trace/<id>[?chrome=1]``: ONE stitched timeline
+    for a trace id — from the fleet aggregator when the federation
+    plane is mounted (spans from every process, freshened by a
+    synchronous collector tick), falling back to the local ring.
+    ``chrome=1`` renders Perfetto-loadable JSON with a distinct
+    process lane (pid) per source process."""
+    from urllib.parse import parse_qs, urlsplit
+    q = parse_qs(urlsplit(path).query)
+    tid = route[len("/debug/trace/"):]
+    chrome = q.get("chrome", ["0"])[0] == "1"
+    tele = _fed_collector(batcher)
+    if tele is not None:
+        tele.tick()  # pull any spans still sitting in the sources
+        agg = tele.aggregator
+        if agg.spans(tid):
+            return 200, (agg.chrome(tid) if chrome
+                         else agg.trace(tid))
+    recs = sorted((r for r in tracing.get_store().records()
+                   if r.trace_id == tid),
+                  key=lambda r: r.t_start)
+    if not recs:
+        _count_error("not_found")
+        return 404, _error_body(404, f"unknown trace id {tid!r}")
+    if chrome:
+        return 200, {"traceEvents": tracing.chrome_events(
+            [r.to_dict() for r in recs], source_lanes=True),
+            "displayTimeUnit": "ms"}
+    t0 = min(r.t_start for r in recs)
+    t1 = max(r.t_start + r.dur_s for r in recs)
+    return 200, {"trace_id": tid, "t_start": round(t0, 6),
+                 "dur_s": round(t1 - t0, 6), "n_spans": len(recs),
+                 "sources": ["router"],
+                 "spans": [r.to_dict() for r in recs]}
+
+
+def _fleet_telemetry_payload(batcher) -> "Tuple[int, dict]":
+    """``GET /debug/fleet/telemetry``: the federation collector's
+    own state — sources and scrape health, merge conflicts, the last
+    per-replica window stats and skew verdicts. 404 when this server
+    fronts no fleet telemetry plane."""
+    tele = _fed_collector(batcher)
+    if tele is None:
+        _count_error("not_found")
+        return 404, _error_body(
+            404, "no fleet telemetry collector mounted")
+    return 200, tele.status()
 
 
 def _slo_payload(path: str) -> dict:
@@ -453,7 +575,8 @@ class InferenceServer:
                 t0 = time.perf_counter()
                 _in_flight().inc()
                 status = 0
-                payload = None  # None == /metrics (rendered below)
+                payload = None
+                raw = None  # (body, ctype) short-circuits _reply
                 route = self.path.split("?", 1)[0]
                 try:
                     if route == "/health":
@@ -461,14 +584,35 @@ class InferenceServer:
                         payload = _health_payload(
                             server.model, server.batcher,
                             server.gen_batcher)
+                    elif route == "/metrics" and \
+                            "fleet=1" in self.path:
+                        status, body = _fleet_metrics_text(
+                            self.path, server.batcher)
+                        if body is None:
+                            payload = _error_body(
+                                404, "no fleet telemetry "
+                                "collector mounted")
+                        else:
+                            raw = (body,
+                                   "text/plain; version=0.0.4")
                     elif route == "/metrics":
+                        status = 200  # rendered after accounting
+                    elif route == "/metrics/json":
                         status = 200
+                        payload = _metrics_json_payload()
                     elif route == "/debug/traces":
                         status = 200
-                        payload = _traces_payload(self.path)
+                        payload = _traces_payload(
+                            self.path, server.batcher)
+                    elif route.startswith("/debug/trace/"):
+                        status, payload = _stitched_trace_payload(
+                            route, self.path, server.batcher)
                     elif route == "/debug/slo":
                         status = 200
                         payload = _slo_payload(self.path)
+                    elif route == "/debug/fleet/telemetry":
+                        status, payload = _fleet_telemetry_payload(
+                            server.batcher)
                     elif route == "/debug/fleet":
                         status, payload = _fleet_payload(
                             server.batcher)
@@ -487,10 +631,13 @@ class InferenceServer:
                     _in_flight().dec()
                     _record_request(self.path, status,
                                     time.perf_counter() - t0)
-                if payload is None:
-                    self._reply_raw(
-                        status, obs.to_prometheus().encode(),
-                        "text/plain; version=0.0.4")
+                if raw is None and payload is None:
+                    # local /metrics renders AFTER accounting so the
+                    # scrape sees itself counted
+                    raw = (_metrics_text(),
+                           "text/plain; version=0.0.4")
+                if raw is not None:
+                    self._reply_raw(status, raw[0], raw[1])
                 else:
                     self._reply(status, payload)
 
@@ -568,6 +715,8 @@ class InferenceServer:
         slo_lib.ensure_default_slos("serving")
         if hasattr(self.batcher, "fleet_status"):
             slo_lib.ensure_default_slos("fleet")
+            if _fed_collector(self.batcher) is not None:
+                slo_lib.ensure_default_slos("fed")
         if background:
             self._thread = threading.Thread(
                 target=self._httpd.serve_forever, daemon=True)
@@ -624,15 +773,33 @@ class NativeInferenceServer:
         trace_id = None
         route = path.split("?", 1)[0]
         try:
-            if route == "/metrics":
+            if route == "/metrics" and "fleet=1" in path:
+                status, body = _fleet_metrics_text(
+                    path, self.batcher)
+                out = body if body is not None else json.dumps(
+                    _error_body(404, "no fleet telemetry "
+                                "collector mounted")).encode()
+            elif route == "/metrics":
                 status = 200
                 out = None  # rendered after accounting, below
+            elif route == "/metrics/json":
+                status = 200
+                out = json.dumps(_metrics_json_payload()).encode()
             elif route == "/debug/traces":
                 status = 200
-                out = json.dumps(_traces_payload(path)).encode()
+                out = json.dumps(_traces_payload(
+                    path, self.batcher)).encode()
+            elif route.startswith("/debug/trace/"):
+                status, payload = _stitched_trace_payload(
+                    route, path, self.batcher)
+                out = json.dumps(payload).encode()
             elif route == "/debug/slo":
                 status = 200
                 out = json.dumps(_slo_payload(path)).encode()
+            elif route == "/debug/fleet/telemetry":
+                status, payload = _fleet_telemetry_payload(
+                    self.batcher)
+                out = json.dumps(payload).encode()
             elif route == "/debug/fleet":
                 status, payload = _fleet_payload(self.batcher)
                 out = json.dumps(payload).encode()
@@ -672,7 +839,7 @@ class NativeInferenceServer:
             _in_flight().dec()
             _record_request(route, status, time.perf_counter() - t0)
         if out is None:
-            out = obs.to_prometheus().encode()
+            out = _metrics_text()
         try:
             self._srv.respond(rid, status, out, trace_id=trace_id)
         except Exception:
@@ -709,6 +876,8 @@ class NativeInferenceServer:
         slo_lib.ensure_default_slos("serving")
         if hasattr(self.batcher, "fleet_status"):
             slo_lib.ensure_default_slos("fleet")
+            if _fed_collector(self.batcher) is not None:
+                slo_lib.ensure_default_slos("fed")
         self._srv.set_health(json.dumps(
             _health_payload(self.model, self.batcher,
                             self.gen_batcher)))

@@ -17,8 +17,25 @@ One process, end to end through the fleet stack (docs/serving.md):
      (/debug/fleet: admitting again) and that it serves traffic
   5. assert the fleet gauge/counter families are on /metrics
 
+A second phase then proves the **fleet telemetry plane**
+(docs/observability.md, Fleet federation) against REAL subprocess
+replicas: 2 `HttpReplica` workers (spawned as
+`fleet_smoke.py --worker`) behind a router front-end take a
+concurrent wave, and
+
+  6. the federated `GET /metrics?fleet=1` acked-request counter
+     equals the router's own count plus the per-replica
+     `GET /metrics/json` counts EXACTLY (every acked request counted
+     once, fleet-wide)
+  7. one worker process is SIGKILLed and a traced wave fired WHILE
+     it dies: every request still succeeds, and the traced request's
+     `GET /debug/trace/<id>` returns ONE stitched timeline with
+     spans from the router process AND a replica process, on
+     distinct Perfetto process lanes (`?chrome=1` pids)
+
 Exit code 0 = the fleet absorbed a mid-load replica kill with zero
-lost acked requests and re-admitted the healed replica.
+lost acked requests and re-admitted the healed replica, and the
+telemetry plane federated/stitched across real process boundaries.
 """
 
 from __future__ import annotations
@@ -104,6 +121,167 @@ def _wave(url, xs, label):
 def _fleet_debug(url) -> dict:
     return json.loads(urllib.request.urlopen(
         url + "/debug/fleet", timeout=30).read())
+
+
+# -- federation phase: real subprocess replicas -------------------------
+
+
+def _worker() -> int:
+    """`fleet_smoke.py --worker`: one subprocess replica — a toy
+    doubler behind the standard front-end. Prints the bound port as
+    JSON on stdout, then parks forever (the parent kills it)."""
+    from analytics_zoo_tpu.pipeline.inference.serving import (
+        InferenceServer)
+
+    class _Doubler:
+        concurrent_slots_free = 8
+        supported_concurrent_num = 8
+        example_input_specs = None
+        generator = None
+
+        def predict(self, xs, timeout_ms=-1):
+            return [np.asarray(x, dtype=np.float32) * 2
+                    for x in xs]
+
+    srv = InferenceServer(_Doubler(), port=0, batcher=None)
+    srv.start()
+    print(json.dumps({"port": srv.port}), flush=True)
+    while True:
+        time.sleep(3600)
+
+
+def _spawn_worker():
+    import subprocess
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get(
+        "PYTHONPATH", "")
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--worker"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, env=env)
+
+
+def _counter_value(snap, name, **labels) -> float:
+    total = 0.0
+    for rec in (snap.get(name) or {}).get("values", ()):
+        rl = rec.get("labels", {})
+        if all(rl.get(k) == v for k, v in labels.items()):
+            total += rec["value"]
+    return total
+
+
+def _traced_post(url, payload):
+    req = urllib.request.Request(
+        url + "/predict", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return (r.status, r.headers.get("X-Zoo-Trace-Id"),
+                json.loads(r.read()))
+
+
+def federation_phase() -> int:
+    """Phase 6+7 of the module docstring: exact federated counter
+    sums and cross-process trace stitching over real subprocess
+    `HttpReplica` workers."""
+    from analytics_zoo_tpu.common import observability as obs
+    from analytics_zoo_tpu.pipeline.inference import InferenceServer
+    from analytics_zoo_tpu.pipeline.inference.fleet import (
+        FleetRouter, HttpReplica, ReplicaPool)
+
+    procs = [_spawn_worker() for _ in range(2)]
+    router = srv = None
+    try:
+        urls = []
+        for p in procs:
+            line = p.stdout.readline()
+            assert line, "replica worker died before binding"
+            urls.append(
+                f"http://127.0.0.1:{json.loads(line)['port']}")
+        pool = ReplicaPool(replicas=[
+            HttpReplica(u, name=f"r{i}")
+            for i, u in enumerate(urls)])
+        router = FleetRouter(pool, probe_interval_s=0,
+                             eject_after=1)
+        srv = InferenceServer(router, port=0)
+        srv.start()
+        url = f"http://127.0.0.1:{srv.port}"
+
+        # 6) concurrent wave, then exact federated counter sums
+        xs = [np.full((n, 4), float(i), np.float32)
+              for i, n in enumerate(SIZES)]
+        for i, (status, out) in enumerate(
+                _wave(url, xs, "federated")):
+            assert status == 200, (i, status, out)
+            got = np.asarray(out["outputs"], np.float32).ravel()
+            assert got[0] == 2.0 * float(i), (i, got[:4])
+        acked = len(SIZES)
+
+        per_replica = []
+        for u in urls:
+            doc = json.loads(urllib.request.urlopen(
+                u + "/metrics/json", timeout=30).read())
+            per_replica.append(_counter_value(
+                doc["metrics"], "zoo_tpu_serving_requests_total",
+                path="/predict", status="200"))
+        assert sum(per_replica) == acked, (per_replica, acked)
+
+        text = urllib.request.urlopen(
+            url + "/metrics?fleet=1", timeout=30).read().decode()
+        local = _counter_value(
+            obs.snapshot(), "zoo_tpu_serving_requests_total",
+            path="/predict", status="200")
+        import re
+        m = re.search(
+            r'^zoo_tpu_serving_requests_total\{[^}]*'
+            r'path="/predict"[^}]*status="200"[^}]*\} ([0-9.]+)',
+            text, re.M)
+        assert m, text
+        fed_val = float(m.group(1))
+        assert fed_val == local + sum(per_replica), (
+            fed_val, local, per_replica)
+
+        # 7) SIGKILL one worker and fire a traced wave WHILE it
+        # dies: zero lost acked work, and the trace still stitches
+        # across the surviving processes
+        procs[0].kill()
+        tid = None
+        for k in range(len(SIZES)):
+            status, tid, out = _traced_post(
+                url, {"inputs": [[9.0, 1.0, 2.0, 3.0]]})
+            assert status == 200, (k, status, out)
+            got = np.asarray(out["outputs"], np.float32).ravel()
+            assert got[0] == 18.0, got[:4]
+        assert tid
+
+        t = json.loads(urllib.request.urlopen(
+            f"{url}/debug/trace/{tid}", timeout=30).read())
+        assert t["trace_id"] == tid, t
+        assert "router" in t["sources"], t["sources"]
+        assert any(s in ("r0", "r1") for s in t["sources"]), (
+            t["sources"])
+        ch = json.loads(urllib.request.urlopen(
+            f"{url}/debug/trace/{tid}?chrome=1", timeout=30).read())
+        pids = {e.get("pid") for e in ch["traceEvents"]
+                if e.get("ph") == "X"}
+        assert len(pids) >= 2, pids  # distinct Perfetto lanes
+
+        n_spans = t["n_spans"]
+    finally:
+        if srv is not None:
+            srv.stop()
+        elif router is not None:
+            router.stop()
+        for p in procs:
+            p.kill()
+        for p in procs:
+            p.wait(timeout=30)
+
+    print(f"fleet-smoke federation OK: {acked} acked requests "
+          f"federated exactly ({'+'.join(str(int(v)) for v in per_replica)}"
+          f"+{int(local)} local = {int(fed_val)}); mid-kill trace "
+          f"{tid} stitched {n_spans} spans from "
+          f"{len(t['sources'])} processes on {len(pids)} lanes")
+    return 0
 
 
 def main() -> int:
@@ -219,8 +397,10 @@ def main() -> int:
     print(f"fleet-smoke OK: {front} served {3 * len(SIZES)} "
           f"requests across 2 replicas; r0 killed mid-load with "
           f"zero lost acked requests, ejected, and re-admitted")
-    return 0
+    return federation_phase()
 
 
 if __name__ == "__main__":
+    if "--worker" in sys.argv[1:]:
+        sys.exit(_worker())
     sys.exit(main())

@@ -38,6 +38,8 @@ MODULES = [
      "slo — declarative objectives & burn-rate engine"),
     ("analytics_zoo_tpu.common.faults",
      "faults — chaos fault-injection registry"),
+    ("analytics_zoo_tpu.common.federation",
+     "federation — fleet metric merge & trace stitching"),
     ("analytics_zoo_tpu.perf",
      "perf — FLOPs accounting & goodput"),
     ("analytics_zoo_tpu.perf.goodput",

@@ -18,12 +18,16 @@ stdlib ast:
   KeyboardInterrupt/SystemExit and masks injected faults the chaos
   harness relies on seeing — catch `Exception` (docs/robustness.md);
 - shipped SLO defaults (`DEFAULT_SERVING_SLOS` /
-  `DEFAULT_FLEET_SLOS` / `DEFAULT_TRAINING_SLOS` in
-  `common/slo.py`, kept as pure dict
+  `DEFAULT_FLEET_SLOS` / `DEFAULT_FED_SLOS` /
+  `DEFAULT_TRAINING_SLOS` in `common/slo.py`, kept as pure dict
   literals precisely so this works): every rule id is unique, every
   window positive and ascending, and every referenced metric name is
   one the package actually registers — a typoed selector would
-  otherwise sit silently in `no_data` forever (docs/slo.md).
+  otherwise sit silently in `no_data` forever (docs/slo.md);
+- metric-catalog drift: every registered metric family appears in
+  the docs/observability.md catalog (between the
+  `metric-catalog:begin/end` markers) and every catalog entry is
+  still registered by some package file.
 
 Run: `python scripts/lint.py` (exit 1 on findings). `make lint`.
 """
@@ -168,7 +172,7 @@ def _bare_except_problems(rel: str, tree: ast.AST) -> list:
 
 
 _SLO_DEFAULT_NAMES = ("DEFAULT_SERVING_SLOS", "DEFAULT_FLEET_SLOS",
-                      "DEFAULT_TRAINING_SLOS")
+                      "DEFAULT_FED_SLOS", "DEFAULT_TRAINING_SLOS")
 _SLO_FILE = os.path.join("analytics_zoo_tpu", "common", "slo.py")
 
 
@@ -246,6 +250,43 @@ def check_slo_defaults(registered: set) -> list:
     return problems
 
 
+_CATALOG_FILE = os.path.join("docs", "observability.md")
+_CATALOG_BEGIN = "<!-- metric-catalog:begin -->"
+_CATALOG_END = "<!-- metric-catalog:end -->"
+
+
+def check_metric_catalog(registered: set) -> list:
+    """Metric-catalog drift gate: every metric family a package file
+    registers must be listed in the docs/observability.md catalog
+    (between the ``metric-catalog`` markers), and every catalog entry
+    must still be registered by some package file. Catches both
+    silent additions (new metric nobody documented) and stale docs
+    (metric renamed/removed but still advertised)."""
+    path = os.path.join(ROOT, _CATALOG_FILE)
+    if not os.path.isfile(path):
+        return [f"{_CATALOG_FILE}: missing (metric catalog "
+                f"unchecked)"]
+    text = open(path, encoding="utf-8").read()
+    try:
+        lo = text.index(_CATALOG_BEGIN)
+        hi = text.index(_CATALOG_END)
+    except ValueError:
+        return [f"{_CATALOG_FILE}: metric-catalog markers missing "
+                f"({_CATALOG_BEGIN} / {_CATALOG_END})"]
+    section = text[lo:hi]
+    documented = set(re.findall(r"`(zoo_tpu_[a-z0-9_]+)`", section))
+    problems = []
+    for name in sorted(registered - documented):
+        problems.append(
+            f"{_CATALOG_FILE}: registered metric '{name}' missing "
+            f"from the metric catalog")
+    for name in sorted(documented - registered):
+        problems.append(
+            f"{_CATALOG_FILE}: catalog lists '{name}' but no "
+            f"package file registers it")
+    return problems
+
+
 def check_file(path: str, registered: Optional[set] = None) -> list:
     rel = os.path.relpath(path, ROOT)
     try:
@@ -306,6 +347,7 @@ def main() -> int:
         n += 1
         all_problems.extend(check_file(path, registered))
     all_problems.extend(check_slo_defaults(registered))
+    all_problems.extend(check_metric_catalog(registered))
     for p in all_problems:
         print(p)
     print(f"# linted {n} files: "

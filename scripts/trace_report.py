@@ -13,9 +13,18 @@
 ``--chrome OUT`` additionally exports every traced span as Perfetto-
 loadable chrome-trace JSON (open at https://ui.perfetto.dev).
 
+``--fleet URL`` switches the source from an offline event log to a
+*running* fleet router: it pulls the stitched cross-process traces
+from ``GET /debug/traces?fleet=1`` (docs/observability.md, Fleet
+federation) and renders the slowest stitched requests with their
+per-source (router / replica) span breakdown; ``--chrome`` then
+exports one Perfetto process lane per source.
+
 Usage:
     python scripts/trace_report.py --events PATH [--top N]
                                    [--chrome OUT]
+    python scripts/trace_report.py --fleet http://router:8080
+                                   [--top N] [--chrome OUT]
 """
 
 from __future__ import annotations
@@ -118,6 +127,53 @@ def export_chrome(events, path: str):
           "https://ui.perfetto.dev")
 
 
+def fetch_fleet_traces(base: str, n: int = 50) -> list:
+    """Pull stitched traces from a running fleet router
+    (``GET /debug/traces?fleet=1`` — docs/observability.md)."""
+    import urllib.request
+    url = f"{base.rstrip('/')}/debug/traces?fleet=1&n={n}"
+    with urllib.request.urlopen(url, timeout=30) as r:
+        doc = json.loads(r.read())
+    if not doc.get("fleet"):
+        raise SystemExit(
+            f"{base} answered /debug/traces without fleet data — "
+            f"is it a fleet router with federation enabled?")
+    return doc.get("traces") or []
+
+
+def fleet_report(traces, top: int, out=sys.stdout):
+    """Slowest stitched cross-process requests, per-source span
+    breakdown under each."""
+    traces = sorted(traces, key=lambda t: t.get("dur_s") or 0.0,
+                    reverse=True)
+    n_spans = sum(t.get("n_spans", 0) for t in traces)
+    print(f"\n== stitched fleet traces (top {top} of {len(traces)}; "
+          f"{n_spans} spans) ==", file=out)
+    for t in traces[:top]:
+        srcs = ",".join(t.get("sources") or [])
+        print(f"  {_fmt_ms(t.get('dur_s'))} ms  "
+              f"trace={t.get('trace_id')}  sources=[{srcs}]",
+              file=out)
+        for s in t.get("spans") or []:
+            print(f"      {_fmt_ms(s.get('dur_s'))} ms  "
+                  f"[{s.get('source', 'router')}] {s.get('name')}",
+                  file=out)
+
+
+def export_fleet_chrome(traces, path: str):
+    """Chrome-trace JSON with one process lane per source (router
+    and each replica get distinct pids)."""
+    recs = [s for t in traces for s in (t.get("spans") or [])]
+    doc = {"traceEvents": tracing.chrome_events(
+               recs, source_lanes=True),
+           "displayTimeUnit": "ms"}
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh)
+    print(f"\nchrome trace -> {path} "
+          f"({len(doc['traceEvents'])} events); open in "
+          "https://ui.perfetto.dev")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--events",
@@ -128,7 +184,18 @@ def main(argv=None) -> int:
                     help="how many slow requests to show")
     ap.add_argument("--chrome", metavar="OUT",
                     help="also export chrome-trace JSON to OUT")
+    ap.add_argument("--fleet", metavar="URL",
+                    help="pull stitched traces from a running fleet "
+                         "router instead of reading an event log")
     args = ap.parse_args(argv)
+    if args.fleet:
+        traces = fetch_fleet_traces(args.fleet,
+                                    n=max(args.top, 50))
+        print(f"{len(traces)} stitched traces from {args.fleet}")
+        fleet_report(traces, args.top)
+        if args.chrome:
+            export_fleet_chrome(traces, args.chrome)
+        return 0
     if not args.events:
         ap.error("--events required (or set ZOO_TPU_EVENT_LOG)")
     if not os.path.exists(args.events):

@@ -9,6 +9,11 @@ import os
 
 if not os.environ.get("ZOO_TPU_TEST_REAL_DEVICE"):
     os.environ["JAX_PLATFORMS"] = "cpu"
+# no background federation ticker threads in tests: every fleet
+# router a test starts would otherwise scrape/merge on a 5s cadence
+# and race the per-test registry resets below. Tests drive
+# TelemetryCollector.tick() manually (the injectable-clock path).
+os.environ.setdefault("ZOO_TPU_FED_TICK_S", "0")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,743 @@
+"""Fleet telemetry plane (common/federation.py + serving/fleet
+wiring): snapshot merging (counters, histograms with identical and
+mismatched bucket boundaries, per-source gauge labeling, type
+conflicts, label-escaping round-trip), the zero-loss incremental
+trace cursor, cross-process trace stitching with per-source Perfetto
+lanes, the TelemetryCollector against stub HTTP sources and a REAL
+subprocess HttpReplica fleet (exact federated counter sums), and the
+fault-injected replica_skew detection path — all ticks manual,
+injectable clocks, no polling sleeps. Tier-1."""
+
+import json
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common import diagnostics, faults
+from analytics_zoo_tpu.common import federation as fed
+from analytics_zoo_tpu.common import observability as obs
+from analytics_zoo_tpu.common import slo as slo_lib
+from analytics_zoo_tpu.common import tracing
+from analytics_zoo_tpu.pipeline.inference import (
+    FleetRouter, InferenceServer, Replica, ReplicaPool)
+
+
+# -- helpers ------------------------------------------------------------------
+
+class _Model:
+    """Duck-typed model: doubles its input. No jax compile."""
+
+    concurrent_slots_free = 4
+    supported_concurrent_num = 4
+    example_input_specs = None
+    generator = None
+
+    def predict(self, xs, timeout_ms=-1):
+        return [np.asarray(x, dtype=np.float32) * 2 for x in xs]
+
+
+def _fleet(n=2):
+    pool = ReplicaPool(replicas=[
+        Replica(f"r{i}", _Model(), batcher=None) for i in range(n)])
+    return FleetRouter(pool, probe_interval_s=0).start()
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.headers.get("Content-Type"), r.read()
+
+
+def _post(url, payload, timeout=30):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return (r.status, r.headers.get(tracing.TRACE_HEADER),
+                json.loads(r.read()))
+
+
+def _counter_value(snap_or_merged, name, **labels):
+    fam = snap_or_merged.get(name) or {}
+    total = 0.0
+    for rec in fam.get("values", ()):
+        rl = rec.get("labels", {})
+        if all(rl.get(k) == v for k, v in labels.items()):
+            total += rec["value"]
+    return total
+
+
+# -- merge_snapshots ----------------------------------------------------------
+
+def test_merge_sums_counters_and_identical_histograms():
+    regs = {}
+    for src, n in (("r0", 3), ("r1", 5)):
+        reg = obs.MetricsRegistry()
+        c = reg.counter("zoo_tpu_serving_requests_total",
+                        labels={"path": "/predict",
+                                "status": "200"})
+        for _ in range(n):
+            c.inc()
+        h = reg.histogram("zoo_tpu_serving_request_seconds",
+                          labels={"path": "/predict"})
+        h.observe(0.001)
+        h.observe(0.2)
+        regs[src] = reg.snapshot()
+    merged, conflicts = fed.merge_snapshots(regs)
+    assert conflicts == []
+    assert _counter_value(
+        merged, "zoo_tpu_serving_requests_total",
+        path="/predict", status="200") == 8
+    hrec = merged["zoo_tpu_serving_request_seconds"]["values"][0]
+    assert hrec["count"] == 4
+    assert hrec["sum"] == pytest.approx(2 * (0.001 + 0.2))
+    assert hrec["buckets"]["+Inf"] == 4
+    # identical layouts: every source bound survives, summed
+    a = regs["r0"]["zoo_tpu_serving_request_seconds"]["values"][0]
+    for le, v in a["buckets"].items():
+        assert hrec["buckets"][le] == 2 * v
+
+
+def test_merge_mismatched_histogram_boundaries_intersect():
+    a = obs.MetricsRegistry()
+    b = obs.MetricsRegistry()
+    ha = a.histogram("zoo_tpu_serving_batch_size",
+                     buckets=[1.0, 2.0, 4.0])
+    hb = b.histogram("zoo_tpu_serving_batch_size",
+                     buckets=[2.0, 4.0, 8.0])
+    for v in (1, 3, 9):
+        ha.observe(v)
+        hb.observe(v)
+    merged, conflicts = fed.merge_snapshots(
+        {"a": a.snapshot(), "b": b.snapshot()})
+    assert conflicts == []
+    rec = merged["zoo_tpu_serving_batch_size"]["values"][0]
+    # only shared finite bounds survive; cumulative counts at a
+    # shared bound stay exact under either layout
+    assert set(rec["buckets"]) == {"2", "4", "+Inf"}
+    assert rec["buckets"]["2"] == 2    # obs 1 per source
+    assert rec["buckets"]["4"] == 4    # obs 1, 3 per source
+    assert rec["buckets"]["+Inf"] == 6
+    assert rec["count"] == 6
+    assert rec["sum"] == pytest.approx(2 * 13.0)
+
+
+def test_merge_gauges_keep_per_source_replica_label():
+    a = obs.MetricsRegistry()
+    b = obs.MetricsRegistry()
+    a.gauge("zoo_tpu_serving_queue_depth").set(3)
+    b.gauge("zoo_tpu_serving_queue_depth").set(7)
+    # a gauge that already carries a replica identity keeps it
+    a.gauge("zoo_tpu_fleet_replica_up",
+            labels={"replica": "remote9"}).set(1)
+    merged, _ = fed.merge_snapshots(
+        {"a": a.snapshot(), "b": b.snapshot()})
+    depth = {r["labels"]["replica"]: r["value"] for r in
+             merged["zoo_tpu_serving_queue_depth"]["values"]}
+    assert depth == {"a": 3, "b": 7}
+    up = merged["zoo_tpu_fleet_replica_up"]["values"]
+    assert up[0]["labels"]["replica"] == "remote9"
+
+
+def test_merge_type_conflict_first_seen_wins_and_reported():
+    a = obs.MetricsRegistry()
+    b = obs.MetricsRegistry()
+    a.counter("zoo_tpu_train_steps_total").inc()
+    b.gauge("zoo_tpu_train_steps_total").set(5)
+    merged, conflicts = fed.merge_snapshots(
+        {"a": a.snapshot(), "b": b.snapshot()})
+    assert merged["zoo_tpu_train_steps_total"]["type"] == "counter"
+    assert _counter_value(
+        merged, "zoo_tpu_train_steps_total") == 1
+    assert len(conflicts) == 1
+    assert conflicts[0]["metric"] == "zoo_tpu_train_steps_total"
+    assert conflicts[0]["source"] == "b"
+    assert conflicts[0]["kept_type"] == "counter"
+
+
+def test_label_escaping_roundtrip_snapshot_merge_prometheus():
+    reg = obs.MetricsRegistry()
+    nasty = 'a"b\\c\nd'
+    reg.counter("zoo_tpu_ingest_records_total",
+                labels={"path": nasty}).inc()
+    merged, _ = fed.merge_snapshots({"r0": reg.snapshot()})
+    text = fed.render_prometheus(merged)
+    # exactly the escaping the single-process exposition uses
+    local = reg.to_prometheus()
+    esc = 'path="a\\"b\\\\c\\nd"'
+    assert esc in local
+    assert esc in text
+    # and the value survived the round trip
+    assert _counter_value(
+        merged, "zoo_tpu_ingest_records_total", path=nasty) == 1
+
+
+def test_render_prometheus_dedupes_help_type_lines():
+    regs = {}
+    for src in ("r0", "r1", "r2"):
+        reg = obs.MetricsRegistry()
+        reg.counter("zoo_tpu_serving_requests_total", help="reqs",
+                    labels={"path": "/predict"}).inc()
+        reg.histogram("zoo_tpu_serving_request_seconds",
+                      help="lat").observe(0.01)
+        regs[src] = reg.snapshot()
+    merged, _ = fed.merge_snapshots(regs)
+    text = fed.render_prometheus(merged)
+    for fam in ("zoo_tpu_serving_requests_total",
+                "zoo_tpu_serving_request_seconds"):
+        assert text.count(f"# TYPE {fam} ") == 1
+        assert text.count(f"# HELP {fam} ") == 1
+    # +Inf is last bucket line and sorted before _sum/_count
+    lines = text.splitlines()
+    inf = [ln for ln in lines if 'le="+Inf"' in ln]
+    assert len(inf) == 1
+
+
+# -- incremental trace cursor -------------------------------------------------
+
+def test_trace_cursor_zero_loss_zero_duplication():
+    store = tracing.get_store()
+    with tracing.trace("serving/request", path="/predict"):
+        pass
+    seq1, recs1 = store.records_since(0)
+    assert len(recs1) >= 1
+    assert seq1 >= len(recs1)
+    # nothing new: empty, cursor stable
+    seq2, recs2 = store.records_since(seq1)
+    assert (seq2, recs2) == (seq1, [])
+    # spans recorded after a scrape land in the NEXT scrape, once
+    with tracing.trace("serving/request", path="/predict"):
+        pass
+    seq3, recs3 = store.records_since(seq1)
+    assert seq3 > seq1
+    new_ids = {(r.trace_id, r.span_id) for r in recs3}
+    old_ids = {(r.trace_id, r.span_id) for r in recs1}
+    assert not (new_ids & old_ids)
+    # and are not served again
+    seq4, recs4 = store.records_since(seq3)
+    assert (seq4, recs4) == (seq3, [])
+
+
+def test_trace_cursor_survives_concurrent_writers():
+    store = tracing.get_store()
+    stop = threading.Event()
+    wrote = []
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            with tracing.trace("serving/request", idx=i):
+                pass
+            wrote.append(i)
+            i += 1
+
+    t = threading.Thread(target=writer)
+    t.start()
+    try:
+        seen = set()
+        cursor = 0
+        deadline = time.monotonic() + 5
+        while len(wrote) < 50 and time.monotonic() < deadline:
+            cursor, recs = store.records_since(cursor)
+            for r in recs:
+                key = (r.trace_id, r.span_id)
+                assert key not in seen  # no duplication, ever
+                seen.add(key)
+    finally:
+        stop.set()
+        t.join()
+    cursor, recs = store.records_since(cursor)
+    seen.update((r.trace_id, r.span_id) for r in recs)
+    # every span the writer produced arrived exactly once
+    assert len(seen) == len(wrote)
+
+
+# -- TraceAggregator ----------------------------------------------------------
+
+def _span(tid, sid, name, t0, dur, **fields):
+    return {"trace_id": tid, "span_id": sid, "parent_id": None,
+            "name": name, "t_start": t0, "dur_s": dur,
+            "thread": "t", "fields": fields}
+
+
+def test_aggregator_stitches_by_trace_id_across_sources():
+    agg = fed.TraceAggregator(capacity=100)
+    agg.add_spans("router", [
+        _span("T1", "s1", "fleet/dispatch", 10.0, 0.5)])
+    agg.add_spans("r0", [
+        _span("T1", "s2", "serving/request", 10.1, 0.3),
+        _span("T2", "s3", "serving/request", 11.0, 0.1)])
+    t = agg.trace("T1")
+    assert t["n_spans"] == 2
+    assert t["sources"] == ["r0", "router"]
+    assert t["t_start"] == pytest.approx(10.0)
+    assert t["dur_s"] == pytest.approx(0.5)
+    assert agg.trace("T2")["sources"] == ["r0"]
+    assert agg.trace("nope") is None
+    recents = agg.recent(10)
+    assert [r["trace_id"] for r in recents] == ["T2", "T1"]
+
+
+def test_aggregator_chrome_export_distinct_process_lanes():
+    agg = fed.TraceAggregator(capacity=100)
+    agg.add_spans("router", [
+        _span("T1", "s1", "fleet/dispatch", 10.0, 0.5)])
+    agg.add_spans("r0", [
+        _span("T1", "s2", "serving/request", 10.1, 0.3)])
+    ch = agg.chrome("T1")
+    events = ch["traceEvents"]
+    xs = [e for e in events if e.get("ph") == "X"]
+    assert len(xs) == 2
+    assert len({e["pid"] for e in xs}) == 2  # one lane per process
+    meta = [e for e in events if e.get("ph") == "M"
+            and e.get("name") == "process_name"]
+    lanes = {m["args"]["name"] for m in meta}
+    assert lanes == {"process router", "process r0"}
+
+
+def test_aggregator_bounded_capacity():
+    agg = fed.TraceAggregator(capacity=10)
+    agg.add_spans("r0", [
+        _span(f"T{i}", f"s{i}", "n", float(i), 0.1)
+        for i in range(25)])
+    assert len(agg) == 10
+    assert agg.trace("T0") is None     # evicted
+    assert agg.trace("T24") is not None
+
+
+# -- TelemetryCollector against stub HTTP sources -----------------------------
+
+class _StubSource:
+    """A replica-shaped telemetry source: real HTTP server handing
+    out a canned registry snapshot and a cursor-correct span feed."""
+
+    def __init__(self, name):
+        self.name = name
+        self.reg = obs.MetricsRegistry()
+        self.store = tracing.TraceStore(capacity=512)
+        self.scrapes = []
+        src = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                from urllib.parse import parse_qs, urlsplit
+                u = urlsplit(self.path)
+                if u.path == "/metrics/json":
+                    body = {"ts": 0.0,
+                            "metrics": src.reg.snapshot()}
+                else:
+                    since = int(parse_qs(u.query).get(
+                        "since", ["0"])[0])
+                    src.scrapes.append(since)
+                    seq, recs = src.store.records_since(since)
+                    body = {"seq": seq,
+                            "spans": [r.to_dict() for r in recs]}
+                raw = json.dumps(body).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(raw)))
+                self.end_headers()
+                self.wfile.write(raw)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True)
+        self.thread.start()
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def stop(self):
+        self.httpd.shutdown()
+
+    def span(self, tid, sid):
+        self.store.add(tracing.SpanRecord(
+            tid, sid, None, "serving/request", 1.0, 0.1, "t", {}))
+
+
+class _StubRouter:
+    def __init__(self, sources):
+        class P:
+            pass
+        self.pool = P()
+        self.pool.replicas = sources
+
+
+def test_collector_merges_stub_sources_and_advances_cursor():
+    s0, s1 = _StubSource("r0"), _StubSource("r1")
+    try:
+        for s, n in ((s0, 2), (s1, 3)):
+            c = s.reg.counter("zoo_tpu_serving_requests_total",
+                              labels={"path": "/predict",
+                                      "status": "200"})
+            for _ in range(n):
+                c.inc()
+        s0.span("T1", "a")
+        s1.span("T1", "b")
+        col = fed.TelemetryCollector(
+            _StubRouter([s0, s1]), tick_s=0, clock=lambda: 100.0)
+        col.tick(now=100.0)
+        merged, conflicts = col.merged_snapshot()
+        assert conflicts == []
+        # replicas' 5 plus whatever this process recorded itself
+        local = _counter_value(
+            obs.snapshot(), "zoo_tpu_serving_requests_total",
+            path="/predict", status="200")
+        assert _counter_value(
+            merged, "zoo_tpu_serving_requests_total",
+            path="/predict", status="200") == 5 + local
+        # both sources' spans stitched under one id
+        assert col.aggregator.trace("T1")["sources"] == ["r0", "r1"]
+        # second tick: cursors advanced, no re-scrape from zero
+        s0.span("T2", "c")
+        col.tick(now=101.0)
+        assert s0.scrapes[0] == 0 and s0.scrapes[-1] > 0
+        assert col.aggregator.trace("T2")["sources"] == ["r0"]
+        # no duplicate T1 spans from the second scrape
+        assert col.aggregator.trace("T1")["n_spans"] == 2
+        st = col.status()
+        assert st["ticks"] == 2
+        assert st["sources"]["r0"]["ok"] is True
+        text = col.fleet_prometheus()
+        assert text.count(
+            "# TYPE zoo_tpu_serving_requests_total") == 1
+    finally:
+        s0.stop()
+        s1.stop()
+
+
+def test_collector_keeps_last_snapshot_of_dead_source():
+    s0 = _StubSource("r0")
+    s0.reg.counter("zoo_tpu_ingest_records_total").inc()
+    col = fed.TelemetryCollector(
+        _StubRouter([s0]), tick_s=0, clock=lambda: 100.0)
+    col.tick(now=100.0)
+    s0.stop()  # source dies
+    col.tick(now=105.0)
+    merged, _ = col.merged_snapshot()
+    # stale beats absent: the dead source's last snapshot persists
+    assert _counter_value(
+        merged, "zoo_tpu_ingest_records_total") == 1
+    assert col.status()["sources"]["r0"]["ok"] is False
+    scrapes = obs.snapshot()["zoo_tpu_fed_scrapes_total"]["values"]
+    outcomes = {(v["labels"]["replica"], v["labels"]["ok"]):
+                v["value"] for v in scrapes}
+    assert outcomes[("r0", "1")] == 1
+    assert outcomes[("r0", "0")] == 1
+
+
+# -- process vitals -----------------------------------------------------------
+
+def test_process_vitals_gauges():
+    vals = diagnostics.update_process_vitals()
+    assert vals["rss_bytes"] > 1 << 20
+    assert vals["uptime_s"] > 0
+    assert vals["open_fds"] > 0
+    snap = obs.snapshot()
+    for g in ("zoo_tpu_process_rss_bytes",
+              "zoo_tpu_process_uptime_s",
+              "zoo_tpu_process_open_fds"):
+        assert snap[g]["type"] == "gauge"
+        assert snap[g]["values"][0]["value"] > 0
+
+
+# -- replica skew detector ----------------------------------------------------
+
+def test_skew_detector_latency_vs_median_of_others():
+    det = diagnostics.ReplicaSkewDetector(
+        factor=3.0, min_events=4, cooldown_s=60.0)
+    stats = {
+        "r0": {"p99_s": 0.9, "error_ratio": 0.0, "events": 10},
+        "r1": {"p99_s": 0.01, "error_ratio": 0.0, "events": 10},
+        "r2": {"p99_s": 0.012, "error_ratio": 0.0, "events": 10},
+    }
+    fired = det.observe(stats, now=100.0)
+    assert [f["replica"] for f in fired] == ["r0"]
+    assert fired[0]["metric"] == "latency_p99"
+    anomalies = obs.snapshot()["zoo_tpu_anomalies_total"]["values"]
+    kinds = {v["labels"]["kind"]: v["value"] for v in anomalies}
+    assert kinds["replica_skew"] == 1
+    # cooldown mutes the same replica; recovery unmutes it
+    assert det.observe(stats, now=110.0) == []
+    ok = dict(stats, r0={"p99_s": 0.011, "error_ratio": 0.0,
+                         "events": 10})
+    assert det.observe(ok, now=120.0) == []
+    assert [f["replica"] for f in
+            det.observe(stats, now=130.0)] == ["r0"]
+
+
+def test_skew_detector_error_ratio_margin_and_min_events():
+    det = diagnostics.ReplicaSkewDetector(
+        factor=3.0, error_margin=0.25, min_events=4)
+    stats = {
+        "r0": {"p99_s": 0.01, "error_ratio": 0.5, "events": 10},
+        "r1": {"p99_s": 0.01, "error_ratio": 0.0, "events": 10},
+    }
+    fired = det.observe(stats, now=10.0)
+    assert [f["replica"] for f in fired] == ["r0"]
+    assert fired[0]["metric"] == "error_ratio"
+    # below min_events: never fires, however bad the numbers
+    det2 = diagnostics.ReplicaSkewDetector(min_events=4)
+    thin = {
+        "r0": {"p99_s": 9.0, "error_ratio": 1.0, "events": 2},
+        "r1": {"p99_s": 0.01, "error_ratio": 0.0, "events": 2},
+    }
+    assert det2.observe(thin, now=10.0) == []
+
+
+def test_injected_replica_delay_fires_replica_skew():
+    """The acceptance path: a per-replica delay fault at
+    fleet/replica_predict makes r0's router-measured p99 diverge
+    from its sibling; two manual collector ticks (injected clock)
+    fire the replica_skew anomaly. No polling, no wall sleeps —
+    the only latency is the injected fault itself."""
+    faults.arm("fleet/replica_predict", "delay", seconds=0.05,
+               where={"replica": "r0"})
+    router = _fleet(2)
+    try:
+        col = router.telemetry
+        assert col is not None and col.tick_s == 0  # conftest env
+        col.skew = diagnostics.ReplicaSkewDetector(
+            factor=3.0, min_events=2, cooldown_s=60.0)
+        col.tick(now=100.0)  # baseline window
+        x = np.ones((1, 4), np.float32)
+        for _ in range(10):
+            router.predict([x])
+        heard = []
+        diagnostics.add_anomaly_listener(
+            lambda kind, fields: heard.append((kind, fields)))
+        col.tick(now=200.0)
+        assert col.skew.fired >= 1
+        skews = [f for k, f in heard if k == "replica_skew"]
+        assert skews and skews[0]["replica"] == "r0"
+        assert skews[0]["metric"] == "latency_p99"
+        stats = col.status()["replica_stats"]
+        assert stats["r0"]["p99_s"] > 3 * stats["r1"]["p99_s"]
+    finally:
+        router.stop()
+
+
+# -- fed SLO defaults ---------------------------------------------------------
+
+def test_fed_slo_defaults_install():
+    engine = slo_lib.SLOEngine()
+    n = slo_lib.install_defaults(engine, "fed")
+    assert n == len(slo_lib.DEFAULT_FED_SLOS) == 2
+    assert engine.has("fed_latency_p99")
+    assert engine.has("fed_error_ratio")
+    # idempotent
+    assert slo_lib.install_defaults(engine, "fed") == 0
+
+
+def test_fed_summary_gauges_feed_slo_rules():
+    s0 = _StubSource("r0")
+    try:
+        h = s0.reg.histogram("zoo_tpu_serving_request_seconds",
+                             labels={"path": "/predict"})
+        for _ in range(30):
+            h.observe(2.0)  # way past the 0.5s objective
+        s0.reg.counter("zoo_tpu_serving_requests_total",
+                       labels={"path": "/predict",
+                               "status": "200"}).inc(30)
+        col = fed.TelemetryCollector(
+            _StubRouter([s0]), tick_s=0, clock=lambda: 100.0)
+        col.tick(now=100.0)
+        snap = obs.snapshot()
+        p99 = snap["zoo_tpu_fed_latency_p99_seconds"]["values"]
+        assert p99[0]["value"] > 0.5
+        engine = slo_lib.SLOEngine()  # global registry
+        slo_lib.install_defaults(engine, "fed")
+        st = engine.tick(now=100.0)
+        rule = {o["id"]: o for o in
+                st["objectives"]}["fed_latency_p99"]
+        assert rule["state"] == "breach"
+    finally:
+        s0.stop()
+
+
+# -- the real thing: subprocess HttpReplica fleet -----------------------------
+
+_WORKER = r"""
+import json, sys, time
+import numpy as np
+from analytics_zoo_tpu.pipeline.inference.serving import (
+    InferenceServer)
+
+class M:
+    concurrent_slots_free = 8
+    supported_concurrent_num = 8
+    example_input_specs = None
+    generator = None
+    def predict(self, xs, timeout_ms=-1):
+        return [np.asarray(x, dtype=np.float32) * 2 for x in xs]
+
+srv = InferenceServer(M(), port=0, batcher=None)
+srv.start()
+print(json.dumps({"port": srv.port}), flush=True)
+while True:
+    time.sleep(3600)
+"""
+
+
+def _spawn_replica_proc(tmp_path, idx):
+    import os
+    script = tmp_path / f"replica_worker_{idx}.py"
+    script.write_text(_WORKER)
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get(
+        "PYTHONPATH", "")
+    return subprocess.Popen(
+        [sys.executable, str(script)], stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True, env=env)
+
+
+def _proc_port(proc, timeout=120):
+    line = proc.stdout.readline()
+    assert line, "replica worker died before binding"
+    return json.loads(line)["port"]
+
+
+def test_subprocess_fleet_federation_and_stitching(tmp_path):
+    """Acceptance: ≥2 HttpReplica subprocess replicas under
+    concurrent load — the federated /metrics?fleet=1 counter equals
+    the per-replica sums exactly, and one traced request stitches
+    into a single timeline with spans from BOTH the router process
+    and a replica process, on distinct Perfetto lanes."""
+    from analytics_zoo_tpu.pipeline.inference.fleet import (
+        HttpReplica)
+    procs = [_spawn_replica_proc(tmp_path, i) for i in range(2)]
+    router = srv = None
+    try:
+        urls = [f"http://127.0.0.1:{_proc_port(p)}" for p in procs]
+        replicas = [HttpReplica(u, name=f"r{i}")
+                    for i, u in enumerate(urls)]
+        pool = ReplicaPool(replicas=replicas)
+        router = FleetRouter(pool, probe_interval_s=0).start()
+        srv = InferenceServer(router, port=0)
+        srv.start()
+        base = f"http://127.0.0.1:{srv.port}"
+
+        n_clients, per_client = 4, 6
+        errs = []
+
+        def client(ci):
+            x = [[float(ci), 2.0, 3.0, 4.0]]
+            for _ in range(per_client):
+                try:
+                    s, _tid, out = _post(f"{base}/predict",
+                                         {"inputs": x})
+                    assert s == 200
+                    got = np.asarray(out["outputs"],
+                                     dtype=np.float32).ravel()
+                    assert got[0] == 2.0 * ci
+                except Exception as e:  # surface in main thread
+                    errs.append(e)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(n_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert errs == []
+        acked = n_clients * per_client
+
+        # per-replica truth, scraped directly from each process
+        per_replica = []
+        for u in urls:
+            _s, ct, body = _get(f"{u}/metrics/json")
+            assert ct == "application/json"
+            per_replica.append(_counter_value(
+                json.loads(body)["metrics"],
+                "zoo_tpu_serving_requests_total",
+                path="/predict", status="200"))
+        # every acked request was served by exactly one replica
+        assert sum(per_replica) == acked
+        assert all(v > 0 for v in per_replica)  # real spread
+
+        # the federated view: replicas' counters + the router's own
+        s, ct, body = _get(f"{base}/metrics?fleet=1")
+        assert s == 200
+        assert ct == "text/plain; version=0.0.4"
+        merged, _ = router.telemetry.merged_snapshot()
+        fed_val = _counter_value(
+            merged, "zoo_tpu_serving_requests_total",
+            path="/predict", status="200")
+        local = _counter_value(
+            obs.snapshot(), "zoo_tpu_serving_requests_total",
+            path="/predict", status="200")
+        assert fed_val == local + sum(per_replica)
+        # and the text exposition carries the same number
+        import re
+        m = re.search(
+            r'^zoo_tpu_serving_requests_total\{[^}]*'
+            r'path="/predict"[^}]*status="200"[^}]*\} (\d+)',
+            body.decode(), re.M)
+        assert m and float(m.group(1)) == fed_val
+
+        # one traced request → one stitched cross-process timeline
+        s, tid, _out = _post(f"{base}/predict",
+                             {"inputs": [[1.0, 2.0, 3.0, 4.0]]})
+        assert tid
+        s, _ct, body = _get(f"{base}/debug/trace/{tid}")
+        t = json.loads(body)
+        assert t["trace_id"] == tid
+        assert "router" in t["sources"]
+        assert any(src in ("r0", "r1") for src in t["sources"])
+        names = {sp["name"] for sp in t["spans"]}
+        assert "fleet/remote_predict" in names  # router side
+        assert "serving/request" in names       # replica side
+        # Perfetto export: distinct pid per process lane
+        s, _ct, body = _get(f"{base}/debug/trace/{tid}?chrome=1")
+        ch = json.loads(body)
+        xs = [e for e in ch["traceEvents"] if e.get("ph") == "X"]
+        assert len({e["pid"] for e in xs}) >= 2
+    finally:
+        if srv is not None:
+            srv.stop()
+        if router is not None:
+            router.stop()
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+# -- front-end content types --------------------------------------------------
+
+def test_metrics_content_types_single_process_server():
+    srv = InferenceServer(_Model(), port=0, batcher=None)
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        s, ct, body = _get(f"{base}/metrics")
+        assert s == 200
+        assert ct == "text/plain; version=0.0.4"
+        assert b"zoo_tpu_process_rss_bytes" in body
+        s, ct, body = _get(f"{base}/metrics/json")
+        assert s == 200
+        assert ct == "application/json"
+        snap = json.loads(body)["metrics"]
+        assert "zoo_tpu_process_uptime_s" in snap
+        # fleet view without a fleet: 404, structured error
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"{base}/metrics?fleet=1")
+        assert ei.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"{base}/debug/fleet/telemetry")
+        assert ei.value.code == 404
+        # incremental scrape works on any server
+        s, _ct, body = _get(f"{base}/debug/traces?since=0")
+        assert "seq" in json.loads(body)
+    finally:
+        srv.stop()
