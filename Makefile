@@ -92,9 +92,14 @@ generate-smoke:
 
 # continuous batching vs sequential per-request decode on the host
 # CPU backend; writes BENCH_generate.json (its own perf-sentinel
-# lineage — decode tokens/s is never compared against predict rows/s)
+# lineage — decode tokens/s is never compared against predict rows/s).
+# Capacity levers on: chunked prefill (chunk sized to ~one decode
+# iteration's compute on this backend) + speculative decoding, so the
+# artifact carries the TTFT short/long probe and acceptance-rate
+# fields the PR 17 gate reads.
 bench-generate:
-	JAX_PLATFORMS=cpu python bench_generate.py --cpu-fallback
+	JAX_PLATFORMS=cpu python bench_generate.py --cpu-fallback \
+	    --prefill-chunk 64 --spec-k 2
 
 # chaos end-to-end: injected kill/straggler/queue-wedge faults under
 # concurrent load (zero lost acked requests), then a canary rollout

@@ -109,6 +109,37 @@ def _env_float(name: str, default: float) -> float:
         return default
 
 
+# -- expected-compile excusal -------------------------------------------
+# Deliberate compiles — engine warm-up, AOT bucket builds — fire the
+# same jax.monitoring backend_compile events as pathological
+# recompiles, and a GenerationEngine.warm() alone (step + a bucket
+# ladder of prefills, 9 programs) trips the default storm threshold
+# of 5. Callers that KNOW they are compiling bracket the work with
+# :func:`expected_compiles`; jax compiles synchronously on the
+# calling thread, so a thread-local depth cleanly scopes the excusal
+# to exactly those compiles while concurrent traffic on other
+# threads stays monitored.
+_expected = threading.local()
+
+
+class expected_compiles:
+    """Context manager marking compiles on THIS thread as expected:
+    still counted in ``zoo_tpu_xla_compiles_total``, but excluded
+    from the RecompileMonitor storm window. Re-entrant."""
+
+    def __enter__(self):
+        _expected.depth = getattr(_expected, "depth", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        _expected.depth -= 1
+        return False
+
+
+def compiles_expected() -> bool:
+    return getattr(_expected, "depth", 0) > 0
+
+
 class RecompileMonitor:
     """Rolling-window XLA compile-storm detector.
 
@@ -116,7 +147,9 @@ class RecompileMonitor:
     :meth:`install` registers a ``jax.monitoring`` event-duration
     listener that calls it on every ``backend_compile`` event. At
     most one anomaly fires per window, so a storm does not itself
-    become an event storm."""
+    become an event storm. Compiles inside an
+    :func:`expected_compiles` bracket (warm-up/AOT spans) are
+    counted but never storm."""
 
     def __init__(self, threshold: Optional[int] = None,
                  window_s: Optional[float] = None):
@@ -136,9 +169,16 @@ class RecompileMonitor:
     def note(self, now: Optional[float] = None) -> bool:
         """Record one compile at monotonic time ``now`` (defaults to
         the real clock). Returns True when this compile tips the
-        window over the threshold (and fires the anomaly)."""
+        window over the threshold (and fires the anomaly). Expected
+        compiles (see :func:`expected_compiles`) bump the counter but
+        skip the storm window entirely."""
         if now is None:
             now = time.monotonic()
+        if compiles_expected():
+            obs.counter(
+                "zoo_tpu_xla_compiles_total",
+                help="XLA backend_compile events observed").inc()
+            return False
         with self._lock:
             self._times.append(now)
             cutoff = now - self.window_s

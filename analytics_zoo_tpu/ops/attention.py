@@ -75,7 +75,10 @@ def decode_flash_profitable(tk: int) -> bool:
 def decode_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                      seq_lens: jnp.ndarray,
                      scale: Optional[float] = None,
-                     impl: Optional[str] = None) -> jnp.ndarray:
+                     impl: Optional[str] = None,
+                     k_scales: Optional[jnp.ndarray] = None,
+                     v_scales: Optional[jnp.ndarray] = None
+                     ) -> jnp.ndarray:
     """Single-query (decode-mode) attention against a cached context.
 
     The generation-time sibling of :func:`dot_product_attention`,
@@ -84,6 +87,12 @@ def decode_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     dense view from `ops.kv_cache.gather_layer`); ``seq_lens`` (S,)
     int32 masks positions ``>= seq_lens[s]`` (stale pages, pad rows).
     Returns (S, H, D). Softmax in f32 regardless of input dtype.
+
+    Int8 caches pass the gathered views still quantized plus their
+    per-row scales ``k_scales``/``v_scales`` (S, T, H): dequant
+    happens here, at the consumption boundary, so the model layer
+    never touches quantization (the flash path forwards the scales
+    into `flash_decode_attention`, which dequantizes at its gather).
 
     Routing mirrors the training path: "auto" takes the Pallas decode
     kernel (`ops.flash_attention.flash_decode_attention`, which
@@ -106,7 +115,13 @@ def decode_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         key_mask = (jnp.arange(t, dtype=jnp.int32)[None, :] <
                     seq_lens[:, None])
         return fa.flash_decode_attention(q, k, v, key_mask,
-                                         scale=scale)
+                                         scale=scale,
+                                         k_scales=k_scales,
+                                         v_scales=v_scales)
+    if k_scales is not None:
+        from analytics_zoo_tpu.ops import kv_cache as kvc
+        k = kvc.dequantize_rows(k, k_scales, q.dtype)
+        v = kvc.dequantize_rows(v, v_scales, q.dtype)
     # dense: (S, H, 1, T) logits never materialise more than one
     # query row per slot — already cheap at serving contexts
     logits = jnp.einsum("shd,sthd->sht", q, k).astype(jnp.float32)
@@ -116,6 +131,47 @@ def decode_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     logits = jnp.where(valid, logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("sht,sthd->shd", probs, v)
+
+
+def chunk_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                    q_positions: jnp.ndarray,
+                    scale: Optional[float] = None,
+                    k_scales: Optional[jnp.ndarray] = None,
+                    v_scales: Optional[jnp.ndarray] = None
+                    ) -> jnp.ndarray:
+    """Multi-query decode attention for a CHUNK of new tokens per
+    slot — the workhorse of chunked prefill and speculative verify.
+
+    ``q``: (S, C, H, D) — C new tokens per slot at absolute positions
+    ``q_positions`` (S, C); ``k``/``v``: (S, T, H, D) gathered cache
+    views that ALREADY contain the chunk's own rows (callers scatter
+    before gathering, exactly like `decode_step`). The mask
+    ``key_pos <= q_pos`` then yields both intra-chunk causality and
+    validity in one comparison: every cache position at or before a
+    query's own position is a real token of that slot, everything
+    after (stale pages, the chunk's later rows) is invisible. Rows of
+    inactive slots produce garbage that callers drop — with every
+    key masked the f32 softmax degrades to uniform, never NaN.
+
+    Dense XLA only: chunks are small (C ≪ T) and the (S, H, C, T)
+    logits are MXU-shaped already; the single-query Pallas kernel's
+    HBM win does not apply at C > 1 sublane occupancy. Int8 caches
+    pass scales as in :func:`decode_attention`. Returns (S, C, H, D).
+    """
+    d = q.shape[-1]
+    t = k.shape[1]
+    scale = float(scale if scale is not None else 1.0 / (d ** 0.5))
+    if k_scales is not None:
+        from analytics_zoo_tpu.ops import kv_cache as kvc
+        k = kvc.dequantize_rows(k, k_scales, q.dtype)
+        v = kvc.dequantize_rows(v, v_scales, q.dtype)
+    logits = jnp.einsum("schd,sthd->shct", q, k).astype(jnp.float32)
+    logits = logits * scale
+    visible = (jnp.arange(t, dtype=jnp.int32)[None, None, :] <=
+               q_positions[:, :, None])                   # (S, C, T)
+    logits = jnp.where(visible[:, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("shct,sthd->schd", probs, v)
 
 
 def dot_product_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,

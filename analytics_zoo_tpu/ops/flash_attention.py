@@ -596,7 +596,9 @@ def flash_block_partial(q, k, v, qk_offset, causal: bool, scale: float,
 def flash_decode_attention(q: jnp.ndarray, k: jnp.ndarray,
                            v: jnp.ndarray, key_mask: jnp.ndarray,
                            scale: float,
-                           interpret: Optional[bool] = None
+                           interpret: Optional[bool] = None,
+                           k_scales: Optional[jnp.ndarray] = None,
+                           v_scales: Optional[jnp.ndarray] = None
                            ) -> jnp.ndarray:
     """Single-query decode attention over a cached context, as a
     Pallas kernel reusing the flash block machinery.
@@ -604,6 +606,16 @@ def flash_decode_attention(q: jnp.ndarray, k: jnp.ndarray,
     q: (S, H, D) — ONE new token per slot; k, v: (S, T, H, D) — the
     dense page-table gather of the cache; key_mask: (S, T) 0/1
     validity (1 = real cached token). Returns (S, H, D).
+
+    Int8 caches (``ZOO_TPU_KV_DTYPE=int8``) pass the gathered views
+    still quantized plus per-row scales ``k_scales``/``v_scales``
+    (S, T, H): dequant runs here at the kernel's gather boundary, as
+    one fused scale-multiply XLA folds into the transposes feeding
+    VMEM, so the kernel body itself stays dtype-agnostic (int8's
+    (32, 128) native tile would force a different block geometry —
+    see the Pallas guide's quantization pattern; not worth it for a
+    1-query kernel whose win is HBM traffic, already halved by
+    reading int8 pages from HBM).
 
     The query tile is the kernel's only novelty: TPU blocks need a
     sublane dim divisible by 8, so the single query row is replicated
@@ -617,6 +629,10 @@ def flash_decode_attention(q: jnp.ndarray, k: jnp.ndarray,
     """
     global invocations
     invocations += 1
+    if k_scales is not None:
+        from analytics_zoo_tpu.ops import kv_cache as kvc
+        k = kvc.dequantize_rows(k, k_scales, q.dtype)
+        v = kvc.dequantize_rows(v, v_scales, q.dtype)
     s, h, d = q.shape
     t = k.shape[1]
     _, bk = _pick_blocks(t, t, jnp.dtype(q.dtype).itemsize)

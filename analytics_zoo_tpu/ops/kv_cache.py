@@ -20,9 +20,23 @@ Everything device-side here is shape-static and jit-safe:
   dropped, so padded batch slots never corrupt live pages);
 - :func:`write_prompt_layer` — bulk-scatter a whole (right-padded)
   prompt's K/V at prefill (pad rows land in pages past `seq_len` and
-  are never gathered — the length mask owns validity);
+  are never gathered — the length mask owns validity); a per-slot
+  ``start`` offset writes a partial chunk of the prompt instead, the
+  primitive chunked prefill is built on;
 - :func:`gather_layer` / :func:`length_mask` — page-table gather back
   to a dense (S, T, H, D) view + key-validity mask for attention.
+
+Int8 pages (``ZOO_TPU_KV_DTYPE=int8``): the pool stores int8 rows
+plus a per-row-per-head scale array of the same page geometry
+(`(num_layers, max_pages, page_size, heads)` f32 — "per-page scales
+stored alongside the pages"). :func:`quantize_rows` computes the
+symmetric scale `max|x| / 127` over ``head_dim`` at every write
+(append and prompt scatter share the coordinate math, so the scale
+rows land exactly where their K/V rows do), and
+:func:`dequantize_rows` restores values at the gather before
+attention — roughly 2x resident-sequence capacity for a bounded,
+tested accuracy cost (tests/test_generate.py's kv-dtype conformance
+matrix).
 
 The host-side :class:`PageAllocator` is the bookkeeping half: a free
 list of physical page ids for the continuous batcher, which assigns
@@ -47,12 +61,18 @@ class PagedKVCache(NamedTuple):
     ids (logical page j of slot s lives in ``page_table[s, j]``).
     ``seq_lens``: (max_slots,) int32 tokens currently cached per slot
     (0 = free slot; doubles as the active mask).
+    ``k_scales``/``v_scales``: (num_layers, max_pages, page_size,
+    heads) f32 per-row-per-head dequant scales, present only when the
+    pools are int8 (None otherwise — None leaves are empty pytree
+    nodes, so the jit'd programs simply specialize per cache dtype).
     """
 
     k_pages: jnp.ndarray
     v_pages: jnp.ndarray
     page_table: jnp.ndarray
     seq_lens: jnp.ndarray
+    k_scales: "jnp.ndarray | None" = None
+    v_scales: "jnp.ndarray | None" = None
 
     @property
     def page_size(self) -> int:
@@ -65,6 +85,10 @@ class PagedKVCache(NamedTuple):
     @property
     def max_slots(self) -> int:
         return self.page_table.shape[0]
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scales is not None
 
 
 def init_cache(num_layers: int, max_slots: int, max_context: int,
@@ -86,13 +110,44 @@ def init_cache(num_layers: int, max_slots: int, max_context: int,
             f"would alias pages")
     shape = (num_layers, max_pages, page_size, heads, head_dim)
     table = np.arange(max_slots * pages_per_slot, dtype=np.int32)
+    quantized = jnp.dtype(dtype) == jnp.dtype(jnp.int8)
+    scale_shape = (num_layers, max_pages, page_size, heads)
     return PagedKVCache(
         k_pages=jnp.zeros(shape, dtype),
         v_pages=jnp.zeros(shape, dtype),
         page_table=jnp.asarray(
             table.reshape(max_slots, pages_per_slot)),
         seq_lens=jnp.zeros((max_slots,), jnp.int32),
+        k_scales=jnp.zeros(scale_shape, jnp.float32)
+        if quantized else None,
+        v_scales=jnp.zeros(scale_shape, jnp.float32)
+        if quantized else None,
     )
+
+
+# int8 pages: symmetric per-(token, head) quantization over head_dim.
+# 127 (not 128) keeps the grid symmetric so dequant is a plain scale.
+INT8_QMAX = 127.0
+
+
+def quantize_rows(x):
+    """Quantize K/V rows ``(…, heads, head_dim)`` to int8 with one
+    f32 scale per ``(…, heads)`` row: ``scale = max|x| / 127`` over
+    head_dim, ``q = round(x / scale)``. Zero rows get scale 0 and
+    dequantize back to exact zeros."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = amax / INT8_QMAX
+    q = jnp.round(xf / jnp.maximum(scale, 1e-12)[..., None])
+    q = jnp.clip(q, -INT8_QMAX, INT8_QMAX).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_rows(q, scale, dtype=jnp.float32):
+    """Inverse of :func:`quantize_rows`: ``(…, H, D)`` int8 + ``(…,
+    H)`` f32 scales back to ``dtype`` values."""
+    out = q.astype(jnp.float32) * scale.astype(jnp.float32)[..., None]
+    return out.astype(dtype)
 
 
 def _scatter_coords(page_table, seq_lens, positions, page_size,
@@ -114,16 +169,28 @@ def _scatter_coords(page_table, seq_lens, positions, page_size,
     return phys, offset
 
 
+def _quantize_for(pages, x):
+    """Route a write through :func:`quantize_rows` when the pool is
+    int8; (values, scales-or-None) otherwise."""
+    if pages.dtype == jnp.int8:
+        return quantize_rows(x)
+    return x.astype(pages.dtype), None
+
+
 def append_layer(k_pages, v_pages, page_table, seq_lens,
-                 k_new, v_new, active=None):
+                 k_new, v_new, active=None,
+                 k_scales=None, v_scales=None):
     """Scatter one decode step's K/V into one layer's pool.
 
     k_pages/v_pages: (P, page, H, D); k_new/v_new: (S, H, D) — the new
     token of every slot, written at position ``seq_lens[s]``. Slots
     with ``active == False`` (or ``seq_lens == 0`` when active is
     None... callers pass the done-mask) are dropped, not written.
-    Returns the updated (k_pages, v_pages). Shape-static; safe inside
-    scan/while_loop."""
+    Returns the updated (k_pages, v_pages), plus the updated
+    (k_scales, v_scales) when scale pools are passed (int8 pages:
+    values are quantized per row and the scale rows scatter through
+    the SAME coordinates, so drop semantics match exactly).
+    Shape-static; safe inside scan/while_loop."""
     page_size = k_pages.shape[1]
     if active is None:
         active = jnp.ones(seq_lens.shape, jnp.bool_)
@@ -131,27 +198,54 @@ def append_layer(k_pages, v_pages, page_table, seq_lens,
     active = jnp.logical_and(active, seq_lens < max_ctx)
     phys, offset = _scatter_coords(page_table, seq_lens, seq_lens,
                                    page_size, active)
+    k_new, k_s = _quantize_for(k_pages, k_new)
+    v_new, v_s = _quantize_for(v_pages, v_new)
     k_pages = k_pages.at[phys, offset].set(k_new, mode="drop")
     v_pages = v_pages.at[phys, offset].set(v_new, mode="drop")
-    return k_pages, v_pages
+    if k_scales is None:
+        return k_pages, v_pages
+    k_scales = k_scales.at[phys, offset].set(k_s, mode="drop")
+    v_scales = v_scales.at[phys, offset].set(v_s, mode="drop")
+    return k_pages, v_pages, k_scales, v_scales
 
 
 def write_prompt_layer(k_pages, v_pages, page_table, prompt_lens,
-                       k_seq, v_seq):
+                       k_seq, v_seq, start=None,
+                       k_scales=None, v_scales=None):
     """Bulk prefill scatter for one layer: k_seq/v_seq (S, T, H, D)
     hold the (right-padded) prompt K/V; positions past
     ``prompt_lens[s]`` are dropped (never written), so pad tokens
-    cannot leak into pages a later admit might reuse."""
+    cannot leak into pages a later admit might reuse.
+
+    ``start`` (S,) int32 shifts each slot's write window: row j of
+    k_seq lands at position ``start[s] + j`` (still gated by
+    ``position < prompt_lens[s]``, where prompt_lens is the TOTAL
+    length the sequence will have after this chunk). This is the
+    partial-prompt primitive chunked prefill interleaves with decode
+    steps — each chunk is one bounded scatter at its offset, and a
+    slot not being chunk-prefilled passes ``prompt_lens == 0`` and is
+    untouched. Scale pools (int8) behave as in
+    :func:`append_layer`."""
     s, t = k_seq.shape[0], k_seq.shape[1]
     page_size = k_pages.shape[1]
     positions = jnp.broadcast_to(
         jnp.arange(t, dtype=jnp.int32)[None, :], (s, t))
-    active = positions < prompt_lens[:, None]
+    if start is not None:
+        positions = positions + jnp.asarray(start, jnp.int32)[:, None]
+    max_ctx = page_table.shape[1] * page_size
+    active = jnp.logical_and(positions < prompt_lens[:, None],
+                             positions < max_ctx)
     phys, offset = _scatter_coords(page_table, prompt_lens, positions,
                                    page_size, active)
+    k_seq, k_s = _quantize_for(k_pages, k_seq)
+    v_seq, v_s = _quantize_for(v_pages, v_seq)
     k_pages = k_pages.at[phys, offset].set(k_seq, mode="drop")
     v_pages = v_pages.at[phys, offset].set(v_seq, mode="drop")
-    return k_pages, v_pages
+    if k_scales is None:
+        return k_pages, v_pages
+    k_scales = k_scales.at[phys, offset].set(k_s, mode="drop")
+    v_scales = v_scales.at[phys, offset].set(v_s, mode="drop")
+    return k_pages, v_pages, k_scales, v_scales
 
 
 def gather_layer(pages, page_table, t_max: int):

@@ -413,12 +413,19 @@ class TransformerLayer(KerasLayer):
     # Autoregressive generation with a paged KV cache (ops/kv_cache):
     # `prefill` runs the prompt once and caches every block's K/V;
     # `decode_step` extends every slot by ONE token against the cache
-    # (O(T) per token instead of the naive O(T²) re-forward); and
-    # `generate` wires both into a lax.while_loop whose shapes are
-    # static in (slots, pages) — the whole loop compiles once and is
-    # AOT-warmable. Logits are tied to `tok_embed` (h @ tok_embedᵀ),
-    # the weight-tying the reference's LM head uses. Inference-only:
-    # no dropout, no sequence/pipeline parallelism.
+    # (O(T) per token instead of the naive O(T²) re-forward);
+    # `forward_chunk` extends every slot by a BOUNDED chunk of C
+    # tokens at a per-slot offset — the shared primitive under
+    # chunked prefill (C-token slices of a long prompt interleaved
+    # with decode iterations) and speculative verify (score C drafted
+    # tokens in one pass); and `generate` wires prefill + decode_step
+    # into a lax.while_loop whose shapes are static in (slots, pages)
+    # — the whole loop compiles once and is AOT-warmable. Logits are
+    # tied to `tok_embed` (h @ tok_embedᵀ), the weight-tying the
+    # reference's LM head uses. Int8 caches carry per-row scale pools
+    # (`ops.kv_cache`): writes quantize, attention dequantizes at the
+    # gather — this layer only threads the scale arrays through.
+    # Inference-only: no dropout, no sequence/pipeline parallelism.
 
     def init_kv_cache(self, max_slots: int, max_context: int,
                       page_size: int = 16, dtype=None):
@@ -457,19 +464,39 @@ class TransformerLayer(KerasLayer):
 
         final, (k_all, v_all) = jax.lax.scan(block, h0,
                                              params["blocks"])
-        dt = cache.k_pages.dtype
-        write = jax.vmap(kvc.write_prompt_layer,
-                         in_axes=(0, 0, None, None, 0, 0))
-        k_pages, v_pages = write(cache.k_pages, cache.v_pages,
-                                 cache.page_table, prompt_lens,
-                                 k_all.astype(dt), v_all.astype(dt))
+        cache = self._write_prompt_all(cache, k_all, v_all,
+                                       prompt_lens)
         cache = cache._replace(
-            k_pages=k_pages, v_pages=v_pages,
             seq_lens=jnp.where(prompt_lens > 0, prompt_lens,
                                cache.seq_lens))
         last = final[jnp.arange(s), jnp.maximum(prompt_lens - 1, 0)]
         logits = last @ params["tok_embed"].astype(last.dtype).T
         return cache, logits
+
+    def _write_prompt_all(self, cache, k_all, v_all, total_lens,
+                          start=None):
+        """vmap the per-layer prompt scatter over the block stack
+        (k_all/v_all: (L, S, T, nh, hd)); quantized caches thread
+        their scale pools through the same coordinates. Returns the
+        cache with pages (and scales) replaced — ``seq_lens`` is the
+        caller's to update."""
+        from analytics_zoo_tpu.ops import kv_cache as kvc
+        if cache.quantized:
+            write = jax.vmap(
+                lambda kp, vp, ks, vs, k, v: kvc.write_prompt_layer(
+                    kp, vp, cache.page_table, total_lens, k, v,
+                    start=start, k_scales=ks, v_scales=vs))
+            kp, vp, ks, vs = write(cache.k_pages, cache.v_pages,
+                                   cache.k_scales, cache.v_scales,
+                                   k_all, v_all)
+            return cache._replace(k_pages=kp, v_pages=vp,
+                                  k_scales=ks, v_scales=vs)
+        write = jax.vmap(
+            lambda kp, vp, k, v: kvc.write_prompt_layer(
+                kp, vp, cache.page_table, total_lens, k, v,
+                start=start))
+        kp, vp = write(cache.k_pages, cache.v_pages, k_all, v_all)
+        return cache._replace(k_pages=kp, v_pages=vp)
 
     def decode_step(self, params, cache, token_ids, active=None):
         """One decode step for every slot: consume ``token_ids`` (S,)
@@ -496,25 +523,117 @@ class TransformerLayer(KerasLayer):
         lens_after = seq_lens + active.astype(jnp.int32)
 
         def block(x, xs):
-            p, kp, vp = xs
+            p, kp, vp, ks, vs = xs
             q, k_new, v_new = self._split_qkv(p, x)
-            kp, vp = kvc.append_layer(
-                kp, vp, table, seq_lens, k_new.astype(kp.dtype),
-                v_new.astype(vp.dtype), active=active)
-            k_ctx = kvc.gather_layer(kp, table, t_max).astype(x.dtype)
-            v_ctx = kvc.gather_layer(vp, table, t_max).astype(x.dtype)
+            if ks is None:
+                kp, vp = kvc.append_layer(
+                    kp, vp, table, seq_lens, k_new, v_new,
+                    active=active)
+                sk = sv = None
+            else:
+                kp, vp, ks, vs = kvc.append_layer(
+                    kp, vp, table, seq_lens, k_new, v_new,
+                    active=active, k_scales=ks, v_scales=vs)
+                sk = kvc.gather_layer(ks, table, t_max)
+                sv = kvc.gather_layer(vs, table, t_max)
+            k_ctx = kvc.gather_layer(kp, table, t_max)
+            v_ctx = kvc.gather_layer(vp, table, t_max)
+            if ks is None:
+                k_ctx = k_ctx.astype(x.dtype)
+                v_ctx = v_ctx.astype(x.dtype)
             attn = decode_attention(q, k_ctx, v_ctx, lens_after,
-                                    impl=self.attention_impl)
+                                    impl=self.attention_impl,
+                                    k_scales=sk, v_scales=sv)
             attn = attn.reshape(s, self.hidden_size)
-            return self._block_tail(p, x, attn), (kp, vp)
+            return self._block_tail(p, x, attn), (kp, vp, ks, vs)
 
-        final, (k_pages, v_pages) = jax.lax.scan(
+        final, (k_pages, v_pages, k_scales, v_scales) = jax.lax.scan(
             block, x, (params["blocks"], cache.k_pages,
-                       cache.v_pages))
+                       cache.v_pages, cache.k_scales,
+                       cache.v_scales))
         cache = cache._replace(k_pages=k_pages, v_pages=v_pages,
+                               k_scales=k_scales, v_scales=v_scales,
                                seq_lens=lens_after)
         logits = final @ params["tok_embed"].astype(final.dtype).T
         return cache, logits
+
+    def forward_chunk(self, params, cache, token_ids, starts, n_new,
+                      all_logits: bool = False):
+        """Consume a bounded CHUNK of new tokens per slot against the
+        cache — `decode_step` generalized from 1 to C tokens, with a
+        per-slot write offset.
+
+        token_ids: (S, C) int — each slot's next tokens, left-aligned
+        and right-padded; starts: (S,) int32 — the absolute position
+        the chunk begins at (== the slot's current cached length);
+        n_new: (S,) int32 — how many of the C rows are real for each
+        slot (0 = slot untouched: nothing written, seq_lens frozen,
+        and — because inactive scatters drop — neighbours cannot be
+        perturbed). Every block writes the chunk's K/V into the pages
+        FIRST, then attends over the gathered cache with the mask
+        ``key_pos <= start + j`` (`ops.attention.chunk_attention`),
+        so intra-chunk causality and cache validity are one rule and
+        the math is the training graph's.
+
+        Returns ``(cache', logits)``: logits (S, V) at each slot's
+        LAST real chunk position (chunked prefill — sample the first
+        token when the final chunk lands), or (S, C, V) at every
+        chunk position when ``all_logits`` (speculative verify —
+        score every draft). ``seq_lens`` advances to
+        ``starts + n_new`` for touched slots. Shape-static in (S, C);
+        safe to AOT-compile once per chunk width.
+        """
+        from analytics_zoo_tpu.ops import kv_cache as kvc
+        from analytics_zoo_tpu.ops.attention import chunk_attention
+        s, c = token_ids.shape
+        starts = jnp.asarray(starts, jnp.int32)
+        n_new = jnp.asarray(n_new, jnp.int32)
+        total = starts + n_new
+        q_pos = starts[:, None] + jnp.arange(c, dtype=jnp.int32)[None]
+        pos_ids = jnp.clip(q_pos, 0, self.seq_len - 1)
+        x = jnp.take(params["tok_embed"],
+                     token_ids.astype(jnp.int32), axis=0) + \
+            jnp.take(params["pos_embed"], pos_ids, axis=0)
+        t_max = cache.max_context
+        table = cache.page_table
+
+        def block(x, xs):
+            p, kp, vp, ks, vs = xs
+            q, k_new, v_new = self._split_qkv(p, x)
+            if ks is None:
+                kp, vp = kvc.write_prompt_layer(
+                    kp, vp, table, total, k_new, v_new, start=starts)
+                sk = sv = None
+            else:
+                kp, vp, ks, vs = kvc.write_prompt_layer(
+                    kp, vp, table, total, k_new, v_new, start=starts,
+                    k_scales=ks, v_scales=vs)
+                sk = kvc.gather_layer(ks, table, t_max)
+                sv = kvc.gather_layer(vs, table, t_max)
+            k_ctx = kvc.gather_layer(kp, table, t_max)
+            v_ctx = kvc.gather_layer(vp, table, t_max)
+            if ks is None:
+                k_ctx = k_ctx.astype(x.dtype)
+                v_ctx = v_ctx.astype(x.dtype)
+            attn = chunk_attention(q, k_ctx, v_ctx, q_pos,
+                                   k_scales=sk, v_scales=sv)
+            attn = attn.reshape(s, c, self.hidden_size)
+            return self._block_tail(p, x, attn), (kp, vp, ks, vs)
+
+        final, (k_pages, v_pages, k_scales, v_scales) = jax.lax.scan(
+            block, x, (params["blocks"], cache.k_pages,
+                       cache.v_pages, cache.k_scales,
+                       cache.v_scales))
+        cache = cache._replace(
+            k_pages=k_pages, v_pages=v_pages,
+            k_scales=k_scales, v_scales=v_scales,
+            seq_lens=jnp.where(n_new > 0, total, cache.seq_lens))
+        embed_t = params["tok_embed"].astype(final.dtype).T
+        if all_logits:
+            return cache, final @ embed_t
+        last = final[jnp.arange(s),
+                     jnp.clip(n_new - 1, 0, c - 1)]
+        return cache, last @ embed_t
 
     def generate(self, params, prompts, prompt_lens=None,
                  max_new_tokens: int = 32, *, temperature=0.0,

@@ -643,7 +643,7 @@ class _GenEntry:
 
     __slots__ = ("ids", "max_new", "temperature", "eos_id", "future",
                  "t_enq", "t_enq_wall", "trace", "slot", "tokens",
-                 "t_first")
+                 "t_first", "prefilling")
 
     def __init__(self, ids, max_new, temperature, eos_id):
         self.ids = ids
@@ -657,6 +657,7 @@ class _GenEntry:
         self.slot = -1
         self.tokens: "list[int]" = []
         self.t_first = 0.0  # monotonic time of the first token
+        self.prefilling = False  # admitted, prompt not fully cached
 
 
 class ContinuousBatcher:
@@ -874,8 +875,25 @@ class ContinuousBatcher:
             self._depth_gauge().set(len(self._q))
         return take
 
+    def _spec_eligible(self, e: "_GenEntry") -> bool:
+        """Whether a resident slot may take a speculative round. A
+        round consumes a full k-token verify window even when the
+        request only needs one more token, so the window must fit
+        inside the slot's page reservation AND the cache context:
+        consumed rows after the round are ``plen + emitted - 1 + k``
+        and the reservation covers ``min(plen + max_new,
+        max_context)`` rows. Ineligible slots fall back to regular
+        one-token steps in the same iteration."""
+        k = self.engine.spec_k
+        consumed_after = len(e.ids) + len(e.tokens) - 1 + k
+        budget = min(len(e.ids) + e.max_new,
+                     self.engine.max_context)
+        return consumed_after <= budget
+
     def _run(self):
         engine = self.engine
+        chunked = getattr(engine, "prefill_chunk", 0) > 0
+        spec_k = int(getattr(engine, "spec_k", 0))
         while True:
             with self._cond:
                 while not self._q and not self._active \
@@ -888,42 +906,143 @@ class ContinuousBatcher:
             try:
                 now = time.monotonic()
                 done: "list[_GenEntry]" = []
+
+                def chunk_step():
+                    # advance every mid-prefill slot by one chunk
+                    # and emit first tokens for prompts whose final
+                    # chunk just landed
+                    with obs.span(
+                            "decode/prefill_chunk",
+                            n=len(engine.prefilling_slots)):
+                        firsts = engine.prefill_step()
+                    t = time.monotonic()
+                    obs.counter(
+                        "zoo_tpu_serving_gen_prefill_chunks_total",
+                        help="prompt chunks written by chunked "
+                             "prefill").inc()
+                    if firsts:
+                        by_slot = {e.slot: e
+                                   for e in self._active}
+                        for slot, tok in firsts:
+                            e = by_slot[slot]
+                            e.prefilling = False
+                            if self._token_out(e, tok, t):
+                                done.append(e)
+                                self._active.remove(e)
                 if fresh:
-                    with obs.span("decode/admit", n=len(fresh)):
-                        first = engine.admit(
-                            [(e.ids, e.max_new, e.temperature)
-                             for e in fresh])
-                    now = time.monotonic()
-                    for e, (slot, tok) in zip(fresh, first):
-                        e.slot = slot
-                        tracing.record_span(
-                            e.trace, "decode/admit", e.t_enq_wall,
-                            now - e.t_enq, slot=slot,
-                            prompt_len=len(e.ids))
-                        if self._token_out(e, tok, now):
-                            done.append(e)
-                        else:
+                    # chunked admission only pays off past one
+                    # chunk: a prompt that fits in a single chunk
+                    # would run the full-width chunk program padded,
+                    # where the classic bucket-padded prefill runs
+                    # one right-sized call — so short prompts keep
+                    # the direct path even when chunking is on
+                    long_p = [e for e in fresh if chunked
+                              and len(e.ids) > engine.prefill_chunk]
+                    short_p = [e for e in fresh if e not in long_p]
+                    if long_p:
+                        # claim slots + pages only; the prompt is
+                        # written chunk-by-chunk below, interleaved
+                        # with decode steps of resident slots
+                        reqs = [(e.ids, e.max_new, e.temperature)
+                                for e in long_p]
+                        with obs.span("decode/admit",
+                                      n=len(long_p)):
+                            slots = engine.admit_partial(reqs)
+                        now = time.monotonic()
+                        for e, slot in zip(long_p, slots):
+                            e.slot = slot
+                            e.prefilling = True
+                            tracing.record_span(
+                                e.trace, "decode/admit",
+                                e.t_enq_wall, now - e.t_enq,
+                                slot=slot, prompt_len=len(e.ids))
                             self._active.append(e)
-                if self._active:
-                    active = np.zeros((engine.max_slots,), np.bool_)
-                    for e in self._active:
+                        # kickoff: land the fresh prompts' first
+                        # chunk in the iteration that admitted them
+                        # rather than waiting a full loop pass —
+                        # one bounded extra chunk call, mirroring
+                        # how short prompts prefill inline at admit
+                        chunk_step()
+                    if short_p:
+                        reqs = [(e.ids, e.max_new, e.temperature)
+                                for e in short_p]
+                        with obs.span("decode/admit",
+                                      n=len(short_p)):
+                            first = engine.admit(reqs)
+                        now = time.monotonic()
+                        for e, (slot, tok) in zip(short_p, first):
+                            e.slot = slot
+                            tracing.record_span(
+                                e.trace, "decode/admit",
+                                e.t_enq_wall, now - e.t_enq,
+                                slot=slot, prompt_len=len(e.ids))
+                            if self._token_out(e, tok, now):
+                                done.append(e)
+                            else:
+                                self._active.append(e)
+                if chunked and engine.prefilling_slots:
+                    chunk_step()
+                    now = time.monotonic()
+                spec: "list[_GenEntry]" = []
+                regular: "list[_GenEntry]" = []
+                for e in self._active:
+                    if e.prefilling:
+                        continue
+                    if spec_k > 0 and self._spec_eligible(e):
+                        spec.append(e)
+                    else:
+                        regular.append(e)
+                emitted = 0
+                if spec:
+                    active = np.zeros((engine.max_slots,),
+                                      np.bool_)
+                    for e in spec:
+                        active[e.slot] = True
+                    prev_acc = engine.spec_accepted
+                    with obs.span("decode/spec_step",
+                                  n=len(spec)):
+                        out, n_emit = engine.spec_step(active)
+                    now = time.monotonic()
+                    obs.counter(
+                        "zoo_tpu_serving_gen_spec_proposed_total",
+                        help="draft tokens proposed for "
+                             "verification").inc(
+                        spec_k * len(spec))
+                    obs.counter(
+                        "zoo_tpu_serving_gen_spec_accepted_total",
+                        help="draft tokens accepted by the "
+                             "target model").inc(
+                        engine.spec_accepted - prev_acc)
+                    for e in spec:
+                        fin = False
+                        for j in range(int(n_emit[e.slot])):
+                            emitted += 1
+                            if self._token_out(
+                                    e, int(out[e.slot, j]), now):
+                                fin = True
+                                break
+                        if fin:
+                            done.append(e)
+                            self._active.remove(e)
+                if regular:
+                    active = np.zeros((engine.max_slots,),
+                                      np.bool_)
+                    for e in regular:
                         active[e.slot] = True
                     with obs.span("decode/step",
-                                  n=int(active.sum())):
+                                  n=len(regular)):
                         toks = engine.step(active)
                     now = time.monotonic()
-                    still = []
-                    for e in self._active:
+                    for e in regular:
+                        emitted += 1
                         if self._token_out(e, int(toks[e.slot]),
                                            now):
                             done.append(e)
-                        else:
-                            still.append(e)
-                    self._active = still
+                            self._active.remove(e)
+                if spec or regular:
                     obs.counter(
                         "zoo_tpu_serving_gen_tokens_total",
-                        help="tokens generated").inc(
-                        int(active.sum()))
+                        help="tokens generated").inc(emitted)
                     obs.counter(
                         "zoo_tpu_serving_gen_steps_total",
                         help="decode iterations executed").inc()
@@ -933,7 +1052,9 @@ class ContinuousBatcher:
                 # a device/step failure must fail its requests, not
                 # the loop thread; slots are reclaimed so the batch
                 # keeps serving whoever comes next
-                for e in fresh + self._active:
+                failing = {id(e): e
+                           for e in fresh + self._active}
+                for e in failing.values():
                     if e.slot >= 0:
                         engine.release(e.slot)
                     _fail_entry(e, exc)

@@ -23,6 +23,34 @@ this module owns everything a *server* needs around it:
   baseline `InferenceModel.generate` serves and `bench_generate.py`
   A/Bs continuous batching against.
 
+Three capacity levers layer on top (each off by default, all
+compounding — docs/serving.md has the tuning guide):
+
+- **Chunked prefill** (``ZOO_TPU_PREFILL_CHUNK`` = chunk width C,
+  0 = off): :meth:`admit_partial` assigns slots/pages WITHOUT running
+  the prompt; :meth:`prefill_step` then advances every prefilling
+  slot by at most C prompt tokens through ONE compiled chunk program
+  (`TransformerLayer.forward_chunk`), so the batcher can interleave
+  a bounded chunk with every decode iteration — a long prompt never
+  stalls resident sequences for more than one chunk's latency, and
+  TTFT p99 stops depending on the longest co-resident prompt.
+- **Int8 paged KV** (``ZOO_TPU_KV_DTYPE=int8|bf16|f32``): the cache
+  pools quantize per row with per-page scale arrays
+  (`ops/kv_cache.quantize_rows`) — ~2x resident sequences per chip
+  for a bounded accuracy cost (the kv-dtype conformance matrix in
+  tests/test_generate.py states the tolerance).
+- **Speculative decoding** (``ZOO_TPU_SPEC_K`` = draft length k,
+  0 = off; needs a ``drafter`` net registered through
+  `InferenceModel.load_generator`): a small drafter proposes k
+  tokens (one compiled scan, :meth:`_get_draft`), the target scores
+  all k in ONE verify chunk (`forward_chunk(all_logits=True)`), and
+  rejection sampling (`ops/sampling.speculative_accept`) accepts a
+  prefix — distribution-exact for temperature sampling, byte-exact
+  for greedy. Both caches simply rewind ``seq_lens`` on rejection
+  (stale rows past the length are invisible by construction), and
+  the drafter's pages mirror the target's table, so page accounting
+  is unchanged.
+
 The engine is NOT thread-safe by design: exactly one driver — the
 :class:`~analytics_zoo_tpu.pipeline.inference.batching.ContinuousBatcher`
 loop thread, or a caller of :meth:`generate` — may touch it at a time
@@ -33,8 +61,16 @@ uses).
 Configuration (constructor kwargs override the environment):
 ``ZOO_TPU_GEN_SLOTS`` (default 8), ``ZOO_TPU_GEN_MAX_CONTEXT``
 (default: the net's ``seq_len``), ``ZOO_TPU_GEN_PAGE_SIZE`` (16),
-``ZOO_TPU_GEN_TOP_K`` (0 = full softmax). docs/serving.md has the
+``ZOO_TPU_GEN_TOP_K`` (0 = full softmax), ``ZOO_TPU_KV_DTYPE``
+(f32), ``ZOO_TPU_PREFILL_CHUNK`` (0 = whole-prompt prefill),
+``ZOO_TPU_SPEC_K`` (0 = no speculation). docs/serving.md has the
 slot/page sizing guide, docs/perf_flags.md the flag catalog.
+
+Every AOT compile here is *deliberate* (warm-up or first-use of a
+known program), so they are bracketed with
+`diagnostics.expected_compiles()` — the RecompileMonitor keeps its
+total count but excludes them from the storm window (a warm() of
+step + buckets used to fire a spurious ``recompile_storm``).
 """
 
 from __future__ import annotations
@@ -48,12 +84,34 @@ from analytics_zoo_tpu.common import faults
 from analytics_zoo_tpu.common import observability as obs
 from analytics_zoo_tpu.pipeline.inference.batching import bucket_ladder
 
-__all__ = ["GenerationEngine"]
+__all__ = ["GenerationEngine", "resolve_kv_dtype"]
 
 # chaos hook: armed via ZOO_TPU_FAULTS or tests (docs/robustness.md);
 # a "kill" here simulates the device/replica dying mid-decode with
 # resident sequences holding KV pages
 _STEP_FAULT = faults.point("generation/decode_step")
+
+_KV_DTYPES = ("f32", "bf16", "int8")
+
+
+def resolve_kv_dtype(cache_dtype=None):
+    """Resolve the paged-cache storage dtype: an explicit dtype (or
+    its string name) wins, else ``ZOO_TPU_KV_DTYPE`` (default f32 —
+    bit-identical to PR 8; bf16 halves cache HBM, int8 halves it
+    again with per-page scales). Returns a jnp dtype."""
+    import jax.numpy as jnp
+    named = {"f32": jnp.float32, "float32": jnp.float32,
+             "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+             "int8": jnp.int8}
+    if cache_dtype is None:
+        cache_dtype = os.environ.get("ZOO_TPU_KV_DTYPE", "f32")
+    if isinstance(cache_dtype, str):
+        if cache_dtype not in named:
+            raise ValueError(
+                f"ZOO_TPU_KV_DTYPE {cache_dtype!r} not one of "
+                f"{_KV_DTYPES}")
+        return named[cache_dtype]
+    return cache_dtype
 
 
 class GenerationEngine:
@@ -61,9 +119,11 @@ class GenerationEngine:
     net (module docstring has the design).
 
     ``net`` must expose the decode surface the transformer layer
-    defines: ``init_kv_cache / prefill / decode_step / generate`` and
-    a ``seq_len`` attribute (duck-typed — any net with those methods
-    serves).
+    defines: ``init_kv_cache / prefill / decode_step / forward_chunk
+    / generate`` and ``seq_len`` / ``vocab`` attributes (duck-typed —
+    any net with those methods serves). A ``drafter`` (same surface,
+    same vocab, typically far fewer blocks) plus ``spec_k > 0`` turns
+    on speculative decoding.
     """
 
     def __init__(self, net, params, *,
@@ -72,6 +132,9 @@ class GenerationEngine:
                  page_size: Optional[int] = None,
                  top_k: Optional[int] = None,
                  cache_dtype=None,
+                 prefill_chunk: Optional[int] = None,
+                 spec_k: Optional[int] = None,
+                 drafter=None, drafter_params=None,
                  rng_seed: int = 0):
         import jax
 
@@ -85,6 +148,10 @@ class GenerationEngine:
             page_size = int(env.get("ZOO_TPU_GEN_PAGE_SIZE", 16))
         if top_k is None:
             top_k = int(env.get("ZOO_TPU_GEN_TOP_K", 0))
+        if prefill_chunk is None:
+            prefill_chunk = int(env.get("ZOO_TPU_PREFILL_CHUNK", 0))
+        if spec_k is None:
+            spec_k = int(env.get("ZOO_TPU_SPEC_K", 0))
         if max_context > net.seq_len:
             raise ValueError(
                 f"max_context {max_context} exceeds the net's "
@@ -94,12 +161,22 @@ class GenerationEngine:
         self.max_slots = int(max_slots)
         self.page_size = int(page_size)
         self.top_k = int(top_k)
-        self.cache_dtype = cache_dtype
+        self.cache_dtype = resolve_kv_dtype(cache_dtype)
+        self.prefill_chunk = max(0, int(prefill_chunk))
+        self.spec_k = max(0, int(spec_k))
+        self.drafter = drafter
+        self.drafter_params = drafter_params
+        if self.spec_k > 0 and drafter is None:
+            raise ValueError(
+                "spec_k > 0 needs a drafter net (load_generator"
+                "(..., drafter=..., drafter_params=...))")
+        if self.spec_k > 1_000:
+            raise ValueError(f"spec_k {self.spec_k} is absurd")
 
         from analytics_zoo_tpu.ops import kv_cache as kvc
         cache = net.init_kv_cache(self.max_slots, int(max_context),
                                   page_size=self.page_size,
-                                  dtype=cache_dtype)
+                                  dtype=self.cache_dtype)
         self.max_context = cache.max_context  # whole-page rounded
         self.pages_per_slot = cache.page_table.shape[1]
         # the engine owns page placement: blank the identity table and
@@ -112,11 +189,42 @@ class GenerationEngine:
         self._slot_pages: "dict[int, list]" = {}
         self.free_slots = set(range(self.max_slots))
 
+        # drafter state: its own (smaller) page pool, but the SAME
+        # slot/page geometry and the SAME table — the target's page
+        # accounting covers both, and seq_lens stay in lockstep
+        # because draft/verify rewind them together
+        self._draft_cache = None
+        if drafter is not None and self.spec_k > 0:
+            if int(drafter.vocab) != int(net.vocab):
+                raise ValueError(
+                    f"drafter vocab {drafter.vocab} != target vocab "
+                    f"{net.vocab}")
+            if self.max_context > drafter.seq_len:
+                raise ValueError(
+                    f"max_context {self.max_context} exceeds the "
+                    f"drafter's position table ({drafter.seq_len})")
+            dcache = drafter.init_kv_cache(
+                self.max_slots, int(max_context),
+                page_size=self.page_size, dtype=self.cache_dtype)
+            # own device copy of the table — the compiled programs
+            # donate whole cache pytrees, and a buffer shared with
+            # the target cache would be deleted out from under it
+            self._draft_cache = dcache._replace(
+                page_table=jax.numpy.array(self._table))
+
         # per-slot sampling state (traced per call — no recompiles)
         self._temps = np.zeros((self.max_slots,), np.float32)
         self._last_tok = np.zeros((self.max_slots,), np.int32)
         self._rng = jax.random.key(int(rng_seed))
         self._step_id = 0
+
+        # chunked-prefill scheduler state: slot -> [ids, next_offset]
+        # (prompts admitted but not yet fully written to the cache)
+        self._pending_prompts: "dict[int, list]" = {}
+
+        # speculative acceptance accounting (bench + /health)
+        self.spec_proposed = 0
+        self.spec_accepted = 0
 
         # prompt-length buckets: the PR 4 ladder, capped at what the
         # position table and the cache can hold
@@ -125,6 +233,11 @@ class GenerationEngine:
 
         self._compiled_step = None
         self._compiled_prefill: dict = {}
+        self._compiled_chunk = None
+        self._compiled_draft_prefill: dict = {}
+        self._compiled_draft_chunk = None
+        self._compiled_draft = None
+        self._compiled_verify = None
         self._gen_jits: dict = {}
 
     # -- compiled programs --------------------------------------------------
@@ -156,65 +269,273 @@ class GenerationEngine:
             if not hasattr(a, "aval") else
             jax.ShapeDtypeStruct(a.shape, a.dtype), tree)
 
+    def _chunk_fn(self, cache, params, ids, starts, n_new, temps,
+                  rng, step):
+        import jax
+        from analytics_zoo_tpu.ops.sampling import sample_tokens
+        cache, logits = self.net.forward_chunk(params, cache, ids,
+                                               starts, n_new)
+        nxt = sample_tokens(jax.random.fold_in(rng, step),
+                            logits.astype(jax.numpy.float32), temps,
+                            self.top_k)
+        return cache, nxt
+
+    def _draft_prefill_fn(self, dcache, dparams, ids, plens):
+        dcache, _ = self.drafter.prefill(dparams, dcache, ids, plens)
+        return dcache
+
+    def _draft_chunk_fn(self, dcache, dparams, ids, starts, n_new):
+        dcache, _ = self.drafter.forward_chunk(dparams, dcache, ids,
+                                               starts, n_new)
+        return dcache
+
+    def _draft_fn(self, dcache, dparams, t0, active, temps, rng,
+                  step):
+        """Propose ``spec_k`` draft tokens per active slot: a scan of
+        drafter decode steps, each sampling with the slot's OWN
+        temperature/top_k so the proposal distribution q (returned
+        per step, (S, K, V)) is exactly what `speculative_accept`
+        needs. Consumes [t0, d1, …, d_{k-1}]; proposes [d1, …, dk]."""
+        import jax
+        from analytics_zoo_tpu.ops.sampling import (sample_tokens,
+                                                    sampling_probs)
+        base = jax.random.fold_in(rng, step)
+
+        def body(carry, i):
+            dcache, tok = carry
+            dcache, logits = self.drafter.decode_step(
+                dparams, dcache, tok, active=active)
+            logits = logits.astype(jax.numpy.float32)
+            nxt = sample_tokens(jax.random.fold_in(base, i), logits,
+                                temps, self.top_k)
+            q = sampling_probs(logits, temps, self.top_k)
+            return (dcache, nxt), (nxt, q)
+
+        (dcache, _), (drafts, qs) = jax.lax.scan(
+            body, (dcache, t0),
+            jax.numpy.arange(self.spec_k, dtype=jax.numpy.int32))
+        return (dcache, jax.numpy.transpose(drafts, (1, 0)),
+                jax.numpy.transpose(qs, (1, 0, 2)))
+
+    def _verify_fn(self, cache, dcache, params, t0, drafts, qprobs,
+                   active, temps, rng, step):
+        """One compiled speculative verify: score the k drafts with
+        the target in a single `forward_chunk(all_logits=True)` pass,
+        run rejection sampling, and rewind BOTH caches' seq_lens to
+        the accepted length. The chunk consumes [t0, d1, …, d_{k-1}]
+        — exactly the tokens the drafter consumed — so target and
+        drafter caches stay row-for-row in lockstep with no resync
+        pass, and a full acceptance leaves ``dk`` as the pending
+        token. Returns (cache, dcache, out_tokens (S, K), n_accept,
+        n_emit, next_tok)."""
+        import jax
+        import jax.numpy as jnp
+        from analytics_zoo_tpu.ops.sampling import (sampling_probs,
+                                                    speculative_accept)
+        k = self.spec_k
+        toks = jnp.concatenate([t0[:, None], drafts[:, :k - 1]],
+                               axis=1)
+        starts = cache.seq_lens
+        n_new = jnp.where(active, k, 0).astype(jnp.int32)
+        cache, all_logits = self.net.forward_chunk(
+            params, cache, toks, starts, n_new, all_logits=True)
+        p = sampling_probs(all_logits.astype(jnp.float32),
+                           jnp.broadcast_to(temps[:, None],
+                                            drafts.shape),
+                           self.top_k)
+        n_acc, corrected = speculative_accept(
+            jax.random.fold_in(rng, step), p, qprobs, drafts)
+        # emitted: the accepted prefix, then (on any rejection) the
+        # corrected token; a full acceptance emits all k drafts and
+        # keeps dk pending — in both cases the caches hold exactly
+        # the consumed tokens, so the rewind is one where()
+        n_emit = jnp.minimum(n_acc + 1, k)
+        idx = jnp.arange(k, dtype=jnp.int32)[None, :]
+        out = jnp.where(idx < n_acc[:, None], drafts,
+                        corrected[:, None])
+        nxt = jnp.where(n_acc == k, drafts[:, -1], corrected)
+        new_len = starts + jnp.where(active, n_emit, 0)
+        cache = cache._replace(
+            seq_lens=jnp.where(active, new_len, cache.seq_lens))
+        dcache = dcache._replace(
+            seq_lens=jnp.where(active, new_len, dcache.seq_lens))
+        return cache, dcache, out, n_acc, n_emit, nxt
+
+    def _compile(self, fn, structs, program, bucket=None,
+                 donate=(0,)):
+        """AOT-compile one engine program inside an
+        `expected_compiles` bracket (deliberate warm/first-use
+        compiles must not count toward the RecompileMonitor's storm
+        window) + the usual span/counter."""
+        import jax
+        from analytics_zoo_tpu.common.diagnostics import \
+            expected_compiles
+        kw = {} if bucket is None else {"bucket": bucket}
+        with expected_compiles(), \
+                obs.span("decode/compile", program=program, **kw):
+            compiled = jax.jit(
+                fn, donate_argnums=donate).lower(*structs).compile()
+        obs.counter(
+            "zoo_tpu_serving_gen_compiles_total",
+            help="generation programs compiled (warm-up only in "
+            "steady state)", labels={"program": program}).inc()
+        return compiled
+
+    def _shape(self, *dims, dtype=np.int32):
+        import jax
+        return jax.ShapeDtypeStruct(tuple(dims), dtype)
+
     def _get_step(self):
         if self._compiled_step is None:
-            import jax
             s = self.max_slots
             structs = (
                 self._abstract(self.cache),
                 self._abstract(self.params),
-                jax.ShapeDtypeStruct((s,), np.int32),
-                jax.ShapeDtypeStruct((s,), np.bool_),
-                jax.ShapeDtypeStruct((s,), np.float32),
+                self._shape(s),
+                self._shape(s, dtype=np.bool_),
+                self._shape(s, dtype=np.float32),
                 self._abstract(self._rng),
-                jax.ShapeDtypeStruct((), np.int32),
+                self._shape(),
             )
-            with obs.span("decode/compile", program="step"):
-                self._compiled_step = jax.jit(
-                    self._step_fn,
-                    donate_argnums=(0,)).lower(*structs).compile()
-            obs.counter(
-                "zoo_tpu_serving_gen_compiles_total",
-                help="generation programs compiled (warm-up only in "
-                "steady state)", labels={"program": "step"}).inc()
+            self._compiled_step = self._compile(
+                self._step_fn, structs, "step")
         return self._compiled_step
 
     def _get_prefill(self, tp: int):
         fn = self._compiled_prefill.get(tp)
         if fn is None:
-            import jax
             s = self.max_slots
             structs = (
                 self._abstract(self.cache),
                 self._abstract(self.params),
-                jax.ShapeDtypeStruct((s, tp), np.int32),
-                jax.ShapeDtypeStruct((s,), np.int32),
-                jax.ShapeDtypeStruct((s,), np.float32),
+                self._shape(s, tp),
+                self._shape(s),
+                self._shape(s, dtype=np.float32),
                 self._abstract(self._rng),
-                jax.ShapeDtypeStruct((), np.int32),
+                self._shape(),
             )
-            with obs.span("decode/compile", program="prefill",
-                          bucket=tp):
-                fn = jax.jit(
-                    self._prefill_fn,
-                    donate_argnums=(0,)).lower(*structs).compile()
-            obs.counter(
-                "zoo_tpu_serving_gen_compiles_total",
-                help="generation programs compiled (warm-up only in "
-                "steady state)", labels={"program": "prefill"}).inc()
+            fn = self._compile(self._prefill_fn, structs, "prefill",
+                               bucket=tp)
             self._compiled_prefill[tp] = fn
         return fn
 
+    def _get_chunk(self):
+        if self._compiled_chunk is None:
+            s, c = self.max_slots, self.prefill_chunk
+            structs = (
+                self._abstract(self.cache),
+                self._abstract(self.params),
+                self._shape(s, c),
+                self._shape(s),
+                self._shape(s),
+                self._shape(s, dtype=np.float32),
+                self._abstract(self._rng),
+                self._shape(),
+            )
+            self._compiled_chunk = self._compile(
+                self._chunk_fn, structs, "chunk")
+        return self._compiled_chunk
+
+    def _get_draft_prefill(self, tp: int):
+        fn = self._compiled_draft_prefill.get(tp)
+        if fn is None:
+            s = self.max_slots
+            structs = (
+                self._abstract(self._draft_cache),
+                self._abstract(self.drafter_params),
+                self._shape(s, tp),
+                self._shape(s),
+            )
+            fn = self._compile(self._draft_prefill_fn, structs,
+                               "draft_prefill", bucket=tp)
+            self._compiled_draft_prefill[tp] = fn
+        return fn
+
+    def _get_draft_chunk(self):
+        if self._compiled_draft_chunk is None:
+            s, c = self.max_slots, self.prefill_chunk
+            structs = (
+                self._abstract(self._draft_cache),
+                self._abstract(self.drafter_params),
+                self._shape(s, c),
+                self._shape(s),
+                self._shape(s),
+            )
+            self._compiled_draft_chunk = self._compile(
+                self._draft_chunk_fn, structs, "draft_chunk")
+        return self._compiled_draft_chunk
+
+    def _get_draft(self):
+        if self._compiled_draft is None:
+            s = self.max_slots
+            structs = (
+                self._abstract(self._draft_cache),
+                self._abstract(self.drafter_params),
+                self._shape(s),
+                self._shape(s, dtype=np.bool_),
+                self._shape(s, dtype=np.float32),
+                self._abstract(self._rng),
+                self._shape(),
+            )
+            self._compiled_draft = self._compile(
+                self._draft_fn, structs, "draft")
+        return self._compiled_draft
+
+    def _get_verify(self):
+        if self._compiled_verify is None:
+            s, k = self.max_slots, self.spec_k
+            v = int(self.net.vocab)
+            structs = (
+                self._abstract(self.cache),
+                self._abstract(self._draft_cache),
+                self._abstract(self.params),
+                self._shape(s),
+                self._shape(s, k),
+                self._shape(s, k, v, dtype=np.float32),
+                self._shape(s, dtype=np.bool_),
+                self._shape(s, dtype=np.float32),
+                self._abstract(self._rng),
+                self._shape(),
+            )
+            self._compiled_verify = self._compile(
+                self._verify_fn, structs, "verify", donate=(0, 1))
+        return self._compiled_verify
+
+    def _warmed(self) -> int:
+        return (bool(self._compiled_step)
+                + len(self._compiled_prefill)
+                + bool(self._compiled_chunk)
+                + len(self._compiled_draft_prefill)
+                + bool(self._compiled_draft_chunk)
+                + bool(self._compiled_draft)
+                + bool(self._compiled_verify))
+
     def warm(self) -> int:
-        """AOT-compile the decode step and every prompt bucket's
-        prefill up front, so the serving loop never compiles under
-        traffic (the DynamicBatcher bucket-warm discipline). Returns
-        the number of programs compiled this call. Idempotent."""
-        n0 = len(self._compiled_prefill) + bool(self._compiled_step)
+        """AOT-compile every program steady-state serving can need —
+        the decode step, every prompt bucket's prefill (plus the
+        drafter's, under speculation), the chunk programs (under
+        chunked prefill), and the draft/verify pair — so the serving
+        loop never compiles under traffic (the DynamicBatcher
+        bucket-warm discipline). Returns the number of programs
+        compiled this call. Idempotent."""
+        n0 = self._warmed()
         self._get_step()
         for tp in self.prompt_buckets:
             self._get_prefill(tp)
-        return (len(self._compiled_prefill) + 1) - n0
+        if self.prefill_chunk > 0:
+            self._get_chunk()
+        if self.spec_k > 0 and self.drafter is not None:
+            self._get_draft()
+            self._get_verify()
+            if self.prefill_chunk > 0:
+                self._get_draft_chunk()
+            # prompts that fit in one chunk admit through the
+            # bucket-padded path even when chunking is on (the
+            # batcher routes them directly), so the drafter's
+            # prefill buckets are steady-state programs regardless
+            for tp in self.prompt_buckets:
+                self._get_draft_prefill(tp)
+        return self._warmed() - n0
 
     # -- admission / stepping / retirement ----------------------------------
     def pages_for(self, prompt_len: int, max_new: int) -> int:
@@ -258,33 +579,140 @@ class GenerationEngine:
         plens = np.zeros((self.max_slots,), np.int32)
         admitted = []
         for prompt_ids, max_new, temperature in requests:
+            slot = self._claim_slot(prompt_ids, max_new, temperature)
             n = len(prompt_ids)
-            need = PageAllocator.pages_needed(
-                min(n + int(max_new), self.max_context),
-                self.page_size)
-            if not self.free_slots:
-                raise MemoryError("no free decode slot")
-            pages = self.allocator.alloc(need)  # MemoryError if short
-            slot = min(self.free_slots)
-            self.free_slots.discard(slot)
-            self._slot_pages[slot] = pages
-            row = np.full((self.pages_per_slot,), pages[-1], np.int32)
-            row[:need] = pages
-            self._table[slot] = row
             ids_arr[slot, :n] = np.asarray(prompt_ids, np.int32)
             plens[slot] = n
-            self._temps[slot] = float(temperature)
             admitted.append(slot)
-        self.cache = self.cache._replace(
-            page_table=jax.numpy.asarray(self._table))
+        self._push_table()
         fn = self._get_prefill(tp)
         self.cache, toks = fn(self.cache, self.params, ids_arr,
                               plens, self._temps, self._rng,
                               np.int32(self._step_id))
         self._step_id += 1
+        if self._draft_cache is not None:
+            dfn = self._get_draft_prefill(tp)
+            self._draft_cache = dfn(self._draft_cache,
+                                    self.drafter_params, ids_arr,
+                                    plens)
         toks = np.asarray(toks)
         out = []
         for slot in admitted:
+            self._last_tok[slot] = toks[slot]
+            out.append((slot, int(toks[slot])))
+        return out
+
+    def _claim_slot(self, prompt_ids, max_new, temperature) -> int:
+        """Allocate pages + a slot + its table row for one request
+        (shared by whole-prompt and chunked admission)."""
+        from analytics_zoo_tpu.ops.kv_cache import PageAllocator
+        n = len(prompt_ids)
+        need = PageAllocator.pages_needed(
+            min(n + int(max_new), self.max_context), self.page_size)
+        if not self.free_slots:
+            raise MemoryError("no free decode slot")
+        pages = self.allocator.alloc(need)  # MemoryError if short
+        slot = min(self.free_slots)
+        self.free_slots.discard(slot)
+        self._slot_pages[slot] = pages
+        row = np.full((self.pages_per_slot,), pages[-1], np.int32)
+        row[:need] = pages
+        self._table[slot] = row
+        self._temps[slot] = float(temperature)
+        return slot
+
+    def _push_table(self):
+        """Publish the host table to BOTH device caches (the drafter
+        mirrors the target's page placement by construction). Each
+        cache gets its OWN device copy: the compiled programs donate
+        whole cache pytrees, and a buffer shared across the two would
+        be deleted under the survivor's feet."""
+        import jax
+        self.cache = self.cache._replace(
+            page_table=jax.numpy.array(self._table))
+        if self._draft_cache is not None:
+            self._draft_cache = self._draft_cache._replace(
+                page_table=jax.numpy.array(self._table))
+
+    # -- chunked prefill ----------------------------------------------------
+    def admit_partial(self, requests: "Sequence[tuple]"
+                      ) -> "list[int]":
+        """Chunked admission: assign each request a slot, pages and a
+        table row — but run NO forward pass. The prompt is parked in
+        the chunk scheduler and :meth:`prefill_step` feeds it to the
+        cache ``prefill_chunk`` tokens at a time, interleaved with
+        decode iterations by the batcher. Returns the slots (first
+        tokens arrive from the prefill_step that lands each prompt's
+        final chunk). Same gating contract as :meth:`admit`."""
+        if self.prefill_chunk <= 0:
+            raise ValueError("admit_partial needs prefill_chunk > 0")
+        for prompt_ids, _, _ in requests:
+            if not 1 <= len(prompt_ids) <= self.max_context - 1:
+                raise ValueError(
+                    f"prompt length {len(prompt_ids)} outside [1, "
+                    f"{self.max_context - 1}]")
+        slots = []
+        for prompt_ids, max_new, temperature in requests:
+            slot = self._claim_slot(prompt_ids, max_new, temperature)
+            self._pending_prompts[slot] = [
+                np.asarray(prompt_ids, np.int32), 0]
+            slots.append(slot)
+        if slots:
+            self._push_table()
+        return slots
+
+    @property
+    def prefilling_slots(self) -> "set[int]":
+        """Slots admitted via :meth:`admit_partial` whose prompts are
+        not yet fully cached (must NOT take decode steps)."""
+        return set(self._pending_prompts)
+
+    def cancel_prefill(self, slot: int):
+        """Drop a mid-prefill slot (drain/cancel): forget its pending
+        prompt; the caller releases pages via :meth:`release` as
+        usual. Rows its finished chunks wrote are dead — seq_lens
+        stops advancing and a future occupant overwrites them."""
+        self._pending_prompts.pop(slot, None)
+
+    def prefill_step(self) -> "list[tuple]":
+        """Advance every prefilling slot by ONE chunk (at most
+        ``prefill_chunk`` prompt tokens) through the compiled chunk
+        program. Slots whose final chunk just landed sample their
+        first token: returns ``[(slot, first_token), ...]`` for
+        exactly those. No-op ([]) when nothing is prefilling."""
+        if not self._pending_prompts:
+            return []
+        c = self.prefill_chunk
+        ids_arr = np.zeros((self.max_slots, c), np.int32)
+        starts = np.zeros((self.max_slots,), np.int32)
+        n_new = np.zeros((self.max_slots,), np.int32)
+        finishing = []
+        for slot, st in self._pending_prompts.items():
+            ids, off = st
+            n = min(c, len(ids) - off)
+            ids_arr[slot, :n] = ids[off:off + n]
+            starts[slot] = off
+            n_new[slot] = n
+            if off + n >= len(ids):
+                finishing.append(slot)
+        fn = self._get_chunk()
+        self.cache, toks = fn(self.cache, self.params, ids_arr,
+                              starts, n_new, self._temps, self._rng,
+                              np.int32(self._step_id))
+        self._step_id += 1
+        if self._draft_cache is not None:
+            dfn = self._get_draft_chunk()
+            self._draft_cache = dfn(self._draft_cache,
+                                    self.drafter_params, ids_arr,
+                                    starts, n_new)
+        toks = np.asarray(toks)
+        out = []
+        for slot in list(self._pending_prompts):
+            if slot in finishing:
+                del self._pending_prompts[slot]
+            else:
+                self._pending_prompts[slot][1] += int(n_new[slot])
+        for slot in finishing:
             self._last_tok[slot] = toks[slot]
             out.append((slot, int(toks[slot])))
         return out
@@ -307,11 +735,46 @@ class GenerationEngine:
                                   ).astype(np.int32)
         return toks
 
+    def spec_step(self, active: np.ndarray):
+        """One speculative round over the active slots: draft
+        ``spec_k`` tokens with the drafter (one compiled scan), then
+        verify them against the target in one compiled chunk pass
+        with rejection sampling. Returns ``(out_tokens (S, K),
+        n_emit (S,))`` — slot s emitted ``out_tokens[s, :n_emit[s]]``
+        this round (1..K tokens; inactive slots emit 0). Callers must
+        only include slots whose remaining token budget AND context
+        window can absorb K tokens (the batcher gates this)."""
+        _STEP_FAULT.fire()
+        active = np.asarray(active, np.bool_)
+        dfn, vfn = self._get_draft(), self._get_verify()
+        self._draft_cache, drafts, qprobs = dfn(
+            self._draft_cache, self.drafter_params, self._last_tok,
+            active, self._temps, self._rng, np.int32(self._step_id))
+        self._step_id += 1
+        (self.cache, self._draft_cache, out, n_acc, n_emit,
+         nxt) = vfn(self.cache, self._draft_cache, self.params,
+                    self._last_tok, drafts, qprobs, active,
+                    self._temps, self._rng, np.int32(self._step_id))
+        self._step_id += 1
+        out, nxt = np.asarray(out), np.asarray(nxt)
+        n_emit = np.where(active, np.asarray(n_emit), 0)
+        self._last_tok = np.where(active, nxt, self._last_tok
+                                  ).astype(np.int32)
+        n_active = int(active.sum())
+        self.spec_proposed += self.spec_k * n_active
+        self.spec_accepted += int(
+            np.asarray(n_acc)[active].sum()) if n_active else 0
+        return out, n_emit
+
     def release(self, slot: int):
         """Retire a slot: reclaim its pages and return it to the free
         pool. The cache rows need no reset — a future `prefill` with
         ``prompt_lens > 0`` overwrites ``seq_lens``, and until then
-        the ``active`` mask keeps the slot frozen."""
+        the ``active`` mask keeps the slot frozen. A slot still
+        mid-chunked-prefill is cancelled (its pending prompt
+        dropped), so cancel/drain leaks neither pages nor scheduler
+        state."""
+        self._pending_prompts.pop(slot, None)
         pages = self._slot_pages.pop(slot, None)
         if pages:
             self.allocator.free(pages)
@@ -371,7 +834,7 @@ class GenerationEngine:
 
     def stats(self) -> dict:
         """JSON-able summary for ``GET /health``."""
-        return {
+        out = {
             "max_slots": self.max_slots,
             "slots_active": self.slots_active,
             "max_context": self.max_context,
@@ -379,9 +842,18 @@ class GenerationEngine:
             "free_pages": self.free_pages,
             "total_pages": self.allocator.max_pages,
             "prompt_buckets": list(self.prompt_buckets),
-            "warmed_programs": (len(self._compiled_prefill)
-                                + bool(self._compiled_step)),
+            "warmed_programs": self._warmed(),
+            "kv_dtype": np.dtype(self.cache.k_pages.dtype).name,
+            "prefill_chunk": self.prefill_chunk,
+            "spec_k": self.spec_k,
         }
+        if self.spec_k > 0:
+            out["spec_proposed"] = self.spec_proposed
+            out["spec_accepted"] = self.spec_accepted
+            out["spec_accept_rate"] = (
+                self.spec_accepted / self.spec_proposed
+                if self.spec_proposed else None)
+        return out
 
     def __repr__(self):
         return (f"GenerationEngine(slots={self.max_slots}, "

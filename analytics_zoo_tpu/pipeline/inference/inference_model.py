@@ -485,15 +485,28 @@ class InferenceModel:
         buckets. ``engine_kwargs`` forward to
         :class:`~analytics_zoo_tpu.pipeline.inference.generation.
         GenerationEngine` (``max_slots``, ``max_context``,
-        ``page_size``, ``top_k``, ``cache_dtype`` — env-defaulted,
-        docs/perf_flags.md)."""
+        ``page_size``, ``top_k``, ``cache_dtype``,
+        ``prefill_chunk``, ``spec_k`` — env-defaulted,
+        docs/perf_flags.md). For speculative decoding pass
+        ``drafter=`` (a smaller net sharing the vocabulary);
+        ``drafter_params`` defaults to the drafter's own estimator
+        params the same way ``params`` defaults to ``net``'s."""
         from analytics_zoo_tpu.pipeline.inference.generation import \
             GenerationEngine
-        if params is None:
-            est = net.estimator
+
+        def _params_of(n, explicit):
+            if explicit is not None:
+                return explicit
+            est = n.estimator
             if est.params is None:
                 est._ensure_initialized()
-            params = est.params
+            return est.params
+
+        params = _params_of(net, params)
+        drafter = engine_kwargs.get("drafter")
+        if drafter is not None:
+            engine_kwargs["drafter_params"] = _params_of(
+                drafter, engine_kwargs.get("drafter_params"))
         self._generator = GenerationEngine(net, params,
                                            **engine_kwargs)
         return self

@@ -195,8 +195,12 @@ def handle_generate(model: InferenceModel, body: bytes,
     With a :class:`ContinuousBatcher` the sequences join the live
     decode batch (one compiled step, token-boundary admission —
     docs/serving.md); without one they run the sequential compiled
-    whole-loop path (`InferenceModel.generate`). 501 when the model
-    has no generator loaded."""
+    whole-loop path (`InferenceModel.generate`). The engine-side
+    capacity levers — chunked prefill, int8 paged KV, speculative
+    decoding (docs/serving.md, docs/perf_flags.md) — are transparent
+    to this contract: same request/response either way, with the
+    active configuration reported under ``generator`` in
+    ``GET /health``. 501 when the model has no generator loaded."""
     try:
         req = json.loads(body)
     except (ValueError, UnicodeDecodeError) as e:

@@ -9,12 +9,36 @@ window. Run twice:
 - **continuous** — every client submits into the live
   `ContinuousBatcher`; sequences share ONE compiled decode step and
   join/leave at token boundaries (ORCA-style iteration scheduling);
+- **continuous+levers** (only when a lever flag is set) — the same
+  harness on a second engine with the requested capacity levers,
+  so the artifact carries a levers-off/levers-on A/B on identical
+  traffic;
 - **sequential** — the per-request baseline: one compiled whole-loop
   `generate` at a time (`InferenceModel.generate`, batch 1),
   serialized the way per-request decode actually serializes.
 
 Reports tokens/sec, request latency p50/p99, and mean time-to-first-
-token for both modes. Prints ONE JSON line in the bench_common
+token for every mode. The levered window (or the plain continuous
+one when no levers are set) also runs a small pool of closed-loop
+TTFT probe clients: alternating short and LONG single-token requests
+whose per-request latencies give `ttft_{short,long}_p{50,99}_ms` —
+the chunked-prefill acceptance signal is long-prompt TTFT p99
+staying within 1.5x of short-prompt p99 while decode traffic flows
+(several probe clients so each shape's p99 rests on hundreds of
+samples taken at realistic slot occupancy, not the max of a hundred
+lightly-loaded ones). Note the CPU host
+under-reports the levered mode's throughput: per-iteration dispatch
+overhead dominates the tiny toy model, so speculation's extra
+tokens/step (~9.7 vs ~5.6 levers-off in the committed artifact) do
+not translate into CPU tokens/s the way they do on a
+bandwidth-bound accelerator decode.
+
+The capacity levers are A/B'd from the command line and recorded in
+the artifact's sentinel key block: ``--prefill-chunk N`` (chunked
+prefill), ``--kv-dtype f32|bf16|int8`` (paged-cache storage), and
+``--spec-k N`` (speculative decoding with a half-width drafter; the
+continuous record then carries ``spec_accept_rate`` and the realized
+``tokens_per_step``). Prints ONE JSON line in the bench_common
 artifact schema and ALSO writes it to ``BENCH_generate.json``:
 
     {"metric": "generate_throughput_tokens_per_sec",
@@ -47,18 +71,33 @@ _t_start = time.perf_counter()
 # mixed workload, cycled per client: (prompt_len, max_new_tokens) —
 # varied on both axes so admission is genuinely staggered and the
 # prompt-bucket ladder is exercised past one shape
-WORK_MIX = ((4, 16), (9, 24), (17, 8), (6, 32), (12, 16), (27, 12))
+# short conversational shapes plus two long-prompt entries so the
+# background mix actually exercises chunked prefill (PR 17): under
+# monolithic prefill the long prompts inflate every neighbour's
+# latency; under chunking they amortize one chunk per iteration
+WORK_MIX = ((4, 16), (9, 24), (17, 8), (6, 32), (12, 16), (27, 12),
+            (72, 8), (100, 6))
 
 SLOTS = 8
 SEQ_LEN = 128
 VOCAB = 256
 
+# TTFT probe shapes: single-token requests whose request latency IS
+# the time to first token; the long one spans many prefill chunks.
+# Several closed-loop probe clients run at once so the per-shape p99
+# rests on hundreds of samples at realistic slot occupancy instead of
+# being the max of ~100 lightly-loaded ones.
+PROBE_SHORT, PROBE_LONG = 4, 100
+PROBE_CLIENTS = 3
 
-def _build_engine():
+
+def _build_engine(prefill_chunk=0, spec_k=0, kv_dtype="f32"):
     from analytics_zoo_tpu import init_nncontext
     from analytics_zoo_tpu.pipeline.api.keras.layers.transformer \
         import TransformerLayer
     from analytics_zoo_tpu.pipeline.inference import InferenceModel
+
+    from analytics_zoo_tpu.common import diagnostics
 
     init_nncontext(seed=0, log_level="WARNING")
     import jax
@@ -68,10 +107,25 @@ def _build_engine():
                            seq_len=SEQ_LEN, vocab=VOCAB,
                            hidden_p_drop=0.0, attn_p_drop=0.0,
                            embed_p_drop=0.0)
-    params = net.build(jax.random.key(0), (SEQ_LEN,))
-    im = InferenceModel()
-    im.load_generator(net, params, max_slots=SLOTS,
-                      max_context=SEQ_LEN, page_size=16)
+    # param-init and loader compiles are deliberate bench setup, not
+    # a storm (the engine excuses its own warm() internally)
+    with diagnostics.expected_compiles():
+        params = net.build(jax.random.key(0), (SEQ_LEN,))
+        kw = dict(max_slots=SLOTS, max_context=SEQ_LEN, page_size=16,
+                  prefill_chunk=prefill_chunk, spec_k=spec_k,
+                  cache_dtype=kv_dtype)
+        if spec_k > 0:
+            # half-width, half-depth drafter sharing the vocabulary
+            drafter = TransformerLayer(n_block=1, hidden_size=64,
+                                       n_head=4, seq_len=SEQ_LEN,
+                                       vocab=VOCAB, hidden_p_drop=0.0,
+                                       attn_p_drop=0.0,
+                                       embed_p_drop=0.0)
+            kw["drafter"] = drafter
+            kw["drafter_params"] = drafter.build(jax.random.key(1),
+                                                 (SEQ_LEN,))
+        im = InferenceModel()
+        im.load_generator(net, params, **kw)
     return im
 
 
@@ -129,12 +183,66 @@ def _run_clients(submit, clients: int, duration_s: float):
     return toks[0], lat, errors[0]
 
 
-def measure(mode: str, im, clients: int, duration_s: float) -> dict:
+def _run_ttft_probe(submit, duration_s: float) -> dict:
+    """PROBE_CLIENTS extra closed-loop clients alternating short/long
+    single-token prompts while the mix clients keep the decode batch
+    busy: each request's latency IS its TTFT. Returns per-shape
+    p50/p99 (ms) and the long/short p99 ratio the chunked-prefill
+    acceptance gate reads."""
+    rs = np.random.RandomState(11)
+    prompts = {n: rs.randint(1, VOCAB, size=n).tolist()
+               for n in (PROBE_SHORT, PROBE_LONG)}
+    samples = {PROBE_SHORT: [], PROBE_LONG: []}
+    lock = threading.Lock()
+    stop_at = time.perf_counter() + duration_s
+    shapes = (PROBE_SHORT, PROBE_LONG)
+
+    def client(cid: int):
+        i = cid  # offset so clients interleave shapes
+        while time.perf_counter() < stop_at:
+            n = shapes[i % 2]
+            i += 1
+            t0 = time.perf_counter()
+            try:
+                submit(prompts[n], 1)
+            except Exception:
+                continue
+            dt = (time.perf_counter() - t0) * 1e3
+            with lock:
+                samples[n].append(dt)
+
+    ts = [threading.Thread(target=client, args=(c,))
+          for c in range(PROBE_CLIENTS)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    out = {}
+    for n, name in ((PROBE_SHORT, "short"), (PROBE_LONG, "long")):
+        arr = np.asarray(samples[n]) if samples[n] else np.zeros((1,))
+        out[f"ttft_{name}_p50_ms"] = round(
+            float(np.percentile(arr, 50)), 2)
+        out[f"ttft_{name}_p99_ms"] = round(
+            float(np.percentile(arr, 99)), 2)
+        out[f"ttft_{name}_samples"] = len(samples[n])
+    p99s, p99l = out["ttft_short_p99_ms"], out["ttft_long_p99_ms"]
+    out["ttft_long_vs_short_p99"] = (
+        round(p99l / p99s, 2) if p99s else None)
+    return out
+
+
+def _counter_value(name: str) -> float:
+    from analytics_zoo_tpu.common import observability as obs
+    return obs.counter(name, help=name).value
+
+
+def measure(mode: str, im, clients: int, duration_s: float,
+            probe_ttft: bool = False) -> dict:
     from analytics_zoo_tpu.pipeline.inference import ContinuousBatcher
 
     engine = im.generator
     cb = None
-    if mode == "continuous":
+    if mode.startswith("continuous"):
         cb = ContinuousBatcher(engine, queue_depth=512).start()
 
         def submit(prompt, max_new):
@@ -150,16 +258,41 @@ def measure(mode: str, im, clients: int, duration_s: float) -> dict:
             with seq_lock:
                 return im.generate(prompt,
                                    max_new_tokens=max_new)[0]
+    probe_rec = {}
     try:
         # warmup outside the window: every (bucket, budget) shape in
-        # the mix compiles here, not inside the measurement
-        for n, max_new in WORK_MIX:
-            submit(list(range(1, n + 1)), max_new)
+        # the mix compiles here, not inside the measurement. The
+        # sequential path compiles on THIS thread (the continuous
+        # one brackets its own warm()), so excuse the burst from the
+        # recompile-storm detector — it is deliberate.
+        from analytics_zoo_tpu.common import diagnostics
+        with diagnostics.expected_compiles():
+            for n, max_new in WORK_MIX:
+                submit(list(range(1, n + 1)), max_new)
+            if cb is not None:
+                submit(list(range(1, PROBE_LONG + 1)), 1)  # probe
+                submit(list(range(1, PROBE_SHORT + 1)), 1)
         ttft0 = _ttft_state()
+        tok0 = _counter_value("zoo_tpu_serving_gen_tokens_total")
+        step0 = _counter_value("zoo_tpu_serving_gen_steps_total")
+        spec0 = (engine.spec_proposed, engine.spec_accepted) \
+            if getattr(engine, "spec_k", 0) else None
         t0 = time.perf_counter()
+        if cb is not None and probe_ttft:
+            probe = {}
+            pt = threading.Thread(target=lambda: probe.update(
+                _run_ttft_probe(submit, duration_s)))
+            pt.start()
         tokens, lat, errors = _run_clients(submit, clients,
                                            duration_s)
+        if cb is not None and probe_ttft:
+            pt.join()
+            probe_rec = probe
         window = time.perf_counter() - t0
+        d_tok = _counter_value(
+            "zoo_tpu_serving_gen_tokens_total") - tok0
+        d_step = _counter_value(
+            "zoo_tpu_serving_gen_steps_total") - step0
     finally:
         if cb is not None:
             cb.stop()
@@ -178,8 +311,21 @@ def measure(mode: str, im, clients: int, duration_s: float) -> dict:
     ttft = _ttft_mean_ms(ttft0)
     # sequential has no streaming boundary: first token arrives with
     # the rest, so mean latency IS its time-to-first-token
-    rec["ttft_mean_ms"] = (ttft if mode == "continuous"
+    rec["ttft_mean_ms"] = (ttft if mode.startswith("continuous")
                            else round(float(np.mean(lat_ms)), 2))
+    if mode.startswith("continuous"):
+        rec.update(probe_rec)
+        # realized tokens per decode iteration: > 1 only when
+        # speculation lands multi-token rounds
+        rec["tokens_per_step"] = (round(d_tok / d_step, 2)
+                                  if d_step else None)
+        if spec0 is not None:
+            dp = engine.spec_proposed - spec0[0]
+            da = engine.spec_accepted - spec0[1]
+            rec["spec_proposed"] = int(dp)
+            rec["spec_accepted"] = int(da)
+            rec["spec_accept_rate"] = (round(da / dp, 3)
+                                       if dp else None)
     print(f"# [{mode}] {rec['tokens_per_sec']} tok/s "
           f"{rec['requests_per_sec']} req/s "
           f"p50={rec['latency_p50_ms']}ms "
@@ -196,6 +342,18 @@ def main():
     ap.add_argument("--duration", type=float,
                     default=float(os.environ.get(
                         "ZOO_TPU_BENCH_GEN_DURATION", "6")))
+    ap.add_argument("--prefill-chunk", type=int, default=int(
+        os.environ.get("ZOO_TPU_PREFILL_CHUNK", "0")),
+        help="chunked prefill: prompt tokens written per batcher "
+        "iteration (0 = whole-prompt bucketed prefill)")
+    ap.add_argument("--spec-k", type=int, default=int(
+        os.environ.get("ZOO_TPU_SPEC_K", "0")),
+        help="speculative decoding: draft tokens per verify round "
+        "(0 = off); the drafter is a half-width half-depth stack")
+    ap.add_argument("--kv-dtype", default=os.environ.get(
+        "ZOO_TPU_KV_DTYPE", "f32"),
+        choices=("f32", "bf16", "int8"),
+        help="paged KV cache storage dtype")
     ap.add_argument("--cpu-fallback", action="store_true",
                     help="pin the run to the host CPU backend; the "
                     "measurement lands in cpu_fallback_value and the "
@@ -208,12 +366,27 @@ def main():
     devices = jax.devices()
     print(f"# backend={devices[0].platform} "
           f"n_devices={len(devices)} clients={args.clients} "
-          f"duration={args.duration}s slots={SLOTS}",
+          f"duration={args.duration}s slots={SLOTS} "
+          f"prefill_chunk={args.prefill_chunk} "
+          f"spec_k={args.spec_k} kv_dtype={args.kv_dtype}",
           file=sys.stderr, flush=True)
 
+    levers_on = (args.prefill_chunk > 0 or args.spec_k > 0
+                 or args.kv_dtype != "f32")
+    # the A/B: the baseline (levers off) keeps the tokens/s lineage
+    # comparable across PRs — continuous vs sequential on identical
+    # engines — while the levered run carries the TTFT probe,
+    # acceptance-rate and tokens/step fields the PR 17 gate reads
     im = _build_engine()
     continuous = measure("continuous", im, args.clients,
-                         args.duration)
+                         args.duration, probe_ttft=not levers_on)
+    levered = None
+    if levers_on:
+        im_lev = _build_engine(prefill_chunk=args.prefill_chunk,
+                               spec_k=args.spec_k,
+                               kv_dtype=args.kv_dtype)
+        levered = measure("continuous+levers", im_lev, args.clients,
+                          args.duration, probe_ttft=True)
     sequential = measure("sequential", im, args.clients,
                          args.duration)
     speedup = (continuous["tokens_per_sec"]
@@ -236,9 +409,14 @@ def main():
             "page_size": 16,
             "max_context": SEQ_LEN,
             "clients": args.clients,
+            "prefill_chunk": args.prefill_chunk,
+            "spec_k": args.spec_k,
+            "kv_dtype": args.kv_dtype,
         },
         "extra_metrics": [
-            continuous, sequential,
+            continuous,
+            *([levered] if levered else []),
+            sequential,
             {"metric": "generate_continuous_speedup",
              "value": round(speedup, 2), "unit": "x"},
         ],

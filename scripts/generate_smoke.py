@@ -14,6 +14,11 @@ to end through the decode fast path (docs/serving.md):
      tokens match the sequential compiled path bit-for-bit
   4. GET /health (generator block present, slots drained) and
      GET /metrics (gen slot/token/TTFT metric families exposed)
+  5. rebuild the generator with the PR 17 capacity levers on
+     (chunked prefill + speculative decoding with a half-width
+     drafter) and push one long-prompt request and one short one
+     through HTTP: tokens must still match the sequential path
+     bit-for-bit and the chunk/speculation counters must move
 
 Exit code 0 = the decode path generated everything exactly; any
 token mismatch or missing metric fails.
@@ -144,10 +149,59 @@ def main() -> int:
         print(f"FAIL: missing metrics {missing}\n---\n{text}",
               file=sys.stderr)
         return 1
+
+    # -- capacity levers: chunked prefill + speculative decode ------
+    drafter = TransformerLayer(n_block=1, hidden_size=16, n_head=2,
+                               seq_len=SEQ_LEN, vocab=VOCAB,
+                               hidden_p_drop=0.0, attn_p_drop=0.0,
+                               embed_p_drop=0.0)
+    dparams = drafter.build(jax.random.key(7), (SEQ_LEN,))
+    im2 = InferenceModel()
+    im2.load_generator(net, params, max_slots=4,
+                       max_context=SEQ_LEN, page_size=8,
+                       prefill_chunk=4, spec_k=2,
+                       drafter=drafter, drafter_params=dparams)
+    lever_mix = [(40, 6), (5, 8)]  # long -> many chunks; short
+    lever_prompts = [rs.randint(1, VOCAB, size=n).tolist()
+                     for n, _ in lever_mix]
+    lever_refs = [list(im2.generate(p, max_new_tokens=m)[0])
+                  for (_, m), p in zip(lever_mix, lever_prompts)]
+    srv2 = make_inference_server(im2, gen_batcher="auto").start()
+    try:
+        url = f"http://127.0.0.1:{srv2.port}"
+        for (n, m), p, ref in zip(lever_mix, lever_prompts,
+                                  lever_refs):
+            req = urllib.request.Request(
+                url + "/generate",
+                data=json.dumps({"prompt": p,
+                                 "max_new_tokens": m}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=120) as r:
+                assert r.status == 200, (n, r.status)
+                out = json.loads(r.read())
+            assert out["tokens"] == ref, (n, out["tokens"], ref)
+        health = json.loads(urllib.request.urlopen(
+            url + "/health", timeout=30).read())
+        gen = health["generator"]
+        assert gen["prefill_chunk"] == 4, health
+        assert gen["spec_k"] == 2, health
+        assert gen["spec_proposed"] > 0, health
+        text = urllib.request.urlopen(
+            url + "/metrics", timeout=30).read().decode()
+    finally:
+        srv2.stop()
+    for m in ("zoo_tpu_serving_gen_prefill_chunks_total",
+              "zoo_tpu_serving_gen_spec_proposed_total",
+              "zoo_tpu_serving_gen_spec_accepted_total"):
+        if m not in text:
+            print(f"FAIL: missing lever metric {m}", file=sys.stderr)
+            return 1
+
     total_new = sum(m for _, m in MIX)
     print(f"generate-smoke OK: {front} decoded {len(MIX)} "
           f"concurrent prompts ({total_new} tokens) exactly, "
-          f"continuous batching on, slots drained")
+          f"continuous batching on, slots drained; capacity levers "
+          f"(chunked prefill + speculative) token-exact over HTTP")
     return 0
 
 

@@ -27,7 +27,11 @@ stdlib ast:
 - metric-catalog drift: every registered metric family appears in
   the docs/observability.md catalog (between the
   `metric-catalog:begin/end` markers) and every catalog entry is
-  still registered by some package file.
+  still registered by some package file;
+- perf-flag drift (both directions, mirroring the metric catalog):
+  every `ZOO_TPU_*` env flag that `analytics_zoo_tpu/` or `scripts/`
+  references appears in docs/perf_flags.md, and every flag the doc
+  names is still referenced by code (docs/perf_flags.md).
 
 Run: `python scripts/lint.py` (exit 1 on findings). `make lint`.
 """
@@ -43,7 +47,8 @@ from typing import Optional
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TARGETS = ["analytics_zoo_tpu", "tests", "scripts", "apps",
            "bench.py", "bench_ncf.py", "bench_bert.py",
-           "bench_common.py", "__graft_entry__.py"]
+           "bench_common.py", "bench_serving.py",
+           "bench_generate.py", "__graft_entry__.py"]
 MAX_LEN = 79
 
 
@@ -287,6 +292,74 @@ def check_metric_catalog(registered: set) -> list:
     return problems
 
 
+_FLAGS_FILE = os.path.join("docs", "perf_flags.md")
+# non-perf toggles documented with their owning module instead of
+# the flag tables: artifact locations and opt-in trust switches
+_FLAGS_EXEMPT = {"ZOO_TPU_PRETRAINED_DIR", "ZOO_TPU_TRUST_TORCH_PICKLE"}
+_FLAG_TOKEN = re.compile(r"ZOO_TPU_[A-Z0-9_]+")
+
+
+def _flag_tokens(text: str) -> "tuple[set, set]":
+    """(exact names, prefix mentions). A token ending in ``_`` is a
+    line-wrapped or templated mention (``ZOO_TPU_SLO_<ID>_...``),
+    useful only as a prefix witness, never as an exact flag."""
+    exact, prefixes = set(), set()
+    for tok in _FLAG_TOKEN.findall(text):
+        (prefixes if tok.endswith("_") else exact).add(tok)
+    return exact, prefixes
+
+
+def check_perf_flags() -> list:
+    """Perf-flag drift gate (the metric-catalog check's twin): every
+    ``ZOO_TPU_*`` environment flag referenced under
+    ``analytics_zoo_tpu/``, ``scripts/`` or the root bench entry
+    points must have a row in docs/perf_flags.md, and every flag the
+    doc names must still be referenced by code. Catches both silent
+    knob additions (new env flag nobody documented) and stale docs
+    (flag renamed/removed but still advertised). Prefix families
+    cover both directions: a code flag extending a family the doc
+    declares wholesale (``ZOO_TPU_BENCH_*`` selects workload shape,
+    not library behavior) needs no own row, and a documented name
+    extending a prefix the code templates
+    (``ZOO_TPU_SLO_<ID>_THRESHOLD``) needs no literal reference."""
+    path = os.path.join(ROOT, _FLAGS_FILE)
+    if not os.path.isfile(path):
+        return [f"{_FLAGS_FILE}: missing (perf flags unchecked)"]
+    doc_exact, doc_prefixes = _flag_tokens(
+        open(path, encoding="utf-8").read())
+    code_exact, code_prefixes = set(), set()
+    for p in _py_files():
+        rel = os.path.relpath(p, ROOT)
+        in_scope = (rel.startswith(("analytics_zoo_tpu" + os.sep,
+                                    "scripts" + os.sep))
+                    or (os.sep not in rel
+                        and rel.startswith("bench")))
+        if not in_scope:
+            continue
+        try:
+            exact, prefixes = _flag_tokens(
+                open(p, encoding="utf-8").read())
+        except UnicodeDecodeError:
+            continue  # check_file already reports it
+        code_exact |= exact
+        code_prefixes |= prefixes
+    problems = []
+    for name in sorted(code_exact - doc_exact - _FLAGS_EXEMPT):
+        if any(name.startswith(pre) for pre in doc_prefixes):
+            continue
+        problems.append(
+            f"{_FLAGS_FILE}: env flag '{name}' is referenced in "
+            f"code but has no row in the flag tables")
+    for name in sorted(doc_exact - code_exact):
+        if any(name.startswith(pre) for pre in code_prefixes):
+            continue
+        problems.append(
+            f"{_FLAGS_FILE}: documents '{name}' but nothing in "
+            f"the package, scripts/ or the bench entry points "
+            f"references it")
+    return problems
+
+
 def check_file(path: str, registered: Optional[set] = None) -> list:
     rel = os.path.relpath(path, ROOT)
     try:
@@ -348,6 +421,7 @@ def main() -> int:
         all_problems.extend(check_file(path, registered))
     all_problems.extend(check_slo_defaults(registered))
     all_problems.extend(check_metric_catalog(registered))
+    all_problems.extend(check_perf_flags())
     for p in all_problems:
         print(p)
     print(f"# linted {n} files: "

@@ -46,6 +46,21 @@ def test_recompile_monitor_fires_deterministically():
     assert s["zoo_tpu_xla_compiles_total"]["values"][0]["value"] == 9
 
 
+def test_expected_compiles_excused_from_storm_window():
+    mon = diagnostics.RecompileMonitor(threshold=2, window_s=60.0)
+    with diagnostics.expected_compiles():
+        # a warm-up burst well past the threshold: counted, no storm
+        assert [mon.note(now=t) for t in
+                (0.0, 0.1, 0.2, 0.3, 0.4)] == [False] * 5
+    assert mon.storms == 0
+    s = obs.snapshot()
+    assert s["zoo_tpu_xla_compiles_total"]["values"][0]["value"] == 5
+    # outside the bracket the same burst trips the detector
+    assert [mon.note(now=t) for t in (10.0, 10.1)] == [False, False]
+    assert mon.note(now=10.2) is True
+    assert mon.storms == 1
+
+
 def test_recompile_listener_filters_event_names():
     mon = diagnostics.RecompileMonitor(threshold=100, window_s=60.0)
     mon._listener("/jax/core/backend_compile_duration", 0.1)

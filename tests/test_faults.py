@@ -399,6 +399,81 @@ def test_decode_kill_reclaims_stranded_pages():
         cb.stop()
 
 
+def test_drain_mid_chunked_prefill_completes_and_frees_pages():
+    """A drain landing while a long prompt is still mid-chunked-
+    prefill lets it finish: the loop keeps writing chunks for the
+    RESIDENT entry (admission is what drain gates), the tokens come
+    out exact, and every page returns to the pool (PR 17)."""
+    from analytics_zoo_tpu.pipeline.inference import (
+        ContinuousBatcher)
+    eng = _gen_engine(max_slots=2, prefill_chunk=2)
+    short, long_p = [4, 19, 7], list(range(3, 27))
+    refs = [
+        [int(t) for t in eng.generate(short, max_new_tokens=6)[0]],
+        [int(t) for t in eng.generate(long_p, max_new_tokens=4)[0]],
+    ]
+    cb = ContinuousBatcher(eng, queue_depth=8).start()
+    try:
+        # decode-step delay stretches each loop iteration, so the
+        # long prompt (12 chunks) stays mid-prefill for a while
+        faults.arm("generation/decode_step", "delay", seconds=0.05)
+        f0 = cb.submit(short, max_new_tokens=6)
+        f1 = cb.submit(long_p, max_new_tokens=4)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if eng.slots_active == 2 and eng.prefilling_slots:
+                break
+            time.sleep(0.002)
+        assert eng.prefilling_slots      # drain lands mid-prefill
+        assert cb.drain(timeout=30) is True
+        assert [int(t) for t in f0.result(5)] == refs[0]
+        assert [int(t) for t in f1.result(5)] == refs[1]
+        assert eng.slots_active == 0
+        assert eng.free_pages == eng.allocator.max_pages
+    finally:
+        faults.disarm_all()
+        cb.stop()
+
+
+def test_spec_step_kill_reclaims_pages_and_loop_survives():
+    """`generation/decode_step` fires inside spec_step too: a kill
+    mid-speculative-round fails the resident requests, strands no
+    pages (draft cache included), and the loop keeps serving —
+    follow-up greedy output stays byte-exact (PR 17)."""
+    from analytics_zoo_tpu.pipeline.inference import (
+        ContinuousBatcher)
+    import jax
+    from analytics_zoo_tpu.pipeline.api.keras.layers.transformer \
+        import TransformerLayer
+    init_nncontext(seed=0)
+    dnet = TransformerLayer(n_block=1, hidden_size=16, n_head=2,
+                            seq_len=SEQ, vocab=VOCAB,
+                            hidden_p_drop=0.0, attn_p_drop=0.0,
+                            embed_p_drop=0.0)
+    dparams = dnet.build(jax.random.key(7), (SEQ,))
+    eng = _gen_engine(max_slots=2, spec_k=2, drafter=dnet,
+                      drafter_params=dparams)
+    ref = [int(t) for t in eng.generate([4, 19, 7],
+                                        max_new_tokens=4)[0]]
+    cb = ContinuousBatcher(eng, queue_depth=8).start()
+    try:
+        faults.arm("generation/decode_step", "kill", times=1)
+        f = cb.submit([4, 19, 7], max_new_tokens=16)
+        with pytest.raises(InjectedKillError):
+            f.result(timeout=30)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if eng.free_pages == eng.allocator.max_pages:
+                break
+            time.sleep(0.005)
+        assert eng.free_pages == eng.allocator.max_pages
+        assert eng.slots_active == 0
+        out = cb.submit([4, 19, 7], max_new_tokens=4).result(30)
+        assert [int(t) for t in out] == ref
+    finally:
+        cb.stop()
+
+
 # -- fleet: exactly-once sibling retry under hash affinity -------------------
 
 class _StubReplicaModel:

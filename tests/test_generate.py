@@ -389,6 +389,365 @@ def test_handle_generate_contract():
         InferenceModel().generate([1, 2])
 
 
+# -- capacity levers: chunked prefill, int8 KV cache, speculation ------------
+
+def _toy_drafter():
+    """A smaller stack sharing the vocabulary, differently
+    initialized: agrees with the target often enough to accept
+    sometimes, rarely enough to exercise rejection + resample."""
+    init_nncontext(seed=0)
+    import jax
+    from analytics_zoo_tpu.pipeline.api.keras.layers.transformer \
+        import TransformerLayer
+    net = TransformerLayer(n_block=1, hidden_size=16, n_head=2,
+                           seq_len=SEQ, vocab=VOCAB,
+                           hidden_p_drop=0.0, attn_p_drop=0.0,
+                           embed_p_drop=0.0)
+    params = net.build(jax.random.key(7), (SEQ,))
+    return net, params
+
+
+def _drive_to_completion(eng, slot, first, prompt_len, max_new):
+    """Finish one admitted request by hand: speculative rounds while
+    the k-token window fits the reservation, regular steps for the
+    tail (the batcher's eligibility gate, inlined)."""
+    got = [first]
+    active = np.zeros((eng.max_slots,), np.bool_)
+    active[slot] = True
+    while len(got) < max_new:
+        window = prompt_len + len(got) - 1 + eng.spec_k
+        budget = min(prompt_len + max_new, eng.max_context)
+        if eng.spec_k > 0 and window <= budget:
+            out, n_emit = eng.spec_step(active)
+            got.extend(int(t) for t in out[slot, :n_emit[slot]])
+        else:
+            got.append(int(eng.step(active)[slot]))
+    return got[:max_new]
+
+
+def test_resolve_kv_dtype(monkeypatch):
+    import jax.numpy as jnp
+    from analytics_zoo_tpu.pipeline.inference.generation import (
+        resolve_kv_dtype)
+    assert resolve_kv_dtype("f32") == jnp.float32
+    assert resolve_kv_dtype("bfloat16") == jnp.bfloat16
+    assert resolve_kv_dtype("int8") == jnp.int8
+    monkeypatch.setenv("ZOO_TPU_KV_DTYPE", "bf16")
+    assert resolve_kv_dtype() == jnp.bfloat16
+    monkeypatch.setenv("ZOO_TPU_KV_DTYPE", "fp4")
+    with pytest.raises(ValueError, match="fp4"):
+        resolve_kv_dtype()
+
+
+def test_chunked_prefill_engine_exact_and_cancel_reclaims():
+    """Chunk-at-a-time prompt writes produce the identical token
+    stream, and cancelling one slot mid-prefill neither perturbs its
+    neighbour nor leaks pages."""
+    eng = _engine(prefill_chunk=4)
+    rs = np.random.RandomState(8)
+    prompt = rs.randint(1, VOCAB, size=11).tolist()  # 3 chunks
+    other = rs.randint(1, VOCAB, size=6).tolist()    # 2 chunks
+    max_new = 5
+    ref = [int(t) for t in
+           eng.generate(prompt, max_new_tokens=max_new)[0]]
+    total = eng.allocator.max_pages
+    s0, s1 = eng.admit_partial([(prompt, max_new, 0.0),
+                                (other, 4, 0.0)])
+    assert eng.free_pages < total
+    assert eng.prefilling_slots == {s0, s1}
+    assert eng.prefill_step() == []     # chunk 1: nobody finishes
+    # cancel the neighbour mid-prefill: its pages must come back
+    free_before = eng.free_pages
+    eng.release(s1)
+    assert s1 not in eng.prefilling_slots
+    assert eng.free_pages > free_before
+    out = {}
+    while eng.prefilling_slots:
+        for slot, tok in eng.prefill_step():
+            out[slot] = [tok]
+    got = out[s0]
+    active = np.zeros((eng.max_slots,), np.bool_)
+    active[s0] = True
+    while len(got) < max_new:
+        got.append(int(eng.step(active)[s0]))
+    eng.release(s0)
+    assert got == ref           # cancelled neighbour left no trace
+    assert eng.free_pages == total
+    assert eng.slots_active == 0
+
+
+def test_chunked_prefill_batcher_exact_with_staggered_admission():
+    """The interleaved scheduler (prompt chunks between decode
+    iterations of resident slots) is invisible in the tokens."""
+    from analytics_zoo_tpu.common import observability as obs
+    eng = _engine(max_slots=2, prefill_chunk=4)
+    rs = np.random.RandomState(9)
+    jobs = [(rs.randint(1, VOCAB, size=n).tolist(), m)
+            for n, m in [(11, 6), (14, 4), (3, 8), (9, 5), (7, 7)]]
+    refs = [[int(t) for t in eng.generate(p, max_new_tokens=m)[0]]
+            for p, m in jobs]
+    cb = ContinuousBatcher(eng, queue_depth=16).start()
+    try:
+        futs = []
+        for i, (p, m) in enumerate(jobs):
+            futs.append(cb.submit(p, max_new_tokens=m))
+            if i < 2:
+                time.sleep(0.01)
+        outs = [[int(t) for t in f.result(timeout=60)]
+                for f in futs]
+    finally:
+        cb.stop()
+    assert outs == refs
+    assert eng.slots_active == 0
+    assert eng.free_pages == eng.allocator.max_pages
+    s = obs.snapshot()
+    chunks = s["zoo_tpu_serving_gen_prefill_chunks_total"][
+        "values"][0]["value"]
+    assert chunks >= 3  # an 11-token prompt alone spans 3 chunks
+    assert eng.stats()["prefill_chunk"] == 4
+
+
+def test_speculative_greedy_engine_exact_with_rejections():
+    """Greedy speculation is byte-identical to plain decode even when
+    the drafter disagrees (rejection + corrected-token path)."""
+    dnet, dparams = _toy_drafter()
+    eng = _engine(spec_k=3, drafter=dnet, drafter_params=dparams)
+    rs = np.random.RandomState(10)
+    for plen, max_new in [(3, 9), (7, 6)]:
+        prompt = rs.randint(1, VOCAB, size=plen).tolist()
+        ref = [int(t) for t in
+               eng.generate(prompt, max_new_tokens=max_new)[0]]
+        (slot, first), = eng.admit([(prompt, max_new, 0.0)])
+        got = _drive_to_completion(eng, slot, first, plen, max_new)
+        eng.release(slot)
+        assert got == ref, (prompt, got, ref)
+    assert eng.spec_proposed > 0
+    assert 0 <= eng.spec_accepted <= eng.spec_proposed
+    st = eng.stats()
+    assert st["spec_k"] == 3
+    assert 0.0 <= st["spec_accept_rate"] <= 1.0
+
+
+def test_speculative_self_draft_accepts_everything():
+    """Drafter == target: every draft must be accepted and the bonus
+    token appended — the full-accept cache-sync boundary (both caches
+    advance k rows, no rewind) stays exact."""
+    net, params = _toy_transformer()
+    from analytics_zoo_tpu.pipeline.inference import (
+        GenerationEngine)
+    eng = GenerationEngine(net, params, max_slots=4,
+                           max_context=SEQ, page_size=8, spec_k=2,
+                           drafter=net, drafter_params=params)
+    prompt, max_new = [4, 19, 7], 8
+    ref = [int(t) for t in
+           eng.generate(prompt, max_new_tokens=max_new)[0]]
+    (slot, first), = eng.admit([(prompt, max_new, 0.0)])
+    got = _drive_to_completion(eng, slot, first, len(prompt),
+                               max_new)
+    eng.release(slot)
+    assert got == ref
+    assert eng.spec_proposed > 0
+    assert eng.spec_accepted == eng.spec_proposed
+
+
+def test_speculative_batcher_greedy_exact_and_stats():
+    from analytics_zoo_tpu.common import observability as obs
+    dnet, dparams = _toy_drafter()
+    eng = _engine(max_slots=2, spec_k=2, drafter=dnet,
+                  drafter_params=dparams)
+    rs = np.random.RandomState(12)
+    jobs = [(rs.randint(1, VOCAB, size=n).tolist(), m)
+            for n, m in [(3, 6), (7, 5), (2, 8), (5, 4)]]
+    refs = [[int(t) for t in eng.generate(p, max_new_tokens=m)[0]]
+            for p, m in jobs]
+    cb = ContinuousBatcher(eng, queue_depth=16).start()
+    try:
+        futs = [cb.submit(p, max_new_tokens=m) for p, m in jobs]
+        outs = [[int(t) for t in f.result(timeout=60)]
+                for f in futs]
+        st = cb.stats()
+    finally:
+        cb.stop()
+    assert outs == refs
+    assert st["spec_k"] == 2
+    assert 0.0 <= st["spec_accept_rate"] <= 1.0
+    assert eng.free_pages == eng.allocator.max_pages
+    s = obs.snapshot()
+    proposed = s["zoo_tpu_serving_gen_spec_proposed_total"][
+        "values"][0]["value"]
+    accepted = s["zoo_tpu_serving_gen_spec_accepted_total"][
+        "values"][0]["value"]
+    assert proposed > 0 and 0 <= accepted <= proposed
+
+
+def test_speculative_sampled_smoke_and_eos():
+    """Temperature > 0 speculation completes with the right budget
+    and in-vocab tokens (distribution exactness is proven at the ops
+    layer); eos raised mid-round stops the stream."""
+    dnet, dparams = _toy_drafter()
+    eng = _engine(max_slots=2, spec_k=3, drafter=dnet,
+                  drafter_params=dparams)
+    greedy = [int(t) for t in
+              eng.generate([4, 19, 7], max_new_tokens=8)[0]]
+    eos = greedy[2]
+    k = greedy.index(eos)  # FIRST occurrence stops the stream
+    cb = ContinuousBatcher(eng, queue_depth=8).start()
+    try:
+        sampled = cb.submit([9, 2, 31], max_new_tokens=10,
+                            temperature=0.8).result(60)
+        stopped = cb.submit([4, 19, 7], max_new_tokens=8,
+                            eos_id=eos).result(60)
+    finally:
+        cb.stop()
+    assert len(sampled) == 10
+    assert all(0 <= int(t) < VOCAB for t in sampled)
+    # greedy + eos: identical prefix, cut at eos inclusive — even
+    # when the eos lands mid-speculative-round
+    assert [int(t) for t in stopped] == greedy[:k + 1]
+
+
+def test_speculative_accept_matches_target_distribution():
+    """Rejection sampling is distribution-exact: over many k=1
+    rounds with mismatched draft/target distributions, the emitted
+    token's empirical law is the TARGET's, not a blend."""
+    import jax
+    import jax.numpy as jnp
+    from analytics_zoo_tpu.ops.sampling import speculative_accept
+    rs = np.random.RandomState(6)
+    v, n = 5, 20000
+    p = rs.dirichlet(np.ones(v)).astype(np.float32)
+    q = rs.dirichlet(np.ones(v)).astype(np.float32)
+    kd, ka = jax.random.split(jax.random.key(0))
+    drafts = jax.random.categorical(
+        kd, jnp.log(jnp.broadcast_to(jnp.asarray(q), (n, v)))
+    )[:, None].astype(jnp.int32)
+    pb = jnp.broadcast_to(jnp.asarray(p), (n, 1, v))
+    qb = jnp.broadcast_to(jnp.asarray(q), (n, 1, v))
+    n_acc, corrected = speculative_accept(ka, pb, qb, drafts)
+    emitted = np.where(np.asarray(n_acc) >= 1,
+                       np.asarray(drafts)[:, 0],
+                       np.asarray(corrected))
+    hist = np.bincount(emitted, minlength=v) / n
+    np.testing.assert_allclose(hist, p, atol=0.025)
+
+
+@pytest.mark.parametrize("kv_dtype,atol", [("bf16", 2e-2),
+                                           ("int8", 5e-2)])
+def test_kv_dtype_conformance_matrix(kv_dtype, atol):
+    """Reduced-precision KV storage: decode logits within the stated
+    tolerance of the f32 cache (docs/serving.md), and this model's
+    greedy argmax margins absorb it — identical token streams."""
+    import jax.numpy as jnp
+    net, params = _toy_transformer()
+    prompt = [7, 3, 11, 2, 19, 33, 8]
+    dt = {"bf16": jnp.bfloat16, "int8": jnp.int8}[kv_dtype]
+    logits = {}
+    for name, dtype in [("f32", jnp.float32), (kv_dtype, dt)]:
+        cache = net.init_kv_cache(1, SEQ, page_size=8, dtype=dtype)
+        ids = jnp.asarray([prompt], jnp.int32)
+        pl = jnp.asarray([len(prompt)], jnp.int32)
+        cache, lg = net.prefill(params, cache, ids, pl)
+        tok, steps = int(jnp.argmax(lg[0])), []
+        for _ in range(6):
+            cache, lg = net.decode_step(
+                params, cache, jnp.asarray([tok], jnp.int32),
+                jnp.asarray([True]))
+            steps.append(np.asarray(lg, np.float32))
+            tok = int(jnp.argmax(lg[0]))
+        logits[name] = np.concatenate(steps)
+    np.testing.assert_allclose(logits[kv_dtype], logits["f32"],
+                               atol=atol)
+    assert np.argmax(logits[kv_dtype], -1).tolist() == \
+        np.argmax(logits["f32"], -1).tolist()
+
+
+def test_int8_engine_greedy_matches_f32_engine():
+    eng8 = _engine(cache_dtype="int8")
+    assert eng8.stats()["kv_dtype"] == "int8"
+    assert eng8.cache.k_pages.dtype == np.int8
+    assert eng8.cache.k_scales is not None
+    engf = _engine()
+    rs = np.random.RandomState(13)
+    for plen, max_new in [(3, 6), (9, 5)]:
+        prompt = rs.randint(1, VOCAB, size=plen).tolist()
+        ref = [int(t) for t in
+               engf.generate(prompt, max_new_tokens=max_new)[0]]
+        (slot, first), = eng8.admit([(prompt, max_new, 0.0)])
+        got = [first]
+        active = np.zeros((eng8.max_slots,), np.bool_)
+        active[slot] = True
+        while len(got) < max_new:
+            got.append(int(eng8.step(active)[slot]))
+        eng8.release(slot)
+        assert got == ref, (prompt, got, ref)
+
+
+def test_no_steady_state_compiles_mixed_chunked_spec_traffic():
+    """THE capacity-lever compile guarantee: chunked admissions,
+    speculative rounds, regular tail steps and retirements across
+    varied lengths/budgets/temperatures — zero compiles after
+    warm()."""
+    from jax import monitoring
+
+    dnet, dparams = _toy_drafter()
+    eng = _engine(prefill_chunk=4, spec_k=2, drafter=dnet,
+                  drafter_params=dparams)
+    rs = np.random.RandomState(14)
+    compiles = []
+    armed = [False]
+
+    def listener(name, dur, **kw):
+        if armed[0] and name.endswith("backend_compile_duration"):
+            compiles.append(name)
+
+    monitoring.register_event_duration_secs_listener(listener)
+    cb = ContinuousBatcher(eng, queue_depth=32)
+    try:
+        cb.start()
+        # step + chunk + draft + draft_chunk + verify, plus the
+        # prefill buckets (both models) that single-chunk prompts
+        # admit through
+        assert eng.stats()["warmed_programs"] >= 5
+        armed[0] = True
+        mix = [(1, 3, 0.0), (11, 5, 0.0), (2, 4, 0.7), (17, 6, 0.0),
+               (24, 2, 0.0), (5, 9, 0.9), (12, 1, 0.0), (7, 7, 0.0)]
+        futs = []
+        for n, m, temp in mix:
+            futs.append(cb.submit(
+                rs.randint(1, VOCAB, size=n).tolist(),
+                max_new_tokens=m, temperature=temp))
+            time.sleep(0.002)
+        for f, (_, m, _) in zip(futs, mix):
+            assert len(f.result(timeout=60)) == m
+        armed[0] = False
+        assert compiles == [], (
+            f"chunked/speculative steady state compiled "
+            f"{len(compiles)} times")
+    finally:
+        armed[0] = False
+        cb.stop()
+    assert eng.free_pages == eng.allocator.max_pages
+
+
+def test_warm_compiles_excused_from_recompile_storm():
+    """warm() AOT-compiles well past the storm threshold in one
+    burst; the expected-compiles bracket keeps the anomaly quiet
+    while still counting every compile."""
+    from analytics_zoo_tpu.common import diagnostics
+    from analytics_zoo_tpu.common import observability as obs
+    dnet, dparams = _toy_drafter()
+    eng = _engine(prefill_chunk=4, spec_k=2, drafter=dnet,
+                  drafter_params=dparams)
+    mon = diagnostics.RecompileMonitor(threshold=2, window_s=300.0)
+    mon.install()
+    before = mon.storms
+    assert eng.warm() >= 5
+    assert mon.storms == before, \
+        "warm-up compiles fired a recompile_storm"
+    s = obs.snapshot()
+    assert s["zoo_tpu_xla_compiles_total"]["values"][0]["value"] > 0
+
+
 def test_generate_route_over_http_sequential_path():
     import urllib.request
     im = _loaded_generator()

@@ -44,6 +44,40 @@ def test_slo_defaults_unknown_metric_flagged():
     assert all("no package file registers" in p for p in problems)
 
 
+# -- perf-flag drift (docs/perf_flags.md) -----------------------------------
+
+def test_perf_flag_drift_clean():
+    """Every ZOO_TPU_* flag in the shipped code has a doc row and
+    vice versa (full-repo pass)."""
+    lint = _lint_mod()
+    assert lint.check_perf_flags() == []
+
+
+def test_perf_flag_drift_detects_both_directions(tmp_path, monkeypatch):
+    lint = _lint_mod()
+    pkg = tmp_path / "analytics_zoo_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        'import os\n'
+        'A = os.environ.get("ZOO_TPU_UNDOCUMENTED_KNOB")\n'
+        'B = os.environ.get("ZOO_TPU_SLO_X_THRESHOLD")\n'
+        'PRE = "ZOO_TPU_SLO_"  # templated family\n')
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "perf_flags.md").write_text(
+        "| `ZOO_TPU_STALE_FLAG` | gone from code |\n"
+        "| `ZOO_TPU_SLO_<ID>_THRESHOLD` | per-rule override |\n")
+    monkeypatch.setattr(lint, "ROOT", str(tmp_path))
+    problems = lint.check_perf_flags()
+    text = "\n".join(problems)
+    # undocumented code flag and stale doc row are both flagged ...
+    assert "ZOO_TPU_UNDOCUMENTED_KNOB" in text
+    assert "ZOO_TPU_STALE_FLAG" in text
+    # ... but names covered by a prefix family on either side are not
+    assert "ZOO_TPU_SLO_X_THRESHOLD" not in text
+    assert len(problems) == 2
+
+
 def test_slo_defaults_structural_problems(tmp_path, monkeypatch):
     """Duplicate ids, non-positive / non-ascending / missing windows
     and non-literal defaults are all caught from the AST alone."""
