@@ -9,7 +9,7 @@ IMAGE ?= analytics-zoo-tpu
     lint obs-smoke fused-conformance flops-audit serving-smoke \
     bench-serving bench-serving-fleet trace-smoke trace-report \
     slo-smoke perf-sentinel fleet-smoke generate-smoke \
-    bench-generate chaos-smoke
+    bench-generate chaos-smoke autotune autotune-smoke
 
 # unit tests plus the end-to-end telemetry smokes (metrics
 # exposition, tracing, SLO control loop), so `make test` proves the
@@ -23,6 +23,7 @@ test:
 	$(MAKE) fleet-smoke
 	$(MAKE) generate-smoke
 	$(MAKE) chaos-smoke
+	$(MAKE) autotune-smoke
 	python scripts/perf_sentinel.py --advisory
 
 # conv+BN (+ residual-epilogue) conformance: the exact Pallas kernel
@@ -113,6 +114,19 @@ chaos-smoke:
 # acked requests), ejected, healed, re-admitted (docs/serving.md)
 fleet-smoke:
 	JAX_PLATFORMS=cpu python scripts/fleet_smoke.py
+
+# populate the persistent autotune cache for the bench shapes
+# (ZOO_TPU_AUTOTUNE=1 sweeps on first sight; docs/autotune.md), then
+# print the decision table. chip_session.sh runs this before the
+# benches and commits the refreshed v5e defaults table.
+autotune:
+	ZOO_TPU_AUTOTUNE=1 python scripts/autotune_report.py --sweep
+
+# autotuner lifecycle end-to-end on CPU: sweep two shapes
+# (interpret-guarded), persist, reload in a FRESH process as pure
+# cache hits (zero sweeps, counter-asserted), report renders
+autotune-smoke:
+	JAX_PLATFORMS=cpu python scripts/autotune_smoke.py
 
 docker-build:
 	docker build -t $(IMAGE) -f docker/Dockerfile .

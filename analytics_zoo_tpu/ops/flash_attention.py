@@ -32,6 +32,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from analytics_zoo_tpu.perf import autotune
+
 # jax ≥0.5 renamed TPUCompilerParams → CompilerParams; bind whichever
 # this jax ships so the kernels compile on both sides of the rename
 _CompilerParams = getattr(pltpu, "CompilerParams",
@@ -699,8 +701,10 @@ def supports(tq: int, tk: int, d: int,
     """Whether the kernel handles this problem (else caller falls back
     to the XLA path): block-divisible sequence lengths, a head dim
     that fits VMEM tiles, and a mask that is either absent or a pure
-    key-padding mask (causal is native)."""
-    bq, bk = _pick_blocks(tq, tk)
+    key-padding mask (causal is native). Feasibility only — block
+    divisibility is identical for every tuner candidate, so this
+    consults the heuristic and never the cache."""
+    bq, bk = _heuristic_blocks(tq, tk)
     if bq is None or bk is None or d > 256:
         return False
     if mask is None:
@@ -708,7 +712,7 @@ def supports(tq: int, tk: int, d: int,
     return b is not None and as_key_mask(mask, b, tk) is not None
 
 
-def _pick_blocks(tq: int, tk: int, itemsize: int = 2):
+def _heuristic_blocks(tq: int, tk: int, itemsize: int = 2):
     # biggest wins on v5e (measured: [1024,1024] beats [256,512] by
     # 1.2-2.2x at T=2k-8k), but the BACKWARD holds ~4 f32
     # (block_q, block_k) tiles in VMEM at once, which at f32 operands
@@ -722,6 +726,20 @@ def _pick_blocks(tq: int, tk: int, itemsize: int = 2):
     bq = next((b for b in sizes if tq % b == 0), None)
     bk = next((b for b in sizes if tk % b == 0), None)
     return bq, bk
+
+
+def _pick_blocks(tq: int, tk: int, itemsize: int = 2):
+    """Tuned (block_q, block_k) via the autotuner ("flash_blocks"
+    op); the heuristic above stays the fallback and the sweep
+    baseline. (None, None) for non-128-divisible T remains the
+    static infeasibility signal and never reaches the tuner."""
+    bq, bk = _heuristic_blocks(tq, tk, itemsize)
+    if bq is None or bk is None:
+        return bq, bk
+    cfg = autotune.decide(
+        "flash_blocks", {"tq": tq, "tk": tk, "isz": itemsize},
+        dtype="f32" if itemsize >= 4 else "bf16")
+    return cfg["bq"], cfg["bk"]
 
 
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
@@ -765,3 +783,58 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     out = _flash(qt, kt, vt, km, scale, causal, bq, bk,
                  bool(interpret))
     return jnp.transpose(out, (0, 2, 1, 3))
+
+
+# -- autotuner spec ---------------------------------------------------------
+# "flash_blocks": the shared fwd/bwd (block_q, block_k) tiling, swept
+# over every divisibility-feasible pair under the dtype-aware VMEM cap
+# (the same cap the heuristic enforces). No legacy env flag exists for
+# the blocks, so there is no flag_value. The probe times fwd+bwd
+# together — the blocks are shared, so a fwd-only winner that loses
+# the backward budget must not win the sweep.
+
+def _blocks_heuristic(p):
+    bq, bk = _heuristic_blocks(p["tq"], p["tk"], p["isz"])
+    return {"bq": bq, "bk": bk}
+
+
+def _blocks_candidates(p):
+    cap = 512 if p["isz"] >= 4 else 1024
+    sizes = [b for b in (1024, 512, 256, 128) if b <= cap]
+    return [{"bq": bq, "bk": bk}
+            for bq in sizes if p["tq"] % bq == 0
+            for bk in sizes if p["tk"] % bk == 0]
+
+
+def _blocks_runner(p, cfg):
+    tq, tk, isz = p["tq"], p["tk"], p["isz"]
+    interpret = jax.default_backend() not in ("tpu", "axon")
+    if interpret and max(tq, tk) > 512:
+        return None    # interpreter probes are for smoke shapes only
+    import numpy as np
+    dtype = jnp.float32 if isz >= 4 else jnp.bfloat16
+    rs = np.random.RandomState(0)
+    b, h, d = 1, 2, 64
+    q = jnp.asarray(rs.randn(b, h, tq, d), dtype)
+    k = jnp.asarray(rs.randn(b, h, tk, d), dtype)
+    v = jnp.asarray(rs.randn(b, h, tk, d), dtype)
+    km = jnp.zeros((), jnp.float32)
+    scale = 1.0 / (d ** 0.5)
+
+    @jax.jit
+    def probe(q, k, v):
+        def loss(q):
+            out = _flash(q, k, v, km, scale, True, cfg["bq"],
+                         cfg["bk"], interpret)
+            return jnp.sum(out.astype(jnp.float32))
+        val, dq = jax.value_and_grad(loss)(q)
+        return val + jnp.sum(dq.astype(jnp.float32))
+
+    def run():
+        jax.block_until_ready(probe(q, k, v))
+    return run
+
+
+autotune.register(autotune.OpSpec(
+    "flash_blocks", heuristic=_blocks_heuristic,
+    candidates=_blocks_candidates, runner=_blocks_runner))

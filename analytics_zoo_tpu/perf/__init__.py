@@ -2,6 +2,6 @@
 ratios, the live goodput/MFU ledger) shared by bench.py,
 scripts/flops_audit.py, the Estimator train loop and tests."""
 
-from analytics_zoo_tpu.perf import flops, goodput
+from analytics_zoo_tpu.perf import autotune, flops, goodput
 
-__all__ = ["flops", "goodput"]
+__all__ = ["autotune", "flops", "goodput"]

@@ -34,6 +34,10 @@ self-contained best-so-far record — the last is the most complete):
 - ``goodput``: recent per-epoch goodput/MFU summaries from
   `analytics_zoo_tpu.perf.goodput` when an Estimator fit ran in this
   process (docs/observability.md).
+- ``autotune``: ``{enabled, cache_hits, cache_misses, sweeps,
+  source}`` provenance from `analytics_zoo_tpu.perf.autotune` —
+  scripts/perf_sentinel.py splits tuned runs into their own ``-tuned``
+  lineages keyed on ``enabled``.
 
 Exit code 0 iff real signal was banked (chip headline or at least one
 fallback stage record).
@@ -76,6 +80,14 @@ def attach_metrics_snapshot(rec: dict) -> dict:
             rec["goodput"] = summaries
     except Exception:
         pass  # goodput is optional decoration on the artifact
+    try:
+        # provenance: was this run tuned? perf_sentinel keys its
+        # tuned-vs-heuristic lineage split on autotune.enabled, so a
+        # tuned run can never masquerade as a heuristic-config win
+        from analytics_zoo_tpu.perf import autotune
+        rec["autotune"] = autotune.stats()
+    except Exception:
+        pass
     return rec
 
 

@@ -16,6 +16,20 @@ run() { echo "### $(date +%H:%M:%S) $*" | tee -a "$LOG"; "$@" 2>&1 | tee -a "$LO
 # 0. chip sanity (fast: bench's own probe path)
 run timeout 150 python bench.py --probe || exit 1
 
+# 0b. first healthy session: populate the autotune cache for the
+#     bench shapes BEFORE the benches (one-time search cost — every
+#     later step then hits a warm cache, docs/autotune.md), freeze
+#     the swept winners into the committed v5e defaults table stamped
+#     with this round, and commit the refresh. Advisory: a sweep
+#     failure must not cost the session its headline artifact.
+ROUND="chip_$(date +%Y%m%d)"
+run timeout 900 make autotune || true
+run env ZOO_TPU_AUTOTUNE=1 python scripts/autotune_report.py \
+  --emit-defaults --round "$ROUND" || true
+git add analytics_zoo_tpu/perf/autotune_defaults/ 2>/dev/null && \
+  git commit -m "Refresh v5e autotune defaults ($ROUND)" \
+    analytics_zoo_tpu/perf/autotune_defaults/ 2>&1 | tee -a "$LOG" || true
+
 # 1. FIRST: the full bench contract (auto A/B + NCF extra metric +
 #    model-FLOPs MFU fields). The tunnel flaps — bank the headline
 #    artifact before anything else. This session is not bound by the

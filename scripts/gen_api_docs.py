@@ -44,6 +44,8 @@ MODULES = [
      "perf — FLOPs accounting & goodput"),
     ("analytics_zoo_tpu.perf.goodput",
      "perf.goodput — live goodput/MFU ledger"),
+    ("analytics_zoo_tpu.perf.autotune",
+     "perf.autotune — persistent kernel autotuner"),
     ("analytics_zoo_tpu.feature", "feature — FeatureSet & ingest"),
     ("analytics_zoo_tpu.feature.image", "feature.image — ImageSet"),
     ("analytics_zoo_tpu.feature.image3d", "feature.image3d"),

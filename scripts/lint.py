@@ -31,7 +31,15 @@ stdlib ast:
 - perf-flag drift (both directions, mirroring the metric catalog):
   every `ZOO_TPU_*` env flag that `analytics_zoo_tpu/` or `scripts/`
   references appears in docs/perf_flags.md, and every flag the doc
-  names is still referenced by code (docs/perf_flags.md).
+  names is still referenced by code (docs/perf_flags.md);
+- autotune override drift (both directions): every `ZOO_TPU_*` env
+  flag actually READ under `analytics_zoo_tpu/ops/` (an
+  `os.environ.get/[]`/`os.getenv` call with a literal name) must be
+  registered in `perf/autotune.py`'s `OVERRIDE_FLAGS` (kept a pure
+  dict literal precisely so this works) AND have a row in
+  docs/perf_flags.md; every registered override must still be read
+  under `ops/` — so a gate flag can never bypass the tuner silently
+  (docs/autotune.md).
 
 Run: `python scripts/lint.py` (exit 1 on findings). `make lint`.
 """
@@ -360,6 +368,110 @@ def check_perf_flags() -> list:
     return problems
 
 
+_OVERRIDES_FILE = os.path.join("analytics_zoo_tpu", "perf",
+                               "autotune.py")
+
+
+def _env_reads(tree: ast.AST) -> set:
+    """Literal ``ZOO_TPU_*`` names passed to ``os.environ.get``,
+    ``os.environ[...]`` or ``os.getenv`` anywhere in ``tree`` —
+    actual gate *reads*, not docstring mentions."""
+    def _is_environ(node) -> bool:
+        return (isinstance(node, ast.Attribute)
+                and node.attr == "environ"
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "os")
+
+    names = set()
+    for node in ast.walk(tree):
+        arg = None
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute):
+            f = node.func
+            if (f.attr == "get" and _is_environ(f.value)) or \
+                    (f.attr == "getenv"
+                     and isinstance(f.value, ast.Name)
+                     and f.value.id == "os"):
+                arg = node.args[0] if node.args else None
+        elif isinstance(node, ast.Subscript) and \
+                _is_environ(node.value):
+            arg = node.slice
+        if isinstance(arg, ast.Constant) and \
+                isinstance(arg.value, str) and \
+                arg.value.startswith("ZOO_TPU_"):
+            names.add(arg.value)
+    return names
+
+
+def _load_override_flags() -> "tuple[dict, list]":
+    """`OVERRIDE_FLAGS` from perf/autotune.py, via literal_eval (the
+    same trick as the SLO-defaults check — the dict is kept a pure
+    literal so the lint gate can read it without importing jax)."""
+    path = os.path.join(ROOT, _OVERRIDES_FILE)
+    if not os.path.isfile(path):
+        return {}, [f"{_OVERRIDES_FILE}: missing (autotune "
+                    f"overrides unchecked)"]
+    try:
+        tree = ast.parse(open(path, encoding="utf-8").read())
+    except SyntaxError:
+        return {}, []  # check_file already reports it
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and \
+                        t.id == "OVERRIDE_FLAGS":
+                    try:
+                        return ast.literal_eval(node.value), []
+                    except ValueError:
+                        return {}, [
+                            f"{_OVERRIDES_FILE}: OVERRIDE_FLAGS must "
+                            f"stay a pure dict literal (the lint "
+                            f"gate literal_evals it)"]
+    return {}, [f"{_OVERRIDES_FILE}: no OVERRIDE_FLAGS assignment "
+                f"found"]
+
+
+def check_autotune_overrides() -> list:
+    """Autotune override drift gate: every ``ZOO_TPU_*`` flag READ
+    under ``analytics_zoo_tpu/ops/`` must be registered in
+    ``perf/autotune.py``'s ``OVERRIDE_FLAGS`` and documented in
+    docs/perf_flags.md; every registered override must still be read
+    under ``ops/``. A gate flag outside the table could bypass the
+    tuner with no provenance (``source="flag"`` unrecorded)."""
+    overrides, problems = _load_override_flags()
+    ops_dir = os.path.join("analytics_zoo_tpu", "ops") + os.sep
+    reads = set()
+    for p in _py_files():
+        rel = os.path.relpath(p, ROOT)
+        if not rel.startswith(ops_dir):
+            continue
+        try:
+            tree = ast.parse(open(p, encoding="utf-8").read())
+        except (SyntaxError, UnicodeDecodeError):
+            continue  # check_file already reports it
+        reads |= _env_reads(tree)
+    doc_exact: set = set()
+    doc_path = os.path.join(ROOT, _FLAGS_FILE)
+    if os.path.isfile(doc_path):
+        doc_exact, _ = _flag_tokens(
+            open(doc_path, encoding="utf-8").read())
+    for name in sorted(reads - set(overrides)):
+        problems.append(
+            f"{_OVERRIDES_FILE}: ops/ reads env gate '{name}' but "
+            f"OVERRIDE_FLAGS does not register it (add it, mapped "
+            f"to the op it overrides, ':pin'-suffixed if outside "
+            f"the sweep space)")
+    for name in sorted(reads - doc_exact):
+        problems.append(
+            f"{_FLAGS_FILE}: ops/ gate '{name}' has no row in the "
+            f"flag tables")
+    for name in sorted(set(overrides) - reads):
+        problems.append(
+            f"{_OVERRIDES_FILE}: OVERRIDE_FLAGS registers '{name}' "
+            f"but nothing under analytics_zoo_tpu/ops/ reads it")
+    return problems
+
+
 def check_file(path: str, registered: Optional[set] = None) -> list:
     rel = os.path.relpath(path, ROOT)
     try:
@@ -422,6 +534,7 @@ def main() -> int:
     all_problems.extend(check_slo_defaults(registered))
     all_problems.extend(check_metric_catalog(registered))
     all_problems.extend(check_perf_flags())
+    all_problems.extend(check_autotune_overrides())
     for p in all_problems:
         print(p)
     print(f"# linted {n} files: "

@@ -46,6 +46,11 @@ def main():
     p.add_argument("--batch", type=int, default=128)
     p.add_argument("--tiny", action="store_true",
                    help="smoke-run mechanics on CPU-size shapes")
+    p.add_argument("--autotune-ab", action="store_true",
+                   help="tuned-vs-heuristic block-config A/B per "
+                        "shape + second-pass zero-sweep assertion "
+                        "(run under ZOO_TPU_AUTOTUNE=1; "
+                        "docs/autotune.md)")
     args = p.parse_args()
 
     import jax
@@ -297,6 +302,70 @@ def main():
                   f"fwd+bwd {td:7.3f}->{tp:7.3f} ms "
                   f"({td / tp:4.2f}x)", flush=True)
 
+    if args.autotune_ab:
+        # tuned-vs-heuristic block-config A/B (ISSUE 18 acceptance
+        # gate): at every swept shape the tuned pick must not be
+        # slower than the analytic heuristic beyond noise, and a
+        # second pass over the same keys must perform ZERO sweeps
+        # (pure cache hits — the persistence contract).
+        from analytics_zoo_tpu.ops.conv_bn import matmul_bn as _mmab
+        from analytics_zoo_tpu.perf import autotune
+        ab_shapes = [(512, 128, 256), (256, 256, 128)] if args.tiny \
+            else _RESNET_SHAPES
+        rs = np.random.RandomState(0)
+        enabled = autotune.sweep_enabled() >= 1
+        print(f"# autotune A/B: tuned vs heuristic conv_bn blocks "
+              f"(sweep {'on' if enabled else 'OFF -- set '}"
+              f"{'' if enabled else 'ZOO_TPU_AUTOTUNE=1'})",
+              flush=True)
+        failures = []
+
+        def time_blocks(cfg, x, w):
+            def fn(x, w):
+                y, sm, sq = _mmab(x, w)
+                y = y + (sm + sq)[None, :].astype(y.dtype) * 0
+                n_ = y.shape[1]
+                return y[:, :x.shape[1]] if n_ >= x.shape[1] else \
+                    jnp.pad(y, ((0, 0), (0, x.shape[1] - n_)))
+            with autotune.forced("conv_bn_blocks", cfg):
+                return chain_time(fn, x, w)
+
+        for m, k, n in ab_shapes:
+            params = {"m": m, "k": k, "n": n, "isz": 2}
+            tuned = autotune.decide("conv_bn_blocks", params)
+            heur = autotune.heuristic("conv_bn_blocks", params)
+            x = jnp.asarray(rs.randn(m, k), jnp.bfloat16)
+            w = jnp.asarray(rs.randn(k, n) * 0.05, jnp.bfloat16)
+            t_tuned = time_blocks(tuned, x, w)
+            t_heur = time_blocks(heur, x, w)
+            verdict = "ok"
+            # generous runtime margin: the sweep already enforced the
+            # 2% NOISE_MARGIN at selection time, this re-measures on
+            # a possibly noisy box
+            if t_tuned > t_heur * 1.25 + 0.05:
+                verdict = "TUNED SLOWER"
+                failures.append((m, k, n, t_tuned, t_heur))
+            print(f"M={m:9d} K={k:4d} N={n:4d}  tuned={tuned} "
+                  f"{t_tuned:7.3f} ms  heur={heur} {t_heur:7.3f} ms "
+                  f"({t_heur / t_tuned:4.2f}x) {verdict}", flush=True)
+        before = autotune.stats()
+        for m, k, n in ab_shapes:      # second pass: must be warm
+            autotune.decide("conv_bn_blocks",
+                            {"m": m, "k": k, "n": n, "isz": 2})
+        after = autotune.stats()
+        new_sweeps = after["sweeps"] - before["sweeps"]
+        new_misses = after["cache_misses"] - before["cache_misses"]
+        print(f"# second pass: sweeps={new_sweeps} "
+              f"misses={new_misses} (want 0/0 with sweep on)",
+              flush=True)
+        if enabled and (new_sweeps or new_misses):
+            print("FAIL: second pass swept or missed", flush=True)
+            return 1
+        if failures:
+            print(f"FAIL: tuned slower than heuristic at "
+                  f"{len(failures)} shape(s)", flush=True)
+            return 1
+
     if not args.skip_model:
         print("# model A/B: ZOO_TPU_BENCH_FUSED 0 vs 1:", flush=True)
         import json
@@ -351,4 +420,4 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -23,7 +23,11 @@ different series from one single-process server, and neither may
 judge the other. Generation artifacts (``BENCH_generate.json`` / any
 record carrying a ``"generate"`` block — `bench_generate.py`) get a
 ``-generate`` suffix likewise: decode tokens/s is not predict-path
-rows/s and the two must never be compared.
+rows/s and the two must never be compared. Autotuned runs (any record
+whose ``"autotune"`` provenance block says ``enabled: true`` —
+``ZOO_TPU_AUTOTUNE>=1``, docs/autotune.md) additionally get a
+``-tuned`` suffix on top of the workload split, so a tuned number is
+never judged against a heuristic-config baseline or vice versa.
 
 Direction is inferred from the metric name (err/p99/latency/_ms/
 seconds → lower is better; everything else → higher is better).
@@ -115,6 +119,16 @@ def is_generate_artifact(rec: dict) -> bool:
     return isinstance(rec.get("generate"), dict)
 
 
+def is_tuned_artifact(rec: dict) -> bool:
+    """Runs under ``ZOO_TPU_AUTOTUNE>=1`` carry an ``"autotune"``
+    provenance block with ``enabled: true`` (bench_common.
+    attach_metrics_snapshot); their numbers get a ``-tuned`` lineage
+    so a tuned run never masquerades as a heuristic-config win
+    (docs/autotune.md)."""
+    at = rec.get("autotune")
+    return isinstance(at, dict) and bool(at.get("enabled"))
+
+
 def extract_series(rec: dict) -> "Dict[Tuple[str, str], float]":
     """``{(lineage, metric): value}`` for one artifact.
     ``lineage`` is ``"chip"`` or ``"cpu"`` — comparisons only ever
@@ -131,6 +145,10 @@ def extract_series(rec: dict) -> "Dict[Tuple[str, str], float]":
         sfx = "-generate"
     else:
         sfx = ""
+    # autotuned runs split into their own lineages on top of the
+    # workload split: tuned-vs-heuristic configs are never comparable
+    if is_tuned_artifact(rec):
+        sfx += "-tuned"
     art_lin = ("cpu" if fb else "chip") + sfx
     cpu_lin = "cpu" + sfx
     headline = rec.get("metric") or "headline"

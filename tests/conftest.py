@@ -14,6 +14,15 @@ if not os.environ.get("ZOO_TPU_TEST_REAL_DEVICE"):
 # and race the per-test registry resets below. Tests drive
 # TelemetryCollector.tick() manually (the injectable-clock path).
 os.environ.setdefault("ZOO_TPU_FED_TICK_S", "0")
+# hermetic autotune: never read (or pollute) the developer's
+# ~/.cache/zoo_tpu/autotune.json — swept winners leaking in could
+# flip crossover gates the tests assert on (e.g. flash_profitable).
+# Tests that exercise sweeping repoint this themselves via
+# monkeypatch + autotune.reset_cache().
+os.environ.setdefault(
+    "ZOO_TPU_AUTOTUNE_CACHE",
+    os.path.join("/tmp", f"zoo_tpu_test_autotune_{os.getpid()}.json"))
+os.environ.setdefault("ZOO_TPU_AUTOTUNE", "0")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -46,18 +55,20 @@ def _fresh_telemetry():
     another's assertions."""
     from analytics_zoo_tpu.common import (
         faults, observability, slo, tracing)
-    from analytics_zoo_tpu.perf import goodput
+    from analytics_zoo_tpu.perf import autotune, goodput
     observability.reset_metrics()
     tracing.reset_tracing()
     slo.reset_slo()
     goodput.reset_goodput()
     faults.reset_faults()
+    autotune.reset_cache()
     yield
     observability.reset_metrics()
     tracing.reset_tracing()
     slo.reset_slo()
     goodput.reset_goodput()
     faults.reset_faults()
+    autotune.reset_cache()
 
 
 @pytest.fixture

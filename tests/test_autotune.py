@@ -1,0 +1,445 @@
+"""Persistent kernel autotuner (perf/autotune.py, docs/autotune.md).
+
+Covers the decision precedence (flag > cache > defaults > heuristic),
+the sweep→persist→reload lifecycle, steady-state guarantees (hit path
+sweeps nothing, recompiles nothing), the committed defaults tables'
+heuristic-consistency (merging the tuner changed no behavior), and
+conformance: every candidate config in every op's sweep space must
+produce the same VALUES as the heuristic pick — tuning may change
+speed, never numerics.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.ops import attention, conv_bn, flash_attention
+from analytics_zoo_tpu.perf import autotune
+
+
+@pytest.fixture
+def tuner(tmp_path, monkeypatch):
+    """A fresh singleton against a tmp cache path; sweeping off."""
+    monkeypatch.setenv("ZOO_TPU_AUTOTUNE_CACHE",
+                       str(tmp_path / "at.json"))
+    monkeypatch.delenv("ZOO_TPU_AUTOTUNE", raising=False)
+    autotune.reset_cache()
+    yield autotune.get_cache()
+    autotune.reset_cache()
+
+
+def _plant(path, key, config, op="attn_crossover", params=None):
+    payload = {"schema": autotune.SCHEMA_VERSION, "entries": {
+        key: {"op": op, "params": params or {}, "dtype": "any",
+              "config": config, "source": "sweep"}}}
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh)
+
+
+# -- registration & heuristics ----------------------------------------------
+
+def test_all_ops_registered():
+    for op in ("flash_blocks", "attn_crossover", "decode_crossover",
+               "conv_bn_blocks", "conv_bn_bwd"):
+        assert op in autotune.registered_ops()
+
+
+def test_crossover_heuristics_unchanged(tuner):
+    """The pre-tuner constants, verbatim (PERF.md crossovers)."""
+    assert not attention.flash_profitable(512)
+    assert attention.flash_profitable(1024)
+    assert not attention.decode_flash_profitable(1024)
+    assert attention.decode_flash_profitable(2048)
+
+
+def test_block_heuristics_unchanged(tuner):
+    for m, k, n, isz in [(512, 128, 256, 2), (100352, 256, 64, 2),
+                         (6272, 512, 2048, 4)]:
+        assert conv_bn._pick_blocks(m, k, n, isz) == \
+            conv_bn._heuristic_blocks(m, k, n, isz)
+    for tq, tk, isz in [(256, 256, 2), (1024, 2048, 4),
+                        (512, 384, 2)]:
+        assert flash_attention._pick_blocks(tq, tk, isz) == \
+            flash_attention._heuristic_blocks(tq, tk, isz)
+    assert conv_bn._pallas_bwd_wins(512, 128, 256)
+
+
+def test_candidates_include_heuristic_first(tuner):
+    p = {"m": 512, "k": 128, "n": 256, "isz": 2}
+    cands = autotune.candidates("conv_bn_blocks", p)
+    assert cands[0] == autotune.heuristic("conv_bn_blocks", p)
+    seen = [json.dumps(c, sort_keys=True) for c in cands]
+    assert len(seen) == len(set(seen)), "candidates must deduplicate"
+    assert len(cands) <= autotune.SWEEP_MAX_CANDIDATES
+
+
+# -- precedence -------------------------------------------------------------
+
+def test_flag_overrides_cache(tuner, monkeypatch):
+    """A set legacy flag bypasses the tuner verbatim — even against a
+    contradicting cached winner (source='flag' semantics)."""
+    key = autotune.make_key("attn_crossover", {"tk": 512}, "any",
+                            tuner.device)
+    tuner._entries[key] = {"config": {"use_flash": False},
+                           "source": "sweep"}
+    monkeypatch.setenv("ZOO_TPU_FLASH_MIN_T", "256")
+    assert attention.flash_profitable(512)      # flag wins
+    monkeypatch.delenv("ZOO_TPU_FLASH_MIN_T")
+    assert not attention.flash_profitable(512)  # cache now serves
+
+
+def test_forced_outranks_flag(tuner, monkeypatch):
+    monkeypatch.setenv("ZOO_TPU_FLASH_MIN_T", "4096")
+    with autotune.forced("attn_crossover", {"use_flash": True}):
+        assert attention.flash_profitable(128)
+    assert not attention.flash_profitable(128)
+
+
+def test_conv_bn_bwd_flag_verbatim(tuner, monkeypatch):
+    monkeypatch.setenv("ZOO_TPU_CONV_BN_PALLAS_BWD", "0")
+    assert not conv_bn._pallas_bwd_wins(512, 128, 256)
+    monkeypatch.setenv("ZOO_TPU_CONV_BN_PALLAS_BWD", "1")
+    assert conv_bn._pallas_bwd_wins(512, 128, 256)
+
+
+def test_cached_entry_served_over_heuristic(tuner):
+    key = autotune.make_key("decode_crossover", {"tk": 512}, "any",
+                            tuner.device)
+    tuner._entries[key] = {"config": {"use_flash": True},
+                           "source": "sweep"}
+    assert attention.decode_flash_profitable(512)
+    assert tuner.hits == 1
+
+
+def test_unknown_op_without_entry_raises(tuner):
+    with pytest.raises(KeyError):
+        tuner.decide("no_such_op", {"x": 1})
+
+
+# -- committed defaults tables ----------------------------------------------
+
+@pytest.mark.parametrize("device", ["cpu", "v5e"])
+def test_defaults_tables_heuristic_consistent(device):
+    """The shipped tables are heuristic-seeded: config == the op's
+    analytic pick at the stored params, so merging the tuner changed
+    no behavior until a chip session refreshes them."""
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(autotune.__file__)),
+        "autotune_defaults", f"{device}.json")
+    with open(path, encoding="utf-8") as fh:
+        table = json.load(fh)
+    assert table["schema"] == autotune.SCHEMA_VERSION
+    assert table["entries"], "table must not ship empty"
+    for key, e in table["entries"].items():
+        assert key.endswith(f"|{device}"), key
+        assert e["config"] == autotune.heuristic(e["op"],
+                                                 e["params"]), key
+
+
+def test_defaults_table_loaded_as_defaults_source(tmp_path,
+                                                  monkeypatch):
+    monkeypatch.setenv("ZOO_TPU_AUTOTUNE_CACHE",
+                       str(tmp_path / "none.json"))
+    autotune.reset_cache()
+    cache = autotune.get_cache()
+    entry_sources = {e.get("source")
+                     for e in cache.entries().values()}
+    # the committed cpu table is present on the CPU test mesh
+    assert entry_sources == {"defaults"}
+    autotune.reset_cache()
+
+
+def test_disk_cache_overrides_defaults(tmp_path, monkeypatch):
+    """A swept winner beats a shipped default for the same key."""
+    path = tmp_path / "at.json"
+    cache0 = autotune.AutotuneCache(path=str(path), device="cpu")
+    key = next(iter(cache0.entries()))
+    e = cache0.entries()[key]
+    _plant(str(path), key, {"planted": True}, op=e["op"],
+           params=e["params"])
+    cache = autotune.AutotuneCache(path=str(path), device="cpu")
+    assert cache.entries()[key]["config"] == {"planted": True}
+    assert cache.entries()[key]["source"] == "cache"
+
+
+# -- sweep lifecycle --------------------------------------------------------
+
+_TINY = {"m": 256, "k": 128, "n": 128, "isz": 2}
+
+
+def test_sweep_persist_reload_hit(tmp_path, monkeypatch):
+    path = str(tmp_path / "at.json")
+    monkeypatch.setenv("ZOO_TPU_AUTOTUNE_CACHE", path)
+    monkeypatch.setenv("ZOO_TPU_AUTOTUNE", "1")
+    autotune.reset_cache()
+    cfg = autotune.decide("conv_bn_blocks", dict(_TINY))
+    cache = autotune.get_cache()
+    assert cache.sweeps == 1
+    assert os.path.exists(path)
+    with open(path, encoding="utf-8") as fh:
+        on_disk = json.load(fh)
+    assert on_disk["schema"] == autotune.SCHEMA_VERSION
+    [entry] = [e for e in on_disk["entries"].values()
+               if e["op"] == "conv_bn_blocks"]
+    assert entry["config"] == cfg
+    assert entry["params"] == _TINY
+    assert entry["ms"] > 0
+    # "reload": a fresh cache object (new process stand-in), sweep OFF
+    monkeypatch.setenv("ZOO_TPU_AUTOTUNE", "0")
+    autotune.reset_cache()
+    assert autotune.decide("conv_bn_blocks", dict(_TINY)) == cfg
+    c2 = autotune.get_cache()
+    assert (c2.hits, c2.misses, c2.sweeps) == (1, 0, 0)
+    autotune.reset_cache()
+
+
+def test_mode2_resweeps_once_per_process(tmp_path, monkeypatch):
+    path = str(tmp_path / "at.json")
+    monkeypatch.setenv("ZOO_TPU_AUTOTUNE_CACHE", path)
+    monkeypatch.setenv("ZOO_TPU_AUTOTUNE", "1")
+    autotune.reset_cache()
+    autotune.decide("conv_bn_blocks", dict(_TINY))
+    assert autotune.get_cache().sweeps == 1
+    monkeypatch.setenv("ZOO_TPU_AUTOTUNE", "2")
+    autotune.reset_cache()                    # entry now from disk
+    autotune.decide("conv_bn_blocks", dict(_TINY))
+    cache = autotune.get_cache()
+    assert cache.sweeps == 1                  # re-swept despite entry
+    autotune.decide("conv_bn_blocks", dict(_TINY))
+    assert cache.sweeps == 1                  # once per process only
+    assert cache.hits == 1
+    autotune.reset_cache()
+
+
+def test_sweep_skipped_inside_trace(tmp_path, monkeypatch):
+    """decide() under an active jit trace must fall back, never
+    sweep (sweeping launches its own compiles)."""
+    monkeypatch.setenv("ZOO_TPU_AUTOTUNE_CACHE",
+                       str(tmp_path / "at.json"))
+    monkeypatch.setenv("ZOO_TPU_AUTOTUNE", "1")
+    autotune.reset_cache()
+    p = {"m": 192, "k": 128, "n": 128, "isz": 2}
+
+    @jax.jit
+    def traced(x):
+        cfg = autotune.decide("conv_bn_blocks", dict(p))
+        return x * cfg["bm"]
+
+    traced(jnp.ones(()))
+    assert autotune.get_cache().sweeps == 0
+    autotune.reset_cache()
+
+
+def test_sweep_counters_and_span(tmp_path, monkeypatch):
+    from analytics_zoo_tpu.common import observability as obs
+    monkeypatch.setenv("ZOO_TPU_AUTOTUNE_CACHE",
+                       str(tmp_path / "at.json"))
+    monkeypatch.setenv("ZOO_TPU_AUTOTUNE", "1")
+    autotune.reset_cache()
+    autotune.decide("conv_bn_blocks", dict(_TINY))
+    snap = obs.snapshot()
+    assert sum(v["value"] for v in
+               snap["zoo_tpu_autotune_sweeps_total"]["values"]) == 1
+    assert sum(v["value"] for v in
+               snap["zoo_tpu_autotune_misses_total"]["values"]) >= 1
+    # the sweep ran under an "autotune/sweep" span -> its wall-time
+    # histogram exists and observed exactly one sweep
+    assert "zoo_tpu_autotune_sweep_seconds" in snap
+    autotune.decide("conv_bn_blocks", dict(_TINY))
+    snap = obs.snapshot()
+    assert sum(v["value"] for v in
+               snap["zoo_tpu_autotune_hits_total"]["values"]) == 1
+    autotune.reset_cache()
+
+
+def test_stats_block_shape(tuner):
+    s = autotune.stats()
+    assert set(s) == {"enabled", "cache_hits", "cache_misses",
+                      "sweeps", "source"}
+    assert s["enabled"] is False
+    assert s["source"] == "none"
+    attention.flash_profitable(512)
+    assert autotune.stats()["source"] in ("defaults", "heuristic")
+
+
+def test_persist_tolerates_unwritable_path(monkeypatch):
+    monkeypatch.setenv("ZOO_TPU_AUTOTUNE_CACHE",
+                       "/proc/0/nope/at.json")
+    monkeypatch.setenv("ZOO_TPU_AUTOTUNE", "1")
+    autotune.reset_cache()
+    cfg = autotune.decide("conv_bn_blocks", dict(_TINY))
+    assert set(cfg) == {"bm", "bk"}    # swept in-process, no crash
+    assert autotune.get_cache().sweeps == 1
+    autotune.reset_cache()
+
+
+# -- steady state: hit path sweeps nothing, recompiles nothing --------------
+
+def test_zero_recompile_zero_sweep_soak(tmp_path, monkeypatch):
+    """The compile-event-listener soak (tests/test_generate.py's
+    pattern): warm one tuned flash call + the decision keys, then
+    repeated tuned calls must trigger ZERO backend compiles and ZERO
+    sweeps — the hit path is a dict lookup, not a search."""
+    from jax import monitoring
+    monkeypatch.setenv("ZOO_TPU_AUTOTUNE_CACHE",
+                       str(tmp_path / "at.json"))
+    monkeypatch.setenv("ZOO_TPU_AUTOTUNE", "1")
+    autotune.reset_cache()
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(1, 256, 2, 32), jnp.float32)
+    compiles = []
+    armed = [False]
+
+    def listener(name, dur, **kw):
+        if armed[0] and name.endswith("backend_compile_duration"):
+            compiles.append(name)
+
+    monitoring.register_event_duration_secs_listener(listener)
+    fn = jax.jit(lambda q: flash_attention.flash_attention(
+        q, q, q, causal=True))
+    # warm EVERY key the soak will touch: the jit compile, plus one
+    # decide() per key so first-sight sweeps (and their deliberate
+    # probe compiles) all land here, not in the armed window
+    jax.block_until_ready(fn(q))
+    attention.flash_profitable(256)
+    attention.decode_flash_profitable(256)
+    conv_bn._pick_blocks(256, 128, 128, 2)
+    cache = autotune.get_cache()
+    base_sweeps = cache.sweeps
+    armed[0] = True
+    try:
+        for _ in range(20):
+            jax.block_until_ready(fn(q))
+            attention.flash_profitable(256)
+            attention.decode_flash_profitable(256)
+            conv_bn._pick_blocks(256, 128, 128, 2)
+    finally:
+        armed[0] = False
+    assert compiles == [], (
+        f"steady-state tuned calls compiled {len(compiles)} times")
+    assert cache.sweeps == base_sweeps, "steady state swept"
+    autotune.reset_cache()
+
+
+# -- conformance: tuning may change speed, never values ---------------------
+
+def _flash_candidates():
+    return autotune.candidates("flash_blocks",
+                               {"tq": 256, "tk": 256, "isz": 4})
+
+
+@pytest.mark.parametrize("cfg", _flash_candidates())
+def test_flash_fwd_bwd_conformance(cfg, tuner):
+    """Every flash block candidate == the heuristic pick's values
+    (f32 tight tolerance: block size changes reduction order)."""
+    rs = np.random.RandomState(3)
+    q = jnp.asarray(rs.randn(1, 256, 2, 32) * 0.5, jnp.float32)
+    k = jnp.asarray(rs.randn(1, 256, 2, 32) * 0.5, jnp.float32)
+    v = jnp.asarray(rs.randn(1, 256, 2, 32) * 0.5, jnp.float32)
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention.flash_attention(
+            q, k, v, causal=True) ** 2)
+
+    def run(c):
+        with autotune.forced("flash_blocks", c):
+            out = flash_attention.flash_attention(q, k, v,
+                                                  causal=True)
+            g = jax.grad(loss)(q, k, v)
+        return np.asarray(out), np.asarray(g)
+
+    heur = autotune.heuristic("flash_blocks",
+                              {"tq": 256, "tk": 256, "isz": 4})
+    out_h, g_h = run(heur)
+    out_c, g_c = run(cfg)
+    np.testing.assert_allclose(out_c, out_h, atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(g_c, g_h, atol=2e-5, rtol=2e-5)
+
+
+_CONV_P = {"m": 256, "k": 128, "n": 128, "isz": 4}
+
+
+@pytest.mark.parametrize(
+    "cfg", autotune.candidates("conv_bn_blocks", dict(_CONV_P)))
+def test_conv_bn_fwd_bwd_conformance(cfg, tuner):
+    rs = np.random.RandomState(4)
+    x = jnp.asarray(rs.randn(256, 128), jnp.float32)
+    w = jnp.asarray(rs.randn(128, 128) * 0.05, jnp.float32)
+
+    def f(x, w):
+        y, sm, sq = conv_bn.matmul_bn(x, w)
+        return jnp.sum(y) + jnp.sum(sm) + jnp.sum(sq)
+
+    def run(c):
+        with autotune.forced("conv_bn_blocks", c):
+            val, g = jax.value_and_grad(f, argnums=(0, 1))(x, w)
+        return (np.asarray(val), np.asarray(g[0]), np.asarray(g[1]))
+
+    heur = run(autotune.heuristic("conv_bn_blocks", dict(_CONV_P)))
+    got = run(cfg)
+    for a, b in zip(got, heur):
+        np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize(
+    "cfg", autotune.candidates("conv_bn_bwd",
+                               {"m": 256, "k": 128, "n": 128}))
+def test_conv_bn_bwd_gate_conformance(cfg, tuner):
+    """Pallas and XLA backward must agree wherever the gate lands."""
+    rs = np.random.RandomState(5)
+    x = jnp.asarray(rs.randn(256, 128), jnp.float32)
+    w = jnp.asarray(rs.randn(128, 128) * 0.05, jnp.float32)
+
+    def f(x, w):
+        y, sm, sq = conv_bn.matmul_bn(x, w)
+        return jnp.sum(y) + jnp.sum(sm) + jnp.sum(sq)
+
+    with autotune.forced("conv_bn_bwd", {"pallas": False}):
+        ref = jax.grad(f, argnums=(0, 1))(x, w)
+    with autotune.forced("conv_bn_bwd", cfg):
+        got = jax.grad(f, argnums=(0, 1))(x, w)
+    for a, b in zip(got, ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=2e-4)
+
+
+@pytest.mark.parametrize("cfg", [{"use_flash": False},
+                                 {"use_flash": True}])
+def test_decode_attention_conformance(cfg, tuner, monkeypatch):
+    """Both sides of the decode crossover produce the same values
+    through the real decode_attention routing."""
+    monkeypatch.setenv("ZOO_TPU_FLASH_FORCE_INTERPRET", "1")
+    rs = np.random.RandomState(6)
+    s, t, h, d = 2, 256, 2, 32
+    q = jnp.asarray(rs.randn(s, h, d), jnp.float32)
+    k = jnp.asarray(rs.randn(s, t, h, d), jnp.float32)
+    v = jnp.asarray(rs.randn(s, t, h, d), jnp.float32)
+    seq_lens = jnp.asarray([t, t // 2], jnp.int32)
+    ref = attention.decode_attention(q, k, v, seq_lens, impl="xla")
+    with autotune.forced("decode_crossover", cfg):
+        out = attention.decode_attention(q, k, v, seq_lens,
+                                         impl="auto")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("cfg", [{"use_flash": False},
+                                 {"use_flash": True}])
+def test_train_attention_crossover_conformance(cfg, tuner,
+                                               monkeypatch):
+    monkeypatch.setenv("ZOO_TPU_FLASH_FORCE_INTERPRET", "1")
+    rs = np.random.RandomState(7)
+    q = jnp.asarray(rs.randn(1, 256, 2, 32) * 0.5, jnp.float32)
+    k = jnp.asarray(rs.randn(1, 256, 2, 32) * 0.5, jnp.float32)
+    v = jnp.asarray(rs.randn(1, 256, 2, 32) * 0.5, jnp.float32)
+    ref = attention.dot_product_attention(q, k, v, causal=True,
+                                          impl="xla")
+    with autotune.forced("attn_crossover", cfg):
+        out = attention.dot_product_attention(q, k, v, causal=True,
+                                              impl="auto")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)

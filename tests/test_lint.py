@@ -107,3 +107,69 @@ DEFAULT_TRAINING_SLOS = [{"id": "d", "windows": [object()],
     assert "'c' has no windows" in text
     assert "'zoo_tpu_nope' that no package file registers" in text
     assert "DEFAULT_TRAINING_SLOS is not a pure literal" in text
+
+# -- autotune override drift (docs/autotune.md) -----------------------------
+
+def test_autotune_overrides_clean():
+    """Every ZOO_TPU_* gate actually read under ops/ is registered in
+    OVERRIDE_FLAGS and documented, and every registered override is
+    still read (full-repo pass)."""
+    lint = _lint_mod()
+    assert lint.check_autotune_overrides() == []
+
+
+def test_autotune_overrides_detect_both_directions(tmp_path,
+                                                   monkeypatch):
+    lint = _lint_mod()
+    ops = tmp_path / "analytics_zoo_tpu" / "ops"
+    ops.mkdir(parents=True)
+    (ops / "mod.py").write_text(
+        'import os\n'
+        'A = os.environ.get("ZOO_TPU_ROGUE_GATE", "1")\n'
+        'B = os.environ["ZOO_TPU_SUBSCRIPT_GATE"]\n'
+        'C = os.getenv("ZOO_TPU_GETENV_GATE")\n'
+        '# a docstring mention alone is NOT a read:\n'
+        'D = "ZOO_TPU_ONLY_MENTIONED"\n')
+    perf = tmp_path / "analytics_zoo_tpu" / "perf"
+    perf.mkdir()
+    (perf / "autotune.py").write_text(
+        'OVERRIDE_FLAGS = {\n'
+        '    "ZOO_TPU_SUBSCRIPT_GATE": "some_op",\n'
+        '    "ZOO_TPU_GETENV_GATE": "some_op:pin",\n'
+        '    "ZOO_TPU_STALE_OVERRIDE": "gone_op",\n'
+        '}\n')
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "perf_flags.md").write_text(
+        "| `ZOO_TPU_SUBSCRIPT_GATE` | row |\n"
+        "| `ZOO_TPU_GETENV_GATE` | row |\n"
+        "| `ZOO_TPU_STALE_OVERRIDE` | row |\n")
+    monkeypatch.setattr(lint, "ROOT", str(tmp_path))
+    problems = lint.check_autotune_overrides()
+    text = "\n".join(problems)
+    # unregistered ops/ read -> flagged (and it has no doc row)
+    assert "ZOO_TPU_ROGUE_GATE" in text
+    # registered override nothing reads anymore -> flagged
+    assert "ZOO_TPU_STALE_OVERRIDE" in text
+    # registered+documented+read flags are clean; mentions don't count
+    assert "ZOO_TPU_SUBSCRIPT_GATE" not in text
+    assert "ZOO_TPU_GETENV_GATE" not in text
+    assert "ZOO_TPU_ONLY_MENTIONED" not in text
+    assert len(problems) == 3  # rogue x2 (table + doc) + stale
+
+
+def test_autotune_overrides_require_pure_literal(tmp_path,
+                                                 monkeypatch):
+    """A computed OVERRIDE_FLAGS defeats the offline gate and must
+    itself be a finding."""
+    lint = _lint_mod()
+    perf = tmp_path / "analytics_zoo_tpu" / "perf"
+    perf.mkdir(parents=True)
+    (perf / "autotune.py").write_text(
+        'BASE = {"ZOO_TPU_X": "op"}\n'
+        'OVERRIDE_FLAGS = dict(BASE)\n')
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "perf_flags.md").write_text("")
+    monkeypatch.setattr(lint, "ROOT", str(tmp_path))
+    problems = lint.check_autotune_overrides()
+    assert any("pure dict literal" in p for p in problems)

@@ -135,6 +135,47 @@ def test_fleet_artifacts_are_their_own_lineage(sentinel, tmp_path):
     assert sentinel.main(["--dir", str(tmp_path)]) == 1
 
 
+def test_tuned_artifacts_are_their_own_lineage(sentinel, tmp_path):
+    """An autotuned run (``autotune.enabled`` provenance from
+    bench_common.attach_metrics_snapshot) never shares a series with
+    heuristic-config runs — and tuned-vs-tuned regressions still
+    fire (docs/autotune.md)."""
+    tuned = {"metric": CHIP, "value": None, "fallback": "cpu",
+             "cpu_fallback_value": 100.0,
+             "autotune": {"enabled": True, "cache_hits": 9,
+                          "cache_misses": 1, "sweeps": 1,
+                          "source": "sweep"}}
+    series = sentinel.extract_series(tuned)
+    assert ("cpu-tuned", CHIP) in series
+    assert not any(lin in ("chip", "cpu") for lin, _ in series)
+    # an untuned record with the provenance block disabled stays in
+    # the base lineage
+    untuned = {"metric": CHIP, "value": None, "fallback": "cpu",
+               "cpu_fallback_value": 5.0,
+               "autotune": {"enabled": False, "cache_hits": 0,
+                            "cache_misses": 4, "sweeps": 0,
+                            "source": "heuristic"}}
+    assert ("cpu", CHIP) in sentinel.extract_series(untuned)
+    # huge tuned-vs-untuned gap regresses nothing ...
+    _wrap(tmp_path, 1, tuned)
+    _wrap(tmp_path, 2, untuned)
+    assert sentinel.main(["--dir", str(tmp_path)]) == 0
+    # ... but tuned-vs-tuned IS compared: a 50% drop fires
+    _wrap(tmp_path, 3, dict(tuned, cpu_fallback_value=50.0))
+    assert sentinel.main(["--dir", str(tmp_path)]) == 1
+
+
+def test_tuned_suffix_composes_with_workload_suffix(sentinel):
+    """-tuned stacks on top of -generate/-fleet: a tuned decode run
+    is not comparable to an untuned decode run either."""
+    rec = {"metric": "generate_tokens_per_sec", "value": None,
+           "fallback": "cpu", "cpu_fallback_value": 42.0,
+           "generate": {"decode": True},
+           "autotune": {"enabled": True}}
+    series = sentinel.extract_series(rec)
+    assert ("cpu-generate-tuned", "generate_tokens_per_sec") in series
+
+
 def test_fleet_named_artifact_loaded_as_own_column(sentinel,
                                                    tmp_path, capsys):
     (tmp_path / "BENCH_serving_fleet.json").write_text(json.dumps(
