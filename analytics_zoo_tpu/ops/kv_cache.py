@@ -46,6 +46,7 @@ retirement (`pipeline/inference/batching.py::ContinuousBatcher`).
 
 from __future__ import annotations
 
+import base64
 from typing import NamedTuple, Sequence
 
 import jax.numpy as jnp
@@ -268,6 +269,112 @@ def length_mask(seq_lens, t: int):
     """(S, t) bool key-validity mask: position p of slot s is a real
     cached token iff ``p < seq_lens[s]``."""
     return jnp.arange(t, dtype=jnp.int32)[None, :] < seq_lens[:, None]
+
+
+# -- KV-page handoff (prefill/decode disaggregation) ---------------------
+#
+# DistServe/Splitwise-style pool separation needs one sequence's cache
+# state to MOVE between engines. Because the cache is block-granular,
+# that transfer is a page gather on the source + a page scatter on the
+# destination — never a per-token reshape — and both sides are
+# shape-static over the full ``pages_per_slot`` width (unused entries
+# ride along masked/dropped), so each engine compiles its half exactly
+# once and reuses it for every handoff regardless of sequence length.
+
+
+def gather_slot_pages(cache: PagedKVCache, page_ids):
+    """Gather one slot's pages out of every layer's pool.
+
+    ``page_ids``: (P,) int32 physical page ids — the slot's page-table
+    row, fixed width (entries past the used prefix may repeat a real
+    page; the caller slices the used prefix host-side). Returns
+    ``(k, v, k_scales, v_scales)`` with k/v shaped
+    ``(num_layers, P, page_size, heads, head_dim)`` and scales
+    ``(num_layers, P, page_size, heads)`` (None for float pools)."""
+    k = jnp.take(cache.k_pages, page_ids, axis=1, mode="clip")
+    v = jnp.take(cache.v_pages, page_ids, axis=1, mode="clip")
+    if cache.k_scales is None:
+        return k, v, None, None
+    k_s = jnp.take(cache.k_scales, page_ids, axis=1, mode="clip")
+    v_s = jnp.take(cache.v_scales, page_ids, axis=1, mode="clip")
+    return k, v, k_s, v_s
+
+
+def scatter_slot_pages(cache: PagedKVCache, page_ids, active, slot,
+                       seq_len, k_rows, v_rows, k_srows=None,
+                       v_srows=None):
+    """Splice gathered pages into freshly allocated destination pages.
+
+    ``page_ids``: (P,) int32 destination physical ids; ``active``:
+    (P,) bool — True for the used prefix (inactive entries are routed
+    out of range and dropped, so zero padding never lands in live
+    pages). ``slot``/``seq_len``: scalars — the destination slot's
+    ``seq_lens`` entry is set so the very next decode step appends at
+    the correct position. ``k_rows``/``v_rows`` (and scale rows for
+    int8 pools) are the :func:`gather_slot_pages` outputs, zero-padded
+    to width P. Returns the updated cache; the caller owns writing the
+    destination page-table row (host-side bookkeeping)."""
+    max_pages = cache.k_pages.shape[1]
+    phys = jnp.where(active, page_ids, max_pages + 2 ** 20)
+    k_pages = cache.k_pages.at[:, phys].set(k_rows, mode="drop")
+    v_pages = cache.v_pages.at[:, phys].set(v_rows, mode="drop")
+    seq_lens = cache.seq_lens.at[slot].set(
+        jnp.asarray(seq_len, jnp.int32))
+    if cache.k_scales is None:
+        return cache._replace(k_pages=k_pages, v_pages=v_pages,
+                              seq_lens=seq_lens)
+    k_scales = cache.k_scales.at[:, phys].set(k_srows, mode="drop")
+    v_scales = cache.v_scales.at[:, phys].set(v_srows, mode="drop")
+    return cache._replace(k_pages=k_pages, v_pages=v_pages,
+                          seq_lens=seq_lens, k_scales=k_scales,
+                          v_scales=v_scales)
+
+
+# Handoff blob: a host-side dict holding one sequence's cache rows plus
+# the decode-resume state. Array fields (below) are np arrays sliced to
+# the used page count; everything else is plain scalars, so the wire
+# codec round-trips through JSON for the HTTP hop.
+HANDOFF_VERSION = 1
+_WIRE_ARRAYS = ("k", "v", "k_scales", "v_scales")
+
+
+def _arr_to_wire(a) -> dict:
+    a = np.ascontiguousarray(a)
+    return {"shape": list(a.shape), "dtype": a.dtype.name,
+            "data": base64.b64encode(a.tobytes()).decode("ascii")}
+
+
+def _arr_from_wire(w):
+    a = np.frombuffer(base64.b64decode(w["data"]),
+                      dtype=np.dtype(str(w["dtype"])))
+    return a.reshape([int(d) for d in w["shape"]]).copy()
+
+
+def handoff_to_wire(blob: dict) -> dict:
+    """JSON-safe encoding of a handoff blob: arrays become
+    ``{shape, dtype, data: base64}`` (bfloat16 rides through ml_dtypes'
+    registered np dtype; int8 pages keep their ~3.7x size edge on the
+    wire)."""
+    wire = {k: v for k, v in blob.items() if k not in _WIRE_ARRAYS}
+    for name in _WIRE_ARRAYS:
+        a = blob.get(name)
+        wire[name] = None if a is None else _arr_to_wire(a)
+    return wire
+
+
+def handoff_from_wire(wire: dict) -> dict:
+    """Inverse of :func:`handoff_to_wire` — bit-exact array restore."""
+    blob = {k: v for k, v in wire.items() if k not in _WIRE_ARRAYS}
+    for name in _WIRE_ARRAYS:
+        w = wire.get(name)
+        blob[name] = None if w is None else _arr_from_wire(w)
+    return blob
+
+
+def handoff_nbytes(blob: dict) -> int:
+    """Payload size of the blob's array fields (wire-cost metric)."""
+    return sum(int(blob[n].nbytes) for n in _WIRE_ARRAYS
+               if blob.get(n) is not None)
 
 
 class PageAllocator:

@@ -643,7 +643,8 @@ class _GenEntry:
 
     __slots__ = ("ids", "max_new", "temperature", "eos_id", "future",
                  "t_enq", "t_enq_wall", "trace", "slot", "tokens",
-                 "t_first", "prefilling")
+                 "t_first", "prefilling", "handoff", "blob",
+                 "prompt_len")
 
     def __init__(self, ids, max_new, temperature, eos_id):
         self.ids = ids
@@ -658,6 +659,15 @@ class _GenEntry:
         self.tokens: "list[int]" = []
         self.t_first = 0.0  # monotonic time of the first token
         self.prefilling = False  # admitted, prompt not fully cached
+        # disaggregation: None = ordinary request; "out" = prefill
+        # side (future resolves to a handoff blob at first token);
+        # "in" = decode side (admitted from ``blob``, no prefill)
+        self.handoff = None
+        self.blob = None
+        # page-accounting length: the prompt length, or — for a
+        # handoff-in entry that never sees the prompt — the blob's
+        # cached position
+        self.prompt_len = len(ids)
 
 
 class ContinuousBatcher:
@@ -796,7 +806,30 @@ class ContinuousBatcher:
                     break
             time.sleep(0.005)
         with self._cond:
-            return not self._active
+            drained = not self._active
+            owned = {e.slot for e in self._active}
+        # page-leak audit (disaggregated serving): a sequence whose
+        # handoff was in flight when we started draining may hold a
+        # claimed slot no entry owns — e.g. the decode-side splice
+        # failed after its entry was failed back to the router.
+        # Reclaim such orphans and count the pages; in a correct
+        # handoff flow this counter stays at exactly 0 (the smoke
+        # asserts it), because export reclaims prefill-side pages
+        # the moment the blob exists and a rejected blob is refunded
+        # before any allocation.
+        before = self.engine.free_pages
+        orphans = [s for s in range(self.engine.max_slots)
+                   if s not in self.engine.free_slots
+                   and s not in owned]
+        for s in orphans:
+            self.engine.release(s)
+        obs.counter(
+            "zoo_tpu_serving_gen_handoff_pages_leaked",
+            help="pages the drain audit reclaimed from slots no "
+                 "request owned (0 = exact pool refill)"
+        ).inc(self.engine.free_pages - before)
+        self._pages_gauge().set(self.engine.free_pages)
+        return drained
 
     # -- admission ----------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int = 32,
@@ -814,6 +847,10 @@ class ContinuousBatcher:
                 f"prompt length {len(ids)} outside [1, "
                 f"{self.engine.max_context - 1}] for this cache")
         entry = _GenEntry(ids, max_new, float(temperature), eos_id)
+        self._enqueue(entry)
+        return entry.future
+
+    def _enqueue(self, entry: "_GenEntry"):
         with self._cond:
             if self._draining or self._stop:
                 raise RuntimeError(
@@ -827,6 +864,55 @@ class ContinuousBatcher:
             self._q.append(entry)
             self._depth_gauge().set(len(self._q))
             self._cond.notify_all()
+
+    def submit_prefill(self, prompt_ids, max_new_tokens: int = 32,
+                       temperature: float = 0.0) -> "Future":
+        """Prefill-pool admission (disaggregated serving): the prompt
+        runs through the normal whole-prompt or chunked prefill path,
+        but at the first sampled token the slot's cache state is
+        exported and its pages reclaimed — the future resolves to a
+        handoff blob (`ops/kv_cache.export`), not tokens. ``max_new``
+        rides along in the reservation so admission applies the same
+        worst-case page gate a monolithic engine would."""
+        ids = [int(t) for t in prompt_ids]
+        max_new = min(int(max_new_tokens), self.max_new_cap)
+        if max_new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if not 1 <= len(ids) <= self.engine.max_context - 1:
+            raise ValueError(
+                f"prompt length {len(ids)} outside [1, "
+                f"{self.engine.max_context - 1}] for this cache")
+        entry = _GenEntry(ids, max_new, float(temperature), None)
+        entry.handoff = "out"
+        self._enqueue(entry)
+        return entry.future
+
+    def submit_handoff(self, blob: dict, max_new_tokens: int = 32,
+                       eos_id=None) -> "Future":
+        """Decode-pool admission (disaggregated serving): claim a
+        slot + pages for a prefilled sequence and splice its shipped
+        KV pages in — no forward pass. The future resolves to the
+        FULL new-token stream (the blob's first token included), so
+        the router's caller sees exactly the monolithic result.
+        Raises ValueError for a blob this engine can never hold
+        (geometry/dtype mismatch — a client error, not a retry)."""
+        max_new = min(int(max_new_tokens), self.max_new_cap)
+        if max_new < 2:
+            raise ValueError(
+                "handoff admission needs max_new_tokens >= 2 "
+                "(the first token was already sampled at prefill)")
+        self.engine._check_handoff_blob(blob)
+        entry = _GenEntry([], max_new,
+                          float(blob.get("temperature", 0.0)),
+                          eos_id)
+        entry.handoff = "in"
+        entry.blob = blob
+        entry.prompt_len = int(blob["seq_len"])
+        # the prefill side already emitted token 1 — seed it so the
+        # done/budget arithmetic and the resolved stream match the
+        # monolithic engine byte-for-byte
+        entry.tokens = [int(blob["last_token"])]
+        self._enqueue(entry)
         return entry.future
 
     # -- the decode loop ----------------------------------------------------
@@ -839,6 +925,63 @@ class ContinuousBatcher:
         tracing.record_span(e.trace, "decode/retire", e.t_enq_wall,
                             dur, slot=e.slot, tokens=len(e.tokens))
         e.future.set_result(np.asarray(e.tokens, np.int32))
+
+    def _finish_handoff_out(self, e: "_GenEntry", now: float):
+        """Prefill-side retirement: export the slot's cache state
+        (which reclaims its pages immediately) and resolve the future
+        with the blob. The entry never joins the decode set."""
+        with obs.span("decode/handoff_export", slot=e.slot):
+            blob = self.engine.export_handoff(e.slot)
+        obs.counter(
+            "zoo_tpu_serving_gen_handoffs_total",
+            help="KV-page handoffs between prefill and decode pools",
+            labels={"direction": "out"}).inc()
+        dur = now - e.t_enq
+        self._ema_req_s = 0.8 * self._ema_req_s + 0.2 * dur
+        tracing.record_span(e.trace, "decode/handoff_export",
+                            e.t_enq_wall, dur, slot=e.slot,
+                            seq_len=blob["seq_len"])
+        e.future.set_result(blob)
+
+    def _admit_handoffs(self, entries, done):
+        """Decode-side admission: splice each blob into the engine —
+        no forward pass — and join the active set. A failed splice
+        fails only its own entry (the router refunds the blob to a
+        sibling); the engine validates before allocating, so a
+        rejected blob leaves the pool intact."""
+        engine = self.engine
+        for e in entries:
+            try:
+                with obs.span("decode/handoff_admit"):
+                    slot = engine.admit_from_handoff(e.blob,
+                                                     e.max_new)
+            except Exception as exc:
+                _fail_entry(e, exc)
+                continue
+            now = time.monotonic()
+            e.slot = slot
+            e.blob = None  # drop the host copy once spliced
+            obs.histogram(
+                "zoo_tpu_serving_gen_handoff_seconds",
+                help="decode-pool handoff admission latency "
+                     "(blob enqueue to pages spliced)"
+            ).observe(now - e.t_enq)
+            obs.counter(
+                "zoo_tpu_serving_gen_handoffs_total",
+                help="KV-page handoffs between prefill and decode "
+                     "pools", labels={"direction": "in"}).inc()
+            tracing.record_span(e.trace, "decode/handoff_admit",
+                                e.t_enq_wall, now - e.t_enq,
+                                slot=slot, seq_len=e.prompt_len)
+            # the seeded first token may already satisfy the budget
+            # (or be eos — the router normally short-circuits that
+            # case before the hop, but stay defensive)
+            if (e.eos_id is not None
+                    and e.tokens[-1] == e.eos_id) \
+                    or len(e.tokens) >= e.max_new:
+                done.append(e)
+            else:
+                self._active.append(e)
 
     def _token_out(self, e: "_GenEntry", tok: int, now: float
                    ) -> bool:
@@ -865,7 +1008,7 @@ class ContinuousBatcher:
         pages = self.engine.free_pages
         while self._q and slots > 0:
             e = self._q[0]
-            need = self.engine.pages_for(len(e.ids), e.max_new)
+            need = self.engine.pages_for(e.prompt_len, e.max_new)
             if need > pages:
                 break
             take.append(self._q.popleft())
@@ -885,8 +1028,8 @@ class ContinuousBatcher:
         max_context)`` rows. Ineligible slots fall back to regular
         one-token steps in the same iteration."""
         k = self.engine.spec_k
-        consumed_after = len(e.ids) + len(e.tokens) - 1 + k
-        budget = min(len(e.ids) + e.max_new,
+        consumed_after = e.prompt_len + len(e.tokens) - 1 + k
+        budget = min(e.prompt_len + e.max_new,
                      self.engine.max_context)
         return consumed_after <= budget
 
@@ -926,9 +1069,20 @@ class ContinuousBatcher:
                         for slot, tok in firsts:
                             e = by_slot[slot]
                             e.prefilling = False
-                            if self._token_out(e, tok, t):
+                            if e.handoff == "out":
+                                self._token_out(e, tok, t)
+                                self._active.remove(e)
+                                self._finish_handoff_out(e, t)
+                            elif self._token_out(e, tok, t):
                                 done.append(e)
                                 self._active.remove(e)
+                if fresh:
+                    hand_in = [e for e in fresh
+                               if e.handoff == "in"]
+                    if hand_in:
+                        fresh = [e for e in fresh
+                                 if e.handoff != "in"]
+                        self._admit_handoffs(hand_in, done)
                 if fresh:
                     # chunked admission only pays off past one
                     # chunk: a prompt that fits in a single chunk
@@ -976,7 +1130,10 @@ class ContinuousBatcher:
                                 e.trace, "decode/admit",
                                 e.t_enq_wall, now - e.t_enq,
                                 slot=slot, prompt_len=len(e.ids))
-                            if self._token_out(e, tok, now):
+                            if e.handoff == "out":
+                                self._token_out(e, tok, now)
+                                self._finish_handoff_out(e, now)
+                            elif self._token_out(e, tok, now):
                                 done.append(e)
                             else:
                                 self._active.append(e)

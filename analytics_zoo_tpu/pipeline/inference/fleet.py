@@ -83,6 +83,7 @@ from analytics_zoo_tpu.common import observability as obs
 from analytics_zoo_tpu.common import tracing
 from analytics_zoo_tpu.common.nncontext import logger
 from analytics_zoo_tpu.pipeline.inference.batching import (
+    ContinuousBatcher,
     DeadlineExpiredError,
     DynamicBatcher,
     QueueFullError,
@@ -104,6 +105,9 @@ __all__ = [
     "FleetSaturatedError",
     "ReplicaUnavailableError",
     "make_fleet_server",
+    "DisaggReplica",
+    "HttpDisaggReplica",
+    "DisaggRouter",
 ]
 
 # replica lifecycle states (fleet_status()/debug surfaces)
@@ -289,6 +293,11 @@ class _ReplicaBase:
         self._clock = clock
         self._lock = threading.Lock()
         self.state = STARTING
+        # what this replica serves: "predict" (the classic fleet),
+        # or a disaggregated generation pool role ("prefill" /
+        # "decode" / "both") — surfaced on /debug/fleet so operators
+        # can see pool imbalance
+        self.role = "predict"
         # model version this replica serves (cohort label; the
         # rollout controller rewrites it across a warm-swap)
         self.version = "v0"
@@ -381,6 +390,7 @@ class _ReplicaBase:
             st = {
                 "name": self.name,
                 "state": self.state,
+                "role": self.role,
                 "version": self.version,
                 "outstanding_rows": self.outstanding_rows,
                 "consecutive_failures": self.consecutive_failures,
@@ -1368,6 +1378,569 @@ class FleetRouter:
     def __repr__(self):
         return (f"FleetRouter(policy={self.policy}, "
                 f"replicas={len(self.pool)})")
+
+
+# -- disaggregated generation serving (prefill/decode pools) -----------------
+
+def _c_handoff_retries():
+    return obs.counter(
+        "zoo_tpu_serving_gen_handoff_retries_total",
+        help="handoffs retried after a pool replica failed "
+             "mid-flight (the blob re-prefills on a sibling)")
+
+
+class DisaggReplica(_ReplicaBase):
+    """One in-process generation replica of a disaggregated pool: a
+    role-specific :class:`GenerationEngine` (``role="prefill"`` or
+    ``"decode"``) plus its OWN :class:`ContinuousBatcher`. The
+    prefill surface returns handoff blobs; the decode surface
+    consumes them (`docs/serving.md` has the topology)."""
+
+    def __init__(self, name: str, engine,
+                 clock: Callable[[], float] = time.monotonic):
+        super().__init__(name, clock)
+        self.engine = engine
+        self.role = getattr(engine, "role", "both")
+        self.batcher = ContinuousBatcher(engine)
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "DisaggReplica":
+        self.batcher.start()
+        self._set_admitting()
+        return self
+
+    def stop(self):
+        self.batcher.stop()
+        with self._lock:
+            self.state = DOWN
+            self.down_reason = "stopped"
+        _g_up(self.name).set(0)
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        with self._lock:
+            if self.state == DOWN:
+                return True
+            self.state = DRAINING
+        _g_up(self.name).set(0)
+        flushed = self.batcher.drain(timeout=timeout)
+        with self._lock:
+            self.state = DRAINED
+        return flushed
+
+    def restart(self) -> "DisaggReplica":
+        self.batcher.start()
+        self._set_admitting()
+        return self
+
+    def probe(self) -> bool:
+        return True  # in-process: alive iff the loop thread is
+
+    # -- generation transport ------------------------------------------------
+    def prefill(self, prompt_ids, max_new: int,
+                temperature: float) -> "Future":
+        """Future resolving to a handoff blob (host dict)."""
+        return self.batcher.submit_prefill(
+            prompt_ids, max_new_tokens=max_new,
+            temperature=temperature)
+
+    def decode(self, blob: dict, max_new: int, eos_id) -> "Future":
+        """Future resolving to the full new-token stream."""
+        return self.batcher.submit_handoff(
+            blob, max_new_tokens=max_new, eos_id=eos_id)
+
+    # -- introspection -------------------------------------------------------
+    def free_pages(self) -> int:
+        return int(self.engine.free_pages)
+
+    def total_pages(self) -> int:
+        return int(self.engine.allocator.max_pages)
+
+    def batcher_stats(self) -> dict:
+        return self.batcher.stats()
+
+    def status(self) -> dict:
+        st = super().status()
+        st["pages_free"] = self.free_pages()
+        st["pages_total"] = self.total_pages()
+        return st
+
+
+class HttpDisaggReplica(_ReplicaBase):
+    """A disaggregated-pool replica in another process behind the
+    standard HTTP front-end: ``prefill`` POSTs ``/generate/prefill``
+    (the handoff blob returns base64-encoded —
+    `ops/kv_cache.handoff_to_wire`), ``decode`` POSTs
+    ``/generate/handoff``. The ambient trace id rides
+    ``X-Zoo-Trace-Id`` on both legs, so one trace spans admission →
+    prefill replica → page hop → decode replica. Page headroom for
+    routing comes from the remote ``/health`` generator block
+    (briefly cached — headroom staleness only costs balance, never
+    correctness)."""
+
+    def __init__(self, url: str, role: str,
+                 name: Optional[str] = None,
+                 timeout_s: float = 60.0, workers: int = 8,
+                 clock: Callable[[], float] = time.monotonic):
+        self.url = url.rstrip("/")
+        if name is None:
+            name = self.url.split("//", 1)[-1].replace(
+                "/", "_").replace(":", "_")
+        super().__init__(name, clock)
+        if role not in ("prefill", "decode", "both"):
+            raise ValueError(f"bad pool role {role!r}")
+        self.role = role
+        self.timeout_s = float(timeout_s)
+        self._workers = int(workers)
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._pages_cache = (0.0, 0, 0)  # (stamp, free, total)
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "HttpDisaggReplica":
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=self._workers,
+                thread_name_prefix=f"zoo-disagg-{self.name}")
+        self._set_admitting()
+        return self
+
+    def stop(self):
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+        with self._lock:
+            self.state = DOWN
+            self.down_reason = "stopped"
+        _g_up(self.name).set(0)
+
+    def restart(self) -> "HttpDisaggReplica":
+        return self.start()
+
+    # -- transport -----------------------------------------------------------
+    def _post(self, path: str, payload: dict, ctx):
+        import urllib.error
+        import urllib.request
+        body = json.dumps(payload).encode()
+        req = urllib.request.Request(
+            self.url + path, data=body,
+            headers={"Content-Type": "application/json"})
+        if ctx is not None:
+            req.add_header(tracing.TRACE_HEADER, ctx[0])
+        t0 = time.time()
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=self.timeout_s) as resp:
+                out = json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            detail = {}
+            try:
+                detail = json.loads(e.read()).get("error", {})
+            except (ValueError, OSError):
+                pass
+            if e.code == 503:
+                raise QueueFullError(
+                    0, float(detail.get("retry_after_s", 1.0)))
+            if e.code == 400:
+                raise ValueError(detail.get("message", "bad request"))
+            raise RuntimeError(
+                f"replica {self.name} HTTP {e.code}: "
+                f"{detail.get('message', '')}")
+        tracing.record_span(ctx, "fleet/remote_generate", t0,
+                            time.time() - t0, replica=self.name,
+                            path=path)
+        return out
+
+    def prefill(self, prompt_ids, max_new: int,
+                temperature: float) -> "Future":
+        from analytics_zoo_tpu.ops.kv_cache import handoff_from_wire
+        ctx = tracing.current()
+
+        def run():
+            out = self._post("/generate/prefill", {
+                "prompt": [int(t) for t in prompt_ids],
+                "max_new_tokens": int(max_new),
+                "temperature": float(temperature)}, ctx)
+            return handoff_from_wire(out["handoff"])
+
+        return self._pool.submit(run)
+
+    def decode(self, blob: dict, max_new: int, eos_id) -> "Future":
+        from analytics_zoo_tpu.ops.kv_cache import handoff_to_wire
+        ctx = tracing.current()
+
+        def run():
+            out = self._post("/generate/handoff", {
+                "handoff": handoff_to_wire(blob),
+                "max_new_tokens": int(max_new),
+                "eos_id": eos_id}, ctx)
+            return np.asarray(out["tokens"], np.int32)
+
+        return self._pool.submit(run)
+
+    def probe(self) -> bool:
+        import urllib.request
+        try:
+            with urllib.request.urlopen(
+                    self.url + "/health", timeout=5.0) as resp:
+                return json.loads(
+                    resp.read()).get("status") == "ok"
+        except Exception:
+            return False
+
+    # -- introspection -------------------------------------------------------
+    def _pages(self) -> "tuple[int, int]":
+        import urllib.request
+        now = time.monotonic()
+        stamp, free, total = self._pages_cache
+        if now - stamp < 0.5:
+            return free, total
+        try:
+            with urllib.request.urlopen(
+                    self.url + "/health", timeout=5.0) as resp:
+                gen = json.loads(resp.read()).get("generator") or {}
+            free = int(gen.get("free_pages", 0))
+            total = int(gen.get("total_pages", 0))
+        except Exception:
+            free, total = 0, 0  # unknown: route elsewhere first
+        self._pages_cache = (now, free, total)
+        return free, total
+
+    def free_pages(self) -> int:
+        return self._pages()[0]
+
+    def total_pages(self) -> int:
+        return self._pages()[1]
+
+    def batcher_stats(self) -> dict:
+        return {"enabled": False, "remote": self.url}
+
+    def status(self) -> dict:
+        st = super().status()
+        free, total = self._pages()
+        st["pages_free"] = free
+        st["pages_total"] = total
+        return st
+
+
+class DisaggRouter:
+    """``/generate`` front door for a disaggregated fleet (DistServe/
+    Splitwise prefill–decode separation): admission goes to the
+    least-loaded **prefill** replica, which runs the prompt to its
+    first token and exports a KV-page handoff blob; the router ships
+    the blob — in-process dict or base64 pages over HTTP — to the
+    **decode** replica with the most free pages, whose future
+    resolves the full token stream. Compute-bound prefill and
+    bandwidth-bound decode each scale on their own bottleneck
+    (capacity = pages).
+
+    Duck-types the gen-batcher surface (``submit`` / ``stats`` /
+    ``start`` / ``stop``), so the HTTP front-ends mount it as
+    ``gen_batcher`` unchanged; :func:`serving._resolve_gen_batcher`
+    builds one automatically when ``ZOO_TPU_DISAGG`` is set.
+
+    **Exactly-once.** The router-level future resolves once. A
+    replica dying mid-handoff fails only its leg: the blob is
+    dropped (its pages were already reclaimed at export) and the
+    request re-prefills from the original prompt on a surviving
+    replica — greedy decoding is deterministic, so a retried stream
+    is byte-identical and acked tokens are never lost or reordered.
+    """
+
+    def __init__(self, prefill_replicas, decode_replicas, *,
+                 max_retries: Optional[int] = None,
+                 request_timeout_s: Optional[float] = None,
+                 eject_after: int = 1):
+        self.prefill = list(prefill_replicas)
+        self.decode = list(decode_replicas)
+        if not self.prefill or not self.decode:
+            raise ValueError(
+                "DisaggRouter needs >= 1 prefill and >= 1 decode "
+                "replica")
+        self.max_retries = (
+            max_retries if max_retries is not None
+            else _env_int("ZOO_TPU_FLEET_MAX_RETRIES", 2))
+        self.request_timeout_s = (
+            request_timeout_s if request_timeout_s is not None
+            else _env_float("ZOO_TPU_DISAGG_TIMEOUT_S", 120.0))
+        self.eject_after = max(1, int(eject_after))
+        self._clock = time.monotonic
+        self._pool: Optional[ThreadPoolExecutor] = None
+
+    @classmethod
+    def for_engine(cls, engine,
+                   n_prefill: Optional[int] = None,
+                   n_decode: Optional[int] = None,
+                   **kwargs) -> "DisaggRouter":
+        """Carve an in-process disaggregated fleet out of one
+        template engine: ``n_prefill`` role-"prefill" engines and
+        ``n_decode`` role-"decode" engines sharing the template's
+        net/params and cache geometry (pool sizes default to
+        ``ZOO_TPU_DISAGG_PREFILL_REPLICAS`` /
+        ``ZOO_TPU_DISAGG_DECODE_REPLICAS``, both 1). The template
+        itself is not used — each pool engine owns its own cache."""
+        from analytics_zoo_tpu.pipeline.inference.generation import \
+            GenerationEngine
+        if getattr(engine, "spec_k", 0) > 0:
+            raise ValueError(
+                "speculative decoding is incompatible with "
+                "disaggregated pools (unset ZOO_TPU_SPEC_K or "
+                "ZOO_TPU_DISAGG)")
+        if n_prefill is None:
+            n_prefill = _env_int("ZOO_TPU_DISAGG_PREFILL_REPLICAS",
+                                 1)
+        if n_decode is None:
+            n_decode = _env_int("ZOO_TPU_DISAGG_DECODE_REPLICAS", 1)
+
+        def make(role, i):
+            eng = GenerationEngine(
+                engine.net, engine.params,
+                max_slots=engine.max_slots,
+                max_context=engine.max_context,
+                page_size=engine.page_size,
+                top_k=engine.top_k,
+                cache_dtype=engine.cache_dtype,
+                prefill_chunk=(engine.prefill_chunk
+                               if role == "prefill" else 0),
+                role=role)
+            return DisaggReplica(f"{role}{i}", eng)
+
+        return cls([make("prefill", i) for i in range(n_prefill)],
+                   [make("decode", i) for i in range(n_decode)],
+                   **kwargs)
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "DisaggRouter":
+        for r in self.prefill + self.decode:
+            r.start()
+        if self._pool is None:
+            # each in-flight request parks one worker on a pool
+            # future; size well past total decode slots so the
+            # router never queues ahead of the pools' own admission
+            workers = 8 * (len(self.prefill) + len(self.decode))
+            self._pool = ThreadPoolExecutor(
+                max_workers=max(32, workers),
+                thread_name_prefix="zoo-disagg-router")
+        _g_size().set(len(self.prefill) + len(self.decode))
+        self._refresh_gauges()
+        return self
+
+    def stop(self):
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+        for r in self.prefill + self.decode:
+            try:
+                r.stop()
+            except Exception as e:
+                logger.warning("disagg: stopping %s failed: %s",
+                               r.name, e)
+        self._refresh_gauges()
+
+    def _refresh_gauges(self):
+        _g_admitting().set(sum(
+            1 for r in self.prefill + self.decode
+            if r.admitting()))
+
+    # -- request path --------------------------------------------------------
+    def submit(self, prompt_ids, max_new_tokens: int = 32,
+               temperature: float = 0.0, eos_id=None) -> "Future":
+        """Gen-batcher surface: future resolves to the 1-D int32
+        array of newly generated tokens, byte-identical (greedy) to
+        a monolithic engine's stream."""
+        ids = [int(t) for t in prompt_ids]
+        _c_requests().inc()
+        fut: "Future" = Future()
+        ctx = tracing.current()
+        self._pool.submit(self._run_request, ids,
+                          int(max_new_tokens), float(temperature),
+                          eos_id, fut, ctx)
+        return fut
+
+    def _pick_prefill(self, exclude: set):
+        cands = [r for r in self.prefill
+                 if r.admitting() and r.name not in exclude]
+        if not cands:
+            return None
+        return min(cands, key=lambda r: r.outstanding_rows)
+
+    def _pick_decode(self, exclude: set):
+        cands = [r for r in self.decode
+                 if r.admitting() and r.name not in exclude]
+        if not cands:
+            return None
+        # page headroom is the decode pool's capacity currency
+        return max(cands, key=lambda r: r.free_pages())
+
+    def _note_failure(self, r, exc):
+        fails = r.note_failure()
+        _c_replica_errors(r.name).inc()
+        logger.warning("disagg: %s leg on %s failed (%s: %s)",
+                       r.role, r.name, type(exc).__name__, exc)
+        if fails >= self.eject_after and r.admitting():
+            r.mark_down(f"{type(exc).__name__}: {exc}",
+                        now=self._clock())
+            self._refresh_gauges()
+
+    def _run_request(self, ids, max_new, temperature, eos_id, fut,
+                     ctx):
+        with tracing.activate(ctx):
+            try:
+                toks = self._generate_once(ids, max_new,
+                                           temperature, eos_id,
+                                           ctx)
+            except Exception as exc:
+                _c_failed().inc()
+                FleetRouter._fail(fut, exc)
+                return
+        FleetRouter._resolve(fut, toks)
+
+    def _generate_once(self, ids, max_new, temperature, eos_id,
+                       ctx):
+        bad_p: set = set()
+        bad_d: set = set()
+        busy_hints: "list[float]" = []
+        last_exc: Optional[Exception] = None
+        for attempt in range(self.max_retries + 1):
+            if attempt:
+                _c_retries().inc()
+                _c_handoff_retries().inc()
+            # leg 1: prefill to first token + handoff blob
+            p = self._pick_prefill(bad_p)
+            if p is None:
+                break
+            t0 = time.time()
+            try:
+                with obs.span("fleet/prefill_dispatch",
+                              replica=p.name, attempt=attempt):
+                    p.note_dispatch(1)
+                    try:
+                        blob = p.prefill(
+                            ids, max_new, temperature).result(
+                            self.request_timeout_s)
+                    finally:
+                        p.note_done(1)
+                p.note_success()
+                _h_replica_latency(p.name).observe(
+                    time.time() - t0)
+            except QueueFullError as e:
+                busy_hints.append(e.retry_after_s)
+                bad_p.add(p.name)  # full, not dead: just skip it
+                continue
+            except ValueError:
+                raise  # client error: no retry can fix the request
+            except Exception as e:
+                last_exc = e
+                bad_p.add(p.name)
+                self._note_failure(p, e)
+                continue
+            first = int(blob["last_token"])
+            if ((eos_id is not None and first == eos_id)
+                    or max_new <= 1):
+                # done at prefill: no pages to ship, no decode leg
+                return np.asarray([first], np.int32)
+            # leg 2: ship the pages, resume decode
+            d = self._pick_decode(bad_d)
+            if d is None:
+                break
+            t0 = time.time()
+            try:
+                with obs.span("fleet/handoff", replica=d.name,
+                              attempt=attempt,
+                              seq_len=blob["seq_len"]):
+                    d.note_dispatch(1)
+                    try:
+                        toks = d.decode(
+                            blob, max_new, eos_id).result(
+                            self.request_timeout_s)
+                    finally:
+                        d.note_done(1)
+                d.note_success()
+                _h_replica_latency(d.name).observe(
+                    time.time() - t0)
+                return np.asarray(toks, np.int32)
+            except QueueFullError as e:
+                busy_hints.append(e.retry_after_s)
+                bad_d.add(d.name)
+                continue  # blob dropped; re-prefill on a sibling
+            except ValueError:
+                raise
+            except Exception as e:
+                # mid-handoff death: the blob dies with the leg
+                # (prefill-side pages were reclaimed at export, so
+                # nothing leaks) and the request re-prefills from
+                # the original prompt — acked tokens only ever come
+                # from a future that resolved, exactly once
+                last_exc = e
+                bad_d.add(d.name)
+                self._note_failure(d, e)
+                continue
+        _c_failed().inc()
+        if last_exc is not None:
+            raise last_exc
+        if busy_hints:
+            _c_saturated().inc()
+            raise FleetSaturatedError(len(busy_hints),
+                                      min(busy_hints))
+        raise ReplicaUnavailableError(1.0)
+
+    # -- drain / introspection ----------------------------------------------
+    def drain(self, timeout: float = 30.0) -> bool:
+        ok = True
+        for r in self.prefill + self.decode:
+            if hasattr(r, "drain"):
+                ok = r.drain(timeout=timeout) and ok
+        self._refresh_gauges()
+        return ok
+
+    def _pool_block(self, replicas) -> dict:
+        return {
+            "replicas": len(replicas),
+            "admitting": sum(1 for r in replicas
+                             if r.admitting()),
+            "pages_free": sum(r.free_pages() for r in replicas),
+            "pages_total": sum(r.total_pages() for r in replicas),
+        }
+
+    def stats(self) -> dict:
+        """``/health`` "generator" block: per-pool page headroom +
+        per-replica batcher state."""
+        out = {
+            "enabled": True,
+            "disagg": True,
+            "pools": {
+                "prefill": self._pool_block(self.prefill),
+                "decode": self._pool_block(self.decode),
+            },
+            "per_replica": {
+                r.name: r.batcher_stats()
+                for r in self.prefill + self.decode},
+        }
+        depth = sum(
+            p.get("queue_depth", 0)
+            for p in out["per_replica"].values()
+            if isinstance(p, dict))
+        out["queue_depth"] = depth
+        return out
+
+    def fleet_status(self) -> dict:
+        """``GET /debug/fleet`` payload for a disaggregated fleet:
+        role-tagged replicas + per-pool page headroom."""
+        return {
+            "disagg": True,
+            "max_retries": self.max_retries,
+            "replicas_admitting": sum(
+                1 for r in self.prefill + self.decode
+                if r.admitting()),
+            "pools": {
+                "prefill": self._pool_block(self.prefill),
+                "decode": self._pool_block(self.decode),
+            },
+            "replicas": [r.status()
+                         for r in self.prefill + self.decode],
+        }
+
+    def __repr__(self):
+        return (f"DisaggRouter(prefill={len(self.prefill)}, "
+                f"decode={len(self.decode)})")
 
 
 def make_fleet_server(pool_or_router, port: int = 0,

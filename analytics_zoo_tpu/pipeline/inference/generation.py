@@ -135,7 +135,8 @@ class GenerationEngine:
                  prefill_chunk: Optional[int] = None,
                  spec_k: Optional[int] = None,
                  drafter=None, drafter_params=None,
-                 rng_seed: int = 0):
+                 rng_seed: int = 0,
+                 role: str = "both"):
         import jax
 
         env = os.environ
@@ -166,10 +167,21 @@ class GenerationEngine:
         self.spec_k = max(0, int(spec_k))
         self.drafter = drafter
         self.drafter_params = drafter_params
+        if role not in ("prefill", "decode", "both"):
+            raise ValueError(
+                f"role {role!r} not one of 'prefill'/'decode'/'both'")
+        self.role = role
         if self.spec_k > 0 and drafter is None:
             raise ValueError(
                 "spec_k > 0 needs a drafter net (load_generator"
                 "(..., drafter=..., drafter_params=...))")
+        if self.spec_k > 0 and role != "both":
+            # the drafter's cache state cannot be reconstructed from
+            # a handoff blob without re-running its forward pass, so
+            # speculation stays a monolithic-engine lever
+            raise ValueError(
+                "speculative decoding (spec_k > 0) is incompatible "
+                "with disaggregated roles; use role='both'")
         if self.spec_k > 1_000:
             raise ValueError(f"spec_k {self.spec_k} is absurd")
 
@@ -238,6 +250,8 @@ class GenerationEngine:
         self._compiled_draft_chunk = None
         self._compiled_draft = None
         self._compiled_verify = None
+        self._compiled_handoff_export = None
+        self._compiled_handoff_import = None
         self._gen_jits: dict = {}
 
     # -- compiled programs --------------------------------------------------
@@ -501,6 +515,61 @@ class GenerationEngine:
                 self._verify_fn, structs, "verify", donate=(0, 1))
         return self._compiled_verify
 
+    def _handoff_export_fn(self, cache, page_ids):
+        from analytics_zoo_tpu.ops import kv_cache as kvc
+        return kvc.gather_slot_pages(cache, page_ids)
+
+    def _handoff_import_fn(self, cache, page_ids, active, slot,
+                           seq_len, k_rows, v_rows, k_srows,
+                           v_srows):
+        from analytics_zoo_tpu.ops import kv_cache as kvc
+        return kvc.scatter_slot_pages(cache, page_ids, active, slot,
+                                      seq_len, k_rows, v_rows,
+                                      k_srows, v_srows)
+
+    def _handoff_row_structs(self):
+        """(k/v rows, scale rows) ShapeDtypeStructs at the FIXED
+        handoff width ``pages_per_slot`` — both handoff programs are
+        shape-static over the full width (unused entries masked/
+        dropped), so each compiles exactly once per engine."""
+        lyr, _, page, h, d = self.cache.k_pages.shape
+        p = self.pages_per_slot
+        rows = self._shape(lyr, p, page, h, d,
+                           dtype=self.cache.k_pages.dtype)
+        if self.cache.k_scales is None:
+            return rows, None
+        return rows, self._shape(lyr, p, page, h, dtype=np.float32)
+
+    def _get_handoff_export(self):
+        if self._compiled_handoff_export is None:
+            structs = (
+                self._abstract(self.cache),
+                self._shape(self.pages_per_slot),
+            )
+            # read-only: the cache must survive the export (the
+            # prefill engine keeps serving other slots), so nothing
+            # is donated
+            self._compiled_handoff_export = self._compile(
+                self._handoff_export_fn, structs, "handoff_export",
+                donate=())
+        return self._compiled_handoff_export
+
+    def _get_handoff_import(self):
+        if self._compiled_handoff_import is None:
+            p = self.pages_per_slot
+            rows, srows = self._handoff_row_structs()
+            structs = (
+                self._abstract(self.cache),
+                self._shape(p),
+                self._shape(p, dtype=np.bool_),
+                self._shape(),
+                self._shape(),
+                rows, rows, srows, srows,
+            )
+            self._compiled_handoff_import = self._compile(
+                self._handoff_import_fn, structs, "handoff_import")
+        return self._compiled_handoff_import
+
     def _warmed(self) -> int:
         return (bool(self._compiled_step)
                 + len(self._compiled_prefill)
@@ -508,7 +577,9 @@ class GenerationEngine:
                 + len(self._compiled_draft_prefill)
                 + bool(self._compiled_draft_chunk)
                 + bool(self._compiled_draft)
-                + bool(self._compiled_verify))
+                + bool(self._compiled_verify)
+                + bool(self._compiled_handoff_export)
+                + bool(self._compiled_handoff_import))
 
     def warm(self) -> int:
         """AOT-compile every program steady-state serving can need —
@@ -519,11 +590,22 @@ class GenerationEngine:
         bucket-warm discipline). Returns the number of programs
         compiled this call. Idempotent."""
         n0 = self._warmed()
-        self._get_step()
-        for tp in self.prompt_buckets:
-            self._get_prefill(tp)
-        if self.prefill_chunk > 0:
-            self._get_chunk()
+        # role-gated: a prefill-pool engine never decodes (its only
+        # steady-state programs are prefill/chunk + handoff export);
+        # a decode-pool engine never sees a raw prompt (step + handoff
+        # import). Monolithic "both" engines skip the handoff pair —
+        # they never hand off, so they never pay those compiles.
+        if self.role != "prefill":
+            self._get_step()
+        if self.role != "decode":
+            for tp in self.prompt_buckets:
+                self._get_prefill(tp)
+            if self.prefill_chunk > 0:
+                self._get_chunk()
+        if self.role == "prefill":
+            self._get_handoff_export()
+        if self.role == "decode":
+            self._get_handoff_import()
         if self.spec_k > 0 and self.drafter is not None:
             self._get_draft()
             self._get_verify()
@@ -780,6 +862,127 @@ class GenerationEngine:
             self.allocator.free(pages)
         self.free_slots.add(slot)
 
+    # -- prefill/decode handoff ---------------------------------------------
+    def export_handoff(self, slot: int) -> dict:
+        """Extract an active slot's cache state into a handoff blob
+        and retire the slot (pages reclaimed immediately — the
+        prefill pool's capacity frees the moment the blob exists;
+        exactly-once on a lost blob is the router's job, via
+        re-prefill from the original prompt). The blob carries the
+        used pages of every layer (int8 scales included), the
+        position, the last sampled token, and the slot's sampling
+        temperature — everything :meth:`admit_from_handoff` needs to
+        resume decode token-exactly with NO forward pass."""
+        import jax
+        from analytics_zoo_tpu.ops import kv_cache as kvc
+        if slot in self._pending_prompts:
+            raise ValueError(
+                f"slot {slot} is still mid-chunked-prefill")
+        if slot in self.free_slots:
+            raise ValueError(f"slot {slot} is not active")
+        seq_len = int(np.asarray(self.cache.seq_lens)[slot])
+        if seq_len <= 0:
+            raise ValueError(f"slot {slot} has no cached tokens")
+        n_used = kvc.PageAllocator.pages_needed(seq_len,
+                                                self.page_size)
+        fn = self._get_handoff_export()
+        k, v, k_s, v_s = fn(self.cache,
+                            jax.numpy.asarray(self._table[slot]))
+        blob = {
+            "version": kvc.HANDOFF_VERSION,
+            "seq_len": seq_len,
+            "page_size": self.page_size,
+            "kv_dtype": np.dtype(self.cache.k_pages.dtype).name,
+            "num_layers": int(self.cache.k_pages.shape[0]),
+            "heads": int(self.cache.k_pages.shape[3]),
+            "head_dim": int(self.cache.k_pages.shape[4]),
+            "last_token": int(self._last_tok[slot]),
+            "temperature": float(self._temps[slot]),
+            "k": np.asarray(k)[:, :n_used].copy(),
+            "v": np.asarray(v)[:, :n_used].copy(),
+            "k_scales": (None if k_s is None
+                         else np.asarray(k_s)[:, :n_used].copy()),
+            "v_scales": (None if v_s is None
+                         else np.asarray(v_s)[:, :n_used].copy()),
+        }
+        self.release(slot)
+        return blob
+
+    def _check_handoff_blob(self, blob: dict):
+        from analytics_zoo_tpu.ops import kv_cache as kvc
+        if int(blob.get("version", -1)) != kvc.HANDOFF_VERSION:
+            raise ValueError(
+                f"handoff version {blob.get('version')!r} != "
+                f"{kvc.HANDOFF_VERSION}")
+        mine = {
+            "page_size": self.page_size,
+            "kv_dtype": np.dtype(self.cache.k_pages.dtype).name,
+            "num_layers": int(self.cache.k_pages.shape[0]),
+            "heads": int(self.cache.k_pages.shape[3]),
+            "head_dim": int(self.cache.k_pages.shape[4]),
+        }
+        for key, want in mine.items():
+            if blob.get(key) != want:
+                raise ValueError(
+                    f"handoff {key} mismatch: blob has "
+                    f"{blob.get(key)!r}, engine has {want!r}")
+        seq_len = int(blob["seq_len"])
+        if not 1 <= seq_len <= self.max_context - 1:
+            raise ValueError(
+                f"handoff seq_len {seq_len} outside [1, "
+                f"{self.max_context - 1}]")
+
+    def admit_from_handoff(self, blob: dict, max_new: int) -> int:
+        """Splice a handoff blob into this engine: claim a slot +
+        pages (the same worst-case reservation :meth:`admit` makes,
+        with the blob's position standing in for the prompt length),
+        scatter the shipped pages into the freshly allocated physical
+        pages, and restore the resume state — NO forward pass runs.
+        The very next :meth:`step` with this slot active appends the
+        blob's ``last_token`` and continues the stream token-exactly.
+        Validation happens before any allocation, so a rejected blob
+        leaves the engine untouched (the router refunds it to a
+        sibling). Returns the claimed slot."""
+        import jax
+        from analytics_zoo_tpu.ops import kv_cache as kvc
+        self._check_handoff_blob(blob)
+        seq_len = int(blob["seq_len"])
+        n_used = kvc.PageAllocator.pages_needed(seq_len,
+                                                self.page_size)
+        need = kvc.PageAllocator.pages_needed(
+            min(seq_len + int(max_new), self.max_context),
+            self.page_size)
+        if not self.free_slots:
+            raise MemoryError("no free decode slot")
+        pages = self.allocator.alloc(need)  # MemoryError if short
+        slot = min(self.free_slots)
+        self.free_slots.discard(slot)
+        self._slot_pages[slot] = pages
+        row = np.full((self.pages_per_slot,), pages[-1], np.int32)
+        row[:need] = pages
+        self._table[slot] = row
+        self._temps[slot] = float(blob["temperature"])
+        self._push_table()
+        p = self.pages_per_slot
+        active = np.zeros((p,), np.bool_)
+        active[:n_used] = True
+
+        def pad(a):
+            if a is None:
+                return None
+            out = np.zeros((a.shape[0], p) + a.shape[2:], a.dtype)
+            out[:, :n_used] = a
+            return out
+
+        fn = self._get_handoff_import()
+        self.cache = fn(self.cache, jax.numpy.asarray(row), active,
+                        np.int32(slot), np.int32(seq_len),
+                        pad(blob["k"]), pad(blob["v"]),
+                        pad(blob["k_scales"]),
+                        pad(blob["v_scales"]))
+        self._last_tok[slot] = int(blob["last_token"])
+        return slot
+
     @property
     def slots_active(self) -> int:
         return self.max_slots - len(self.free_slots)
@@ -835,6 +1038,7 @@ class GenerationEngine:
     def stats(self) -> dict:
         """JSON-able summary for ``GET /health``."""
         out = {
+            "role": self.role,
             "max_slots": self.max_slots,
             "slots_active": self.slots_active,
             "max_context": self.max_context,

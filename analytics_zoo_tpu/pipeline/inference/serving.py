@@ -213,7 +213,8 @@ def handle_generate(model: InferenceModel, body: bytes,
             400, 'request must be a JSON object with exactly one of '
             '"prompt" (one token-id list) or "prompts" (a list of '
             'them)')
-    if getattr(model, "generator", None) is None:
+    if gen_batcher is None and \
+            getattr(model, "generator", None) is None:
         _count_error("no_generator")
         return 501, _error_body(
             501, "this server has no generative model loaded "
@@ -249,6 +250,109 @@ def handle_generate(model: InferenceModel, body: bytes,
         _count_error("bad_request")
         return 400, _error_body(400, str(e))
     except Exception as e:  # serving boundary: report, not die
+        _count_error("internal")
+        return 500, _error_body(500, str(e), kind="internal")
+
+
+def handle_prefill(model: InferenceModel, body: bytes,
+                   gen_batcher=None) -> "Tuple[int, dict]":
+    """``POST /generate/prefill`` — the disaggregated fleet's
+    prefill-pool ingress (docs/serving.md §Disaggregation). Request:
+    ``{"prompt": [ids...]}`` with optional ``max_new_tokens`` /
+    ``temperature``. The prompt runs to its first sampled token,
+    then the sequence's KV pages leave the cache as a handoff blob:
+    response ``{"handoff": {...}}`` in the base64 wire form
+    (`ops/kv_cache.handoff_to_wire`), ready to POST at a decode
+    replica's ``/generate/handoff``. 501 unless this server's
+    batcher fronts a prefill-capable engine."""
+    sub = getattr(gen_batcher, "submit_prefill", None)
+    if sub is None:
+        _count_error("no_generator")
+        return 501, _error_body(
+            501, "this server has no prefill-capable generation "
+            "batcher mounted (disaggregated prefill pool only)")
+    try:
+        req = json.loads(body)
+    except (ValueError, UnicodeDecodeError) as e:
+        _count_error("bad_json")
+        return 400, _error_body(400, f"malformed JSON body: {e}")
+    if not isinstance(req, dict) or "prompt" not in req:
+        _count_error("bad_request")
+        return 400, _error_body(
+            400, 'request must be a JSON object with a "prompt" '
+            'token-id list')
+    try:
+        prompt = [int(t) for t in req["prompt"]]
+        max_new = int(req.get("max_new_tokens", 32))
+        temperature = float(req.get("temperature", 0.0))
+    except (TypeError, ValueError) as e:
+        _count_error("bad_request")
+        return 400, _error_body(
+            400, f"prompt must be a list of token ids: {e}")
+    from analytics_zoo_tpu.ops.kv_cache import handoff_to_wire
+    try:
+        blob = sub(prompt, max_new_tokens=max_new,
+                   temperature=temperature).result()
+        return 200, {"handoff": handoff_to_wire(blob)}
+    except QueueFullError as e:
+        return 503, _error_body(
+            503, str(e), retry_after_s=round(e.retry_after_s, 3))
+    except ValueError as e:
+        _count_error("bad_request")
+        return 400, _error_body(400, str(e))
+    except Exception as e:  # serving boundary: report, not die
+        _count_error("internal")
+        return 500, _error_body(500, str(e), kind="internal")
+
+
+def handle_handoff(model: InferenceModel, body: bytes,
+                   gen_batcher=None) -> "Tuple[int, dict]":
+    """``POST /generate/handoff`` — the disaggregated fleet's
+    decode-pool ingress. Request: ``{"handoff": {...}}`` (wire form
+    from a prefill replica) with optional ``max_new_tokens`` /
+    ``eos_id``. The blob's pages splice into this replica's cache
+    with no forward pass and the sequence resumes decoding; response
+    ``{"tokens": [...]}`` is the FULL new-token stream including the
+    prefill-sampled first token — byte-identical to what a
+    monolithic ``/generate`` would have returned. 501 unless this
+    server's batcher can admit handoffs."""
+    sub = getattr(gen_batcher, "submit_handoff", None)
+    if sub is None:
+        _count_error("no_generator")
+        return 501, _error_body(
+            501, "this server has no handoff-capable generation "
+            "batcher mounted (disaggregated decode pool only)")
+    try:
+        req = json.loads(body)
+    except (ValueError, UnicodeDecodeError) as e:
+        _count_error("bad_json")
+        return 400, _error_body(400, f"malformed JSON body: {e}")
+    if not isinstance(req, dict) or \
+            not isinstance(req.get("handoff"), dict):
+        _count_error("bad_request")
+        return 400, _error_body(
+            400, 'request must be a JSON object with a "handoff" '
+            'wire blob (POST /generate/prefill produces one)')
+    from analytics_zoo_tpu.ops.kv_cache import handoff_from_wire
+    try:
+        max_new = int(req.get("max_new_tokens", 32))
+        eos_id = req.get("eos_id")
+        eos_id = None if eos_id is None else int(eos_id)
+        blob = handoff_from_wire(req["handoff"])
+    except (TypeError, ValueError, KeyError) as e:
+        _count_error("bad_request")
+        return 400, _error_body(400, f"bad handoff blob: {e}")
+    try:
+        toks = sub(blob, max_new_tokens=max_new,
+                   eos_id=eos_id).result()
+        return 200, {"tokens": [int(t) for t in toks]}
+    except QueueFullError as e:
+        return 503, _error_body(
+            503, str(e), retry_after_s=round(e.retry_after_s, 3))
+    except ValueError as e:  # blob/engine geometry mismatch
+        _count_error("bad_request")
+        return 400, _error_body(400, str(e))
+    except Exception as e:
         _count_error("internal")
         return 500, _error_body(500, str(e), kind="internal")
 
@@ -414,13 +518,17 @@ _profile_lock = threading.Lock()
 _profile_thread: "Optional[threading.Thread]" = None
 
 
-def _fleet_payload(batcher) -> "Tuple[int, dict]":
+def _fleet_payload(batcher, gen_batcher=None) -> "Tuple[int, dict]":
     """``GET /debug/fleet``: topology + per-replica lifecycle state
     (state machine, outstanding rows, failure counts, per-queue
-    batcher stats) when a ``FleetRouter`` fronts this server.
-    Single-model servers 404 — the route's presence is how clients
-    discover they are talking to a fleet."""
+    batcher stats) when a ``FleetRouter`` fronts this server — or,
+    on a disaggregated generation front door, the
+    :class:`DisaggRouter`'s role-tagged replicas and per-pool page
+    headroom. Single-model servers 404 — the route's presence is how
+    clients discover they are talking to a fleet."""
     status_fn = getattr(batcher, "fleet_status", None)
+    if status_fn is None:
+        status_fn = getattr(gen_batcher, "fleet_status", None)
     if status_fn is None:
         _count_error("not_found")
         return 404, _error_body(
@@ -507,13 +615,27 @@ def _resolve_gen_batcher(model: InferenceModel, gen_batcher):
     ``ZOO_TPU_GEN_BATCH=0`` — /generate then runs the sequential
     per-request path); explicit ``None`` / instance pass through. A
     FleetRouter standing in for the model has no generator, so fleet
-    front doors resolve to None and /generate degrades cleanly."""
+    front doors resolve to None and /generate degrades cleanly.
+
+    ``ZOO_TPU_DISAGG=1`` swaps the ContinuousBatcher for a
+    :class:`fleet.DisaggRouter` carved out of the loaded generator
+    (pool sizes from ``ZOO_TPU_DISAGG_PREFILL_REPLICAS`` /
+    ``ZOO_TPU_DISAGG_DECODE_REPLICAS``): /generate then runs the
+    prefill→handoff→decode path transparently, same contract. Only a
+    ``role="both"`` engine is split — pool workers (role-specific
+    engines behind /generate/prefill + /generate/handoff) keep their
+    plain batcher."""
     if gen_batcher == "auto":
         import os
         engine = getattr(model, "generator", None)
         if engine is None or \
                 os.environ.get("ZOO_TPU_GEN_BATCH", "1") == "0":
             return None
+        if os.environ.get("ZOO_TPU_DISAGG", "0") not in ("", "0") \
+                and getattr(engine, "role", "both") == "both":
+            from analytics_zoo_tpu.pipeline.inference.fleet import \
+                DisaggRouter
+            return DisaggRouter.for_engine(engine)
         from analytics_zoo_tpu.pipeline.inference.batching import \
             ContinuousBatcher
         return ContinuousBatcher(engine)
@@ -619,7 +741,7 @@ class InferenceServer:
                             server.batcher)
                     elif route == "/debug/fleet":
                         status, payload = _fleet_payload(
-                            server.batcher)
+                            server.batcher, server.gen_batcher)
                     elif route == "/debug/rollout":
                         status, payload = _rollout_payload(
                             server.batcher)
@@ -653,6 +775,8 @@ class InferenceServer:
                 route = self.path.split("?", 1)[0]
                 try:
                     if route not in ("/predict", "/generate",
+                                     "/generate/prefill",
+                                     "/generate/handoff",
                                      "/debug/profile"):
                         status = 404
                         _count_error("not_found")
@@ -677,7 +801,19 @@ class InferenceServer:
                                         trace_id=self.headers.get(
                                             tracing.TRACE_HEADER),
                                         path=route) as tr:
-                                    if route == "/generate":
+                                    if route == \
+                                            "/generate/prefill":
+                                        status, payload = \
+                                            handle_prefill(
+                                                server.model, body,
+                                                server.gen_batcher)
+                                    elif route == \
+                                            "/generate/handoff":
+                                        status, payload = \
+                                            handle_handoff(
+                                                server.model, body,
+                                                server.gen_batcher)
+                                    elif route == "/generate":
                                         status, payload = \
                                             handle_generate(
                                                 server.model, body,
@@ -805,7 +941,8 @@ class NativeInferenceServer:
                     self.batcher)
                 out = json.dumps(payload).encode()
             elif route == "/debug/fleet":
-                status, payload = _fleet_payload(self.batcher)
+                status, payload = _fleet_payload(self.batcher,
+                                                 self.gen_batcher)
                 out = json.dumps(payload).encode()
             elif route == "/debug/rollout":
                 status, payload = _rollout_payload(self.batcher)
@@ -813,7 +950,9 @@ class NativeInferenceServer:
             elif route == "/debug/profile":
                 status, payload = handle_profile(body)
                 out = json.dumps(payload).encode()
-            elif route not in ("/predict", "/generate"):
+            elif route not in ("/predict", "/generate",
+                               "/generate/prefill",
+                               "/generate/handoff"):
                 status = 404
                 _count_error("not_found")
                 out = json.dumps(
@@ -823,7 +962,13 @@ class NativeInferenceServer:
                 with tracing.trace("serving/request",
                                    trace_id=trace_hdr,
                                    path=route) as tr:
-                    if route == "/generate":
+                    if route == "/generate/prefill":
+                        status, payload = handle_prefill(
+                            self.model, body, self.gen_batcher)
+                    elif route == "/generate/handoff":
+                        status, payload = handle_handoff(
+                            self.model, body, self.gen_batcher)
+                    elif route == "/generate":
                         status, payload = handle_generate(
                             self.model, body, self.gen_batcher)
                     else:

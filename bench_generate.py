@@ -33,6 +33,19 @@ tokens/step (~9.7 vs ~5.6 levers-off in the committed artifact) do
 not translate into CPU tokens/s the way they do on a
 bandwidth-bound accelerator decode.
 
+``--disagg`` adds the disaggregated-serving A/B on top (ISSUE 19 /
+docs/serving.md §Disaggregation): the same closed-loop mix — sized
+up so the decode pool saturates — through a ``DisaggRouter`` over
+1 prefill + 2 decode replicas, measured twice: **disagg-inproc**
+(blob hands off as a host dict) and **disagg-http** (the same
+warmed pool engines behind stdlib HTTP front-ends, pages base64 on
+the wire). Both windows run the TTFT probe: with prefill on its own
+pool the long/short p99 ratio stays ≈1 even while every decode slot
+is busy — the contention case a monolithic engine cannot shield —
+and the artifact's ``disagg{...}`` block records the ratio plus the
+per-window handoff latency quantiles from
+``zoo_tpu_serving_gen_handoff_seconds``.
+
 The capacity levers are A/B'd from the command line and recorded in
 the artifact's sentinel key block: ``--prefill-chunk N`` (chunked
 prefill), ``--kv-dtype f32|bf16|int8`` (paged-cache storage), and
@@ -91,7 +104,8 @@ PROBE_SHORT, PROBE_LONG = 4, 100
 PROBE_CLIENTS = 3
 
 
-def _build_engine(prefill_chunk=0, spec_k=0, kv_dtype="f32"):
+def _build_engine(prefill_chunk=0, spec_k=0, kv_dtype="f32",
+                  slots=SLOTS, role="both"):
     from analytics_zoo_tpu import init_nncontext
     from analytics_zoo_tpu.pipeline.api.keras.layers.transformer \
         import TransformerLayer
@@ -111,9 +125,9 @@ def _build_engine(prefill_chunk=0, spec_k=0, kv_dtype="f32"):
     # a storm (the engine excuses its own warm() internally)
     with diagnostics.expected_compiles():
         params = net.build(jax.random.key(0), (SEQ_LEN,))
-        kw = dict(max_slots=SLOTS, max_context=SEQ_LEN, page_size=16,
+        kw = dict(max_slots=slots, max_context=SEQ_LEN, page_size=16,
                   prefill_chunk=prefill_chunk, spec_k=spec_k,
-                  cache_dtype=kv_dtype)
+                  cache_dtype=kv_dtype, role=role)
         if spec_k > 0:
             # half-width, half-depth drafter sharing the vocabulary
             drafter = TransformerLayer(n_block=1, hidden_size=64,
@@ -236,13 +250,65 @@ def _counter_value(name: str) -> float:
     return obs.counter(name, help=name).value
 
 
+def _handoff_hist():
+    from analytics_zoo_tpu.common import observability as obs
+    return obs.histogram(
+        "zoo_tpu_serving_gen_handoff_seconds",
+        help="prefill-pool export to decode-pool admission latency")
+
+
+def _hist_counts(h) -> "list[int]":
+    """Per-bucket counts (last = +Inf overflow) from the public
+    cumulative exposition, so window deltas can be quantiled."""
+    cum = [c for _, c in h.cumulative()]
+    return [cum[0]] + [b - a for a, b in zip(cum, cum[1:])]
+
+
+def _hist_window_quantiles(h, before: "list[int]") -> dict:
+    """p50/p99 (ms) + count of the observations since ``before``
+    (a `_hist_counts` snapshot) — per-mode handoff latency even
+    though the histogram accumulates across the whole bench."""
+    from analytics_zoo_tpu.common.observability import bucket_quantile
+    delta = [b - a for a, b in zip(before, _hist_counts(h))]
+    n = sum(delta)
+    if not n:
+        return {"handoffs": 0}
+    return {
+        "handoffs": n,
+        "handoff_p50_ms": round(
+            bucket_quantile(h.buckets, delta, 0.5) * 1e3, 2),
+        "handoff_p99_ms": round(
+            bucket_quantile(h.buckets, delta, 0.99) * 1e3, 2),
+    }
+
+
+def _measure_disagg(mode: str, router, im, clients: int,
+                    duration_s: float) -> dict:
+    """One disagg window: the standard closed-loop mix (sized to
+    saturate the decode pool) + the TTFT probe, annotated with the
+    window's handoff latency quantiles."""
+    h = _handoff_hist()
+    before = _hist_counts(h)
+    rec = measure(mode, im, clients, duration_s, probe_ttft=True,
+                  router=router)
+    rec.update(_hist_window_quantiles(h, before))
+    return rec
+
+
 def measure(mode: str, im, clients: int, duration_s: float,
-            probe_ttft: bool = False) -> dict:
+            probe_ttft: bool = False, router=None) -> dict:
     from analytics_zoo_tpu.pipeline.inference import ContinuousBatcher
 
     engine = im.generator
     cb = None
-    if mode.startswith("continuous"):
+    if router is not None:
+        # disaggregated path: the router fans prompts to the prefill
+        # pool and ships KV pages to the decode pool (caller owns the
+        # router's lifecycle — pools warm at router.start())
+        def submit(prompt, max_new):
+            return router.submit(prompt,
+                                 max_new_tokens=max_new).result(120)
+    elif mode.startswith("continuous"):
         cb = ContinuousBatcher(engine, queue_depth=512).start()
 
         def submit(prompt, max_new):
@@ -258,6 +324,7 @@ def measure(mode: str, im, clients: int, duration_s: float,
             with seq_lock:
                 return im.generate(prompt,
                                    max_new_tokens=max_new)[0]
+    stream = cb is not None or router is not None
     probe_rec = {}
     try:
         # warmup outside the window: every (bucket, budget) shape in
@@ -269,7 +336,7 @@ def measure(mode: str, im, clients: int, duration_s: float,
         with diagnostics.expected_compiles():
             for n, max_new in WORK_MIX:
                 submit(list(range(1, n + 1)), max_new)
-            if cb is not None:
+            if stream:
                 submit(list(range(1, PROBE_LONG + 1)), 1)  # probe
                 submit(list(range(1, PROBE_SHORT + 1)), 1)
         ttft0 = _ttft_state()
@@ -278,14 +345,14 @@ def measure(mode: str, im, clients: int, duration_s: float,
         spec0 = (engine.spec_proposed, engine.spec_accepted) \
             if getattr(engine, "spec_k", 0) else None
         t0 = time.perf_counter()
-        if cb is not None and probe_ttft:
+        if stream and probe_ttft:
             probe = {}
             pt = threading.Thread(target=lambda: probe.update(
                 _run_ttft_probe(submit, duration_s)))
             pt.start()
         tokens, lat, errors = _run_clients(submit, clients,
                                            duration_s)
-        if cb is not None and probe_ttft:
+        if stream and probe_ttft:
             pt.join()
             probe_rec = probe
         window = time.perf_counter() - t0
@@ -311,9 +378,9 @@ def measure(mode: str, im, clients: int, duration_s: float,
     ttft = _ttft_mean_ms(ttft0)
     # sequential has no streaming boundary: first token arrives with
     # the rest, so mean latency IS its time-to-first-token
-    rec["ttft_mean_ms"] = (ttft if mode.startswith("continuous")
+    rec["ttft_mean_ms"] = (ttft if stream
                            else round(float(np.mean(lat_ms)), 2))
-    if mode.startswith("continuous"):
+    if stream:
         rec.update(probe_rec)
         # realized tokens per decode iteration: > 1 only when
         # speculation lands multi-token rounds
@@ -354,11 +421,21 @@ def main():
         "ZOO_TPU_KV_DTYPE", "f32"),
         choices=("f32", "bf16", "int8"),
         help="paged KV cache storage dtype")
+    ap.add_argument("--disagg", action="store_true",
+                    help="add the disaggregated-serving A/B: the "
+                    "same mix through a DisaggRouter (1 prefill + 2 "
+                    "decode replicas) in-process AND over an HTTP "
+                    "hop, with the decode pool saturated; the "
+                    "artifact gains a disagg{...} block and its own "
+                    "perf_sentinel lineage")
     ap.add_argument("--cpu-fallback", action="store_true",
                     help="pin the run to the host CPU backend; the "
                     "measurement lands in cpu_fallback_value and the "
                     "chip headline stays null")
     args = ap.parse_args()
+    if args.disagg and args.spec_k > 0:
+        ap.error("--disagg is incompatible with --spec-k (the "
+                 "verify step needs prefill+decode on one engine)")
 
     import jax
     if args.cpu_fallback:
@@ -396,6 +473,75 @@ def main():
           f"per-request decode ({args.clients} clients)",
           file=sys.stderr, flush=True)
 
+    disagg_inproc = disagg_http = disagg_block = None
+    if args.disagg:
+        from analytics_zoo_tpu.pipeline.inference import \
+            ContinuousBatcher
+        from analytics_zoo_tpu.pipeline.inference.fleet import (
+            DisaggRouter, HttpDisaggReplica)
+        from analytics_zoo_tpu.pipeline.inference.serving import \
+            InferenceServer
+        # small per-replica pools so the closed-loop mix actually
+        # saturates the decode pool (the gate's contention case);
+        # the prefill pool runs whole-prompt bucketed prefill —
+        # chunking exists to protect co-resident decode, which
+        # disaggregation removes
+        d_slots = 4
+        n_prefill, n_decode = 1, 2
+        d_clients = max(args.clients, n_decode * d_slots + 2)
+        im_d = _build_engine(kv_dtype=args.kv_dtype, slots=d_slots)
+        router = DisaggRouter.for_engine(
+            im_d.generator, n_prefill=n_prefill, n_decode=n_decode)
+        router.start()
+        disagg_inproc = _measure_disagg(
+            "disagg-inproc", router, im_d, d_clients, args.duration)
+        router.drain()
+        pool = [(r.engine, r.role)
+                for r in router.prefill + router.decode]
+        router.stop()
+        # HTTP hop: the SAME warmed pool engines behind stdlib HTTP
+        # front-ends — the delta vs in-process is pure wire cost
+        # (base64 pages + two request hops), no new compiles
+        servers, reps = [], {"prefill": [], "decode": []}
+        for i, (eng, role) in enumerate(pool):
+            srv = InferenceServer(im_d, port=0, batcher=None,
+                                  gen_batcher=ContinuousBatcher(eng))
+            srv.start()
+            servers.append(srv)
+            reps[role].append(HttpDisaggReplica(
+                f"http://127.0.0.1:{srv.port}", role,
+                name=f"http-{role}{i}"))
+        router2 = DisaggRouter(reps["prefill"], reps["decode"])
+        router2.start()
+        disagg_http = _measure_disagg(
+            "disagg-http", router2, im_d, d_clients, args.duration)
+        router2.stop()
+        for srv in servers:
+            srv.stop()
+        ratio = disagg_inproc.get("ttft_long_vs_short_p99")
+        disagg_block = {
+            "prefill_replicas": n_prefill,
+            "decode_replicas": n_decode,
+            "slots_per_replica": d_slots,
+            "page_size": 16,
+            "kv_dtype": args.kv_dtype,
+            "mix_clients": d_clients,
+            "decode_slots": n_decode * d_slots,
+            "ttft_long_vs_short_p99": ratio,
+            "handoff_p50_ms": disagg_inproc.get("handoff_p50_ms"),
+            "handoff_p99_ms": disagg_inproc.get("handoff_p99_ms"),
+            "handoff_http_p50_ms": disagg_http.get(
+                "handoff_p50_ms"),
+            "handoff_http_p99_ms": disagg_http.get(
+                "handoff_p99_ms"),
+        }
+        print(f"# disagg TTFT long/short p99 ratio={ratio} "
+              f"(gate: <= 1.1 with the decode pool saturated); "
+              f"handoff p99 in-proc="
+              f"{disagg_block['handoff_p99_ms']}ms http="
+              f"{disagg_block['handoff_http_p99_ms']}ms",
+              file=sys.stderr, flush=True)
+
     headline = continuous["tokens_per_sec"]
     rec = {
         "metric": "generate_throughput_tokens_per_sec",
@@ -417,10 +563,16 @@ def main():
             continuous,
             *([levered] if levered else []),
             sequential,
+            *([disagg_inproc] if disagg_inproc else []),
+            *([disagg_http] if disagg_http else []),
             {"metric": "generate_continuous_speedup",
              "value": round(speedup, 2), "unit": "x"},
         ],
     }
+    if disagg_block is not None:
+        # perf_sentinel keys on this block: disagg runs are their own
+        # lineage, never compared against monolithic decode rows
+        rec["disagg"] = disagg_block
     if args.cpu_fallback:
         rec["cpu_fallback_value"] = headline
         rec["fallback"] = (f"cpu clients={args.clients} "

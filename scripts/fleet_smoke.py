@@ -33,9 +33,29 @@ concurrent wave, and
      spans from the router process AND a replica process, on
      distinct Perfetto process lanes (`?chrome=1` pids)
 
+A third phase proves **disaggregated generation serving**
+(docs/serving.md §Disaggregation) the same way:
+
+  8. in-process: a `DisaggRouter` (1 prefill + 2 decode replicas
+     carved from one toy transformer) serves a concurrent /generate
+     wave byte-identical to a monolithic engine; a decode replica is
+     poisoned mid-wave and every request STILL returns the exact
+     stream (the KV handoff blob re-prefills on the sibling —
+     exactly-once); the router drains clean and the
+     `zoo_tpu_serving_gen_handoff_pages_leaked` audit counter stays
+     0 (exact page refill, no orphaned slots)
+  9. subprocess: 1 prefill + 2 decode workers (`--gen-worker ROLE`)
+     behind HTTP front-ends take a concurrent wave; the prefill
+     worker is SIGKILLed mid-wave — every 200 is byte-exact (zero
+     lost acked requests), failures are only retryable transport
+     errors, and the decode workers' /health settles back to
+     free_pages == total_pages (the pool refills exactly)
+
 Exit code 0 = the fleet absorbed a mid-load replica kill with zero
-lost acked requests and re-admitted the healed replica, and the
-telemetry plane federated/stitched across real process boundaries.
+lost acked requests and re-admitted the healed replica, the
+telemetry plane federated/stitched across real process boundaries,
+and the disaggregated pools survived both a decode and a prefill
+death without losing or corrupting an acked token.
 """
 
 from __future__ import annotations
@@ -284,6 +304,270 @@ def federation_phase() -> int:
     return 0
 
 
+# -- disagg phase: prefill/decode pools with KV-page handoff ------------
+
+GEN_SEQ, GEN_VOCAB = 32, 61
+
+
+def _gen_net():
+    """The disagg phase's toy transformer — seeded build, so every
+    process (parent, prefill worker, decode workers) holds IDENTICAL
+    params and greedy streams are comparable byte-for-byte."""
+    from analytics_zoo_tpu import init_nncontext
+    init_nncontext(seed=0, log_level="WARNING")
+    import jax
+    from analytics_zoo_tpu.pipeline.api.keras.layers.transformer \
+        import TransformerLayer
+    net = TransformerLayer(n_block=2, hidden_size=32, n_head=2,
+                           seq_len=GEN_SEQ, vocab=GEN_VOCAB,
+                           hidden_p_drop=0.0, attn_p_drop=0.0,
+                           embed_p_drop=0.0)
+    params = net.build(jax.random.key(0), (GEN_SEQ,))
+    return net, params
+
+
+def _gen_prompts():
+    rs = np.random.RandomState(3)
+    return [rs.randint(1, GEN_VOCAB, size=n).tolist()
+            for n in (3, 7, 5, 11, 9, 4)]
+
+
+def _gen_worker(role: str) -> int:
+    """`fleet_smoke.py --gen-worker prefill|decode`: one pool
+    replica — a role-specific generation engine behind the standard
+    front-end (its /generate/prefill · /generate/handoff routes are
+    the pool surface). Prints the bound port, parks forever."""
+    from analytics_zoo_tpu.pipeline.inference import InferenceModel
+    from analytics_zoo_tpu.pipeline.inference.serving import (
+        InferenceServer)
+
+    net, params = _gen_net()
+    im = InferenceModel()
+    im.load_generator(net, params, max_slots=4, max_context=GEN_SEQ,
+                      page_size=8, role=role,
+                      prefill_chunk=4 if role == "prefill" else 0)
+    srv = InferenceServer(im, port=0, batcher=None)
+    srv.start()
+    print(json.dumps({"port": srv.port}), flush=True)
+    while True:
+        time.sleep(3600)
+
+
+def _spawn_gen_worker(role: str):
+    import subprocess
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get(
+        "PYTHONPATH", "")
+    env.pop("ZOO_TPU_DISAGG", None)  # workers are pools, not routers
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--gen-worker",
+         role],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, env=env)
+
+
+def _gen_wave(url, prompts, max_new, n_reqs, label,
+              mid_wave=None):
+    """Fire ``n_reqs`` concurrent /generate requests (prompts
+    cycled); run ``mid_wave()`` once the wave is in flight. Returns
+    the (status, payload) list — transport failures land as
+    status 599 so the caller can classify them as retryable."""
+    import urllib.error
+    results: "list" = [None] * n_reqs
+    started = threading.Event()
+
+    def client(i: int):
+        body = {"prompt": prompts[i % len(prompts)],
+                "max_new_tokens": max_new}
+        req = urllib.request.Request(
+            url + "/generate", data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        started.set()
+        try:
+            with urllib.request.urlopen(req, timeout=120) as r:
+                results[i] = (r.status, json.loads(r.read()))
+        except urllib.error.HTTPError as e:
+            try:
+                results[i] = (e.code, json.loads(e.read()))
+            except (ValueError, OSError):
+                results[i] = (e.code, {})
+        except Exception as e:  # connection died mid-request
+            results[i] = (599, {"error": str(e)})
+
+    ts = [threading.Thread(target=client, args=(i,))
+          for i in range(n_reqs)]
+    for t in ts:
+        t.start()
+    if mid_wave is not None:
+        started.wait(timeout=30)
+        mid_wave()
+    for t in ts:
+        t.join(timeout=120)
+    for i, r in enumerate(results):
+        assert r is not None, f"{label}: request {i} hung"
+    return results
+
+
+def disagg_phase() -> int:
+    """Phase 8+9 of the module docstring."""
+    from analytics_zoo_tpu.common import observability as obs
+    from analytics_zoo_tpu.pipeline.inference import (
+        ContinuousBatcher, GenerationEngine)
+    from analytics_zoo_tpu.pipeline.inference.fleet import (
+        DisaggRouter, HttpDisaggReplica)
+    from analytics_zoo_tpu.pipeline.inference.serving import (
+        InferenceServer)
+
+    net, params = _gen_net()
+    prompts = _gen_prompts()
+    max_new = 8
+
+    # the monolithic reference stream every disagg answer must match
+    mono = GenerationEngine(net, params, max_slots=4,
+                            max_context=GEN_SEQ, page_size=8)
+    mb = ContinuousBatcher(mono).start()
+    expect = [mb.submit(p, max_new_tokens=max_new).result(120)
+              .tolist() for p in prompts]
+    mb.stop()
+
+    # 8) in-process pools; poison a decode replica mid-wave
+    tmpl = GenerationEngine(net, params, max_slots=4,
+                            max_context=GEN_SEQ, page_size=8,
+                            prefill_chunk=4)
+    router = DisaggRouter.for_engine(tmpl, n_prefill=1, n_decode=2,
+                                     eject_after=1)
+    router.start()
+    victim = router.decode[0]
+
+    def poison():
+        def dying(blob, mx, eos):
+            from concurrent.futures import Future
+            f = Future()
+            f.set_exception(
+                ConnectionError("injected decode death"))
+            return f
+        victim.decode = dying
+
+    n_reqs = 2 * len(prompts)
+    futs = [router.submit(prompts[i % len(prompts)],
+                          max_new_tokens=max_new)
+            for i in range(n_reqs)]
+    poison()  # in flight: some handoffs now land on a dead replica
+    for i, f in enumerate(futs):
+        got = f.result(120).tolist()
+        assert got == expect[i % len(prompts)], (i, got)
+    assert not victim.admitting(), "dead decode replica not ejected"
+    assert router.drain(), "disagg pools did not drain"
+    leaked = obs.counter(
+        "zoo_tpu_serving_gen_handoff_pages_leaked",
+        help="pages the drain audit reclaimed from slots no "
+        "request owned (0 = exact pool refill)").value
+    assert leaked == 0, f"drain audit reclaimed {leaked} pages"
+    for r in router.prefill + router.decode:
+        assert r.free_pages() == r.total_pages(), r.name
+    router.stop()
+    retried = obs.counter(
+        "zoo_tpu_serving_gen_handoff_retries_total",
+        help="handoffs retried after a pool replica failed "
+        "mid-flight (the blob re-prefills on a sibling)").value
+    print(f"fleet-smoke disagg(in-process) OK: {n_reqs} streams "
+          f"byte-identical to monolithic through a mid-wave decode "
+          f"death ({int(retried)} handoffs re-prefilled); drained "
+          f"with 0 leaked pages")
+
+    # 9) subprocess pools; SIGKILL the prefill worker mid-wave
+    procs = {"prefill": [_spawn_gen_worker("prefill")],
+             "decode": [_spawn_gen_worker("decode"),
+                        _spawn_gen_worker("decode")]}
+    srv = None
+    try:
+        urls = {}
+        for role, ps in procs.items():
+            urls[role] = []
+            for p in ps:
+                line = p.stdout.readline()
+                assert line, f"{role} worker died before binding"
+                urls[role].append(
+                    f"http://127.0.0.1:{json.loads(line)['port']}")
+        router = DisaggRouter(
+            [HttpDisaggReplica(u, "prefill", name=f"hp{i}")
+             for i, u in enumerate(urls["prefill"])],
+            [HttpDisaggReplica(u, "decode", name=f"hd{i}")
+             for i, u in enumerate(urls["decode"])],
+            eject_after=1)
+
+        class _NoModel:  # front door: routing only, no local model
+            concurrent_slots_free = 8
+            supported_concurrent_num = 8
+            example_input_specs = None
+            generator = None
+
+        srv = InferenceServer(_NoModel(), port=0, batcher=None,
+                              gen_batcher=router)
+        srv.start()
+        url = f"http://127.0.0.1:{srv.port}"
+
+        # warm the workers' compiled programs outside the kill wave
+        warm = _gen_wave(url, prompts[:2], max_new, 2, "warm")
+        for i, (status, out) in enumerate(warm):
+            assert status == 200, (i, status, out)
+            assert out["tokens"] == expect[i], (i, out)
+
+        # role + per-pool page headroom on the front door
+        fleet = _fleet_debug(url)
+        assert fleet.get("disagg") is True, fleet
+        roles = sorted(r["role"] for r in fleet["replicas"])
+        assert roles == ["decode", "decode", "prefill"], roles
+        assert fleet["pools"]["decode"]["pages_total"] > 0, fleet
+
+        results = _gen_wave(
+            url, prompts, max_new, 3 * len(prompts), "kill",
+            mid_wave=procs["prefill"][0].kill)
+        acked = failed = 0
+        for i, (status, out) in enumerate(results):
+            if status == 200:
+                acked += 1
+                assert out["tokens"] == expect[i % len(prompts)], (
+                    i, out)  # an acked stream is NEVER corrupt
+            else:
+                failed += 1
+                # with the only prefill replica dead, new admissions
+                # can only fail retryably (5xx/transport), never as
+                # a client error and never with a wrong stream
+                assert status in (500, 503, 599), (i, status, out)
+
+        # the decode pool settles back to an exactly-full free list
+        deadline = time.monotonic() + 60
+        settled = []
+        while time.monotonic() < deadline:
+            settled = []
+            for u in urls["decode"]:
+                gen = json.loads(urllib.request.urlopen(
+                    u + "/health", timeout=30).read())["generator"]
+                settled.append(
+                    gen["slots_active"] == 0 and
+                    gen["free_pages"] == gen["total_pages"])
+            if all(settled):
+                break
+            time.sleep(0.2)
+        assert all(settled), "decode pool did not refill exactly"
+    finally:
+        if srv is not None:
+            srv.stop()
+        for ps in procs.values():
+            for p in ps:
+                p.kill()
+        for ps in procs.values():
+            for p in ps:
+                p.wait(timeout=30)
+
+    print(f"fleet-smoke disagg(subprocess) OK: prefill worker "
+          f"SIGKILLed mid-wave; {acked} acked streams all "
+          f"byte-exact, {failed} failures all retryable, decode "
+          f"pool refilled exactly")
+    return 0
+
+
 def main() -> int:
     from analytics_zoo_tpu import init_nncontext
     from analytics_zoo_tpu.parallel import replica_device_slices
@@ -397,10 +681,16 @@ def main() -> int:
     print(f"fleet-smoke OK: {front} served {3 * len(SIZES)} "
           f"requests across 2 replicas; r0 killed mid-load with "
           f"zero lost acked requests, ejected, and re-admitted")
-    return federation_phase()
+    rc = federation_phase()
+    if rc:
+        return rc
+    return disagg_phase()
 
 
 if __name__ == "__main__":
     if "--worker" in sys.argv[1:]:
         sys.exit(_worker())
+    if "--gen-worker" in sys.argv[1:]:
+        role = sys.argv[sys.argv.index("--gen-worker") + 1]
+        sys.exit(_gen_worker(role))
     sys.exit(main())

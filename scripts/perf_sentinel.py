@@ -119,6 +119,14 @@ def is_generate_artifact(rec: dict) -> bool:
     return isinstance(rec.get("generate"), dict)
 
 
+def is_disagg_artifact(rec: dict) -> bool:
+    """Disaggregated-serving runs (`bench_generate.py --disagg`)
+    carry a ``"disagg"`` block; prefill/decode-pool numbers (handoff
+    latency in the path, pool-bound capacity) are their own lineage,
+    never compared against monolithic decode throughput."""
+    return isinstance(rec.get("disagg"), dict)
+
+
 def is_tuned_artifact(rec: dict) -> bool:
     """Runs under ``ZOO_TPU_AUTOTUNE>=1`` carry an ``"autotune"``
     provenance block with ``enabled: true`` (bench_common.
@@ -137,9 +145,12 @@ def extract_series(rec: dict) -> "Dict[Tuple[str, str], float]":
     if not isinstance(rec, dict):
         return out
     fb = is_fallback_artifact(rec)
-    # mutually exclusive in practice (a record is a fleet run OR a
-    # generation run); fleet wins if both ever appear
-    if is_fleet_artifact(rec):
+    # mutually exclusive in practice (a record is a disagg run OR a
+    # fleet run OR a generation run); disagg wins over the plain
+    # generate lineage its records also qualify for
+    if is_disagg_artifact(rec):
+        sfx = "-disagg"
+    elif is_fleet_artifact(rec):
         sfx = "-fleet"
     elif is_generate_artifact(rec):
         sfx = "-generate"

@@ -1,0 +1,425 @@
+"""Disaggregated generation serving: prefill/decode pools with
+KV-page handoff (docs/serving.md §Disaggregation). The contract
+under test is EXACTNESS — a greedy stream produced by prefill on one
+engine, a page handoff, and decode on another engine must be
+byte-identical to the monolithic engine's stream, across every KV
+storage dtype, through chunked prefill, with staggered neighbours,
+over the wire codec, and through mid-handoff replica death (the
+exactly-once retry). Plus the steady-state guarantee: a warmed pool
+never compiles, and a drained pool refills its page free list
+exactly (leak counter 0). Tier-1 fast.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu import init_nncontext
+from analytics_zoo_tpu.common import observability as obs
+from analytics_zoo_tpu.common.observability import reset_metrics
+from analytics_zoo_tpu.pipeline.inference import (
+    ContinuousBatcher, GenerationEngine)
+from analytics_zoo_tpu.pipeline.inference.fleet import (
+    DisaggReplica, DisaggRouter)
+
+SEQ, VOCAB = 32, 61
+
+
+@pytest.fixture(autouse=True)
+def _fresh_metrics():
+    reset_metrics()
+    yield
+    reset_metrics()
+
+
+def _toy_transformer():
+    init_nncontext(seed=0)
+    import jax
+    from analytics_zoo_tpu.pipeline.api.keras.layers.transformer \
+        import TransformerLayer
+    net = TransformerLayer(n_block=2, hidden_size=32, n_head=2,
+                           seq_len=SEQ, vocab=VOCAB,
+                           hidden_p_drop=0.0, attn_p_drop=0.0,
+                           embed_p_drop=0.0)
+    params = net.build(jax.random.key(0), (SEQ,))
+    return net, params
+
+
+def _engine(**kw):
+    net, params = _toy_transformer()
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("max_context", SEQ)
+    kw.setdefault("page_size", 8)
+    return GenerationEngine(net, params, **kw)
+
+
+def _mono_stream(prompt, max_new, **kw):
+    """The monolithic reference: one role="both" engine, admit →
+    step loop — the stream every disagg path must reproduce."""
+    eng = _engine(**kw)
+    (slot, first), = eng.admit([(prompt, max_new, 0.0)])
+    out = [first]
+    active = np.zeros((eng.max_slots,), np.bool_)
+    active[slot] = True
+    while len(out) < max_new:
+        out.append(int(eng.step(active)[slot]))
+    eng.release(slot)
+    return out
+
+
+def _export(eng, prompt, max_new=4):
+    """Admit one prompt on a prefill engine (chunked when the engine
+    is configured for it) and export its handoff blob."""
+    if eng.prefill_chunk > 0:
+        slot, = eng.admit_partial([(prompt, max_new, 0.0)])
+        while eng.prefilling_slots:
+            eng.prefill_step()
+    else:
+        (slot, _), = eng.admit([(prompt, max_new, 0.0)])
+    return eng.export_handoff(slot)
+
+
+def _decode_stream(dec, blob, max_new):
+    dslot = dec.admit_from_handoff(blob, max_new)
+    out = [int(blob["last_token"])]
+    active = np.zeros((dec.max_slots,), np.bool_)
+    active[dslot] = True
+    while len(out) < max_new:
+        out.append(int(dec.step(active)[dslot]))
+    dec.release(dslot)
+    return out
+
+
+def _pool_stream(prompt, max_new, prefill_kw=None, decode_kw=None):
+    """prefill engine → export_handoff → decode engine →
+    admit_from_handoff → step loop."""
+    pre = _engine(role="prefill", **(prefill_kw or {}))
+    dec = _engine(role="decode", **(decode_kw or {}))
+    blob = _export(pre, prompt, max_new)
+    # export reclaims the prefill side immediately and exactly
+    assert pre.free_pages == pre.allocator.max_pages
+    assert pre.slots_active == 0
+    out = _decode_stream(dec, blob, max_new)
+    assert dec.free_pages == dec.allocator.max_pages
+    return out
+
+
+# -- engine layer: handoff is token-exact, every dtype -----------------------
+
+@pytest.mark.parametrize("kv", ["f32", "bf16", "int8"])
+def test_handoff_stream_matches_monolithic(kv):
+    rs = np.random.RandomState(2)
+    for plen in (3, 11):
+        prompt = rs.randint(1, VOCAB, size=plen).tolist()
+        ref = _mono_stream(prompt, 8, cache_dtype=kv)
+        got = _pool_stream(prompt, 8,
+                           prefill_kw={"cache_dtype": kv},
+                           decode_kw={"cache_dtype": kv})
+        assert got == ref, (kv, plen)
+
+
+def test_handoff_after_chunked_prefill_is_exact():
+    # the prompt spans several prefill chunks AND several pages; the
+    # exported pages must carry the full chunk-accumulated prefix
+    prompt = list(range(1, 20))
+    ref = _mono_stream(prompt, 6)
+    got = _pool_stream(prompt, 6, prefill_kw={"prefill_chunk": 4})
+    assert got == ref
+
+
+def test_staggered_admission_neighbor_invariance():
+    # a handoff admitted mid-decode must not perturb the sequences
+    # already decoding in neighbouring slots (fixed-shape scatter
+    # touches ONLY the new slot's pages)
+    rs = np.random.RandomState(4)
+    pa, pb, pc = (rs.randint(1, VOCAB, size=n).tolist()
+                  for n in (5, 9, 3))
+    ref_a = _mono_stream(pa, 10)
+    ref_b = _mono_stream(pb, 10)
+
+    pre = _engine(role="prefill")
+    dec = _engine(role="decode")
+    blob_a = _export(pre, pa, 10)
+    blob_b = _export(pre, pb, 10)
+    sa = dec.admit_from_handoff(blob_a, 10)
+    sb = dec.admit_from_handoff(blob_b, 10)
+    out_a = [int(blob_a["last_token"])]
+    out_b = [int(blob_b["last_token"])]
+    out_c = []
+    active = np.zeros((dec.max_slots,), np.bool_)
+    active[sa] = active[sb] = True
+    sc = None
+    for i in range(9):
+        if i == 3:  # mid-stream: a third handoff lands next door
+            blob_c = _export(pre, pc, 4)
+            sc = dec.admit_from_handoff(blob_c, 4)
+            out_c.append(int(blob_c["last_token"]))
+            active[sc] = True
+        toks = dec.step(active)
+        out_a.append(int(toks[sa]))
+        out_b.append(int(toks[sb]))
+        if sc is not None and active[sc]:
+            out_c.append(int(toks[sc]))
+            if len(out_c) >= 4:  # budget done: freeze its slot
+                active[sc] = False
+    assert out_a == ref_a
+    assert out_b == ref_b
+    assert len(out_c) == 4
+
+
+def test_blob_validation_rejects_mismatched_geometry():
+    pre = _engine(role="prefill")
+    blob = _export(pre, [1, 2, 3])
+    wrong_page = _engine(role="decode", page_size=16,
+                         max_context=SEQ)
+    with pytest.raises(ValueError):
+        wrong_page.admit_from_handoff(dict(blob), 4)
+    wrong_dtype = _engine(role="decode", cache_dtype="int8")
+    with pytest.raises(ValueError):
+        wrong_dtype.admit_from_handoff(dict(blob), 4)
+    stale = dict(blob, version=99)
+    with pytest.raises(ValueError):
+        _engine(role="decode").admit_from_handoff(stale, 4)
+    # a rejected blob leaves the engine untouched (atomic admit)
+    dec = _engine(role="decode")
+    with pytest.raises(ValueError):
+        dec.admit_from_handoff(stale, 4)
+    assert dec.free_pages == dec.allocator.max_pages
+    assert dec.slots_active == 0
+
+
+def test_wire_codec_roundtrip_preserves_dtype_exactly():
+    from analytics_zoo_tpu.ops.kv_cache import (
+        handoff_from_wire, handoff_to_wire)
+    for kv in ("f32", "bf16", "int8"):
+        pre = _engine(role="prefill", cache_dtype=kv)
+        blob = _export(pre, [5, 9, 2, 14], 5)
+        back = handoff_from_wire(handoff_to_wire(blob))
+        assert back["kv_dtype"] == blob["kv_dtype"]
+        assert back["seq_len"] == blob["seq_len"]
+        assert back["k"].dtype == blob["k"].dtype
+        np.testing.assert_array_equal(back["k"], blob["k"])
+        np.testing.assert_array_equal(back["v"], blob["v"])
+        if kv == "int8":
+            np.testing.assert_array_equal(back["k_scales"],
+                                          blob["k_scales"])
+        else:
+            assert back["k_scales"] is None
+        # the decoded blob must admit and stream like the original
+        ref = _mono_stream([5, 9, 2, 14], 5, cache_dtype=kv)
+        dec = _engine(role="decode", cache_dtype=kv)
+        assert _decode_stream(dec, back, 5) == ref, kv
+
+
+# -- role surface ------------------------------------------------------------
+
+def test_role_validation():
+    with pytest.raises(ValueError):
+        _engine(role="frontend")
+    net, params = _toy_transformer()
+    with pytest.raises(ValueError):  # spec decode needs both phases
+        GenerationEngine(net, params, max_slots=4, max_context=SEQ,
+                         page_size=8, role="decode", spec_k=2,
+                         drafter=net, drafter_params=params)
+    assert _engine(role="prefill").stats()["role"] == "prefill"
+
+
+# -- router layer: conformance, exactly-once, drain audit --------------------
+
+def _router_prompts():
+    rs = np.random.RandomState(7)
+    return [rs.randint(1, VOCAB, size=n).tolist()
+            for n in (3, 7, 5, 11)]
+
+
+def test_router_greedy_conformance_and_exactly_once():
+    prompts = _router_prompts()
+    ref = [_mono_stream(p, 8) for p in prompts]
+    router = DisaggRouter.for_engine(
+        _engine(prefill_chunk=4), n_prefill=1, n_decode=2,
+        eject_after=1)
+    router.start()
+    try:
+        futs = [router.submit(p, max_new_tokens=8)
+                for p in prompts]
+        got = [f.result(120).tolist() for f in futs]
+        assert got == ref
+
+        # kill a decode replica between waves: in-flight blobs die
+        # with it, the router re-prefills on the sibling, and every
+        # resolved stream is STILL byte-identical (exactly-once)
+        victim = router.decode[0]
+
+        def dying(blob, mx, eos):
+            from concurrent.futures import Future
+            f = Future()
+            f.set_exception(ConnectionError("killed mid-handoff"))
+            return f
+
+        victim.decode = dying
+        futs = [router.submit(p, max_new_tokens=8)
+                for p in prompts]
+        got = [f.result(120).tolist() for f in futs]
+        assert got == ref
+        assert not victim.admitting()
+        retries = obs.counter(
+            "zoo_tpu_serving_gen_handoff_retries_total",
+            help="x").value
+        assert retries >= 1
+    finally:
+        router.stop()
+
+
+def test_router_short_request_resolves_at_prefill():
+    # max_new=1 needs no decode leg: the prefill-sampled token IS
+    # the stream, and no pages ever ship
+    prompts = _router_prompts()
+    ref = [_mono_stream(p, 1) for p in prompts]
+    router = DisaggRouter.for_engine(_engine(), n_prefill=1,
+                                     n_decode=1)
+    router.start()
+    try:
+        got = [router.submit(p, max_new_tokens=1).result(120)
+               .tolist() for p in prompts]
+        assert got == ref
+        ho_in = obs.counter("zoo_tpu_serving_gen_handoffs_total",
+                            help="x", labels={"direction": "in"}
+                            ).value
+        assert ho_in == 0
+    finally:
+        router.stop()
+
+
+def test_router_drain_leak_counter_and_exact_refill():
+    router = DisaggRouter.for_engine(_engine(), n_prefill=1,
+                                     n_decode=2)
+    router.start()
+    try:
+        futs = [router.submit(p, max_new_tokens=6)
+                for p in _router_prompts()]
+        for f in futs:
+            f.result(120)
+        assert router.drain()
+        leaked = obs.counter(
+            "zoo_tpu_serving_gen_handoff_pages_leaked",
+            help="x").value
+        assert leaked == 0
+        for r in router.prefill + router.decode:
+            assert r.free_pages() == r.total_pages(), r.name
+        st = router.fleet_status()
+        assert st["disagg"] is True
+        roles = sorted(r["role"] for r in st["replicas"])
+        assert roles == ["decode", "decode", "prefill"]
+        pools = st["pools"]
+        assert pools["prefill"]["pages_free"] == \
+            pools["prefill"]["pages_total"]
+    finally:
+        router.stop()
+
+
+def test_spec_decode_incompatible_with_disagg():
+    net, params = _toy_transformer()
+    eng = GenerationEngine(net, params, max_slots=4,
+                           max_context=SEQ, page_size=8, spec_k=2,
+                           drafter=net, drafter_params=params)
+    with pytest.raises(ValueError):
+        DisaggRouter.for_engine(eng)
+
+
+# -- the headline guarantee: zero compiles on BOTH pools after warm ----------
+
+def test_no_steady_state_compiles_under_disagg_traffic():
+    from jax import monitoring
+
+    router = DisaggRouter.for_engine(
+        _engine(prefill_chunk=4), n_prefill=1, n_decode=2)
+    compiles = []
+    armed = [False]
+
+    def listener(name, dur, **kw):
+        if armed[0] and name.endswith("backend_compile_duration"):
+            compiles.append(name)
+
+    monitoring.register_event_duration_secs_listener(listener)
+    router.start()  # pool warm-up: prefill buckets + export on the
+    try:            # prefill engine, step + import on decode engines
+        armed[0] = True
+        rs = np.random.RandomState(9)
+        futs = []
+        for n, m in [(1, 3), (9, 5), (2, 4), (17, 6), (5, 2),
+                     (12, 3), (7, 7), (3, 1)]:
+            futs.append(router.submit(
+                rs.randint(1, VOCAB, size=n).tolist(),
+                max_new_tokens=m))
+            time.sleep(0.002)
+        for f, (_, m) in zip(futs, [(1, 3), (9, 5), (2, 4), (17, 6),
+                                    (5, 2), (12, 3), (7, 7),
+                                    (3, 1)]):
+            assert len(f.result(timeout=120)) == m
+        armed[0] = False
+        assert compiles == [], (
+            f"disagg steady state compiled {len(compiles)} times "
+            f"across mixed prefill/decode traffic")
+    finally:
+        armed[0] = False
+        router.stop()
+
+
+# -- batcher surface: the pool-side ingress ----------------------------------
+
+def test_batcher_prefill_and_handoff_futures_roundtrip():
+    prompt = [8, 3, 17, 2, 9]
+    ref = _mono_stream(prompt, 7)
+    pre_cb = ContinuousBatcher(_engine(role="prefill",
+                                       prefill_chunk=4))
+    dec_cb = ContinuousBatcher(_engine(role="decode"))
+    pre_cb.start()
+    dec_cb.start()
+    try:
+        blob = pre_cb.submit_prefill(
+            prompt, max_new_tokens=7).result(120)
+        assert blob["seq_len"] == len(prompt)
+        got = dec_cb.submit_handoff(
+            blob, max_new_tokens=7).result(120)
+        assert [int(t) for t in got] == ref
+        assert pre_cb.drain() and dec_cb.drain()
+    finally:
+        pre_cb.stop()
+        dec_cb.stop()
+
+
+def test_disagg_replica_status_reports_role_and_pages():
+    rep = DisaggReplica("d0", _engine(role="decode"))
+    rep.start()
+    try:
+        st = rep.status()
+        assert st["role"] == "decode"
+        assert st["pages_free"] == st["pages_total"] > 0
+    finally:
+        rep.stop()
+
+
+def test_serving_resolves_disagg_router_from_env(monkeypatch):
+    from analytics_zoo_tpu.pipeline.inference import InferenceModel
+    from analytics_zoo_tpu.pipeline.inference.serving import (
+        _resolve_gen_batcher)
+    net, params = _toy_transformer()
+    im = InferenceModel()
+    im.load_generator(net, params, max_slots=2, max_context=SEQ,
+                      page_size=8)
+    monkeypatch.setenv("ZOO_TPU_DISAGG", "1")
+    monkeypatch.setenv("ZOO_TPU_DISAGG_PREFILL_REPLICAS", "1")
+    monkeypatch.setenv("ZOO_TPU_DISAGG_DECODE_REPLICAS", "2")
+    gb = _resolve_gen_batcher(im, "auto")
+    assert isinstance(gb, DisaggRouter)
+    assert len(gb.prefill) == 1 and len(gb.decode) == 2
+    # pool workers (role-specific engines) keep the plain batcher
+    im2 = InferenceModel()
+    im2.load_generator(net, params, max_slots=2, max_context=SEQ,
+                       page_size=8, role="decode")
+    assert isinstance(_resolve_gen_batcher(im2, "auto"),
+                      ContinuousBatcher)
+    monkeypatch.setenv("ZOO_TPU_DISAGG", "0")
+    assert isinstance(_resolve_gen_batcher(im, "auto"),
+                      ContinuousBatcher)
