@@ -9,7 +9,8 @@ IMAGE ?= analytics-zoo-tpu
     lint obs-smoke fused-conformance flops-audit serving-smoke \
     bench-serving bench-serving-fleet trace-smoke trace-report \
     slo-smoke perf-sentinel fleet-smoke generate-smoke \
-    bench-generate chaos-smoke autotune autotune-smoke
+    bench-generate chaos-smoke autotune autotune-smoke \
+    dashboard-smoke
 
 # unit tests plus the end-to-end telemetry smokes (metrics
 # exposition, tracing, SLO control loop), so `make test` proves the
@@ -24,6 +25,7 @@ test:
 	$(MAKE) generate-smoke
 	$(MAKE) chaos-smoke
 	$(MAKE) autotune-smoke
+	$(MAKE) dashboard-smoke
 	python scripts/perf_sentinel.py --advisory
 
 # conv+BN (+ residual-epilogue) conformance: the exact Pallas kernel
@@ -127,6 +129,13 @@ autotune:
 # cache hits (zero sweeps, counter-asserted), report renders
 autotune-smoke:
 	JAX_PLATFORMS=cpu python scripts/autotune_smoke.py
+
+# metric-history plane end-to-end: MetricHistory sampling cost under
+# a byte cap, capacity_forecast firing with a finite KV-page ETA
+# BEFORE saturation, /debug/metrics/history + /debug/dashboard on
+# both HTTP front-ends, fleet-merged series (docs/observability.md)
+dashboard-smoke:
+	JAX_PLATFORMS=cpu python scripts/dashboard_smoke.py
 
 docker-build:
 	docker build -t $(IMAGE) -f docker/Dockerfile .

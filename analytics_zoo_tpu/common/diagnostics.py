@@ -55,6 +55,8 @@ __all__ = [
     "get_recompile_monitor",
     "update_device_memory_gauges",
     "update_process_vitals",
+    "build_info",
+    "update_build_info",
 ]
 
 # control loops (e.g. the rollout controller's canary auto-rollback,
@@ -432,6 +434,69 @@ def update_process_vitals() -> dict:
                        "process").set(n_fds)
         out["open_fds"] = n_fds
     return out
+
+
+# build_info is stable for the life of the process (version, jax,
+# device kind, flag fingerprint) — computed once, cached
+_build_info_lock = threading.Lock()
+_build_info: "Optional[dict]" = None
+
+
+def build_info() -> dict:
+    """Provenance of this process: package + jax versions, the
+    accelerator kind, and a fingerprint (first 12 sha256 hex chars)
+    of every active ``ZOO_TPU_*`` flag — enough to answer "what
+    exactly was running?" from a scrape or a bench artifact. Cached;
+    jax is probed lazily and failure degrades to ``"none"`` /
+    ``"unknown"`` (the executor-side import constraint)."""
+    global _build_info
+    with _build_info_lock:
+        if _build_info is not None:
+            return dict(_build_info)
+        import hashlib
+
+        from analytics_zoo_tpu.version import __version__
+        jax_version = "none"
+        device = "unknown"
+        try:
+            import jax
+
+            jax_version = jax.__version__
+            devs = jax.devices()
+            if devs:
+                device = getattr(devs[0], "device_kind",
+                                 devs[0].platform)
+        except Exception:
+            pass
+        flags = sorted(f"{k}={v}" for k, v in os.environ.items()
+                       if k.startswith("ZOO_TPU_"))
+        fp = hashlib.sha256(
+            "\n".join(flags).encode()).hexdigest()[:12]
+        _build_info = {
+            "version": __version__,
+            "jax": jax_version,
+            "device": str(device),
+            "flags_fingerprint": fp,
+            "flags": flags,
+        }
+        return dict(_build_info)
+
+
+def update_build_info() -> dict:
+    """Publish :func:`build_info` as the info-style gauge
+    ``zoo_tpu_build_info{version,jax,device,flags}`` (value pinned
+    to 1 — the labels ARE the payload, the Prometheus
+    ``*_build_info`` convention). Called on every ``/metrics``
+    render next to :func:`update_process_vitals`."""
+    info = build_info()
+    obs.gauge("zoo_tpu_build_info",
+              help="build/runtime provenance as labels "
+                   "(value is always 1)",
+              labels={"version": info["version"],
+                      "jax": info["jax"],
+                      "device": info["device"],
+                      "flags": info["flags_fingerprint"]}).set(1)
+    return info
 
 
 def update_device_memory_gauges() -> int:

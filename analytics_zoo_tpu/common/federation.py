@@ -53,6 +53,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from analytics_zoo_tpu.common import diagnostics
 from analytics_zoo_tpu.common import observability as obs
+from analytics_zoo_tpu.common import timeseries
 from analytics_zoo_tpu.common import tracing
 
 __all__ = [
@@ -371,6 +372,14 @@ def _fed_spans(replica: str):
                        labels={"replica": replica})
 
 
+def _fed_source_age(replica: str):
+    return obs.gauge("zoo_tpu_fed_source_age_s",
+                     help="age of each source's newest good "
+                          "scrape (carried-forward data shows "
+                          "its true staleness here)",
+                     labels={"replica": replica})
+
+
 def _fed_p99_gauge():
     return obs.gauge("zoo_tpu_fed_latency_p99_seconds",
                      help="fleet-wide /predict p99 over the last "
@@ -495,6 +504,12 @@ class TelemetryCollector:
         self._prev_replica_stats: "Dict[str, dict]" = {}
         self._cursors: "Dict[str, int]" = {}    # source -> trace seq
         self._source_meta: "Dict[str, dict]" = {}
+        self._carried: "List[str]" = []
+        # fleet-merged metric history: one timeline across replicas
+        # (append-only — fed merged snapshots each tick; served via
+        # GET /debug/metrics/history?fleet=1)
+        self.history = timeseries.MetricHistory(
+            registry=None, clock=self._clock)
         self._ticks = 0
         self._last_tick_at: Optional[float] = None
         self._thread: Optional[threading.Thread] = None
@@ -515,10 +530,13 @@ class TelemetryCollector:
                 url, timeout=self.scrape_timeout_s) as resp:
             return json.loads(resp.read())
 
-    def _scrape_one(self, name: str, url: str) -> None:
+    def _scrape_one(self, name: str, url: str,
+                    at: Optional[float] = None) -> None:
         """One source's metrics + incremental trace scrape; records
         the outcome, never raises (telemetry must not take down the
-        router)."""
+        router). ``at`` is the tick timestamp the scrape is stamped
+        with (falls back to the clock), so source ages stay on the
+        injectable-clock timeline."""
         meta = self._source_meta.setdefault(name, {})
         try:
             payload = self._fetch_json(url + "/metrics/json")
@@ -538,12 +556,13 @@ class TelemetryCollector:
         if n:
             _fed_spans(name).inc(n)
         meta.update(ok=True, error=None,
-                    last_scrape_at=self._clock(),
+                    last_scrape_at=(self._clock() if at is None
+                                    else float(at)),
                     spans_collected=meta.get("spans_collected", 0)
                     + n)
         self._snaps[name] = snap
 
-    def _scrape_router(self) -> None:
+    def _scrape_router(self, at: Optional[float] = None) -> None:
         """The router's own process is always a source: its registry
         snapshot (which covers in-process replicas) and its local
         trace ring, read through the same incremental cursor."""
@@ -557,7 +576,9 @@ class TelemetryCollector:
             _fed_spans("router").inc(n)
         self._snaps["router"] = obs.snapshot()
         self._source_meta.setdefault("router", {}).update(
-            ok=True, error=None, last_scrape_at=self._clock(),
+            ok=True, error=None,
+            last_scrape_at=(self._clock() if at is None
+                            else float(at)),
             spans_collected=self._source_meta.get(
                 "router", {}).get("spans_collected", 0) + n)
 
@@ -600,15 +621,27 @@ class TelemetryCollector:
             t = self._clock() if now is None else float(now)
             self._prev_snaps = dict(self._snaps)
             self._snaps = {}
-            self._scrape_router()
+            self._scrape_router(at=t)
             for name, url in self._http_sources():
-                self._scrape_one(name, url)
+                self._scrape_one(name, url, at=t)
             # carry forward the last good snapshot of a source that
             # failed this tick (stale beats absent for merged views)
+            # — but record WHICH sources are stale, and publish each
+            # source's true data age so staleness is never hidden
+            carried = [name for name in self._prev_snaps
+                       if name not in self._snaps]
+            self._carried = carried
             for name, snap in self._prev_snaps.items():
                 self._snaps.setdefault(name, snap)
+            for name in self._snaps:
+                at = self._source_meta.get(name, {}).get(
+                    "last_scrape_at")
+                if at is not None:
+                    _fed_source_age(name).set(
+                        round(max(t - at, 0.0), 3))
             merged, conflicts = merge_snapshots(self._snaps)
             self._merged, self._conflicts = merged, conflicts
+            self.history.append(t, merged)
             self._ticks += 1
             self._last_tick_at = t
             _fed_sources_gauge().set(len(self._snaps))
@@ -677,6 +710,7 @@ class TelemetryCollector:
                     "error": meta.get("error"),
                     "age_s": (round(now - at, 3)
                               if at is not None else None),
+                    "carried_forward": name in self._carried,
                     "spans_collected": meta.get(
                         "spans_collected", 0),
                     "trace_cursor": self._cursors.get(name, 0),
@@ -685,6 +719,7 @@ class TelemetryCollector:
                 "ticks": self._ticks,
                 "tick_s": self.tick_s,
                 "sources": sources,
+                "history": self.history.stats(),
                 "conflicts": list(self._conflicts),
                 "replica_stats": dict(self._prev_replica_stats),
                 "skew": dict(self.skew.last),

@@ -35,9 +35,11 @@ Naming convention (see docs/observability.md): every metric is
 from __future__ import annotations
 
 import bisect
+import gzip
 import json
 import os
 import re
+import shutil
 import threading
 import time
 from typing import Any, Dict, Optional, Sequence, Tuple
@@ -405,14 +407,57 @@ def to_prometheus() -> str:
 _event_lock = threading.Lock()
 _event_path: Optional[str] = None
 _event_fh = None
+_rotated_bytes = 0  # total size of rotated segments (metrics feed)
+
+
+def _event_log_keep() -> int:
+    try:
+        return int(os.environ.get("ZOO_TPU_EVENT_LOG_KEEP", "3"))
+    except ValueError:
+        return 3
+
+
+def _gzip_segment(path: str):
+    """Compress a freshly-rotated segment in place (``path`` →
+    ``path.gz``). Best-effort: on failure the uncompressed segment
+    is kept and the partial ``.gz`` removed."""
+    try:
+        with open(path, "rb") as src, \
+                gzip.open(path + ".gz", "wb") as dst:
+            shutil.copyfileobj(src, dst)
+        os.remove(path)
+    except OSError:
+        try:
+            os.remove(path + ".gz")
+        except OSError:
+            pass
+
+
+def _scan_rotated_bytes() -> int:
+    """On-disk size of the rotated segments (``.N.gz`` and legacy
+    uncompressed ``.N``) still inside the keep window."""
+    if not _event_path:
+        return 0
+    total = 0
+    for i in range(1, _event_log_keep() + 1):
+        for ext in (".gz", ""):
+            try:
+                total += os.path.getsize(
+                    f"{_event_path}.{i}{ext}")
+            except OSError:
+                pass
+    return total
 
 
 def _rotate_locked():
     """Size-based rotation: when ``ZOO_TPU_EVENT_LOG_MAX_MB`` is set
     and the sink grew past it, shift ``path.1 → path.2 → ...``
-    (keeping ``ZOO_TPU_EVENT_LOG_KEEP`` rotated files, default 3) and
-    reopen a fresh ``path``. Called with ``_event_lock`` held."""
-    global _event_fh
+    (keeping ``ZOO_TPU_EVENT_LOG_KEEP`` rotated files, default 3),
+    gzip-compress the fresh ``path.1`` (``ZOO_TPU_EVENT_LOG_GZIP=0``
+    keeps it raw) and reopen a fresh ``path``. Each rotation bumps
+    ``zoo_tpu_event_log_rotations_total``. Called with
+    ``_event_lock`` held."""
+    global _event_fh, _rotated_bytes
     raw = os.environ.get("ZOO_TPU_EVENT_LOG_MAX_MB")
     if not raw or _event_fh is None:
         return
@@ -428,28 +473,36 @@ def _rotate_locked():
         _event_fh.close()
     except (OSError, ValueError):
         return
-    try:
-        keep = int(os.environ.get("ZOO_TPU_EVENT_LOG_KEEP", "3"))
-    except ValueError:
-        keep = 3
+    keep = _event_log_keep()
+    rotated = False
     try:
         for i in range(max(keep - 1, 0), 0, -1):
-            src = f"{_event_path}.{i}"
-            if os.path.exists(src):
-                os.replace(src, f"{_event_path}.{i + 1}")
+            for ext in (".gz", ""):
+                src = f"{_event_path}.{i}{ext}"
+                if os.path.exists(src):
+                    os.replace(src, f"{_event_path}.{i + 1}{ext}")
         if keep >= 1:
             os.replace(_event_path, _event_path + ".1")
+            rotated = True
+            if os.environ.get("ZOO_TPU_EVENT_LOG_GZIP",
+                              "1") != "0":
+                _gzip_segment(_event_path + ".1")
         else:
             os.remove(_event_path)
+            rotated = True
     except OSError:
         pass  # rotation is best-effort; keep logging regardless
     _event_fh = open(_event_path, "a", encoding="utf-8")
+    _rotated_bytes = _scan_rotated_bytes()
+    if rotated:
+        counter("zoo_tpu_event_log_rotations_total",
+                help="event-log segment rotations").inc()
 
 
 def _event_sink():
     """Cached append handle for ``ZOO_TPU_EVENT_LOG`` (re-resolved
     per call so tests can repoint the env var)."""
-    global _event_path, _event_fh
+    global _event_path, _event_fh, _rotated_bytes
     path = os.environ.get("ZOO_TPU_EVENT_LOG")
     if not path:
         return None
@@ -461,6 +514,7 @@ def _event_sink():
                 pass
         _event_fh = open(path, "a", encoding="utf-8")
         _event_path = path
+        _rotated_bytes = _scan_rotated_bytes()
     _rotate_locked()
     return _event_fh
 
@@ -484,10 +538,18 @@ def event(name: str, **fields):
             line = json.dumps(rec)
         fh.write(line + "\n")
         fh.flush()
+        try:
+            # live + rotated footprint: the disk feed the capacity
+            # forecaster extrapolates (docs/observability.md)
+            gauge("zoo_tpu_event_log_bytes",
+                  help="event-log bytes on disk (live segment + "
+                       "rotated)").set(fh.tell() + _rotated_bytes)
+        except (OSError, ValueError):
+            pass
 
 
 def _close_event_log():
-    global _event_path, _event_fh
+    global _event_path, _event_fh, _rotated_bytes
     with _event_lock:
         if _event_fh is not None:
             try:
@@ -496,6 +558,7 @@ def _close_event_log():
                 pass
         _event_fh = None
         _event_path = None
+        _rotated_bytes = 0
 
 
 def reset_metrics():

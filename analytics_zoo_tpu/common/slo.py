@@ -17,9 +17,13 @@ judges the registry against them, Google-SRE style:
   ticker (``ZOO_TPU_SLO_TICK_S``, default 5 s; ``0`` = manual
   :meth:`~SLOEngine.tick` only) and evaluates every rule against
   windowed *deltas* of those snapshots, so cumulative counters and
-  histograms become per-window rates and quantiles. Early in a
-  process's life, windows clip to engine uptime (the oldest snapshot
-  stands in for one that is not old enough yet).
+  histograms become per-window rates and quantiles. Snapshot history
+  lives in a shared
+  :class:`~analytics_zoo_tpu.common.timeseries.MetricHistory` (one
+  history, one clock — the same store that backs
+  ``/debug/metrics/history`` and the capacity forecaster). Early in
+  a process's life, windows clip to engine uptime (the oldest
+  snapshot stands in for one that is not old enough yet).
 - a healthy→breach transition increments
   ``zoo_tpu_slo_breaches_total{slo}`` exactly once and rides the
   existing :func:`diagnostics.anomaly` pipeline
@@ -28,7 +32,7 @@ judges the registry against them, Google-SRE style:
   :meth:`~SLOEngine.status`.
 
 Shipped default objectives live in :data:`DEFAULT_SERVING_SLOS`,
-:data:`DEFAULT_FLEET_SLOS` and
+:data:`DEFAULT_FLEET_SLOS`, :data:`DEFAULT_FORECAST_SLOS` and
 :data:`DEFAULT_TRAINING_SLOS` as pure dict literals so
 ``scripts/lint.py`` can validate them (metric names, windows,
 duplicate ids) without importing this module. Thresholds are
@@ -46,11 +50,11 @@ from __future__ import annotations
 import os
 import threading
 import time
-from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
 from analytics_zoo_tpu.common import diagnostics
 from analytics_zoo_tpu.common import observability as obs
+from analytics_zoo_tpu.common import timeseries
 
 __all__ = [
     "SLO",
@@ -58,6 +62,7 @@ __all__ = [
     "DEFAULT_SERVING_SLOS",
     "DEFAULT_FLEET_SLOS",
     "DEFAULT_FED_SLOS",
+    "DEFAULT_FORECAST_SLOS",
     "DEFAULT_TRAINING_SLOS",
     "get_engine",
     "install_defaults",
@@ -177,6 +182,31 @@ DEFAULT_FED_SLOS = [
                    "metric": "zoo_tpu_fed_error_ratio"},
         "threshold": 0.05,
         "op": ">",
+        "windows": [60.0],
+    },
+]
+
+DEFAULT_FORECAST_SLOS = [
+    {
+        "id": "forecast_capacity_pending",
+        "description": "no capacity-exhaustion forecast is "
+                       "pending (predictive anomaly rate stays 0)",
+        "signal": {"type": "rate",
+                   "metric": "zoo_tpu_anomalies_total",
+                   "labels": {"kind": "capacity_forecast"}},
+        "threshold": 0.0,
+        "op": ">",
+        "windows": [300.0],
+    },
+    {
+        "id": "forecast_kv_pages_eta",
+        "description": "KV-page exhaustion stays more than 2 min "
+                       "out at the current admission trend",
+        "signal": {"type": "gauge",
+                   "metric": "zoo_tpu_forecast_eta_s",
+                   "labels": {"resource": "kv_pages"}},
+        "threshold": 120.0,
+        "op": "<",
         "windows": [60.0],
     },
 ]
@@ -402,16 +432,29 @@ class SLOEngine:
 
     ``clock`` is injectable (monotonic seconds) so the breach
     lifecycle is unit-testable without sleeps; :meth:`tick` likewise
-    accepts an explicit ``now``."""
+    accepts an explicit ``now``. Snapshot history lives in a
+    :class:`~analytics_zoo_tpu.common.timeseries.MetricHistory`
+    (``history``): the global engine shares the process-global
+    history that also feeds ``/debug/metrics/history`` and the
+    forecaster; explicit-registry engines get a private one on the
+    same clock."""
 
     def __init__(self, registry: "Optional[obs.MetricsRegistry]" = None,
-                 clock: "Optional[Callable[[], float]]" = None):
+                 clock: "Optional[Callable[[], float]]" = None,
+                 history: "Optional[timeseries.MetricHistory]" = None):
+        if history is None:
+            if registry is None and clock is None:
+                history = timeseries.get_history()
+            else:
+                history = timeseries.MetricHistory(
+                    registry=registry or obs.get_registry(),
+                    clock=clock)
+        self.history = history
         self._registry = registry or obs.get_registry()
         self._clock = clock or time.monotonic
         self._lock = threading.RLock()
         self._rules: "Dict[str, SLO]" = {}
         self._states: "Dict[str, dict]" = {}
-        self._history: "deque" = deque(maxlen=4096)
         self._ticks = 0
         self._interval_s: Optional[float] = None
         self._stop_evt = threading.Event()
@@ -439,21 +482,14 @@ class SLOEngine:
         with self._lock:
             self._rules.clear()
             self._states.clear()
-            self._history.clear()
+            self.history.clear()
 
     # -- evaluation ---------------------------------------------------------
     def _baseline(self, now: float, window_s: float):
         """Newest snapshot at least ``window_s`` old; the oldest one
-        stands in while the engine is younger than the window."""
-        best = None
-        for ts, snap in self._history:
-            if ts <= now - window_s:
-                best = (ts, snap)
-            else:
-                break
-        if best is None and self._history:
-            best = self._history[0]
-        return best
+        stands in while the engine is younger than the window
+        (delegated to the shared :class:`MetricHistory`)."""
+        return self.history.baseline(now, window_s)
 
     def _window_result(self, rule: SLO, snap: dict, now: float,
                        window_s: float) -> dict:
@@ -555,23 +591,21 @@ class SLOEngine:
             max_w = max((r.windows[-1]
                          for r in self._rules.values()),
                         default=600.0)
-        h = self._history
-        horizon = now - max_w
         # keep the newest snapshot that is already older than the
         # largest window: it is the baseline for full-width windows
-        while len(h) >= 2 and h[1][0] <= horizon:
-            h.popleft()
+        # (the MetricHistory prune contract)
+        self.history.prune(now, keep_s=max_w)
 
     def tick(self, now: Optional[float] = None) -> dict:
         """Snapshot the registry, evaluate every rule against history
         (which holds strictly older snapshots), then append the new
-        snapshot. Returns :meth:`status`."""
+        snapshot to the shared history. Returns :meth:`status`."""
         with self._lock:
             t = self._clock() if now is None else float(now)
             snap = self._registry.snapshot()
             for rule in list(self._rules.values()):
                 self._evaluate(rule, snap, t)
-            self._history.append((t, snap))
+            self.history.append(t, snap)
             self._prune(t)
             self._ticks += 1
             return self._status_locked()
@@ -676,15 +710,18 @@ def _env_overrides(d: dict) -> dict:
 
 def install_defaults(engine: SLOEngine, role: str) -> int:
     """Install the shipped objectives for ``role`` (``"serving"``,
-    ``"fleet"``, ``"fed"`` or ``"training"``) into ``engine``,
-    skipping ids already present (idempotent; user-replaced rules
-    are never clobbered). Returns how many rules were added."""
+    ``"fleet"``, ``"fed"``, ``"forecast"`` or ``"training"``) into
+    ``engine``, skipping ids already present (idempotent;
+    user-replaced rules are never clobbered). Returns how many rules
+    were added."""
     if role == "serving":
         defaults = DEFAULT_SERVING_SLOS
     elif role == "fleet":
         defaults = DEFAULT_FLEET_SLOS
     elif role == "fed":
         defaults = DEFAULT_FED_SLOS
+    elif role == "forecast":
+        defaults = DEFAULT_FORECAST_SLOS
     elif role == "training":
         defaults = DEFAULT_TRAINING_SLOS
     else:

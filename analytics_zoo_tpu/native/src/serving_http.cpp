@@ -60,8 +60,10 @@ struct Server {
     std::mutex mu;
     std::condition_variable cv;
     std::deque<Request> queue;
-    // req_id -> (connection fd, wants text/plain i.e. GET /metrics)
-    std::map<long, std::pair<int, bool>> pending;
+    // req_id -> (connection fd, response content-type code:
+    // 0 = application/json, 1 = Prometheus text (GET /metrics),
+    // 2 = text/html (GET /debug/dashboard))
+    std::map<long, std::pair<int, int>> pending;
     long next_id = 1;
     std::string health = "{\"status\": \"ok\"}";
 };
@@ -175,9 +177,10 @@ void handle_conn(Server* s, int fd) {
         // GET /metrics[?...], /metrics/json and GET /debug/* ride
         // the worker queue: Python owns the metrics registry, the
         // trace store, and the fleet federation collector. The
-        // pending flag picks the response content-type: Prometheus
+        // pending code picks the response content-type: Prometheus
         // text for /metrics (with or without a ?fleet=1 query),
-        // JSON for everything else including /metrics/json.
+        // HTML for /debug/dashboard, JSON for everything else
+        // including /metrics/json.
         bool is_json_metrics = method == "GET" &&
             (path == "/metrics/json" ||
              path.rfind("/metrics/json?", 0) == 0);
@@ -186,6 +189,9 @@ void handle_conn(Server* s, int fd) {
              path.rfind("/metrics?", 0) == 0);
         bool is_debug = method == "GET" &&
             path.rfind("/debug/", 0) == 0;
+        bool is_dashboard = method == "GET" &&
+            (path == "/debug/dashboard" ||
+             path.rfind("/debug/dashboard?", 0) == 0);
         if (method == "GET" && path == "/health") {
             std::string payload;
             {
@@ -207,7 +213,8 @@ void handle_conn(Server* s, int fd) {
                 req.body = std::move(body);
                 req.trace = std::move(trace);
                 req.fd = fd;
-                s->pending[req.id] = {fd, is_metrics};
+                s->pending[req.id] =
+                    {fd, is_metrics ? 1 : (is_dashboard ? 2 : 0)};
                 s->queue.push_back(std::move(req));
             }
             s->cv.notify_one();
@@ -334,13 +341,13 @@ static int respond_impl(void* h, long req_id, int status,
                         const char* trace) {
     auto* s = static_cast<Server*>(h);
     int fd = -1;
-    bool is_metrics = false;
+    int ctype_code = 0;
     {
         std::lock_guard<std::mutex> g(s->mu);
         auto it = s->pending.find(req_id);
         if (it == s->pending.end()) return -1;
         fd = it->second.first;
-        is_metrics = it->second.second;
+        ctype_code = it->second.second;
         s->pending.erase(it);
     }
     std::string extra;
@@ -350,8 +357,9 @@ static int respond_impl(void* h, long req_id, int status,
     }
     send_response(fd, status,
                   std::string(body, static_cast<size_t>(len)),
-                  is_metrics ? "text/plain; version=0.0.4"
-                             : "application/json",
+                  ctype_code == 1 ? "text/plain; version=0.0.4"
+                  : ctype_code == 2 ? "text/html; charset=utf-8"
+                  : "application/json",
                   extra);
     ::close(fd);
     return 0;

@@ -33,6 +33,18 @@ GET  /debug/fleet  →  fleet topology + per-replica lifecycle state
 GET  /debug/rollout  →  warm-swap rollout state machine + canary
      split + per-replica versions (docs/robustness.md); 404 on
      single-model servers
+GET  /debug/metrics/history[?family=&window=&fleet=1]  →  windowed
+     metric time series from the in-process history store
+     (docs/observability.md §History): no ``family`` lists known
+     families + store stats; with one, per-label-set points
+     (counters as deltas+rates, histograms as quantile summaries).
+     ``fleet=1`` reads the federation collector's merged fleet
+     timeline instead of the local store
+GET  /debug/dashboard  →  dependency-free single-file HTML live
+     dashboard (inline SVG sparklines over the history API: QPS,
+     p99, queue depth, goodput/MFU, KV pages free, forecast ETAs,
+     anomaly rate + SLO state); ``?fleet=1`` renders the merged
+     fleet timeline
 POST /debug/profile {"dir": ..., "ms": 500}  →  on-demand jax.profiler
      capture written to ``dir`` (one at a time; 503 while busy)
 
@@ -68,8 +80,10 @@ from typing import Optional, Tuple
 import numpy as np
 
 from analytics_zoo_tpu.common import diagnostics
+from analytics_zoo_tpu.common import forecast as forecast_lib
 from analytics_zoo_tpu.common import observability as obs
 from analytics_zoo_tpu.common import slo as slo_lib
+from analytics_zoo_tpu.common import timeseries
 from analytics_zoo_tpu.common import tracing
 from analytics_zoo_tpu.pipeline.inference.batching import (
     DeadlineExpiredError, DynamicBatcher, QueueFullError)
@@ -386,9 +400,10 @@ def _fed_collector(batcher):
 
 def _metrics_text() -> bytes:
     """Local-registry Prometheus text; refreshes the process vitals
-    gauges first so every scrape carries current RSS/uptime/fd
-    readings (docs/observability.md)."""
+    + build-info gauges first so every scrape carries current
+    RSS/uptime/fd readings and provenance (docs/observability.md)."""
     diagnostics.update_process_vitals()
+    diagnostics.update_build_info()
     return obs.to_prometheus().encode()
 
 
@@ -397,6 +412,7 @@ def _metrics_json_payload() -> dict:
     collector scrapes — same data as ``/metrics``, machine-mergeable
     (explicit ``application/json``)."""
     diagnostics.update_process_vitals()
+    diagnostics.update_build_info()
     return {"ts": time.time(), "metrics": obs.snapshot()}
 
 
@@ -510,6 +526,228 @@ def _slo_payload(path: str) -> dict:
     if q.get("tick", ["1"])[0] != "0":
         return engine.tick()
     return engine.status()
+
+
+def _history_payload(path: str, batcher=None
+                     ) -> "Tuple[int, dict]":
+    """``GET /debug/metrics/history[?family=&window=&fleet=1]``:
+    windowed series from the in-process
+    :class:`~analytics_zoo_tpu.common.timeseries.MetricHistory`.
+    Without ``family``, lists known families + store stats. The
+    local store takes a fresh sample first by default (so the
+    response reflects this instant even with no background ticker;
+    ``sample=0`` reads passively); ``fleet=1`` serves the federation
+    collector's merged fleet timeline instead (``tick=1`` forces a
+    synchronous collector tick first)."""
+    from urllib.parse import parse_qs, urlsplit
+    q = parse_qs(urlsplit(path).query)
+    fleet = q.get("fleet", ["0"])[0] == "1"
+    if fleet:
+        tele = _fed_collector(batcher)
+        if tele is None:
+            _count_error("not_found")
+            return 404, _error_body(
+                404, "no fleet telemetry collector mounted")
+        if q.get("tick", ["0"])[0] == "1":
+            tele.tick()
+        hist = tele.history
+    else:
+        hist = timeseries.get_history()
+        if q.get("sample", ["1"])[0] != "0":
+            hist.sample()
+    window_s = None
+    if q.get("window"):
+        try:
+            window_s = float(q["window"][0])
+        except ValueError:
+            _count_error("bad_request")
+            return 400, _error_body(
+                400, f"bad window {q['window'][0]!r} "
+                "(seconds expected)")
+        if window_s <= 0:
+            _count_error("bad_request")
+            return 400, _error_body(
+                400, "window must be positive seconds")
+    family = q.get("family", [None])[0]
+    if not family:
+        return 200, {"fleet": fleet,
+                     "families": hist.families(),
+                     "stats": hist.stats()}
+    return 200, dict(hist.series(family, window_s=window_s),
+                     fleet=fleet)
+
+
+# The live dashboard: ONE self-contained HTML file, zero external
+# assets (loads even when the fleet is on fire and a CDN is not an
+# option). All series come from /debug/metrics/history; sparklines
+# are inline SVG built client-side.
+_DASHBOARD_PAGE = """<!doctype html>
+<html><head><meta charset="utf-8">
+<title>analytics-zoo-tpu dashboard</title>
+<style>
+body{font:13px/1.4 system-ui,sans-serif;margin:16px;
+     background:#0b0e14;color:#d6deeb}
+h1{font-size:16px;margin:0 0 2px}
+#meta{color:#7a88a8;margin-bottom:12px}
+#panels{display:grid;gap:10px;
+        grid-template-columns:repeat(auto-fill,minmax(290px,1fr))}
+.panel{background:#131824;border:1px solid #232b3d;
+       border-radius:6px;padding:8px 10px}
+.panel h2{font-size:12px;margin:0 0 4px;color:#9fb2d8;
+          font-weight:600}
+.row{display:flex;align-items:center;gap:8px;margin:2px 0}
+.lbl{color:#7a88a8;font-size:11px;white-space:nowrap;
+     overflow:hidden;text-overflow:ellipsis;max-width:45%}
+.val{margin-left:auto;font-variant-numeric:tabular-nums}
+.nodata{color:#53607c;font-style:italic}
+svg{flex:1 1 auto;min-width:60px}
+polyline{fill:none;stroke:#58a6ff;stroke-width:1.5}
+.bad polyline{stroke:#ff7b72}
+#slo .breach{color:#ff7b72}
+#slo .ok{color:#3fb950}
+#slo .no_data{color:#53607c}
+</style></head><body>
+<h1>analytics-zoo-tpu &mdash; live dashboard</h1>
+<div id="meta">loading&hellip;</div>
+<div id="panels"></div>
+<div class="panel" id="slo" style="margin-top:10px">
+<h2>SLO state &amp; recent anomalies</h2>
+<div id="slobody" class="nodata">loading&hellip;</div></div>
+<script>
+"use strict";
+var FLEET = new URLSearchParams(location.search)
+    .get("fleet") === "1";
+var SUFFIX = FLEET ? "&fleet=1" : "";
+var PANELS = [
+  {t: "QPS (requests/s)", f: "zoo_tpu_serving_requests_total",
+   k: "rate"},
+  {t: "p99 latency (s)", f: "zoo_tpu_serving_request_seconds",
+   k: "q99"},
+  {t: "queue depth", f: "zoo_tpu_serving_queue_depth",
+   k: "value"},
+  {t: "KV pages free", f: "zoo_tpu_serving_gen_free_pages",
+   k: "value"},
+  {t: "goodput share", f: "zoo_tpu_goodput_share", k: "value"},
+  {t: "MFU", f: "zoo_tpu_mfu", k: "value"},
+  {t: "forecast ETA (s)", f: "zoo_tpu_forecast_eta_s",
+   k: "value", bad: function (v) { return v < 600; }},
+  {t: "anomalies/s", f: "zoo_tpu_anomalies_total", k: "rate",
+   bad: function (v) { return v > 0; }}
+];
+function esc(s) {
+  return String(s).replace(/[&<>"]/g, function (c) {
+    return {"&": "&amp;", "<": "&lt;", ">": "&gt;",
+            '"': "&quot;"}[c];
+  });
+}
+function spark(vals) {
+  var w = 120, h = 26;
+  if (vals.length < 2) {
+    return '<svg width="' + w + '" height="' + h + '"></svg>';
+  }
+  var lo = Math.min.apply(null, vals);
+  var hi = Math.max.apply(null, vals);
+  var span = (hi - lo) || 1;
+  var pts = vals.map(function (v, i) {
+    var x = i * w / (vals.length - 1);
+    var y = h - 2 - (v - lo) / span * (h - 4);
+    return x.toFixed(1) + "," + y.toFixed(1);
+  }).join(" ");
+  return '<svg width="' + w + '" height="' + h +
+    '" viewBox="0 0 ' + w + " " + h +
+    '"><polyline points="' + pts + '"/></svg>';
+}
+function fmtv(v) {
+  if (v === null || v === undefined) { return "-"; }
+  if (v >= 1e8) { return "&#8734;"; }
+  if (Math.abs(v) >= 100) { return v.toFixed(0); }
+  return v.toPrecision(3);
+}
+function labelText(labels) {
+  var ks = Object.keys(labels);
+  if (!ks.length) { return "total"; }
+  return ks.map(function (k) {
+    return k + "=" + labels[k];
+  }).join(",");
+}
+function renderPanel(p, doc) {
+  var html = "<h2>" + esc(p.t) + "</h2>";
+  var series = (doc && doc.series) || [];
+  var rows = 0;
+  series.forEach(function (s) {
+    var vals = s.points.map(function (pt) {
+      return pt[p.k];
+    }).filter(function (v) {
+      return v !== null && v !== undefined;
+    });
+    if (!vals.length) { return; }
+    rows += 1;
+    var last = vals[vals.length - 1];
+    var bad = p.bad && p.bad(last);
+    html += '<div class="row' + (bad ? " bad" : "") +
+      '"><span class="lbl" title="' +
+      esc(labelText(s.labels)) + '">' +
+      esc(labelText(s.labels)) + "</span>" + spark(vals) +
+      '<span class="val">' + fmtv(last) + "</span></div>";
+  });
+  if (!rows) {
+    html += '<div class="nodata">no data</div>';
+  }
+  return html;
+}
+function refresh() {
+  PANELS.forEach(function (p, i) {
+    fetch("/debug/metrics/history?family=" + p.f + SUFFIX)
+      .then(function (r) { return r.json(); })
+      .then(function (doc) {
+        document.getElementById("p" + i).innerHTML =
+          renderPanel(p, doc);
+      }).catch(function () {});
+  });
+  fetch("/debug/metrics/history?" + (FLEET ? "fleet=1" : ""))
+    .then(function (r) { return r.json(); })
+    .then(function (doc) {
+      var st = doc.stats || {};
+      document.getElementById("meta").textContent =
+        (FLEET ? "fleet-merged timeline" : "local timeline") +
+        " \\u00b7 " + (st.raw_samples || 0) + " samples over " +
+        (st.span_s || 0).toFixed(0) + "s \\u00b7 " +
+        ((st.resident_bytes || 0) / 1024).toFixed(0) +
+        " KiB resident \\u00b7 " + new Date().toLocaleTimeString();
+    }).catch(function () {});
+  fetch("/debug/slo?tick=0")
+    .then(function (r) { return r.json(); })
+    .then(function (doc) {
+      var html = "";
+      (doc.objectives || []).forEach(function (o) {
+        html += '<div class="row"><span class="lbl">' +
+          esc(o.id) + '</span><span class="' + esc(o.state) +
+          '">' + esc(o.state) + "</span>" +
+          '<span class="val">' + fmtv(o.value) + "</span></div>";
+      });
+      document.getElementById("slobody").innerHTML =
+        html || '<div class="nodata">no objectives</div>';
+    }).catch(function () {});
+}
+var panels = document.getElementById("panels");
+PANELS.forEach(function (p, i) {
+  var d = document.createElement("div");
+  d.className = "panel";
+  d.id = "p" + i;
+  d.innerHTML = "<h2>" + esc(p.t) +
+    '</h2><div class="nodata">loading&hellip;</div>';
+  panels.appendChild(d);
+});
+refresh();
+setInterval(refresh, 5000);
+</script></body></html>
+"""
+
+
+def _dashboard_html() -> bytes:
+    """``GET /debug/dashboard``: the self-contained live dashboard
+    page (same bytes on both front-ends)."""
+    return _DASHBOARD_PAGE.encode()
 
 
 # On-demand jax.profiler capture: one at a time per process (the XLA
@@ -745,6 +983,13 @@ class InferenceServer:
                     elif route == "/debug/rollout":
                         status, payload = _rollout_payload(
                             server.batcher)
+                    elif route == "/debug/metrics/history":
+                        status, payload = _history_payload(
+                            self.path, server.batcher)
+                    elif route == "/debug/dashboard":
+                        status = 200
+                        raw = (_dashboard_html(),
+                               "text/html; charset=utf-8")
                     else:
                         status = 404
                         _count_error("not_found")
@@ -851,8 +1096,12 @@ class InferenceServer:
             self.gen_batcher.start()
         # shipped serving objectives + background evaluation ticker
         # (docs/slo.md; ZOO_TPU_SLO=0 disables); a fleet front door
-        # adds the fleet-level objectives on top
+        # adds the fleet-level objectives on top. The SLO ticker
+        # also feeds the shared MetricHistory, which the capacity
+        # forecaster rides (docs/observability.md §Forecasting).
         slo_lib.ensure_default_slos("serving")
+        slo_lib.ensure_default_slos("forecast")
+        forecast_lib.ensure_forecaster()
         if hasattr(self.batcher, "fleet_status"):
             slo_lib.ensure_default_slos("fleet")
             if _fed_collector(self.batcher) is not None:
@@ -947,6 +1196,13 @@ class NativeInferenceServer:
             elif route == "/debug/rollout":
                 status, payload = _rollout_payload(self.batcher)
                 out = json.dumps(payload).encode()
+            elif route == "/debug/metrics/history":
+                status, payload = _history_payload(
+                    path, self.batcher)
+                out = json.dumps(payload).encode()
+            elif route == "/debug/dashboard":
+                status = 200
+                out = _dashboard_html()
             elif route == "/debug/profile":
                 status, payload = handle_profile(body)
                 out = json.dumps(payload).encode()
@@ -1023,6 +1279,8 @@ class NativeInferenceServer:
         if self.gen_batcher is not None:
             self.gen_batcher.start()
         slo_lib.ensure_default_slos("serving")
+        slo_lib.ensure_default_slos("forecast")
+        forecast_lib.ensure_forecaster()
         if hasattr(self.batcher, "fleet_status"):
             slo_lib.ensure_default_slos("fleet")
             if _fed_collector(self.batcher) is not None:

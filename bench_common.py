@@ -38,6 +38,9 @@ self-contained best-so-far record — the last is the most complete):
   source}`` provenance from `analytics_zoo_tpu.perf.autotune` —
   scripts/perf_sentinel.py splits tuned runs into their own ``-tuned``
   lineages keyed on ``enabled``.
+- ``build_info``: package/jax versions, device kind, and the active
+  ``ZOO_TPU_*`` flag fingerprint (`common/diagnostics.build_info` —
+  the same record the ``zoo_tpu_build_info`` gauge exposes).
 
 Exit code 0 iff real signal was banked (chip headline or at least one
 fallback stage record).
@@ -86,6 +89,14 @@ def attach_metrics_snapshot(rec: dict) -> dict:
         # tuned run can never masquerade as a heuristic-config win
         from analytics_zoo_tpu.perf import autotune
         rec["autotune"] = autotune.stats()
+    except Exception:
+        pass
+    try:
+        # provenance: package/jax versions, device kind, and the
+        # ZOO_TPU_* flag fingerprint this run executed under — the
+        # same record the zoo_tpu_build_info gauge exposes
+        from analytics_zoo_tpu.common import diagnostics
+        rec["build_info"] = diagnostics.build_info()
     except Exception:
         pass
     return rec

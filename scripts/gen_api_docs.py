@@ -36,6 +36,10 @@ MODULES = [
      "diagnostics — anomaly detectors & device watermarks"),
     ("analytics_zoo_tpu.common.slo",
      "slo — declarative objectives & burn-rate engine"),
+    ("analytics_zoo_tpu.common.timeseries",
+     "timeseries — bounded in-process metric history"),
+    ("analytics_zoo_tpu.common.forecast",
+     "forecast — capacity trend extrapolation & ETAs"),
     ("analytics_zoo_tpu.common.faults",
      "faults — chaos fault-injection registry"),
     ("analytics_zoo_tpu.common.federation",

@@ -185,7 +185,8 @@ def _bare_except_problems(rel: str, tree: ast.AST) -> list:
 
 
 _SLO_DEFAULT_NAMES = ("DEFAULT_SERVING_SLOS", "DEFAULT_FLEET_SLOS",
-                      "DEFAULT_FED_SLOS", "DEFAULT_TRAINING_SLOS")
+                      "DEFAULT_FED_SLOS", "DEFAULT_TRAINING_SLOS",
+                      "DEFAULT_FORECAST_SLOS")
 _SLO_FILE = os.path.join("analytics_zoo_tpu", "common", "slo.py")
 
 

@@ -36,6 +36,14 @@ best prior comparable value (or, lower-better, a rise past the
 tolerance above it, with a small absolute floor so a 1e-9 conformance
 wiggle over a 0.0 best does not page).
 
+``--history FILE`` additionally digests an exported metric-history
+document (``MetricHistory.export()`` /
+``GET /debug/metrics/history`` — docs/observability.md §History)
+into live serving vitals (QPS, worst p99, queue depth, forecast
+ETAs) printed next to the trajectory table, so a bench round's
+artifact numbers can be eyeballed against what the serving plane
+actually saw over the same window.
+
 Exit codes: 1 when the newest round regressed (0 with
 ``--advisory``), 2 when no artifacts could be loaded, else 0.
 ``make perf-sentinel`` runs it enforcing; ``make test`` runs it
@@ -292,6 +300,50 @@ def trajectory_table(rounds, named=None) -> str:
     return "\n".join(lines)
 
 
+def _history_points(doc: dict, family: str) -> "List[dict]":
+    ser = (doc.get("families") or {}).get(family) or {}
+    out = []
+    for s in ser.get("series") or []:
+        out.extend(s.get("points") or [])
+    return out
+
+
+def history_vitals(doc: dict) -> "List[str]":
+    """Live serving vitals out of an exported metric-history
+    document: mean QPS, worst windowed p99, last queue depth, and
+    any finite forecast ETAs."""
+    lines = []
+    rates = [p["rate"] for p in _history_points(
+        doc, "zoo_tpu_serving_requests_total")
+        if isinstance(p.get("rate"), (int, float))]
+    if rates:
+        lines.append(f"  qps(mean/max): {_fmt(sum(rates) / len(rates))}"
+                     f" / {_fmt(max(rates))}")
+    q99s = [p["q99"] for p in _history_points(
+        doc, "zoo_tpu_serving_request_seconds")
+        if isinstance(p.get("q99"), (int, float))]
+    if q99s:
+        lines.append(f"  p99_s(worst): {_fmt(max(q99s))}")
+    depths = [p["value"] for p in _history_points(
+        doc, "zoo_tpu_serving_queue_depth")
+        if isinstance(p.get("value"), (int, float))]
+    if depths:
+        lines.append(f"  queue_depth(last/max): {_fmt(depths[-1])}"
+                     f" / {_fmt(max(depths))}")
+    etas = (doc.get("families") or {}).get(
+        "zoo_tpu_forecast_eta_s") or {}
+    for s in etas.get("series") or []:
+        pts = [p["value"] for p in s.get("points") or []
+               if isinstance(p.get("value"), (int, float))]
+        if not pts:
+            continue
+        res = (s.get("labels") or {}).get("resource", "?")
+        last = pts[-1]
+        shown = "none" if last >= 1e8 else _fmt(last) + "s"
+        lines.append(f"  forecast_eta[{res}]: {shown}")
+    return lines
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--dir", default=os.path.dirname(
@@ -305,7 +357,22 @@ def main(argv=None) -> int:
                          "whose best prior is ~0")
     ap.add_argument("--advisory", action="store_true",
                     help="print the verdict but always exit 0")
+    ap.add_argument("--history", metavar="FILE",
+                    help="exported metric-history JSON to digest "
+                         "into live serving vitals")
     args = ap.parse_args(argv)
+
+    if args.history:
+        try:
+            with open(args.history, encoding="utf-8") as fh:
+                hdoc = json.load(fh)
+            lines = history_vitals(hdoc)
+            print(f"# live history vitals ({args.history})")
+            print("\n".join(lines) if lines
+                  else "  (no serving series in the export)")
+        except (OSError, ValueError) as e:
+            print(f"perf-sentinel: bad --history file: {e}",
+                  file=sys.stderr)
 
     rounds, named, baseline = load_rounds(args.dir)
     if not rounds and not named:

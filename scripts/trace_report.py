@@ -20,9 +20,22 @@ federation) and renders the slowest stitched requests with their
 per-source (router / replica) span breakdown; ``--chrome`` then
 exports one Perfetto process lane per source.
 
+Filters: ``--last N`` keeps only the newest N events; ``--since TS``
+(epoch seconds, as in the records' ``ts`` field) keeps events at or
+after TS. ``--check`` turns the anomaly digest into a CI gate: exit
+code 2 when any anomalies survive the filters (pair with ``--since``
+to gate on "no anomalies since the last deploy"). Rotated ``.gz``
+segments load transparently.
+
+``--history FILE`` additionally summarizes an exported metric-history
+JSON document (``MetricHistory.export()`` /
+``GET /debug/metrics/history`` — docs/observability.md §History).
+
 Usage:
     python scripts/trace_report.py --events PATH [--top N]
-                                   [--chrome OUT]
+                                   [--last N] [--since TS]
+                                   [--check] [--chrome OUT]
+                                   [--history FILE]
     python scripts/trace_report.py --fleet http://router:8080
                                    [--top N] [--chrome OUT]
 """
@@ -30,6 +43,7 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import gzip
 import json
 import os
 import sys
@@ -43,10 +57,12 @@ from analytics_zoo_tpu.common import tracing  # noqa: E402
 
 
 def load_events(path: str) -> "List[Dict[str, Any]]":
-    """Parse a JSONL event log, skipping malformed lines (a crashed
-    writer may leave a truncated tail)."""
+    """Parse a JSONL event log (gzip-compressed rotated segments
+    too), skipping malformed lines (a crashed writer may leave a
+    truncated tail)."""
     out: "List[Dict[str, Any]]" = []
-    with open(path, "r", encoding="utf-8") as fh:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt", encoding="utf-8") as fh:
         for line in fh:
             line = line.strip()
             if not line:
@@ -104,7 +120,9 @@ def slowest_requests(events, top: int, out=sys.stdout):
                   f" {c.get('event')}{extra}", file=out)
 
 
-def anomaly_digest(events, out=sys.stdout):
+def anomaly_digest(events, out=sys.stdout) -> "Dict[str, int]":
+    """Print the per-kind anomaly counts; returns them so
+    ``--check`` can gate on a non-empty digest."""
     anomalies = [e for e in events
                  if e.get("event") == "diagnostics/anomaly"]
     print(f"\n== anomalies ({len(anomalies)}) ==", file=out)
@@ -113,6 +131,50 @@ def anomaly_digest(events, out=sys.stdout):
         kinds[e.get("kind", "?")] = kinds.get(e.get("kind", "?"), 0) + 1
     for kind, n in sorted(kinds.items()):
         print(f"  {kind}: {n}", file=out)
+    return kinds
+
+
+def filter_events(events, last=None, since=None):
+    """``--last N`` / ``--since TS`` filters: newest-N (by file
+    order — the writer appends chronologically) and/or at-or-after
+    an epoch-seconds timestamp (events without a ``ts`` are kept)."""
+    if since is not None:
+        events = [e for e in events
+                  if e.get("ts") is None
+                  or float(e["ts"]) >= float(since)]
+    if last is not None and last >= 0:
+        events = events[-last:] if last else []
+    return events
+
+
+def history_report(doc, out=sys.stdout):
+    """Summarize an exported metric-history document
+    (``MetricHistory.export()`` shape): store stats plus one line
+    per family — type, series count, point count, last value of the
+    first series."""
+    stats = doc.get("stats") or {}
+    fams = doc.get("families") or {}
+    print(f"\n== metric history ({len(fams)} families, "
+          f"{stats.get('raw_samples', '?')} raw samples, "
+          f"{stats.get('resident_bytes', '?')} resident bytes) ==",
+          file=out)
+    for name in sorted(fams):
+        ser = fams[name] or {}
+        series = ser.get("series") or []
+        n_pts = sum(len(s.get("points") or []) for s in series)
+        last = None
+        for s in series:
+            for p in reversed(s.get("points") or []):
+                for k in ("value", "q99", "count"):
+                    if p.get(k) is not None:
+                        last = f"{k}={p[k]}"
+                        break
+                if last:
+                    break
+            break
+        print(f"  {name} [{ser.get('type', '?')}] "
+              f"{len(series)} series / {n_pts} pts"
+              f"{'  last ' + last if last else ''}", file=out)
 
 
 def export_chrome(events, path: str):
@@ -187,6 +249,17 @@ def main(argv=None) -> int:
     ap.add_argument("--fleet", metavar="URL",
                     help="pull stitched traces from a running fleet "
                          "router instead of reading an event log")
+    ap.add_argument("--last", type=int, metavar="N",
+                    help="only the newest N events")
+    ap.add_argument("--since", type=float, metavar="TS",
+                    help="only events at/after this epoch-seconds "
+                         "timestamp")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 2 when the (filtered) anomaly digest "
+                         "is non-empty — a CI gate")
+    ap.add_argument("--history", metavar="FILE",
+                    help="also summarize an exported metric-history "
+                         "JSON document")
     args = ap.parse_args(argv)
     if args.fleet:
         traces = fetch_fleet_traces(args.fleet,
@@ -202,12 +275,27 @@ def main(argv=None) -> int:
         print(f"no event log at {args.events}", file=sys.stderr)
         return 1
     events = load_events(args.events)
-    print(f"{len(events)} events from {args.events}")
+    n_all = len(events)
+    events = filter_events(events, last=args.last,
+                           since=args.since)
+    suffix = (f" ({n_all} before filters)"
+              if len(events) != n_all else "")
+    print(f"{len(events)} events from {args.events}{suffix}")
     step_timeline(events)
     slowest_requests(events, args.top)
-    anomaly_digest(events)
+    kinds = anomaly_digest(events)
     if args.chrome:
         export_chrome(events, args.chrome)
+    if args.history:
+        with open(args.history, "r", encoding="utf-8") as fh:
+            history_report(json.load(fh))
+    if args.check and kinds:
+        total = sum(kinds.values())
+        print(f"\nCHECK FAILED: {total} anomalies "
+              f"({', '.join(sorted(kinds))})", file=sys.stderr)
+        return 2
+    if args.check:
+        print("\ncheck passed: no anomalies")
     return 0
 
 

@@ -54,11 +54,13 @@ def _fresh_telemetry():
     breach state leaked by one test can never satisfy (or break)
     another's assertions."""
     from analytics_zoo_tpu.common import (
-        faults, observability, slo, tracing)
+        faults, forecast, observability, slo, timeseries, tracing)
     from analytics_zoo_tpu.perf import autotune, goodput
     observability.reset_metrics()
     tracing.reset_tracing()
     slo.reset_slo()
+    timeseries.reset_history()
+    forecast.reset_forecast()
     goodput.reset_goodput()
     faults.reset_faults()
     autotune.reset_cache()
@@ -66,6 +68,8 @@ def _fresh_telemetry():
     observability.reset_metrics()
     tracing.reset_tracing()
     slo.reset_slo()
+    timeseries.reset_history()
+    forecast.reset_forecast()
     goodput.reset_goodput()
     faults.reset_faults()
     autotune.reset_cache()

@@ -431,6 +431,69 @@ def test_collector_keeps_last_snapshot_of_dead_source():
     assert outcomes[("r0", "0")] == 1
 
 
+def test_collector_marks_carried_forward_and_source_age():
+    """Stale-beats-absent must be *visible*: a dead source's rows
+    are flagged carried_forward in status() and its
+    zoo_tpu_fed_source_age_s gauge keeps growing — carried data can
+    no longer masquerade as fresh."""
+    s0, s1 = _StubSource("r0"), _StubSource("r1")
+    try:
+        s0.reg.counter("zoo_tpu_ingest_records_total").inc()
+        s1.reg.counter("zoo_tpu_ingest_records_total").inc()
+        col = fed.TelemetryCollector(
+            _StubRouter([s0, s1]), tick_s=0, clock=lambda: 100.0)
+        col.tick(now=100.0)
+        st = col.status()["sources"]
+        assert st["r0"]["carried_forward"] is False
+        assert st["r1"]["carried_forward"] is False
+
+        def age(replica):
+            fam = obs.snapshot()["zoo_tpu_fed_source_age_s"]
+            return {v["labels"]["replica"]: v["value"]
+                    for v in fam["values"]}[replica]
+
+        assert age("r0") == 0.0
+        s0.stop()  # r0 dies; r1 stays live
+        col.tick(now=130.0)
+        st = col.status()["sources"]
+        assert st["r0"]["carried_forward"] is True
+        assert st["r1"]["carried_forward"] is False
+        assert age("r0") == 30.0  # true staleness, not scrape time
+        assert age("r1") == 0.0
+        col.tick(now=175.0)
+        assert age("r0") == 75.0  # keeps growing while carried
+        # the carried data itself still merges (stale beats absent)
+        merged, _ = col.merged_snapshot()
+        assert _counter_value(
+            merged, "zoo_tpu_ingest_records_total") >= 2
+    finally:
+        s1.stop()
+
+
+def test_collector_fleet_history_timeline():
+    """The collector appends every merged snapshot to its
+    append-only MetricHistory — the fleet-wide timeline behind
+    /debug/metrics/history?fleet=1."""
+    s0 = _StubSource("r0")
+    try:
+        c = s0.reg.counter("zoo_tpu_ingest_records_total")
+        c.inc(5)
+        col = fed.TelemetryCollector(
+            _StubRouter([s0]), tick_s=0, clock=lambda: 100.0)
+        col.tick(now=100.0)
+        c.inc(5)
+        col.tick(now=110.0)
+        assert len(col.history) == 2
+        ser = col.history.series("zoo_tpu_ingest_records_total",
+                                 window_s=60, now=110.0)
+        pts = ser["series"][0]["points"]
+        assert pts[-1]["value"] == 5.0  # fleet-merged delta
+        assert pts[-1]["rate"] == pytest.approx(0.5)
+        assert col.status()["history"]["raw_samples"] == 2
+    finally:
+        s0.stop()
+
+
 # -- process vitals -----------------------------------------------------------
 
 def test_process_vitals_gauges():

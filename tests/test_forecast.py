@@ -1,0 +1,277 @@
+"""Capacity forecasting (common/forecast.py): exact trend math on
+synthetic series, ETA gauges, predictive-anomaly edge/re-arm
+semantics, and the shipped forecast SLO defaults. Injectable clocks
++ manual tick(now=) — no sleeps. Tier-1 fast."""
+
+import pytest
+
+from analytics_zoo_tpu.common import forecast, observability as obs
+from analytics_zoo_tpu.common import slo, timeseries
+from analytics_zoo_tpu.common.forecast import (
+    NO_ETA, Forecaster, eta_to_limit, ewma, linear_slope)
+
+
+# -- pure trend math ---------------------------------------------------------
+
+def test_ewma_identity_and_smoothing():
+    assert ewma([1.0, 2.0, 3.0], 1.0) == [1.0, 2.0, 3.0]
+    out = ewma([0.0, 10.0], 0.5)
+    assert out == [0.0, 5.0]
+    assert ewma([], 0.3) == []
+
+
+def test_linear_slope_exact():
+    assert linear_slope([(0.0, 0.0), (10.0, 20.0)]) == \
+        pytest.approx(2.0)
+    pts = [(float(t), 5.0 - 0.5 * t) for t in range(10)]
+    assert linear_slope(pts) == pytest.approx(-0.5)
+    assert linear_slope([(1.0, 2.0)]) is None
+    assert linear_slope([(1.0, 2.0), (1.0, 3.0)]) is None  # no span
+
+
+def test_eta_exact_on_linear_series_down():
+    # 100 falling 2/s from t=0 -> hits 0 at t=50; at t=20 (value
+    # 60) the remaining ETA is exactly 30 s (alpha=1: no smoothing)
+    pts = [(float(t), 100.0 - 2.0 * t) for t in range(0, 21, 5)]
+    assert eta_to_limit(pts, 0.0, "down", alpha=1.0) == \
+        pytest.approx(30.0)
+
+
+def test_eta_exact_on_linear_series_up():
+    pts = [(float(t), 10.0 + 3.0 * t) for t in range(0, 11, 2)]
+    # value 40 at t=10, limit 100 -> 60/3 = 20 s out
+    assert eta_to_limit(pts, 100.0, "up", alpha=1.0) == \
+        pytest.approx(20.0)
+
+
+def test_eta_zero_when_already_exhausted():
+    assert eta_to_limit([(0.0, 5.0), (1.0, 0.0)], 0.0, "down") \
+        == 0.0
+    assert eta_to_limit([(0.0, 99.0), (1.0, 120.0)], 100.0, "up") \
+        == 0.0
+
+
+def test_eta_none_on_flat_or_away_trend():
+    flat = [(float(t), 50.0) for t in range(5)]
+    assert eta_to_limit(flat, 0.0, "down", alpha=1.0) is None
+    rising = [(float(t), 50.0 + t) for t in range(5)]
+    assert eta_to_limit(rising, 0.0, "down", alpha=1.0) is None
+    falling = [(float(t), 50.0 - t) for t in range(5)]
+    assert eta_to_limit(falling, 100.0, "up", alpha=1.0) is None
+    assert eta_to_limit([], 0.0, "down") is None
+
+
+def test_eta_on_noisy_series_with_ewma():
+    # alternating +/-8 noise on a -1/s trend from 100 (true ~80 s
+    # remaining): smoothing still yields a finite same-magnitude
+    # ETA instead of flapping between spikes — the EWMA lag biases
+    # it upward, never to None/negative
+    pts = [(float(t), 100.0 - t + (8.0 if t % 2 else -8.0))
+           for t in range(0, 21)]
+    eta = eta_to_limit(pts, 0.0, "down", alpha=0.3)
+    assert eta is not None
+    assert 40.0 < eta < 200.0
+
+
+# -- Forecaster over a history ----------------------------------------------
+
+def _rig(**kw):
+    clock = [0.0]
+    reg = obs.MetricsRegistry()
+    hist = timeseries.MetricHistory(
+        registry=reg, clock=lambda: clock[0], tiers=[])
+    kw.setdefault("window_s", 120.0)
+    kw.setdefault("horizon_s", 600.0)
+    kw.setdefault("min_points", 5)
+    kw.setdefault("min_span_s", 10.0)
+    kw.setdefault("alpha", 1.0)
+    f = Forecaster(hist, registry=reg, clock=lambda: clock[0], **kw)
+    return clock, reg, hist, f
+
+
+def _eta_gauge(reg, resource):
+    fam = reg.snapshot().get("zoo_tpu_forecast_eta_s") or {}
+    for rec in fam.get("values", ()):
+        if rec["labels"].get("resource") == resource:
+            return rec["value"]
+    return None
+
+
+def _anomaly_count(resource="kv_pages"):
+    fam = obs.snapshot().get("zoo_tpu_anomalies_total") or {}
+    return sum(v["value"] for v in fam.get("values", ())
+               if v["labels"].get("kind") == "capacity_forecast")
+
+
+def test_forecaster_exact_kv_eta_and_anomaly_once():
+    """Linear page drain -> exact ETA gauge; the predictive anomaly
+    fires exactly once on the False->True edge, while pages are
+    still free (before saturation)."""
+    clock, reg, hist, f = _rig()
+    g = reg.gauge("zoo_tpu_serving_gen_free_pages")
+    for i in range(7):  # 1000 pages draining 10/s, 5 s cadence
+        clock[0] = i * 5.0
+        g.set(1000.0 - 50.0 * i)
+        hist.tick(now=clock[0])
+        f.tick(now=clock[0])
+    st = f.status()["resources"]["kv_pages"]
+    # at t=30 value=700, slope -10/s -> 70 s to exhaustion
+    assert st["eta_s"] == pytest.approx(70.0, abs=0.01)
+    assert st["pending"] is True
+    assert st["value"] == 700.0  # fired while pages remain free
+    assert _eta_gauge(reg, "kv_pages") == pytest.approx(70.0,
+                                                       abs=0.01)
+    assert _anomaly_count() == 1
+    # further pending ticks do NOT re-fire
+    clock[0] = 35.0
+    g.set(650.0)
+    hist.tick(now=clock[0])
+    f.tick(now=clock[0])
+    assert _anomaly_count() == 1
+
+
+def test_forecaster_rearms_after_recovery():
+    clock, reg, hist, f = _rig()
+    g = reg.gauge("zoo_tpu_serving_gen_free_pages")
+    t = [0.0]
+
+    def run(values, step=5.0):
+        for v in values:
+            clock[0] = t[0]
+            g.set(v)
+            hist.tick(now=t[0])
+            f.tick(now=t[0])
+            t[0] += step
+
+    run([1000.0 - 50.0 * i for i in range(7)])  # drain -> fires
+    assert _anomaly_count() == 1
+    run([700.0 + 50.0 * i for i in range(30)])  # recovery
+    assert f.status()["resources"]["kv_pages"]["pending"] is False
+    assert _eta_gauge(reg, "kv_pages") == NO_ETA
+    run([2000.0 - 50.0 * i for i in range(30)])  # drains again
+    assert _anomaly_count() == 2  # re-armed edge fired once more
+
+
+def test_forecaster_no_data_and_too_few_points_never_fire():
+    clock, reg, hist, f = _rig()
+    f.tick(now=0.0)  # empty history
+    st = f.status()["resources"]["kv_pages"]
+    assert st["eta_s"] is None and st["pending"] is False
+    assert _eta_gauge(reg, "kv_pages") == NO_ETA
+    # 3 points < min_points=5: still no forecast
+    g = reg.gauge("zoo_tpu_serving_gen_free_pages")
+    for i in range(3):
+        clock[0] = i * 10.0
+        g.set(100.0 - 40.0 * i)
+        hist.tick(now=clock[0])
+        f.tick(now=clock[0])
+    assert f.status()["resources"]["kv_pages"]["eta_s"] is None
+    assert _anomaly_count() == 0
+
+
+def test_forecaster_min_span_gate():
+    clock, reg, hist, f = _rig(min_span_s=60.0)
+    g = reg.gauge("zoo_tpu_serving_gen_free_pages")
+    for i in range(8):  # 35 s span < 60 s gate
+        clock[0] = i * 5.0
+        g.set(1000.0 - 50.0 * i)
+        hist.tick(now=clock[0])
+        f.tick(now=clock[0])
+    assert f.status()["resources"]["kv_pages"]["eta_s"] is None
+    assert _anomaly_count() == 0
+
+
+def test_forecaster_flat_trend_publishes_no_eta_sentinel():
+    clock, reg, hist, f = _rig()
+    g = reg.gauge("zoo_tpu_serving_gen_free_pages")
+    for i in range(8):
+        clock[0] = i * 5.0
+        g.set(500.0)  # flat: exhaustion never comes
+        hist.tick(now=clock[0])
+        f.tick(now=clock[0])
+    st = f.status()["resources"]["kv_pages"]
+    assert st["eta_s"] is None and st["pending"] is False
+    assert _eta_gauge(reg, "kv_pages") == NO_ETA  # finite sentinel
+
+
+def test_forecaster_sums_multi_labelset_series():
+    """Queue depth split across batchers: capacity trend is the
+    SUM, not any single labelset."""
+    clock, reg, hist, f = _rig()
+    a = reg.gauge("zoo_tpu_serving_queue_depth", labels={"b": "0"})
+    b = reg.gauge("zoo_tpu_serving_queue_depth", labels={"b": "1"})
+    for i in range(7):  # sum climbs 20/tick = 4/s toward 256
+        clock[0] = i * 5.0
+        a.set(10.0 * i)
+        b.set(10.0 * i)
+        hist.tick(now=clock[0])
+        f.tick(now=clock[0])
+    st = f.status()["resources"]["queue"]
+    # sum=120 at t=30, slope 4/s -> (256-120)/4 = 34 s
+    assert st["value"] == 120.0
+    assert st["eta_s"] == pytest.approx(34.0, abs=0.01)
+
+
+def test_event_log_limit_from_rotation_budget(monkeypatch):
+    monkeypatch.setenv("ZOO_TPU_EVENT_LOG_MAX_MB", "1.0")
+    monkeypatch.setenv("ZOO_TPU_EVENT_LOG_KEEP", "3")
+    monkeypatch.delenv("ZOO_TPU_FORECAST_EVENT_LOG_LIMIT_MB",
+                       raising=False)
+    clock, reg, hist, f = _rig()
+    spec = [s for s in f._resources
+            if s["resource"] == "event_log"][0]
+    assert f._limit(spec) == 4.0 * 1048576.0  # keep+1 segments
+    monkeypatch.setenv("ZOO_TPU_FORECAST_EVENT_LOG_LIMIT_MB", "10")
+    assert f._limit(spec) == 10.0 * 1048576.0
+    monkeypatch.delenv("ZOO_TPU_FORECAST_EVENT_LOG_LIMIT_MB",
+                       raising=False)
+    monkeypatch.delenv("ZOO_TPU_EVENT_LOG_MAX_MB", raising=False)
+    assert f._limit(spec) is None  # unrotated log: skipped
+    f.tick(now=0.0)
+    assert f.status()["resources"]["event_log"]["skipped"]
+
+
+# -- global wiring -----------------------------------------------------------
+
+def test_ensure_forecaster_rides_history_listener(monkeypatch):
+    monkeypatch.delenv("ZOO_TPU_FORECAST", raising=False)
+    hist = timeseries.get_history()
+    f = forecast.ensure_forecaster()
+    assert f is not None
+    assert forecast.ensure_forecaster() is f  # idempotent
+    g = obs.gauge("zoo_tpu_serving_gen_free_pages")
+    for i in range(7):
+        g.set(1000.0 - 50.0 * i)
+        hist.tick(now=1000.0 + i * 5.0)  # listener ticks forecast
+    assert f.status()["ticks"] >= 7
+    assert f.status()["resources"]["kv_pages"]["pending"] is True
+    assert _anomaly_count() == 1
+
+
+def test_forecast_disabled_by_env(monkeypatch):
+    monkeypatch.setenv("ZOO_TPU_FORECAST", "0")
+    assert forecast.enabled() is False
+    assert forecast.ensure_forecaster() is None
+
+
+# -- shipped SLO defaults ----------------------------------------------------
+
+def test_forecast_slo_defaults_install_and_page():
+    clock = [0.0]
+    reg = obs.MetricsRegistry()
+    eng = slo.SLOEngine(registry=reg, clock=lambda: clock[0])
+    assert slo.install_defaults(eng, "forecast") == 2
+    assert slo.install_defaults(eng, "forecast") == 0  # idempotent
+    eta = reg.gauge("zoo_tpu_forecast_eta_s",
+                    labels={"resource": "kv_pages"})
+    eta.set(NO_ETA)
+    for i in range(1, 4):
+        clock[0] = i * 10.0
+        eng.tick()
+    st = {o["id"]: o for o in eng.status()["objectives"]}
+    assert st["forecast_kv_pages_eta"]["state"] == "ok"
+    eta.set(45.0)  # exhaustion 45 s out: < 120 s threshold
+    clock[0] += 10.0
+    eng.tick()
+    st = {o["id"]: o for o in eng.status()["objectives"]}
+    assert st["forecast_kv_pages_eta"]["state"] == "breach"

@@ -2,6 +2,7 @@
 thread-safety, Prometheus golden output, JSONL event log, span API,
 and the training / serving / ingest integrations. Tier-1 fast."""
 
+import gzip
 import json
 import threading
 import urllib.error
@@ -194,7 +195,8 @@ def test_event_log_jsonl_roundtrip(tmp_path, monkeypatch):
 
 
 def test_event_log_size_rotation(tmp_path, monkeypatch):
-    """ZOO_TPU_EVENT_LOG_MAX_MB rotates path -> path.1 -> path.2,
+    """ZOO_TPU_EVENT_LOG_MAX_MB rotates path -> path.1.gz ->
+    path.2.gz (rotated segments gzip-compressed by default),
     keeping ZOO_TPU_EVENT_LOG_KEEP rotated files."""
     path = tmp_path / "events.jsonl"
     monkeypatch.setenv("ZOO_TPU_EVENT_LOG", str(path))
@@ -204,12 +206,48 @@ def test_event_log_size_rotation(tmp_path, monkeypatch):
     from analytics_zoo_tpu.common.observability import event
     for i in range(60):
         event("rotate/test", i=i, pad="x" * 40)
+    snap = snapshot()
+    rot = snap["zoo_tpu_event_log_rotations_total"]["values"][0]
+    assert rot["value"] >= 2  # at least two generations turned over
+    # bytes gauge covers live segment + rotated generations
+    total = (path.stat().st_size
+             + (tmp_path / "events.jsonl.1.gz").stat().st_size
+             + (tmp_path / "events.jsonl.2.gz").stat().st_size)
+    assert snap["zoo_tpu_event_log_bytes"]["values"][0]["value"] == \
+        pytest.approx(total, abs=200)
     reset_metrics()
     assert path.exists()
+    assert (tmp_path / "events.jsonl.1.gz").exists()
+    assert (tmp_path / "events.jsonl.2.gz").exists()
+    assert not (tmp_path / "events.jsonl.3.gz").exists()  # keep=2
+    assert not (tmp_path / "events.jsonl.1").exists()  # compressed
+    # every surviving segment holds whole, parseable JSONL lines
+    for ln in path.read_text().strip().splitlines():
+        assert json.loads(ln)["event"] == "rotate/test"
+    for p in (tmp_path / "events.jsonl.1.gz",
+              tmp_path / "events.jsonl.2.gz"):
+        with gzip.open(p, "rt", encoding="utf-8") as fh:
+            lines = fh.read().strip().splitlines()
+        assert lines  # non-empty after decompression
+        for ln in lines:
+            assert json.loads(ln)["event"] == "rotate/test"
+
+
+def test_event_log_rotation_gzip_disabled(tmp_path, monkeypatch):
+    """ZOO_TPU_EVENT_LOG_GZIP=0 keeps the legacy bare .1/.2
+    rotated-segment naming (no compression)."""
+    path = tmp_path / "events.jsonl"
+    monkeypatch.setenv("ZOO_TPU_EVENT_LOG", str(path))
+    monkeypatch.setenv("ZOO_TPU_EVENT_LOG_MAX_MB", "0.0002")
+    monkeypatch.setenv("ZOO_TPU_EVENT_LOG_KEEP", "2")
+    monkeypatch.setenv("ZOO_TPU_EVENT_LOG_GZIP", "0")
+    from analytics_zoo_tpu.common.observability import event
+    for i in range(60):
+        event("rotate/test", i=i, pad="x" * 40)
+    reset_metrics()
     assert (tmp_path / "events.jsonl.1").exists()
     assert (tmp_path / "events.jsonl.2").exists()
-    assert not (tmp_path / "events.jsonl.3").exists()  # keep=2
-    # every surviving file holds whole, parseable JSONL lines
+    assert not (tmp_path / "events.jsonl.1.gz").exists()
     for p in (path, tmp_path / "events.jsonl.1",
               tmp_path / "events.jsonl.2"):
         for ln in p.read_text().strip().splitlines():
